@@ -1,0 +1,76 @@
+"""Benchmark: quorum-rounds/sec/chip on the flagship fuzzing config.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric definition (BASELINE.md): quorum-rounds/sec/chip — each scheduler
+tick advances every instance's consensus state machine by one protocol
+round (deliver -> vote -> quorum-check), so throughput = instances x ticks
+/ wall-clock.  North star: >= 10M at 1M concurrent instances on a v5e-1.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    # rbg is markedly faster than threefry on TPU for the per-tick mask
+    # sampling; streams stay deterministic per (seed, tick) within the impl.
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+    from paxos_tpu.harness.config import config2_dueling_drop
+    from paxos_tpu.harness.run import (
+        base_key,
+        get_step_fn,
+        init_plan,
+        init_state,
+        run_chunk,
+    )
+
+    platform = jax.devices()[0].platform
+    n_inst = 1 << 20 if platform != "cpu" else 1 << 14  # 1,048,576 on TPU
+    cfg = config2_dueling_drop(n_inst=n_inst, seed=0)
+    step = get_step_fn(cfg.protocol)
+
+    state = init_state(cfg)
+    plan = init_plan(cfg)
+    key = base_key(cfg)
+
+    chunk = 64
+    # Warmup: compile + one chunk.  NOTE: timing must end with a device->host
+    # readback, not block_until_ready — on the axon tunnel backend
+    # block_until_ready can return before execution finishes.
+    state = run_chunk(state, key, plan, cfg.fault, chunk, step)
+    int(state.tick)
+
+    timed_chunks = 4
+    t0 = time.perf_counter()
+    for _ in range(timed_chunks):
+        state = run_chunk(state, key, plan, cfg.fault, chunk, step)
+    violations = int(state.learner.violations.sum())  # forces completion
+    dt = time.perf_counter() - t0
+
+    ticks = timed_chunks * chunk
+    value = n_inst * ticks / dt
+    baseline = 10_000_000.0  # BASELINE.md north-star target
+    out = {
+        "metric": "quorum-rounds/sec/chip",
+        "value": round(value, 1),
+        "unit": "instance-rounds/sec",
+        "vs_baseline": round(value / baseline, 3),
+        "n_instances": n_inst,
+        "ticks": ticks,
+        "seconds": round(dt, 4),
+        "platform": platform,
+        "violations": violations,
+        "config_fingerprint": cfg.fingerprint(),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

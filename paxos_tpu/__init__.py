@@ -1,0 +1,29 @@
+"""paxos_tpu — a TPU-native batched-consensus fuzzing framework.
+
+A brand-new framework with the capabilities of ``rgrover/cloud-haskell-paxos``
+(see SURVEY.md): the reference's Proposer/Acceptor/Learner Cloud Haskell
+processes and their send/expect message loop get a vectorized twin in which
+thousands-to-millions of independent consensus instances advance in lockstep
+as one fused JAX array program — ``vmap`` semantics over an ``instances``
+axis, ``lax.scan`` over scheduler ticks, ``pjit`` sharding over a device
+mesh — while message drop/reorder/duplication, acceptor crashes, and
+Byzantine equivocation are injected as PRNG masks and safety/liveness
+invariants are checked on-device.
+
+Reference parity map (SURVEY.md §2: no file:line citations are possible —
+the reference mount was empty at survey time; provenance labels per §0):
+
+- ``Network.Transport`` seam [B]        -> :mod:`paxos_tpu.transport`
+- ``distributed-process`` actor runtime  -> :mod:`paxos_tpu.core` (state
+  arrays) + :mod:`paxos_tpu.protocols` (role transition functions)
+- SimpleLocalnet deployment backend     -> :mod:`paxos_tpu.harness`
+- Paxos roles / ``PaxosMessage`` [B]    -> :mod:`paxos_tpu.core.messages`,
+  :mod:`paxos_tpu.protocols.paxos`
+- monitors / failure notification       -> :mod:`paxos_tpu.faults`
+- (new) on-device invariant checking    -> :mod:`paxos_tpu.check`
+- (new) mesh sharding                   -> :mod:`paxos_tpu.parallel`
+"""
+
+__version__ = "0.1.0"
+
+from paxos_tpu.core import ballot  # noqa: F401

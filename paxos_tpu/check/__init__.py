@@ -1,0 +1,1 @@
+"""On-device safety and liveness checking."""

@@ -1,0 +1,118 @@
+"""Vectorized safety checking — the learner as invariant oracle.
+
+Reference parity (SURVEY.md §3.1 "Learner process" [B][P] and §5.2): the
+reference learner counts Accepted(b, v) per ballot and declares the value
+chosen on a majority.  Here the learner is *omniscient* (it observes every
+accept event on-device, un-droppable — the checker should not miss
+violations because the network was lossy) and doubles as the safety oracle:
+
+- **Agreement**: at most one value is ever chosen per instance.  Tracked by
+  the bounded (ballot, value) -> voter-bitmask table in
+  :class:`~paxos_tpu.core.state.LearnerState`; a second distinct chosen value
+  increments ``violations``.  Keying the table by the *(b, v) pair* (not just
+  b) means Byzantine equivocation — the same ballot accepted with two values
+  (config 4) — shows up as two competing table rows and is caught by the same
+  majority test, with no special case.
+- **Acceptor-local invariants** (:func:`acceptor_invariants`): promises are
+  monotone and accepted ballots never exceed the promise — checked per tick
+  against the pre-tick state, honest acceptors only (equivocators violate by
+  design).
+
+Completeness bound: the table holds K pairs, evicting the lowest ballot;
+``evictions`` counts both evictions and rejected inserts.  A run with
+``evictions == 0`` (all tests and all BASELINE configs) has a *complete*
+checker: no accept event escaped quorum accounting.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.core.state import AcceptorState, LearnerState
+from paxos_tpu.utils.bitops import popcount
+
+
+def learner_observe(
+    learner: LearnerState,
+    ev_flag: jnp.ndarray,  # (I, A) bool: acceptor a accepted something this tick
+    ev_bal: jnp.ndarray,  # (I, A) int32
+    ev_val: jnp.ndarray,  # (I, A) int32
+    tick: jnp.ndarray,  # () int32
+    quorum: int,
+) -> LearnerState:
+    """Fold this tick's accept events into the learner table; update chosen/violations."""
+    n_acc = ev_flag.shape[1]
+    lt_bal, lt_val, lt_mask = learner.lt_bal, learner.lt_val, learner.lt_mask
+    evictions = learner.evictions
+
+    pre_chosen_slots = popcount(lt_mask) >= quorum  # (I, K)
+
+    # At most one accept event per acceptor per tick (one-message-per-actor
+    # scheduling), so an unrolled sequential fold over the small acceptors
+    # axis is exact: a second acceptor hitting a just-inserted pair matches it.
+    for a in range(n_acc):
+        b, v, f = ev_bal[:, a], ev_val[:, a], ev_flag[:, a]
+        f = f & (b > 0)
+        match = (lt_bal == b[:, None]) & (lt_val == v[:, None]) & (b[:, None] > 0)
+        any_match = match.any(axis=-1)
+        min_slot = jnp.argmin(lt_bal, axis=-1)  # empty slots (bal 0) win first
+        min_bal = jnp.take_along_axis(lt_bal, min_slot[:, None], axis=-1)[:, 0]
+        can_insert = (min_bal == 0) | (b > min_bal)
+        do_insert = f & ~any_match & can_insert
+        missed = f & ~any_match & ~can_insert
+        bit = jnp.asarray(1 << a, jnp.int32)
+
+        lt_mask = jnp.where(match & f[:, None], lt_mask | bit, lt_mask)
+        ins = jax.nn.one_hot(min_slot, lt_bal.shape[1], dtype=jnp.bool_)
+        ins = ins & do_insert[:, None]
+        lt_bal = jnp.where(ins, b[:, None], lt_bal)
+        lt_val = jnp.where(ins, v[:, None], lt_val)
+        lt_mask = jnp.where(ins, bit, lt_mask)
+        evictions = evictions + missed.astype(jnp.int32) + (do_insert & (min_bal != 0)).astype(jnp.int32)
+
+    chosen_slots = popcount(lt_mask) >= quorum  # (I, K)
+    newly_chosen = chosen_slots & ~pre_chosen_slots
+    any_new = newly_chosen.any(axis=-1)
+
+    # First newly chosen value (slot order is arbitrary but deterministic).
+    first_idx = jnp.argmax(newly_chosen, axis=-1)
+    first_val = jnp.take_along_axis(lt_val, first_idx[:, None], axis=-1)[:, 0]
+
+    chosen_val = jnp.where(learner.chosen, learner.chosen_val, jnp.where(any_new, first_val, 0))
+    chosen = learner.chosen | any_new
+    chosen_tick = jnp.where(
+        learner.chosen, learner.chosen_tick, jnp.where(any_new, tick, -1)
+    )
+
+    # Agreement: every newly chosen slot must carry THE chosen value.
+    viol = (newly_chosen & (lt_val != chosen_val[:, None]) & chosen[:, None]).sum(
+        axis=-1, dtype=jnp.int32
+    )
+
+    return learner.replace(
+        lt_bal=lt_bal,
+        lt_val=lt_val,
+        lt_mask=lt_mask,
+        chosen=chosen,
+        chosen_val=chosen_val,
+        chosen_tick=chosen_tick,
+        violations=learner.violations + viol,
+        evictions=evictions,
+    )
+
+
+def acceptor_invariants(
+    old: AcceptorState, new: AcceptorState, honest: jnp.ndarray
+) -> jnp.ndarray:
+    """(I,) int32 count of per-tick acceptor-local invariant breaks (honest lanes).
+
+    - promise monotonicity: ``promised`` never decreases;
+    - acceptance bound: ``acc_bal <= promised`` after every transition;
+    - accepted pair consistency: a nil ballot never carries a value.
+    """
+    mono = new.promised < old.promised
+    bound = new.acc_bal > new.promised
+    nilpair = (new.acc_bal == 0) & (new.acc_val != 0)
+    bad = (mono | bound | nilpair) & honest
+    return bad.sum(axis=-1, dtype=jnp.int32)

@@ -1,0 +1,1 @@
+"""Core array encodings: ballots, role state, message buffers."""

@@ -1,0 +1,48 @@
+"""Packed ballot numbers.
+
+Reference parity (SURVEY.md §3.1 "Ballot numbers" [P]): the reference's
+proposer-unique, totally ordered ballots — classically ``(round, proposerId)``
+with lexicographic order — become a single int32 so that ballot comparison is
+integer comparison, the form the TPU's vector units and the quorum kernel
+want.  Encoding::
+
+    ballot = round * MAX_PROPOSERS + proposer_id + 1      (NIL = 0)
+
+``MAX_PROPOSERS`` is a power of two so pack/unpack are shifts.  With int32
+this supports rounds up to 2**27 — far beyond any fuzzing schedule (ticks per
+run are bounded by the scan length).
+
+All functions are shape-polymorphic and jit-safe: they operate elementwise on
+arrays of any shape.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Power of two so round/owner unpack compiles to shifts/ands.
+MAX_PROPOSERS = 8
+NIL = 0  # "no ballot" — smaller than every real ballot.
+
+
+def make_ballot(rnd, proposer_id):
+    """Pack (round, proposer_id) into an ordered int32 ballot.
+
+    Lexicographic (round, proposer_id) order is preserved; every real ballot
+    compares greater than NIL.
+    """
+    rnd = jnp.asarray(rnd, jnp.int32)
+    proposer_id = jnp.asarray(proposer_id, jnp.int32)
+    return rnd * MAX_PROPOSERS + proposer_id + 1
+
+
+def ballot_round(bal):
+    """Round component of a packed ballot (NIL maps to round -1... safe)."""
+    bal = jnp.asarray(bal, jnp.int32)
+    return (bal - 1) // MAX_PROPOSERS
+
+
+def ballot_owner(bal):
+    """Proposer id that owns this ballot. Only meaningful for bal != NIL."""
+    bal = jnp.asarray(bal, jnp.int32)
+    return (bal - 1) % MAX_PROPOSERS

@@ -1,0 +1,59 @@
+"""Fixed-slot message buffers — the vectorized ``PaxosMessage`` wire format.
+
+Reference parity (SURVEY.md §3.1 "PaxosMessage ADT" [B]): the reference's
+ADT — Prepare(ballot) / Promise(ballot, maybe (ballot,value)) /
+Accept(ballot, value) / Accepted(ballot, value) — becomes struct-of-arrays
+device buffers with one slot per directed (proposer, acceptor) edge and
+message kind.  A slot is a bounded, overwriting channel: sending while an
+older message of the same kind is still in flight overwrites it (the network
+is allowed to drop, so this loses no adversarial power — SURVEY.md §8.4.2's
+"fixed-shape message plumbing" requirement).
+
+Two buffer families, each with a ``kind`` axis of size 2:
+
+- requests, proposer→acceptor:  kind 0 = PREPARE(bal), kind 1 = ACCEPT(bal,val)
+- replies,  acceptor→proposer:  kind 0 = PROMISE(bal, prev_bal, prev_val),
+                                kind 1 = ACCEPTED(bal, val)
+
+Array shape is ``(instances, 2, n_prop, n_acc)`` throughout; int32 payloads,
+bool presence.  Asynchrony (delay, reordering, duplication, loss) is realized
+by the transport's per-tick masks over these slots, not by queues — see
+``paxos_tpu.transport.inmemory_tpu``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+# Request kinds (proposer -> acceptor)
+PREPARE = 0
+ACCEPT = 1
+# Reply kinds (acceptor -> proposer)
+PROMISE = 0
+ACCEPTED = 1
+
+
+@struct.dataclass
+class MsgBuf:
+    """In-flight messages for one direction, all instances at once.
+
+    ``bal``/``v1``/``v2`` are int32 payload lanes whose meaning depends on
+    the kind (see module docstring); ``present`` marks occupied slots.
+    """
+
+    bal: jnp.ndarray  # (I, 2, P, A) int32
+    v1: jnp.ndarray  # (I, 2, P, A) int32
+    v2: jnp.ndarray  # (I, 2, P, A) int32
+    present: jnp.ndarray  # (I, 2, P, A) bool
+
+    @classmethod
+    def empty(cls, n_inst: int, n_prop: int, n_acc: int) -> "MsgBuf":
+        shape = (n_inst, 2, n_prop, n_acc)
+        # Fresh buffer per field: aliased leaves break buffer donation.
+        return cls(
+            bal=jnp.zeros(shape, jnp.int32),
+            v1=jnp.zeros(shape, jnp.int32),
+            v2=jnp.zeros(shape, jnp.int32),
+            present=jnp.zeros(shape, jnp.bool_),
+        )

@@ -1,0 +1,175 @@
+"""Struct-of-arrays role state — the vectorized actor heap.
+
+Reference parity (SURVEY.md §3.1 [B][P]): each Cloud Haskell role process's
+loop-carried state becomes a field of a batched dataclass over the
+``instances`` axis:
+
+- Acceptor process state (``promisedBallot``, ``acceptedBallot``,
+  ``acceptedValue``) -> :class:`AcceptorState`, shape ``(I, A)``.
+- Proposer process state (current ballot, phase, collected promises, the
+  value to propose, retry timer) -> :class:`ProposerState`, shape ``(I, P)``.
+- Learner process state (per-ballot Accepted counts) -> :class:`LearnerState`,
+  a bounded top-K table of (ballot, value) -> acceptor-bitmask, shape
+  ``(I, K)`` — the on-device twin of the learner's quorum counting, and the
+  substrate of the safety checker (``paxos_tpu.check.safety``).
+
+Everything is int32/bool; NIL ballots/values are 0.  All dataclasses are
+immutable flax pytrees, so the whole simulator state is one pytree that
+``lax.scan`` carries and ``pjit`` shards on its leading axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from paxos_tpu.core.ballot import make_ballot
+from paxos_tpu.core.messages import MsgBuf
+
+# Proposer phases
+P1 = 0  # prepare sent, collecting promises
+P2 = 1  # accept sent, collecting accepted
+DONE = 2  # proposer observed a quorum of Accepted for its ballot
+
+
+@struct.dataclass
+class AcceptorState:
+    promised: jnp.ndarray  # (I, A) int32 ballot; highest ballot promised
+    acc_bal: jnp.ndarray  # (I, A) int32 ballot of last accepted proposal
+    acc_val: jnp.ndarray  # (I, A) int32 value of last accepted proposal
+
+    @classmethod
+    def init(cls, n_inst: int, n_acc: int) -> "AcceptorState":
+        # Fresh buffer per field: aliased leaves break buffer donation.
+        def z():
+            return jnp.zeros((n_inst, n_acc), jnp.int32)
+
+        return cls(promised=z(), acc_bal=z(), acc_val=z())
+
+
+@struct.dataclass
+class ProposerState:
+    bal: jnp.ndarray  # (I, P) int32 current ballot
+    phase: jnp.ndarray  # (I, P) int32 in {P1, P2, DONE}
+    own_val: jnp.ndarray  # (I, P) int32 value this proposer wants
+    prop_val: jnp.ndarray  # (I, P) int32 value sent in phase 2 (else NIL)
+    heard: jnp.ndarray  # (I, P) int32 acceptor bitmask for current phase
+    best_bal: jnp.ndarray  # (I, P) int32 highest prev-accepted ballot seen
+    best_val: jnp.ndarray  # (I, P) int32 its value
+    timer: jnp.ndarray  # (I, P) int32 ticks since phase start (can be <0: backoff)
+    decided_val: jnp.ndarray  # (I, P) int32 value this proposer saw decided
+
+    @classmethod
+    def init(cls, n_inst: int, n_prop: int) -> "ProposerState":
+        def z():
+            return jnp.zeros((n_inst, n_prop), jnp.int32)
+
+        pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), (n_inst, n_prop))
+        return cls(
+            bal=make_ballot(jnp.zeros_like(pid), pid),  # all start at round 0
+            phase=z(),  # P1
+            own_val=pid + 100,  # distinct per proposer so duels are observable
+            prop_val=z(),
+            heard=z(),
+            best_bal=z(),
+            best_val=z(),
+            timer=z(),
+            decided_val=z(),
+        )
+
+
+@struct.dataclass
+class LearnerState:
+    """Bounded per-instance table of (ballot, value) -> acceptor bitmask.
+
+    The learner counts Accepted(b, v) events per distinct (b, v) pair; a pair
+    whose bitmask reaches a majority is *chosen*.  K slots, evicting the
+    smallest ballot when full (evictions counted — a nonzero count means the
+    checker's completeness bound was hit, which adversarial configs keep at 0).
+    """
+
+    lt_bal: jnp.ndarray  # (I, K) int32
+    lt_val: jnp.ndarray  # (I, K) int32
+    lt_mask: jnp.ndarray  # (I, K) int32 acceptor bitmask
+    chosen: jnp.ndarray  # (I,) bool: some value has been chosen
+    chosen_val: jnp.ndarray  # (I,) int32: the first chosen value
+    chosen_tick: jnp.ndarray  # (I,) int32: tick of first choice (-1 if none)
+    violations: jnp.ndarray  # (I,) int32: safety violations observed
+    evictions: jnp.ndarray  # (I,) int32: table evictions (completeness bound)
+
+    @classmethod
+    def init(cls, n_inst: int, k: int = 8) -> "LearnerState":
+        def zk():
+            return jnp.zeros((n_inst, k), jnp.int32)
+
+        def zi():
+            return jnp.zeros((n_inst,), jnp.int32)
+
+        return cls(
+            lt_bal=zk(),
+            lt_val=zk(),
+            lt_mask=zk(),
+            chosen=jnp.zeros((n_inst,), jnp.bool_),
+            chosen_val=zi(),
+            chosen_tick=jnp.full((n_inst,), -1, jnp.int32),
+            violations=zi(),
+            evictions=zi(),
+        )
+
+
+@struct.dataclass
+class PaxosState:
+    """Full simulator state for single-decree Paxos: one pytree, scanned and sharded."""
+
+    acceptor: AcceptorState
+    proposer: ProposerState
+    learner: LearnerState
+    requests: MsgBuf  # proposer -> acceptor (PREPARE / ACCEPT)
+    replies: MsgBuf  # acceptor -> proposer (PROMISE / ACCEPTED)
+    tick: jnp.ndarray  # () int32 global tick counter
+
+    @classmethod
+    def init(cls, n_inst: int, n_prop: int, n_acc: int, k: int = 8) -> "PaxosState":
+        from paxos_tpu.core.ballot import MAX_PROPOSERS
+        from paxos_tpu.utils.bitops import MAX_ACCEPTORS
+
+        if not 1 <= n_prop <= MAX_PROPOSERS:
+            raise ValueError(
+                f"n_prop={n_prop} exceeds ballot packing capacity {MAX_PROPOSERS}"
+            )
+        if not 1 <= n_acc <= MAX_ACCEPTORS:
+            raise ValueError(
+                f"n_acc={n_acc} exceeds voter bitmask capacity {MAX_ACCEPTORS}"
+            )
+        proposer = ProposerState.init(n_inst, n_prop)
+        # Every proposer opens with a phase-1 broadcast: PREPARE(bal) to all
+        # acceptors is in flight at tick 0 (the reference's `forM_ pids $
+        # send (Prepare b)` before the first `receiveWait` — SURVEY.md §4.2).
+        requests = MsgBuf.empty(n_inst, n_prop, n_acc)
+        prep_bal = jnp.broadcast_to(
+            proposer.bal[:, :, None], (n_inst, n_prop, n_acc)
+        )
+        requests = requests.replace(
+            bal=requests.bal.at[:, 0].set(prep_bal),  # kind 0 == PREPARE
+            present=requests.present.at[:, 0].set(True),
+        )
+        return cls(
+            acceptor=AcceptorState.init(n_inst, n_acc),
+            proposer=proposer,
+            learner=LearnerState.init(n_inst, k),
+            requests=requests,
+            replies=MsgBuf.empty(n_inst, n_prop, n_acc),
+            tick=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def n_inst(self) -> int:
+        return self.acceptor.promised.shape[0]
+
+    @property
+    def n_acc(self) -> int:
+        return self.acceptor.promised.shape[1]
+
+    @property
+    def n_prop(self) -> int:
+        return self.proposer.bal.shape[1]

@@ -1,0 +1,1 @@
+"""CPU golden models for differential testing."""

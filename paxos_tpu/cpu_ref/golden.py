@@ -1,0 +1,200 @@
+"""Golden model: event-driven single-instance Paxos in pure Python.
+
+Reference parity (SURVEY.md §5.2.1): an independently written, readable
+implementation of the same protocol the batched kernels implement — the
+Proposer/Acceptor/Learner roles as objects, the network as an explicit
+multiset of in-flight messages, and the asynchronous scheduler as a seeded
+random choice of which enabled event fires next (deliver some message, or
+fire a proposer timeout).  This mirrors the reference's actor semantics
+(unordered selective receive from mailboxes [CH]) without any array tricks,
+so the batched simulator's behavior can be checked against it property-wise:
+both must satisfy agreement + validity on every seed, and both must decide
+under fair scheduling.
+
+The safety oracle here recomputes *chosen* from the full accept-event
+history (no bounded table) — strictly more complete than the device checker,
+which the tests exploit to validate the device checker's bounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from collections import defaultdict
+from typing import Optional
+
+# Message kinds
+PREPARE, PROMISE, ACCEPT, ACCEPTED = "prepare", "promise", "accept", "accepted"
+
+
+def make_ballot(rnd: int, pid: int, max_props: int = 8) -> int:
+    return rnd * max_props + pid + 1
+
+
+@dataclasses.dataclass
+class Msg:
+    kind: str
+    src: int  # proposer id for requests, acceptor id for replies
+    dst: int
+    bal: int
+    val: int = 0
+    prev_bal: int = 0
+    prev_val: int = 0
+
+
+class Acceptor:
+    def __init__(self) -> None:
+        self.promised = 0
+        self.acc_bal = 0
+        self.acc_val = 0
+
+    def on_prepare(self, m: Msg) -> Optional[Msg]:
+        if m.bal > self.promised:
+            self.promised = m.bal
+            return Msg(PROMISE, m.dst, m.src, m.bal,
+                       prev_bal=self.acc_bal, prev_val=self.acc_val)
+        return None
+
+    def on_accept(self, m: Msg) -> Optional[Msg]:
+        if m.bal >= self.promised:
+            self.promised = max(self.promised, m.bal)
+            self.acc_bal, self.acc_val = m.bal, m.val
+            return Msg(ACCEPTED, m.dst, m.src, m.bal, val=m.val)
+        return None
+
+
+class Proposer:
+    P1, P2, DONE = 0, 1, 2
+
+    def __init__(self, pid: int, own_val: int, n_acc: int) -> None:
+        self.pid = pid
+        self.own_val = own_val
+        self.n_acc = n_acc
+        self.rnd = 0
+        self.bal = make_ballot(0, pid)
+        self.phase = self.P1
+        self.heard: set[int] = set()
+        self.best = (0, 0)
+        self.prop_val = 0
+        self.decided_val: Optional[int] = None
+
+    @property
+    def quorum(self) -> int:
+        return self.n_acc // 2 + 1
+
+    def broadcast(self, kind: str, **kw) -> list[Msg]:
+        return [Msg(kind, self.pid, a, self.bal, **kw) for a in range(self.n_acc)]
+
+    def start(self) -> list[Msg]:
+        return self.broadcast(PREPARE)
+
+    def on_promise(self, m: Msg) -> list[Msg]:
+        if self.phase != self.P1 or m.bal != self.bal:
+            return []
+        self.heard.add(m.src)
+        if m.prev_bal > self.best[0]:
+            self.best = (m.prev_bal, m.prev_val)
+        if len(self.heard) >= self.quorum:
+            self.phase = self.P2
+            self.heard = set()
+            self.prop_val = self.best[1] if self.best[0] > 0 else self.own_val
+            return self.broadcast(ACCEPT, val=self.prop_val)
+        return []
+
+    def on_accepted(self, m: Msg) -> list[Msg]:
+        if self.phase != self.P2 or m.bal != self.bal:
+            return []
+        self.heard.add(m.src)
+        if len(self.heard) >= self.quorum:
+            self.phase = self.DONE
+            self.decided_val = self.prop_val
+        return []
+
+    def on_timeout(self) -> list[Msg]:
+        if self.phase == self.DONE:
+            return []
+        self.rnd += 1
+        self.bal = make_ballot(self.rnd, self.pid)
+        self.phase = self.P1
+        self.heard = set()
+        self.best = (0, 0)
+        return self.broadcast(PREPARE)
+
+
+@dataclasses.dataclass
+class GoldenReport:
+    decided: bool
+    chosen_values: set[int]
+    agreement_ok: bool
+    validity_ok: bool
+    steps: int
+
+
+def run_golden(
+    seed: int,
+    n_prop: int = 2,
+    n_acc: int = 3,
+    p_drop: float = 0.0,
+    p_dup: float = 0.0,
+    timeout_weight: float = 0.05,
+    max_steps: int = 20_000,
+) -> GoldenReport:
+    """Run one instance to decision under a seeded adversarial scheduler."""
+    rng = random.Random(seed)
+    acceptors = [Acceptor() for _ in range(n_acc)]
+    proposers = [Proposer(p, 100 + p, n_acc) for p in range(n_prop)]
+    own_vals = {p.own_val for p in proposers}
+    network: list[Msg] = []
+    accept_events: list[tuple[int, int, int]] = []  # (acceptor, bal, val)
+
+    for p in proposers:
+        network.extend(p.start())
+
+    def dispatch(m: Msg) -> None:
+        out: list[Msg] = []
+        if m.kind == PREPARE:
+            r = acceptors[m.dst].on_prepare(m)
+            out = [r] if r else []
+        elif m.kind == ACCEPT:
+            r = acceptors[m.dst].on_accept(m)
+            if r:
+                accept_events.append((m.dst, m.bal, m.val))
+                out = [r]
+        elif m.kind == PROMISE:
+            out = proposers[m.dst].on_promise(m)
+        elif m.kind == ACCEPTED:
+            out = proposers[m.dst].on_accepted(m)
+        for o in out:
+            if rng.random() >= p_drop:
+                network.append(o)
+
+    steps = 0
+    while steps < max_steps and not all(p.phase == p.DONE for p in proposers):
+        steps += 1
+        # Enabled events: deliver any in-flight message, or any live timeout.
+        if network and rng.random() >= timeout_weight:
+            i = rng.randrange(len(network))
+            m = network[i] if rng.random() < p_dup else network.pop(i)
+            dispatch(m)
+        else:
+            live = [p for p in proposers if p.phase != p.DONE]
+            if not live:
+                break
+            for m in rng.choice(live).on_timeout():
+                if rng.random() >= p_drop:
+                    network.append(m)
+
+    # Omniscient oracle: chosen = any (b, v) accepted by a majority, over history.
+    voters: dict[tuple[int, int], set[int]] = defaultdict(set)
+    for a, b, v in accept_events:
+        voters[(b, v)].add(a)
+    quorum = n_acc // 2 + 1
+    chosen = {v for (b, v), accs in voters.items() if len(accs) >= quorum}
+    decided_vals = {p.decided_val for p in proposers if p.decided_val is not None}
+    return GoldenReport(
+        decided=all(p.phase == p.DONE for p in proposers),
+        chosen_values=chosen,
+        agreement_ok=len(chosen) <= 1 and all(v in chosen for v in decided_vals),
+        validity_ok=chosen <= own_vals,
+        steps=steps,
+    )

@@ -1,0 +1,3 @@
+"""Fault injection: PRNG-mask twins of real crashes and lossy networks."""
+
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan  # noqa: F401

@@ -1,0 +1,101 @@
+"""Fault injection — declarative crash schedules and per-tick chaos masks.
+
+Reference parity (SURVEY.md §4.4, §6.3): the reference gets failure semantics
+from the actor runtime — monitors/links deliver ``ProcessMonitorNotification``
+when a process or node dies, and fault *injection* means actually killing OS
+processes [CH].  Here both collapse into data:
+
+- **Static plan** (:class:`FaultPlan`): per-(instance, acceptor) crash windows
+  and Byzantine-equivocation flags, sampled once per run from a PRNG key.
+  "Failure detection" needs no detector — the quorum kernel simply sees fewer
+  live votes (SURVEY.md §4.4).
+- **Dynamic masks** (:class:`FaultConfig` probabilities, sampled per tick
+  inside the step): send-time message drop, duplication (a processed message
+  stays in flight and is processed again), acceptor idling and reply holding
+  (both of which realize unbounded delay and reordering under the synchronous
+  round model — SURVEY.md §8.1's "adversarial delivery mask").
+
+Crashed acceptors stop processing but *keep their state* across recovery —
+Paxos' durable-storage assumption.  Amnesia on recovery (a real-world bug the
+checker should catch) is a separate switch, as is equivocation (config 4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+NEVER = jnp.iinfo(jnp.int32).max
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Static (trace-time) fault probabilities and protocol timing knobs.
+
+    Hashable and frozen so it can be a static argument to ``jax.jit``.
+    """
+
+    # Network chaos (per message / per tick)
+    p_drop: float = 0.0  # send-time message loss
+    p_dup: float = 0.0  # processed message remains in flight (duplicate)
+    p_idle: float = 0.0  # acceptor processes nothing this tick
+    p_hold: float = 0.0  # a deliverable reply stays in flight this tick
+    # Crash schedule (sampled once per run)
+    p_crash: float = 0.0  # per (instance, acceptor): crashes at some point
+    crash_max_start: int = 32  # crash start ~ U[0, crash_max_start)
+    crash_max_len: int = 16  # window length ~ U[1, crash_max_len]
+    crash_forever: bool = False  # never recover instead
+    amnesia: bool = False  # (bug injection) lose acceptor state on recovery
+    # Byzantine (config 4)
+    p_equiv: float = 0.0  # per (instance, acceptor): equivocates forever
+    # Proposer timing
+    timeout: int = 10  # ticks in a phase before retrying with higher ballot
+    backoff_max: int = 8  # retry backoff ~ U[0, backoff_max) extra ticks
+
+
+@struct.dataclass
+class FaultPlan:
+    """Per-run static fault schedule (device arrays, shard with the state)."""
+
+    crash_start: jnp.ndarray  # (I, A) int32 tick; NEVER if no crash
+    crash_end: jnp.ndarray  # (I, A) int32 tick; NEVER if crash is permanent
+    equivocate: jnp.ndarray  # (I, A) bool
+
+    @classmethod
+    def none(cls, n_inst: int, n_acc: int) -> "FaultPlan":
+        full = jnp.full((n_inst, n_acc), NEVER, jnp.int32)
+        return cls(
+            crash_start=full,
+            crash_end=full,
+            equivocate=jnp.zeros((n_inst, n_acc), jnp.bool_),
+        )
+
+    @classmethod
+    def sample(
+        cls, key: jax.Array, cfg: FaultConfig, n_inst: int, n_acc: int
+    ) -> "FaultPlan":
+        k_crash, k_start, k_len, k_eq = jax.random.split(key, 4)
+        shape = (n_inst, n_acc)
+        crashes = jax.random.uniform(k_crash, shape) < cfg.p_crash
+        start = jax.random.randint(k_start, shape, 0, max(cfg.crash_max_start, 1))
+        length = jax.random.randint(k_len, shape, 1, max(cfg.crash_max_len, 1) + 1)
+        crash_start = jnp.where(crashes, start, NEVER)
+        crash_end = jnp.where(
+            crashes & (not cfg.crash_forever),
+            # Guard overflow: NEVER + length would wrap.
+            jnp.minimum(start + length, NEVER - 1),
+            NEVER,
+        )
+        equivocate = jax.random.uniform(k_eq, shape) < cfg.p_equiv
+        return cls(crash_start=crash_start, crash_end=crash_end, equivocate=equivocate)
+
+    def alive(self, tick: jnp.ndarray) -> jnp.ndarray:
+        """(I, A) bool: acceptor is up at ``tick``."""
+        return ~((self.crash_start <= tick) & (tick < self.crash_end))
+
+    def recovering(self, tick: jnp.ndarray) -> jnp.ndarray:
+        """(I, A) bool: acceptor comes back up exactly at ``tick`` (for amnesia)."""
+        return self.crash_end == tick

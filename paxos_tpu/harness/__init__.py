@@ -1,0 +1,4 @@
+"""Host-side harness: configs, the scan driver, metrics, checkpointing."""
+
+from paxos_tpu.harness.config import SimConfig  # noqa: F401
+from paxos_tpu.harness.run import run, summarize  # noqa: F401

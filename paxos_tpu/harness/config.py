@@ -1,0 +1,85 @@
+"""Typed run configs — the deployment/discovery layer's TPU twin.
+
+Reference parity (SURVEY.md §6.6): the reference configures runs with
+SimpleLocalnet positional CLI args (``master|slave host port``) [CH].  Here a
+run is a frozen, hashable dataclass (so it can ride into ``jax.jit`` as a
+static argument) and each BASELINE.json evaluation config has a named
+constructor.  ``fingerprint()`` lands in benchmark reports so numbers are
+attributable to exact configurations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+from paxos_tpu.faults.injector import FaultConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """One fuzzing run: protocol, topology, scale, faults, timing."""
+
+    n_inst: int = 1024
+    n_prop: int = 1
+    n_acc: int = 3
+    k_slots: int = 8  # learner-table capacity
+    seed: int = 0
+    protocol: str = "paxos"
+    fault: FaultConfig = dataclasses.field(default_factory=FaultConfig)
+
+    def fingerprint(self) -> str:
+        blob = json.dumps(dataclasses.asdict(self), sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+
+# --- BASELINE.json evaluation configs (BASELINE.md "Evaluation configs") ---
+
+
+def config1_no_faults(n_inst: int = 1024, seed: int = 0) -> SimConfig:
+    """Config 1: single-decree, 3 acceptors, 1 proposer, no faults."""
+    return SimConfig(n_inst=n_inst, n_prop=1, n_acc=3, seed=seed)
+
+
+def config2_dueling_drop(n_inst: int = 100_000, seed: int = 0) -> SimConfig:
+    """Config 2: 5 acceptors, 2 dueling proposers, 10% message drop."""
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        seed=seed,
+        fault=FaultConfig(p_drop=0.1, p_idle=0.2, p_hold=0.2),
+    )
+
+
+def config3_multipaxos(n_inst: int = 1_000_000, seed: int = 0) -> SimConfig:
+    """Config 3: Multi-Paxos log replication, leader lease + leader crash."""
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        seed=seed,
+        protocol="multipaxos",
+        fault=FaultConfig(p_drop=0.05, p_idle=0.1, p_hold=0.1, p_crash=0.2),
+    )
+
+
+def config4_byzantine(n_inst: int = 4096, seed: int = 0) -> SimConfig:
+    """Config 4: acceptor equivocation (double-promise) to validate the checker."""
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        seed=seed,
+        fault=FaultConfig(p_idle=0.2, p_hold=0.2, p_equiv=0.25),
+    )
+
+
+def config5_sweep(n_inst: int = 65_536, seed: int = 0) -> tuple[SimConfig, ...]:
+    """Config 5: Paxos vs Fast-Paxos vs Raft-core under identical fault masks."""
+    fault = FaultConfig(p_drop=0.1, p_idle=0.2, p_hold=0.2)
+    return tuple(
+        SimConfig(n_inst=n_inst, n_prop=2, n_acc=5, seed=seed, protocol=p, fault=fault)
+        for p in ("paxos", "fastpaxos", "raftcore")
+    )

@@ -1,0 +1,131 @@
+"""The scan driver — bootstrap, round loop, and metric readback.
+
+Reference parity (SURVEY.md §4.1): the reference's bootstrap (CLI → backend
+init → node creation → spawn roles → run proposer → print decision) becomes:
+build config → init state pytree → sample fault plan → `lax.scan` the
+protocol step over chunks of ticks → read back reduced metrics.  The only
+host↔device crossings are at chunk boundaries (SURVEY.md §8.4.5).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.core.state import DONE, PaxosState
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.harness.config import SimConfig
+
+
+def get_step_fn(protocol: str) -> Callable:
+    """Resolve a protocol name to its step function (shared signature)."""
+    if protocol == "paxos":
+        from paxos_tpu.protocols.paxos import paxos_step
+
+        return paxos_step
+    raise ValueError(f"unknown protocol: {protocol!r}")
+
+
+def init_state(cfg: SimConfig) -> PaxosState:
+    return PaxosState.init(cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots)
+
+
+def init_plan(cfg: SimConfig) -> FaultPlan:
+    key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 1)
+    return FaultPlan.sample(key, cfg.fault, cfg.n_inst, cfg.n_acc)
+
+
+def base_key(cfg: SimConfig) -> jax.Array:
+    return jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("fault", "n_ticks", "step_fn"), donate_argnums=(0,)
+)
+def run_chunk(
+    state: PaxosState,
+    key: jax.Array,
+    plan: FaultPlan,
+    fault: FaultConfig,
+    n_ticks: int,
+    step_fn: Callable,
+) -> PaxosState:
+    """Advance ``n_ticks`` scheduler ticks fully on-device."""
+
+    def body(s, _):
+        return step_fn(s, key, plan, fault), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return state
+
+
+def summarize(state: PaxosState) -> dict[str, Any]:
+    """Reduce on-device state to a host-side scalar report.
+
+    Reductions run on-device (sharded states psum automatically under jit);
+    only scalars come back to the host.
+    """
+    n_inst = state.learner.chosen.shape[0]
+    lrn, prop = state.learner, state.proposer
+    chosen = lrn.chosen
+    decided = (prop.phase == DONE).any(axis=-1)
+    # A proposer that believes it decided v while the learner chose v' != v
+    # is a cross-role disagreement — counted as a safety signal.
+    disagree = (
+        (prop.phase == DONE) & chosen[:, None] & (prop.decided_val != lrn.chosen_val[:, None])
+    ).any(axis=-1)
+    mean_tick = jnp.where(
+        chosen.any(),
+        jnp.where(chosen, lrn.chosen_tick, 0).sum(dtype=jnp.float32)
+        / jnp.maximum(chosen.sum(), 1),
+        -1.0,
+    )
+    out = {
+        "n_inst": n_inst,
+        "ticks": state.tick,
+        "chosen_frac": chosen.mean(dtype=jnp.float32),
+        "decided_frac": decided.mean(dtype=jnp.float32),
+        "violations": lrn.violations.sum(),
+        "evictions": lrn.evictions.sum(),
+        "proposer_disagree": disagree.sum(),
+        "mean_choose_tick": mean_tick,
+    }
+    return {k: (v.item() if hasattr(v, "item") else v) for k, v in jax.device_get(out).items()}
+
+
+def run(
+    cfg: SimConfig,
+    total_ticks: int = 64,
+    chunk: int = 32,
+    until_all_chosen: bool = False,
+    max_ticks: int = 4096,
+    return_state: bool = False,
+):
+    """Host loop: init, scan chunks, return the final report.
+
+    With ``until_all_chosen`` the loop keeps scanning chunks until every
+    instance's learner chose a value (or ``max_ticks``), the batch analog of
+    the reference master's "wait for the decision, then print it".
+    """
+    step_fn = get_step_fn(cfg.protocol)
+    state = init_state(cfg)
+    plan = init_plan(cfg)
+    key = base_key(cfg)
+
+    budget = max_ticks if until_all_chosen else total_ticks
+    done = 0
+    while done < budget:
+        n = min(chunk, budget - done)
+        state = run_chunk(state, key, plan, cfg.fault, n, step_fn)
+        done += n
+        if until_all_chosen:
+            if state.learner.chosen.all().item():
+                break
+    report = summarize(state)
+    report["config_fingerprint"] = cfg.fingerprint()
+    if return_state:
+        return report, state
+    return report

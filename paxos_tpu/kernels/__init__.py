@@ -1,0 +1,3 @@
+"""Hot array kernels: quorum voting, majority reduction."""
+
+from paxos_tpu.kernels.quorum import majority, quorum_reached  # noqa: F401

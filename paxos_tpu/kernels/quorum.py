@@ -1,0 +1,35 @@
+"""Quorum-vote kernel: ballot-compare + majority-reduce.
+
+Reference parity (SURVEY.md §3.2 "intra-instance all-to-all"): the reference
+proposer's `collectPromises`/`collectAccepted` loops — N point-to-point
+`expect`s followed by a count — become a bitmask popcount per (instance,
+proposer) lane.  Votes are accumulated as bits (so duplicate deliveries of
+the same acceptor's reply cannot inflate the count), and "until majority"
+becomes "recompute the quorum predicate each tick" under `lax.scan`.
+
+The acceptors axis is small (3–7) and unsharded, so this is a segment
+reduce, not a collective; XLA fuses it into the surrounding step.  A Pallas
+variant exists for the fused deliver+vote path (`paxos_tpu.kernels` grows it
+in M8) only if profiling shows XLA failed to fuse — SURVEY.md §8.2.5.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paxos_tpu.utils.bitops import popcount
+
+
+def majority(n_acc: int) -> int:
+    """Size of a classic majority quorum."""
+    return n_acc // 2 + 1
+
+
+def fast_quorum(n_acc: int) -> int:
+    """Size of a Fast Paxos fast quorum: ceil(3n/4)."""
+    return -((-3 * n_acc) // 4)
+
+
+def quorum_reached(heard_mask: jnp.ndarray, quorum: int) -> jnp.ndarray:
+    """Elementwise: does the voter bitmask contain >= ``quorum`` voters?"""
+    return popcount(heard_mask) >= quorum

@@ -1,0 +1,3 @@
+"""Mesh sharding of the instances axis across chips."""
+
+from paxos_tpu.parallel.mesh import make_mesh, shard_pytree  # noqa: F401

@@ -1,0 +1,46 @@
+"""Device-mesh scale-out — the SimpleLocalnet twin.
+
+Reference parity (SURVEY.md §3.2): the reference scales out by adding nodes
+(SimpleLocalnet master/slave over TCP [B]); here the scale-out axis is the
+``instances`` dimension sharded over a 1-D `jax.sharding.Mesh`.  Instances
+are independent, so the step function needs no cross-device communication at
+all — XLA inserts collectives only for the scalar metric reductions in
+`summarize` (psums over ICI intra-slice / DCN across slices).  There is no
+NCCL/MPI anywhere: collectives are XLA's (SURVEY.md §6.8).
+
+Tests exercise this on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``) — the TPU analog of the Cloud
+Haskell ecosystem's ``network-transport-inmemory`` trick (SURVEY.md §5.2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+INSTANCES_AXIS = "instances"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over all (or the given) devices, named ``instances``."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), (INSTANCES_AXIS,))
+
+
+def state_sharding(tree: Any, mesh: Mesh, n_inst: int) -> Any:
+    """Per-leaf shardings: leading ``instances`` axis sharded, scalars replicated."""
+
+    def leaf_sharding(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n_inst:
+            return NamedSharding(mesh, P(INSTANCES_AXIS, *([None] * (x.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(leaf_sharding, tree)
+
+
+def shard_pytree(tree: Any, mesh: Mesh, n_inst: int) -> Any:
+    """Place a host/state pytree onto the mesh with instance sharding."""
+    return jax.device_put(tree, state_sharding(tree, mesh, n_inst))

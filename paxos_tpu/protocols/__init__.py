@@ -1,0 +1,7 @@
+"""Protocol step functions: Paxos, Multi-Paxos, Fast Paxos, Raft-core.
+
+All protocols share one step-fn shape so the cross-protocol sweep (BASELINE
+config 5) can drive them under identical fault masks:
+
+    step(state, base_key, plan, cfg) -> state
+"""

@@ -1,0 +1,224 @@
+"""Single-decree Paxos as one fused array program per scheduler tick.
+
+Reference parity (SURVEY.md §4.2-§4.3): the reference's proposer ballot round
+— `send (Prepare b)` to every acceptor, `receiveWait` promises until
+majority, adopt the highest-ballot accepted value, `send (Accept b v)`,
+collect Accepted until majority, retry with a higher ballot on timeout — and
+the acceptor/learner `expect` loops all collapse into :func:`paxos_step`:
+one tick = deliver (masked gathers) → role transitions (pure elementwise
+updates) → emit (masked scatters), batched over every instance at once.
+
+Scheduling model (SURVEY.md §8.1): each acceptor processes at most ONE
+in-flight request per tick, chosen uniformly at random — the asynchronous
+adversarial scheduler.  Proposers fold ALL delivered replies per tick, which
+is sound because the fold is a commutative monoid (voter-bitmask OR, running
+max of prev-accepted ballots): any interleaving gives the same result, so
+batching loses no adversarial coverage.  Delay, loss, duplication, crashes
+and equivocation come from `paxos_tpu.faults` masks.
+
+The famous killer interleavings survive vectorization:
+
+- *accept-old-ballot-after-new-promise*: a stale ACCEPT slot can be selected
+  after the acceptor promised a higher ballot; `msg_bal >= promised` rejects.
+- *dueling proposers*: both proposers' PREPAREs race per tick; retries pick
+  fresh ballots with randomized backoff.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.check.safety import acceptor_invariants, learner_observe
+from paxos_tpu.core import ballot as bal_mod
+from paxos_tpu.core.messages import ACCEPT, ACCEPTED, PREPARE, PROMISE
+from paxos_tpu.core.state import DONE, P1, P2, PaxosState
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.kernels.quorum import majority, quorum_reached
+from paxos_tpu.transport import inmemory_tpu as net
+
+
+def paxos_step(
+    state: PaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+) -> PaxosState:
+    """Advance every instance by one scheduler tick."""
+    n_inst, n_acc = state.acceptor.promised.shape
+    n_prop = state.proposer.bal.shape[1]
+    quorum = majority(n_acc)
+
+    # Keys depend only on (seed, tick): checkpoint/resume replays bit-exactly.
+    key = jax.random.fold_in(base_key, state.tick)
+    (k_sel, k_dup_req, k_hold, k_dup_rep, k_drop_prom, k_drop_accd,
+     k_drop_p1, k_drop_p2, k_backoff) = jax.random.split(key, 9)
+
+    acc = state.acceptor
+    alive = plan.alive(state.tick)  # (I, A)
+    equiv = plan.equivocate  # (I, A)
+
+    if cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
+        rec = plan.recovering(state.tick)
+        acc = acc.replace(
+            promised=jnp.where(rec, 0, acc.promised),
+            acc_bal=jnp.where(rec, 0, acc.acc_bal),
+            acc_val=jnp.where(rec, 0, acc.acc_val),
+        )
+    acc_pre = acc
+
+    # Reply delivery is decided (and delivered slots are cleared) BEFORE the
+    # acceptor half-tick writes new replies: otherwise a reply written this
+    # tick could land in a slot being consumed and be lost even on a
+    # fault-free network.  Proposers read payloads from the pre-tick buffer.
+    delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
+    replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
+
+    # ---- Acceptor half-tick: select one request per (instance, acceptor) ----
+    sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
+    sel = sel & alive[:, None, None, :]  # crashed acceptors process nothing
+
+    # Gather the selected message's fields onto (I, A).
+    def gather(x):
+        return jnp.where(sel, x, 0).sum(axis=(1, 2))
+
+    msg_bal = gather(state.requests.bal)  # (I, A)
+    msg_val = gather(state.requests.v1)  # (I, A) (ACCEPT payload)
+    is_prep = sel[:, PREPARE].any(axis=1)  # (I, A)
+    is_acc = sel[:, ACCEPT].any(axis=1)  # (I, A)
+
+    # PREPARE(b): honest promise iff b > promised; equivocators "promise"
+    # unconditionally, never record it, and hide their accepted pair.
+    ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
+    ok_prep = ok_prep_h | (is_prep & equiv)
+    # ACCEPT(b, v): honest accept iff b >= promised; equivocators accept all.
+    ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised)
+    ok_acc = ok_acc_h | (is_acc & equiv)
+
+    promised = jnp.where(ok_prep_h, msg_bal, acc.promised)
+    promised = jnp.where(ok_acc_h, jnp.maximum(promised, msg_bal), promised)
+    acc_bal = jnp.where(ok_acc, msg_bal, acc.acc_bal)
+    acc_val = jnp.where(ok_acc, msg_val, acc.acc_val)
+
+    # Replies routed back to the selected sender's slot.
+    prom_payload_bal = jnp.where(equiv, 0, acc.acc_bal)  # pre-update pair
+    prom_payload_val = jnp.where(equiv, 0, acc.acc_val)
+    replies = net.send(
+        replies, PROMISE,
+        send_mask=sel[:, PREPARE] & ok_prep[:, None, :],
+        bal=msg_bal[:, None, :],
+        v1=prom_payload_bal[:, None, :],
+        v2=prom_payload_val[:, None, :],
+        key=k_drop_prom, p_drop=cfg.p_drop,
+    )
+    replies = net.send(
+        replies, ACCEPTED,
+        send_mask=sel[:, ACCEPT] & ok_acc[:, None, :],
+        bal=msg_bal[:, None, :],
+        v1=msg_val[:, None, :],
+        v2=jnp.zeros_like(msg_val)[:, None, :],
+        key=k_drop_accd, p_drop=cfg.p_drop,
+    )
+    requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
+    acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
+
+    # ---- Learner / safety checker (omniscient: sees accept events directly) ----
+    learner = learner_observe(
+        state.learner, ok_acc, msg_bal, msg_val, state.tick, quorum
+    )
+    inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
+    learner = learner.replace(violations=learner.violations + inv_viol)
+
+    # ---- Proposer half-tick: fold all delivered replies ----
+    prop = state.proposer
+    bits = (jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32))  # (A,)
+
+    cur_bal = prop.bal[:, :, None]  # (I, P, 1)
+    prom_ok = (
+        delivered[:, PROMISE]
+        & (state.replies.bal[:, PROMISE] == cur_bal)
+        & (prop.phase == P1)[:, :, None]
+    )  # (I, P, A)
+    accd_ok = (
+        delivered[:, ACCEPTED]
+        & (state.replies.bal[:, ACCEPTED] == cur_bal)
+        & (prop.phase == P2)[:, :, None]
+    )
+    heard = (
+        prop.heard
+        | jnp.where(prom_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
+        | jnp.where(accd_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
+    )
+
+    # Highest previously-accepted (ballot, value) among valid promises.
+    prev_bal = jnp.where(prom_ok, state.replies.v1[:, PROMISE], 0)  # (I, P, A)
+    best_a = jnp.argmax(prev_bal, axis=-1)  # (I, P)
+    cand_bal = jnp.take_along_axis(prev_bal, best_a[..., None], axis=-1)[..., 0]
+    cand_val = jnp.take_along_axis(
+        jnp.where(prom_ok, state.replies.v2[:, PROMISE], 0), best_a[..., None], axis=-1
+    )[..., 0]
+    upgrade = cand_bal > prop.best_bal
+    best_bal = jnp.where(upgrade, cand_bal, prop.best_bal)
+    best_val = jnp.where(upgrade, cand_val, prop.best_val)
+
+    # Phase transitions.
+    p1_done = (prop.phase == P1) & quorum_reached(heard, quorum)
+    p2_done = (prop.phase == P2) & quorum_reached(heard, quorum)
+    v_chosen_by_p1 = jnp.where(best_bal > 0, best_val, prop.own_val)
+
+    timer = jnp.where(prop.phase == DONE, prop.timer, prop.timer + 1)
+    expired = (
+        (prop.phase != DONE) & ~p1_done & ~p2_done & (timer > cfg.timeout)
+    )
+    backoff = jax.random.randint(
+        k_backoff, timer.shape, 0, max(cfg.backoff_max, 1), jnp.int32
+    )
+    pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), timer.shape)
+    new_bal = bal_mod.make_ballot(bal_mod.ballot_round(prop.bal) + 1, pid)
+
+    phase = jnp.where(p1_done, P2, prop.phase)
+    phase = jnp.where(p2_done, DONE, phase)
+    phase = jnp.where(expired, P1, phase)
+    prop_val = jnp.where(p1_done, v_chosen_by_p1, prop.prop_val)
+    decided_val = jnp.where(p2_done, prop.prop_val, prop.decided_val)
+    bal_next = jnp.where(expired, new_bal, prop.bal)
+    heard = jnp.where(p1_done | expired, 0, heard)
+    best_bal = jnp.where(expired, 0, best_bal)
+    best_val = jnp.where(expired, 0, best_val)
+    timer = jnp.where(p1_done, 0, timer)
+    timer = jnp.where(expired, -backoff, timer)
+
+    # Emit: ACCEPT broadcast on phase-1 completion, PREPARE broadcast on retry.
+    requests = net.send(
+        requests, ACCEPT,
+        send_mask=jnp.broadcast_to(p1_done[:, :, None], (n_inst, n_prop, n_acc)),
+        bal=prop.bal[:, :, None],
+        v1=prop_val[:, :, None],
+        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        key=k_drop_p2, p_drop=cfg.p_drop,
+    )
+    requests = net.send(
+        requests, PREPARE,
+        send_mask=jnp.broadcast_to(expired[:, :, None], (n_inst, n_prop, n_acc)),
+        bal=bal_next[:, :, None],
+        v1=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        key=k_drop_p1, p_drop=cfg.p_drop,
+    )
+
+    prop = prop.replace(
+        bal=bal_next,
+        phase=phase,
+        prop_val=prop_val,
+        heard=heard,
+        best_bal=best_bal,
+        best_val=best_val,
+        timer=timer,
+        decided_val=decided_val,
+    )
+
+    return state.replace(
+        acceptor=acc,
+        proposer=prop,
+        learner=learner,
+        requests=requests,
+        replies=replies,
+        tick=state.tick + 1,
+    )

@@ -1,0 +1,1 @@
+"""Transports: how in-flight messages move. The `Network.Transport` seam."""

@@ -1,0 +1,110 @@
+"""InMemoryTPU transport — message delivery as masked gathers in HBM.
+
+Reference parity (SURVEY.md §2 L0, §4.3 [B][CH]): the reference's
+``Network.Transport`` abstraction (endpoints, ordered-reliable connections
+over TCP) is the declared plugin seam; this module is the plug.  There is no
+wire: "in flight" means a populated slot in a :class:`~paxos_tpu.core.messages.MsgBuf`,
+and a tick's delivery decisions are PRNG masks:
+
+- **Request path (proposer→acceptor), one message per actor per tick**: each
+  (instance, acceptor) *selects* at most one present request uniformly at
+  random and processes it; unselected slots stay in flight.  This is the
+  classic asynchronous-scheduler model (one enabled event per actor per
+  step): arbitrary delay and arbitrary interleaving across senders and kinds
+  fall out of the random selection, so the synchronous scan step explores the
+  same interleaving space as the reference's nondeterministic mailbox order
+  (SURVEY.md §8.1).
+- **Reply path (acceptor→proposer), deliver-all-with-holds**: the proposer's
+  handler is a commutative monoid action (bitmask-OR of voters, max of
+  prev-accepted ballots), so processing any subset in any order equals any
+  serialization — replies need no one-at-a-time discipline.  A per-slot
+  *hold* mask keeps a reply in flight to realize delay/reordering; delivered
+  slots clear (minus duplicates).
+
+Send-time drop and duplication masks complete the fault model (SURVEY.md
+§6.8).  Everything is fixed-shape; no host round-trips.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.core.messages import MsgBuf
+
+
+def select_one(present: jnp.ndarray, key: jax.Array, p_idle: float) -> jnp.ndarray:
+    """Pick at most one present request per (instance, acceptor).
+
+    Args:
+      present: (I, 2, P, A) bool — occupied request slots.
+      key: PRNG key for this tick.
+      p_idle: probability an acceptor processes nothing despite pending mail.
+
+    Returns:
+      (I, 2, P, A) bool one-hot (per (I, A) fiber) selection mask.
+    """
+    i, k, p, a = present.shape
+    k_sel, k_idle = jax.random.split(key)
+    # Uniform scores; absent slots can never win.
+    scores = jax.random.uniform(k_sel, present.shape)
+    scores = jnp.where(present, scores, -1.0)
+    # argmax over the flattened (kind, proposer) fiber for each (I, A).
+    flat = jnp.moveaxis(scores, 3, 1).reshape(i, a, k * p)  # (I, A, 2P)
+    winner = jnp.argmax(flat, axis=-1)  # (I, A)
+    onehot = jax.nn.one_hot(winner, k * p, dtype=jnp.bool_)  # (I, A, 2P)
+    onehot = jnp.moveaxis(onehot.reshape(i, a, k, p), 1, 3)  # (I, 2, P, A)
+    busy = jax.random.uniform(k_idle, (i, 1, 1, a)) >= p_idle
+    return onehot & present & busy
+
+
+def hold_mask(present: jnp.ndarray, key: jax.Array, p_hold: float) -> jnp.ndarray:
+    """(shape of present) bool: which present reply slots deliver this tick."""
+    deliver = jax.random.uniform(key, present.shape) >= p_hold
+    return present & deliver
+
+
+def send(
+    buf: MsgBuf,
+    kind: int,
+    send_mask: jnp.ndarray,
+    bal: jnp.ndarray,
+    v1: jnp.ndarray,
+    v2: jnp.ndarray,
+    key: jax.Array,
+    p_drop: float,
+) -> MsgBuf:
+    """Write messages of ``kind`` into their slots (overwriting), minus drops.
+
+    Args:
+      buf: the target buffer family.
+      kind: request/reply kind index (0 or 1).
+      send_mask: (I, P, A) bool — which edges send this tick.
+      bal, v1, v2: (I, P, A) int32 payloads (broadcastable).
+      key: PRNG key; p_drop: send-time loss probability.
+    """
+    if p_drop > 0.0:
+        kept = jax.random.uniform(key, send_mask.shape) >= p_drop
+        send_mask = send_mask & kept
+    zero = jnp.zeros_like(buf.bal[:, kind])
+    return buf.replace(
+        bal=buf.bal.at[:, kind].set(jnp.where(send_mask, bal + zero, buf.bal[:, kind])),
+        v1=buf.v1.at[:, kind].set(jnp.where(send_mask, v1 + zero, buf.v1[:, kind])),
+        v2=buf.v2.at[:, kind].set(jnp.where(send_mask, v2 + zero, buf.v2[:, kind])),
+        present=buf.present.at[:, kind].set(buf.present[:, kind] | send_mask),
+    )
+
+
+def consume(
+    buf: MsgBuf, taken: jnp.ndarray, key: jax.Array, p_dup: float
+) -> MsgBuf:
+    """Clear slots that were processed this tick, except duplicated ones.
+
+    Args:
+      taken: (I, 2, P, A) bool — slots whose message was processed.
+      p_dup: probability a processed slot stays in flight (duplicate delivery).
+    """
+    if p_dup > 0.0:
+        dup = jax.random.uniform(key, taken.shape) < p_dup
+        taken = taken & ~dup
+    return buf.replace(present=buf.present & ~taken)

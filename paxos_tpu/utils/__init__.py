@@ -1,0 +1,3 @@
+"""Small shared utilities (bit tricks, pytree helpers)."""
+
+from paxos_tpu.utils.bitops import acceptor_bit, popcount  # noqa: F401

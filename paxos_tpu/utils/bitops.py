@@ -1,0 +1,24 @@
+"""Bit-level helpers for quorum bookkeeping.
+
+"Which acceptors have I heard from this phase" is a set over at most
+``MAX_ACCEPTORS`` elements, so it lives in one int32 lane per (instance,
+proposer) — the struct-of-arrays analog of the reference proposer's list of
+collected Promise/Accepted replies (SURVEY.md §4.2 [P]).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MAX_ACCEPTORS = 16  # bitmask capacity; protocol configs use 3-7
+
+
+def acceptor_bit(a):
+    """int32 mask with bit ``a`` set."""
+    return jnp.asarray(1, jnp.int32) << jnp.asarray(a, jnp.int32)
+
+
+def popcount(mask):
+    """Number of set bits, elementwise (int32 in, int32 out)."""
+    return jax.lax.population_count(jnp.asarray(mask, jnp.int32))

@@ -1,0 +1,21 @@
+"""Test env: force CPU with 8 virtual devices (SURVEY.md §5.2.4).
+
+Multi-chip tests without a cluster — the TPU analog of Cloud Haskell's
+`network-transport-inmemory`.  The image's sitecustomize registers the
+`axon` TPU backend at interpreter start and pins `jax_platforms=axon,cpu`,
+so an env var alone is not enough: re-point jax at CPU explicitly before
+any backend is used.  XLA_FLAGS must be set before the CPU client is
+created (lazily), which this module-level code guarantees.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

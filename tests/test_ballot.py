@@ -1,0 +1,35 @@
+"""Ballot packing: order, roundtrip, NIL."""
+
+import jax.numpy as jnp
+
+from paxos_tpu.core.ballot import (
+    MAX_PROPOSERS,
+    NIL,
+    ballot_owner,
+    ballot_round,
+    make_ballot,
+)
+
+
+def test_roundtrip():
+    for rnd in (0, 1, 7, 1000):
+        for pid in range(MAX_PROPOSERS):
+            b = make_ballot(rnd, pid)
+            assert int(ballot_round(b)) == rnd
+            assert int(ballot_owner(b)) == pid
+
+
+def test_order_lexicographic():
+    pairs = [(r, p) for r in (0, 1, 2, 50) for p in range(MAX_PROPOSERS)]
+    bals = [int(make_ballot(r, p)) for (r, p) in pairs]
+    assert bals == sorted(bals)
+    assert all(b > NIL for b in bals)
+
+
+def test_vectorized():
+    r = jnp.array([[0, 1], [2, 3]])
+    p = jnp.array([[0, 1], [2, 3]])
+    b = make_ballot(r, p)
+    assert b.shape == (2, 2)
+    assert (ballot_round(b) == r).all()
+    assert (ballot_owner(b) == p).all()

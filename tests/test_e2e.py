@@ -1,0 +1,52 @@
+"""End-to-end: BASELINE configs on scaled-down instance counts (CPU CI).
+
+Config 1 is SURVEY.md §8.3's "minimum end-to-end slice": every instance
+decides, decisions are valid, the checker is green.
+"""
+
+import jax.numpy as jnp
+
+from paxos_tpu.harness.config import (
+    config1_no_faults,
+    config2_dueling_drop,
+    config4_byzantine,
+)
+from paxos_tpu.harness.run import run
+
+
+def test_config1_all_decide_no_violations():
+    cfg = config1_no_faults(n_inst=512, seed=3)
+    report, state = run(cfg, until_all_chosen=True, max_ticks=64, return_state=True)
+    assert report["chosen_frac"] == 1.0
+    assert report["decided_frac"] == 1.0
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["proposer_disagree"] == 0
+    # Validity: the single proposer's value (100) is the only possible choice.
+    assert bool((state.learner.chosen_val == 100).all())
+    # Fault-free single-proposer runs decide in a handful of ticks.
+    assert report["mean_choose_tick"] <= 8
+
+
+def test_config2_dueling_proposers_drop_safe():
+    cfg = config2_dueling_drop(n_inst=2048, seed=11)
+    report, state = run(cfg, until_all_chosen=True, max_ticks=600, return_state=True)
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["proposer_disagree"] == 0
+    assert report["chosen_frac"] > 0.99  # liveness under 10% drop
+    # Validity: chosen values come from the proposers' own values {100, 101}.
+    chosen = state.learner.chosen
+    vals = state.learner.chosen_val
+    assert bool(jnp.isin(vals[chosen], jnp.array([100, 101])).all())
+
+
+def test_config4_byzantine_checker_lights_up():
+    """The 0-violations claim must be falsifiable: equivocation MUST trip it."""
+    cfg = config4_byzantine(n_inst=2048, seed=5)
+    report = run(cfg, total_ticks=400)
+    assert report["violations"] > 0
+    # And the control: same run, no equivocation -> green.
+    clean = config2_dueling_drop(n_inst=2048, seed=5)
+    report2 = run(clean, total_ticks=400)
+    assert report2["violations"] == 0

@@ -1,0 +1,27 @@
+"""Golden model property tests: agreement, validity, liveness over many seeds."""
+
+import pytest
+
+from paxos_tpu.cpu_ref.golden import run_golden
+
+
+@pytest.mark.parametrize("n_prop,n_acc", [(1, 3), (2, 3), (2, 5), (3, 5)])
+def test_safety_across_seeds(n_prop, n_acc):
+    for seed in range(40):
+        rep = run_golden(seed, n_prop=n_prop, n_acc=n_acc)
+        assert rep.agreement_ok, (seed, rep)
+        assert rep.validity_ok, (seed, rep)
+
+
+def test_safety_under_drop_and_dup():
+    for seed in range(40):
+        rep = run_golden(seed, n_prop=2, n_acc=5, p_drop=0.2, p_dup=0.1)
+        assert rep.agreement_ok, (seed, rep)
+        assert rep.validity_ok, (seed, rep)
+
+
+def test_liveness_fair_scheduler():
+    decided = sum(
+        run_golden(seed, n_prop=2, n_acc=3).decided for seed in range(20)
+    )
+    assert decided >= 18  # fair random scheduling decides essentially always

@@ -1,0 +1,144 @@
+"""Deterministic known-answer tests for the paxos step.
+
+With at most one in-flight request per acceptor and p_idle = p_hold = 0, the
+adversarial scheduler has no freedom: `select_one` must pick the lone
+message and replies deliver the next tick.  That determinism lets us
+hand-construct the interleavings that famously break wrong Paxos
+implementations (SURVEY.md §5.2.3) and assert exact state transitions.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.core.ballot import make_ballot
+from paxos_tpu.core.messages import ACCEPT, ACCEPTED, PREPARE, PROMISE
+from paxos_tpu.core.state import DONE, P1, P2, PaxosState
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.protocols.paxos import paxos_step
+
+CFG = FaultConfig(timeout=1000)  # no timeouts, no faults: fully deterministic
+KEY = jax.random.PRNGKey(7)
+
+
+def fresh(n_inst=2, n_prop=1, n_acc=3):
+    """Init state with the automatic initial PREPAREs cleared out."""
+    s = PaxosState.init(n_inst, n_prop, n_acc)
+    s = s.replace(
+        requests=s.requests.replace(present=jnp.zeros_like(s.requests.present))
+    )
+    return s, FaultPlan.none(n_inst, n_acc)
+
+
+def put(buf, kind, p, a, bal, v1=0, v2=0):
+    return buf.replace(
+        bal=buf.bal.at[:, kind, p, a].set(bal),
+        v1=buf.v1.at[:, kind, p, a].set(v1),
+        v2=buf.v2.at[:, kind, p, a].set(v2),
+        present=buf.present.at[:, kind, p, a].set(True),
+    )
+
+
+def test_prepare_granted_and_rejected():
+    s, plan = fresh()
+    reqs = s.requests.replace(present=jnp.zeros_like(s.requests.present))
+    b = int(make_ballot(1, 0))
+    reqs = put(reqs, PREPARE, p=0, a=0, bal=b)
+    # Instance 1's acceptor 0 already promised higher.
+    acc = s.acceptor.replace(promised=s.acceptor.promised.at[1, 0].set(b + 8))
+    s = s.replace(requests=reqs, acceptor=acc)
+
+    s2 = paxos_step(s, KEY, plan, CFG)
+    assert int(s2.acceptor.promised[0, 0]) == b  # granted
+    assert int(s2.acceptor.promised[1, 0]) == b + 8  # unchanged
+    assert bool(s2.replies.present[0, PROMISE, 0, 0])  # promise sent
+    assert not bool(s2.replies.present[1, PROMISE, 0, 0])  # silent reject
+    assert int(s2.replies.bal[0, PROMISE, 0, 0]) == b
+    assert not bool(s2.requests.present[0, PREPARE, 0, 0])  # consumed
+
+
+def test_stale_accept_after_higher_promise_rejected():
+    """THE killer interleaving: ACCEPT(b1) delivered after PROMISE(b2>b1)."""
+    s, plan = fresh()
+    b1, b2 = int(make_ballot(0, 0)), int(make_ballot(5, 0))
+    reqs = s.requests.replace(present=jnp.zeros_like(s.requests.present))
+    reqs = put(reqs, ACCEPT, p=0, a=0, bal=b1, v1=42)
+    acc = s.acceptor.replace(promised=jnp.full_like(s.acceptor.promised, b2))
+    s = s.replace(requests=reqs, acceptor=acc)
+
+    s2 = paxos_step(s, KEY, plan, CFG)
+    assert int(s2.acceptor.acc_bal[0, 0]) == 0  # NOT accepted
+    assert int(s2.acceptor.acc_val[0, 0]) == 0
+    assert not bool(s2.replies.present[0, ACCEPTED, 0, 0])
+    assert int(s2.learner.lt_mask.sum()) == 0  # no accept event observed
+    assert int(s2.learner.violations.sum()) == 0
+
+
+def test_accept_at_or_above_promise_accepted():
+    s, plan = fresh()
+    b = int(make_ballot(2, 0))
+    reqs = s.requests.replace(present=jnp.zeros_like(s.requests.present))
+    reqs = put(reqs, ACCEPT, p=0, a=1, bal=b, v1=42)
+    acc = s.acceptor.replace(promised=s.acceptor.promised.at[:, 1].set(b))
+    s = s.replace(requests=reqs, acceptor=acc)
+
+    s2 = paxos_step(s, KEY, plan, CFG)
+    assert int(s2.acceptor.acc_bal[0, 1]) == b
+    assert int(s2.acceptor.acc_val[0, 1]) == 42
+    assert bool(s2.replies.present[0, ACCEPTED, 0, 1])
+    # Learner recorded the accept event for (b, 42) by acceptor 1.
+    assert int(s2.learner.lt_mask.sum(axis=-1)[0]) == 2  # bit 1
+    assert int(s2.learner.violations.sum()) == 0
+
+
+def test_proposer_adopts_highest_accepted_value():
+    s, plan = fresh(n_inst=1, n_prop=1, n_acc=3)
+    b = int(s.proposer.bal[0, 0])  # round-0 ballot, phase P1
+    reps = s.replies
+    reps = put(reps, PROMISE, p=0, a=0, bal=b, v1=0, v2=0)
+    # Acceptor 1 previously accepted (5, 77): its promise carries the pair.
+    reps = put(reps, PROMISE, p=0, a=1, bal=b, v1=5, v2=77)
+    s = s.replace(replies=reps)
+    s = s.replace(requests=s.requests.replace(present=jnp.zeros_like(s.requests.present)))
+
+    s2 = paxos_step(s, KEY, plan, CFG)
+    assert int(s2.proposer.phase[0, 0]) == P2  # quorum of 2/3 promises
+    assert int(s2.proposer.prop_val[0, 0]) == 77  # adopted, NOT own value
+    for a in range(3):
+        assert bool(s2.requests.present[0, ACCEPT, 0, a])
+        assert int(s2.requests.v1[0, ACCEPT, 0, a]) == 77
+        assert int(s2.requests.bal[0, ACCEPT, 0, a]) == b
+
+
+def test_proposer_decides_on_accepted_quorum():
+    s, plan = fresh(n_inst=1, n_prop=1, n_acc=3)
+    b = int(s.proposer.bal[0, 0])
+    prop = s.proposer.replace(
+        phase=s.proposer.phase.at[0, 0].set(P2),
+        prop_val=s.proposer.prop_val.at[0, 0].set(100),
+    )
+    reps = s.replies
+    reps = put(reps, ACCEPTED, p=0, a=0, bal=b, v1=100)
+    reps = put(reps, ACCEPTED, p=0, a=2, bal=b, v1=100)
+    s = s.replace(
+        proposer=prop,
+        replies=reps,
+        requests=s.requests.replace(present=jnp.zeros_like(s.requests.present)),
+    )
+
+    s2 = paxos_step(s, KEY, plan, CFG)
+    assert int(s2.proposer.phase[0, 0]) == DONE
+    assert int(s2.proposer.decided_val[0, 0]) == 100
+
+
+def test_stale_ballot_replies_ignored():
+    s, plan = fresh(n_inst=1, n_prop=1, n_acc=3)
+    stale = 999  # not the proposer's current ballot
+    reps = put(s.replies, PROMISE, p=0, a=0, bal=stale, v1=0, v2=0)
+    s = s.replace(
+        replies=reps,
+        requests=s.requests.replace(present=jnp.zeros_like(s.requests.present)),
+    )
+    s2 = paxos_step(s, KEY, plan, CFG)
+    assert int(s2.proposer.heard[0, 0]) == 0
+    assert int(s2.proposer.phase[0, 0]) == P1
+    assert not bool(s2.replies.present[0, PROMISE, 0, 0])  # consumed anyway
