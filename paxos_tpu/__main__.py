@@ -1,0 +1,3 @@
+from paxos_tpu.harness.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,41 @@
+"""Liveness statistics: decided-by-tick curves and stuck-instance detection.
+
+Reference parity (SURVEY.md §3.3 `check/liveness`, §6.5): the reference's
+liveness story is "the master blocks on `expect` until the decision arrives"
+[CH]; at batch scale that becomes distributional statistics computed
+on-device from `LearnerState.chosen_tick`:
+
+- ``decided_by(k)``: fraction of instances whose value was chosen by tick k
+  (the decided-by-round-k statistic of SURVEY.md §6).
+- ``chosen_tick_histogram``: decision-latency distribution over instances.
+- ``stuck_mask``: instances still undecided after a tick budget — under a
+  fair scheduler these indicate livelock (e.g. dueling proposers without
+  backoff), the classic Paxos liveness failure (FLP-adjacent), which the
+  fuzzer is meant to surface, not hide.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paxos_tpu.core.state import LearnerState
+
+
+def decided_by(learner: LearnerState, k) -> jnp.ndarray:
+    """Fraction of instances chosen at tick <= k (scalar float32)."""
+    ok = learner.chosen & (learner.chosen_tick <= k)
+    return ok.mean(dtype=jnp.float32)
+
+
+def chosen_tick_histogram(
+    learner: LearnerState, n_bins: int, bin_width: int
+) -> jnp.ndarray:
+    """(n_bins,) int32 histogram of decision ticks; undecided in the last bin."""
+    t = jnp.where(learner.chosen, learner.chosen_tick, jnp.iinfo(jnp.int32).max)
+    binned = jnp.clip(t // bin_width, 0, n_bins - 1)
+    return jnp.zeros((n_bins,), jnp.int32).at[binned].add(1)
+
+
+def stuck_mask(learner: LearnerState, budget_ticks: int, now) -> jnp.ndarray:
+    """(I,) bool: still undecided although ``budget_ticks`` have elapsed."""
+    return ~learner.chosen & (jnp.asarray(now) >= budget_ticks)

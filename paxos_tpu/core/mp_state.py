@@ -1,0 +1,198 @@
+"""Struct-of-arrays state for Multi-Paxos log replication (BASELINE config 3).
+
+Reference parity: the reference implements single-decree Paxos only
+(SURVEY.md §1 [B]); Multi-Paxos is part of the north-star config set
+(BASELINE.json configs[2]).  Design per SURVEY.md §6.7/§8.4.6: the log is a
+statically-bounded per-instance array axis ``L`` (no dynamic shapes on TPU);
+long-log scaling comes from chunked scans, not unbounded arrays.
+
+Protocol shape: classic Multi-Paxos with a distinguished leader.
+
+- Phase 1 (leader election) covers the WHOLE log: one ``Prepare(b)``; the
+  ``Promise(b)`` reply carries the acceptor's accepted (ballot, value) pair
+  for every slot (the new leader's recovery information).
+- The leader then drives phase 2 slot-by-slot (pipeline width 1): it
+  re-proposes from slot 0 upward, adopting the highest accepted value per
+  slot — re-confirming already-chosen slots is safe (it adopts the chosen
+  value) and costs at most L extra rounds per leadership change.
+- Leases are failure-detection-by-progress: followers watch the instance's
+  chosen count; no new slot chosen for ``lease_len`` ticks means the leader
+  is presumed dead and a follower runs phase 1 with a higher ballot.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from paxos_tpu.core.ballot import make_ballot
+from paxos_tpu.core.messages import MsgBuf
+
+# Proposer phases
+FOLLOW = 0  # passive: watching progress, lease ticking
+CANDIDATE = 1  # phase-1 outstanding
+LEAD = 2  # distinguished leader, driving slots
+
+
+@struct.dataclass
+class MPAcceptorState:
+    promised: jnp.ndarray  # (I, A) int32 — one promise covers every slot
+    log_bal: jnp.ndarray  # (I, A, L) int32 accepted ballot per slot
+    log_val: jnp.ndarray  # (I, A, L) int32 accepted value per slot
+
+    @classmethod
+    def init(cls, n_inst: int, n_acc: int, log_len: int) -> "MPAcceptorState":
+        return cls(
+            promised=jnp.zeros((n_inst, n_acc), jnp.int32),
+            log_bal=jnp.zeros((n_inst, n_acc, log_len), jnp.int32),
+            log_val=jnp.zeros((n_inst, n_acc, log_len), jnp.int32),
+        )
+
+
+@struct.dataclass
+class MPProposerState:
+    bal: jnp.ndarray  # (I, P) int32 current ballot
+    phase: jnp.ndarray  # (I, P) int32 in {FOLLOW, CANDIDATE, LEAD}
+    heard: jnp.ndarray  # (I, P) int32 acceptor bitmask (phase-1 or current slot)
+    commit_idx: jnp.ndarray  # (I, P) int32 next slot this leader drives
+    recov_bal: jnp.ndarray  # (I, P, L) int32 highest accepted ballot per slot (from promises)
+    recov_val: jnp.ndarray  # (I, P, L) int32 its value
+    lease_timer: jnp.ndarray  # (I, P) int32 ticks since observed progress
+    last_chosen_count: jnp.ndarray  # (I, P) int32 chosen slots last observed
+    candidate_timer: jnp.ndarray  # (I, P) int32 ticks spent as candidate
+
+    @classmethod
+    def init(
+        cls, n_inst: int, n_prop: int, log_len: int, lease_init: int = 0
+    ) -> "MPProposerState":
+        def z():
+            return jnp.zeros((n_inst, n_prop), jnp.int32)
+
+        return cls(
+            bal=z(),  # NIL: nobody has a ballot until first election
+            phase=z(),  # FOLLOW
+            heard=z(),
+            commit_idx=z(),
+            recov_bal=jnp.zeros((n_inst, n_prop, log_len), jnp.int32),
+            recov_val=jnp.zeros((n_inst, n_prop, log_len), jnp.int32),
+            # Head start: the first election should not wait a full lease.
+            lease_timer=jnp.full((n_inst, n_prop), lease_init, jnp.int32),
+            last_chosen_count=z(),
+            candidate_timer=z(),
+        )
+
+
+@struct.dataclass
+class MPLearnerState:
+    """Per-(instance, slot) chosen tracking + agreement checking.
+
+    K rows of (ballot, value) -> voter bitmask per slot (K small: honest
+    Multi-Paxos uses few ballots per slot; evictions are counted).
+    """
+
+    lt_bal: jnp.ndarray  # (I, L, K) int32
+    lt_val: jnp.ndarray  # (I, L, K) int32
+    lt_mask: jnp.ndarray  # (I, L, K) int32
+    chosen: jnp.ndarray  # (I, L) bool
+    chosen_val: jnp.ndarray  # (I, L) int32
+    chosen_tick: jnp.ndarray  # (I, L) int32 (-1 if not chosen)
+    violations: jnp.ndarray  # (I,) int32
+    evictions: jnp.ndarray  # (I,) int32
+
+    @classmethod
+    def init(cls, n_inst: int, log_len: int, k: int = 4) -> "MPLearnerState":
+        def zk():
+            return jnp.zeros((n_inst, log_len, k), jnp.int32)
+
+        return cls(
+            lt_bal=zk(),
+            lt_val=zk(),
+            lt_mask=zk(),
+            chosen=jnp.zeros((n_inst, log_len), jnp.bool_),
+            chosen_val=jnp.zeros((n_inst, log_len), jnp.int32),
+            chosen_tick=jnp.full((n_inst, log_len), -1, jnp.int32),
+            violations=jnp.zeros((n_inst,), jnp.int32),
+            evictions=jnp.zeros((n_inst,), jnp.int32),
+        )
+
+
+@struct.dataclass
+class PromiseBuf:
+    """Promise replies with full-log recovery payload: one slot per (p, a) edge."""
+
+    present: jnp.ndarray  # (I, P, A) bool
+    bal: jnp.ndarray  # (I, P, A) int32 — the promised ballot
+    pb: jnp.ndarray  # (I, P, A, L) int32 — accepted ballot per log slot
+    pv: jnp.ndarray  # (I, P, A, L) int32 — accepted value per log slot
+
+    @classmethod
+    def empty(cls, n_inst: int, n_prop: int, n_acc: int, log_len: int) -> "PromiseBuf":
+        return cls(
+            present=jnp.zeros((n_inst, n_prop, n_acc), jnp.bool_),
+            bal=jnp.zeros((n_inst, n_prop, n_acc), jnp.int32),
+            pb=jnp.zeros((n_inst, n_prop, n_acc, log_len), jnp.int32),
+            pv=jnp.zeros((n_inst, n_prop, n_acc, log_len), jnp.int32),
+        )
+
+
+@struct.dataclass
+class AcceptedBuf:
+    """Accepted replies: (ballot, slot, value) per (p, a) edge."""
+
+    present: jnp.ndarray  # (I, P, A) bool
+    bal: jnp.ndarray  # (I, P, A) int32
+    slot: jnp.ndarray  # (I, P, A) int32
+    val: jnp.ndarray  # (I, P, A) int32
+
+    @classmethod
+    def empty(cls, n_inst: int, n_prop: int, n_acc: int) -> "AcceptedBuf":
+        return cls(
+            present=jnp.zeros((n_inst, n_prop, n_acc), jnp.bool_),
+            bal=jnp.zeros((n_inst, n_prop, n_acc), jnp.int32),
+            slot=jnp.zeros((n_inst, n_prop, n_acc), jnp.int32),
+            val=jnp.zeros((n_inst, n_prop, n_acc), jnp.int32),
+        )
+
+
+@struct.dataclass
+class MultiPaxosState:
+    """Full Multi-Paxos simulator state: one pytree, scanned and sharded."""
+
+    acceptor: MPAcceptorState
+    proposer: MPProposerState
+    learner: MPLearnerState
+    requests: MsgBuf  # p->a: kind 0 PREPARE(bal), kind 1 ACCEPT(bal, val, slot)
+    promises: PromiseBuf  # a->p
+    accepted: AcceptedBuf  # a->p
+    tick: jnp.ndarray  # () int32
+
+    @classmethod
+    def init(
+        cls,
+        n_inst: int,
+        n_prop: int,
+        n_acc: int,
+        log_len: int = 8,
+        k: int = 4,
+        lease_init: int = 0,
+    ) -> "MultiPaxosState":
+        from paxos_tpu.core.ballot import MAX_PROPOSERS
+        from paxos_tpu.utils.bitops import MAX_ACCEPTORS
+
+        if not 1 <= n_prop <= MAX_PROPOSERS:
+            raise ValueError(f"n_prop={n_prop} exceeds {MAX_PROPOSERS}")
+        if not 1 <= n_acc <= MAX_ACCEPTORS:
+            raise ValueError(f"n_acc={n_acc} exceeds {MAX_ACCEPTORS}")
+        return cls(
+            acceptor=MPAcceptorState.init(n_inst, n_acc, log_len),
+            proposer=MPProposerState.init(n_inst, n_prop, log_len, lease_init),
+            learner=MPLearnerState.init(n_inst, log_len, k),
+            requests=MsgBuf.empty(n_inst, n_prop, n_acc),
+            promises=PromiseBuf.empty(n_inst, n_prop, n_acc, log_len),
+            accepted=AcceptedBuf.empty(n_inst, n_prop, n_acc),
+            tick=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def log_len(self) -> int:
+        return self.acceptor.log_bal.shape[2]

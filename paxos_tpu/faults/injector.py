@@ -45,6 +45,7 @@ class FaultConfig:
     p_hold: float = 0.0  # a deliverable reply stays in flight this tick
     # Crash schedule (sampled once per run)
     p_crash: float = 0.0  # per (instance, acceptor): crashes at some point
+    p_crash_prop: float = 0.0  # per (instance, proposer): crashes (leader crash)
     crash_max_start: int = 32  # crash start ~ U[0, crash_max_start)
     crash_max_len: int = 16  # window length ~ U[1, crash_max_len]
     crash_forever: bool = False  # never recover instead
@@ -54,6 +55,9 @@ class FaultConfig:
     # Proposer timing
     timeout: int = 10  # ticks in a phase before retrying with higher ballot
     backoff_max: int = 8  # retry backoff ~ U[0, backoff_max) extra ticks
+    # Multi-Paxos leader lease (ticks without chosen-count progress before
+    # followers suspect the leader / a leader demotes itself)
+    lease_len: int = 24
 
 
 @struct.dataclass
@@ -63,38 +67,62 @@ class FaultPlan:
     crash_start: jnp.ndarray  # (I, A) int32 tick; NEVER if no crash
     crash_end: jnp.ndarray  # (I, A) int32 tick; NEVER if crash is permanent
     equivocate: jnp.ndarray  # (I, A) bool
+    pcrash_start: jnp.ndarray  # (I, P) int32 — proposer (leader) crash window
+    pcrash_end: jnp.ndarray  # (I, P) int32
 
     @classmethod
-    def none(cls, n_inst: int, n_acc: int) -> "FaultPlan":
-        full = jnp.full((n_inst, n_acc), NEVER, jnp.int32)
+    def none(cls, n_inst: int, n_acc: int, n_prop: int = 1) -> "FaultPlan":
         return cls(
-            crash_start=full,
-            crash_end=full,
+            crash_start=jnp.full((n_inst, n_acc), NEVER, jnp.int32),
+            crash_end=jnp.full((n_inst, n_acc), NEVER, jnp.int32),
             equivocate=jnp.zeros((n_inst, n_acc), jnp.bool_),
+            pcrash_start=jnp.full((n_inst, n_prop), NEVER, jnp.int32),
+            pcrash_end=jnp.full((n_inst, n_prop), NEVER, jnp.int32),
         )
 
     @classmethod
     def sample(
-        cls, key: jax.Array, cfg: FaultConfig, n_inst: int, n_acc: int
+        cls,
+        key: jax.Array,
+        cfg: FaultConfig,
+        n_inst: int,
+        n_acc: int,
+        n_prop: int = 1,
     ) -> "FaultPlan":
-        k_crash, k_start, k_len, k_eq = jax.random.split(key, 4)
-        shape = (n_inst, n_acc)
-        crashes = jax.random.uniform(k_crash, shape) < cfg.p_crash
-        start = jax.random.randint(k_start, shape, 0, max(cfg.crash_max_start, 1))
-        length = jax.random.randint(k_len, shape, 1, max(cfg.crash_max_len, 1) + 1)
-        crash_start = jnp.where(crashes, start, NEVER)
-        crash_end = jnp.where(
-            crashes & (not cfg.crash_forever),
-            # Guard overflow: NEVER + length would wrap.
-            jnp.minimum(start + length, NEVER - 1),
-            NEVER,
+        k_crash, k_eq, kp = jax.random.split(key, 3)
+
+        def windows(k, shape, p):
+            k1, k2, k3 = jax.random.split(k, 3)
+            crashes = jax.random.uniform(k1, shape) < p
+            start = jax.random.randint(k2, shape, 0, max(cfg.crash_max_start, 1))
+            length = jax.random.randint(k3, shape, 1, max(cfg.crash_max_len, 1) + 1)
+            c_start = jnp.where(crashes, start, NEVER)
+            c_end = jnp.where(
+                crashes & (not cfg.crash_forever),
+                # Guard overflow: NEVER + length would wrap.
+                jnp.minimum(start + length, NEVER - 1),
+                NEVER,
+            )
+            return c_start, c_end
+
+        crash_start, crash_end = windows(k_crash, (n_inst, n_acc), cfg.p_crash)
+        pcrash_start, pcrash_end = windows(kp, (n_inst, n_prop), cfg.p_crash_prop)
+        equivocate = jax.random.uniform(k_eq, (n_inst, n_acc)) < cfg.p_equiv
+        return cls(
+            crash_start=crash_start,
+            crash_end=crash_end,
+            equivocate=equivocate,
+            pcrash_start=pcrash_start,
+            pcrash_end=pcrash_end,
         )
-        equivocate = jax.random.uniform(k_eq, shape) < cfg.p_equiv
-        return cls(crash_start=crash_start, crash_end=crash_end, equivocate=equivocate)
 
     def alive(self, tick: jnp.ndarray) -> jnp.ndarray:
         """(I, A) bool: acceptor is up at ``tick``."""
         return ~((self.crash_start <= tick) & (tick < self.crash_end))
+
+    def prop_alive(self, tick: jnp.ndarray) -> jnp.ndarray:
+        """(I, P) bool: proposer is up at ``tick``."""
+        return ~((self.pcrash_start <= tick) & (tick < self.pcrash_end))
 
     def recovering(self, tick: jnp.ndarray) -> jnp.ndarray:
         """(I, A) bool: acceptor comes back up exactly at ``tick`` (for amnesia)."""

@@ -1,0 +1,74 @@
+"""Checkpoint / resume — elastic recovery for the simulator itself.
+
+Reference parity (SURVEY.md §6.4): the reference has no checkpointing
+(single-decree Paxos decides and exits; acceptor state is in-memory [?]);
+the TPU twin needs it because long fuzzing campaigns outlive TPU
+preemptions.  The full simulator state (one pytree: role arrays, message
+buffers, learner/checker accumulators, tick counter) plus the fault plan is
+saved at chunk boundaries; because per-tick PRNG keys are derived as
+``fold_in(base_key, tick)``, a resumed run replays the exact key stream and
+is bit-identical to an uninterrupted one (test: tests/test_checkpoint.py).
+
+Uses Orbax (the standard JAX checkpointing library); state arrays are
+restored host-side and can be re-sharded onto any mesh afterwards, so a run
+checkpointed on N chips can resume on M.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from paxos_tpu.core.state import PaxosState
+from paxos_tpu.faults.injector import FaultPlan
+from paxos_tpu.harness.config import SimConfig
+
+
+def save(
+    path: str | pathlib.Path,
+    state: PaxosState,
+    plan: FaultPlan,
+    cfg: SimConfig,
+) -> None:
+    """Write a complete, resumable snapshot to ``path`` (a directory)."""
+    path = pathlib.Path(path).absolute()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(
+            path,
+            {
+                "state": jax.device_get(state),
+                "plan": jax.device_get(plan),
+            },
+            force=True,
+        )
+    (path / "simconfig.json").write_text(json.dumps(dataclasses.asdict(cfg)))
+
+
+def restore(
+    path: str | pathlib.Path,
+) -> tuple[PaxosState, FaultPlan, SimConfig]:
+    """Read a snapshot back; arrays land on the default device, unsharded."""
+    path = pathlib.Path(path).absolute()
+    raw = json.loads((path / "simconfig.json").read_text())
+    fault = raw.pop("fault")
+    from paxos_tpu.faults.injector import FaultConfig
+
+    cfg = SimConfig(**raw, fault=FaultConfig(**fault))
+
+    # Restore against concrete templates so pytree structure (dataclasses,
+    # not dicts) and dtypes come back exactly.
+    from paxos_tpu.harness.run import init_state
+
+    template = {
+        "state": jax.device_get(init_state(cfg)),
+        "plan": jax.device_get(FaultPlan.none(cfg.n_inst, cfg.n_acc, cfg.n_prop)),
+    }
+    with ocp.PyTreeCheckpointer() as ckptr:
+        out = ckptr.restore(path, item=template)
+    return out["state"], out["plan"], cfg

@@ -1,0 +1,127 @@
+"""CLI — the master/slave command line's TPU twin.
+
+Reference parity (SURVEY.md §4.1, §6.6): where the reference's `main` parses
+``master|slave host port`` and boots SimpleLocalnet [CH], this CLI picks a
+named BASELINE config, scales it, runs the scan loop with optional mesh
+sharding, JSONL metrics, and periodic checkpoints, and prints the final
+report as JSON — the batch analog of "print the decided value".
+
+    python -m paxos_tpu run --config config2 --n-inst 65536 --ticks 400
+    python -m paxos_tpu run --config config4 --log metrics.jsonl
+    python -m paxos_tpu run --resume ckpt_dir --ticks 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+from paxos_tpu.harness import config as config_mod
+from paxos_tpu.harness.config import SimConfig
+
+CONFIGS = {
+    "config1": config_mod.config1_no_faults,
+    "config2": config_mod.config2_dueling_drop,
+    "config3": config_mod.config3_multipaxos,
+    "config4": config_mod.config4_byzantine,
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="paxos_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("run", help="run a fuzzing campaign")
+    r.add_argument("--config", choices=sorted(CONFIGS), default="config2")
+    r.add_argument("--n-inst", type=int, default=None, help="override instance count")
+    r.add_argument("--seed", type=int, default=0)
+    r.add_argument("--ticks", type=int, default=256, help="total scheduler ticks")
+    r.add_argument("--chunk", type=int, default=64, help="ticks per device dispatch")
+    r.add_argument("--until-all-chosen", action="store_true")
+    r.add_argument("--shard", action="store_true", help="shard over all devices")
+    r.add_argument("--log", default=None, help="JSONL metrics path")
+    r.add_argument("--checkpoint-dir", default=None)
+    r.add_argument("--checkpoint-every", type=int, default=0, help="ticks (0=off)")
+    r.add_argument("--resume", default=None, help="checkpoint dir to resume from")
+    return p
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    import jax
+
+    from paxos_tpu.harness import checkpoint as ckpt
+    from paxos_tpu.harness.metrics import MetricsLog
+    from paxos_tpu.harness.run import (
+        base_key,
+        get_step_fn,
+        init_plan,
+        init_state,
+        run_chunk,
+        summarize,
+    )
+    from paxos_tpu.parallel.mesh import make_mesh, shard_pytree
+
+    if args.checkpoint_every and not args.checkpoint_dir:
+        print("error: --checkpoint-every requires --checkpoint-dir", file=sys.stderr)
+        return 1
+
+    log = MetricsLog(args.log)
+    if args.resume:
+        state, plan, cfg = ckpt.restore(args.resume)
+        log.emit("resume", path=args.resume, tick=int(state.tick))
+    else:
+        kw = {"seed": args.seed}
+        if args.n_inst:
+            kw["n_inst"] = args.n_inst
+        cfg = CONFIGS[args.config](**kw)
+        state, plan = init_state(cfg), init_plan(cfg)
+
+    if args.shard:
+        mesh = make_mesh()
+        state = shard_pytree(state, mesh, cfg.n_inst)
+        plan = shard_pytree(plan, mesh, cfg.n_inst)
+        log.emit("mesh", devices=len(mesh.devices))
+
+    step_fn = get_step_fn(cfg.protocol)
+    key = base_key(cfg)
+    log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
+             n_inst=cfg.n_inst, protocol=cfg.protocol)
+
+    done, since_ckpt = 0, 0
+    while done < args.ticks:
+        n = min(args.chunk, args.ticks - done)
+        state = run_chunk(state, key, plan, cfg.fault, n, step_fn)
+        done += n
+        since_ckpt += n
+        rep = summarize(state)
+        log.emit("chunk", **rep)
+        if args.checkpoint_every and since_ckpt >= args.checkpoint_every:
+            ckpt.save(args.checkpoint_dir, state, plan, cfg)
+            log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
+            since_ckpt = 0
+        # Exact check (a float32 mean can round to != 1.0 at huge scales).
+        if args.until_all_chosen and bool(state.learner.chosen.all()):
+            break
+
+    report = summarize(state)
+    report["config_fingerprint"] = cfg.fingerprint()
+    if args.checkpoint_dir:
+        ckpt.save(args.checkpoint_dir, state, plan, cfg)
+        log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
+    log.emit("final", **report)
+    log.close()
+    print(json.dumps(report))
+    return 0 if report["violations"] == 0 else 2
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "run":
+        return cmd_run(args)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,7 @@ class SimConfig:
     n_prop: int = 1
     n_acc: int = 3
     k_slots: int = 8  # learner-table capacity
+    log_len: int = 8  # Multi-Paxos replicated-log length
     seed: int = 0
     protocol: str = "paxos"
     fault: FaultConfig = dataclasses.field(default_factory=FaultConfig)
@@ -59,9 +60,20 @@ def config3_multipaxos(n_inst: int = 1_000_000, seed: int = 0) -> SimConfig:
         n_inst=n_inst,
         n_prop=2,
         n_acc=5,
+        log_len=8,
+        k_slots=4,  # per-slot table rows; plenty with re-confirmation suppression
         seed=seed,
         protocol="multipaxos",
-        fault=FaultConfig(p_drop=0.05, p_idle=0.1, p_hold=0.1, p_crash=0.2),
+        fault=FaultConfig(
+            p_drop=0.05,
+            p_idle=0.1,
+            p_hold=0.1,
+            p_crash=0.1,
+            p_crash_prop=0.4,  # leader crash is the config's point
+            crash_max_start=150,
+            crash_max_len=40,
+            lease_len=24,
+        ),
     )
 
 

@@ -1,0 +1,53 @@
+"""Observability: structured JSONL metrics and profiler scopes.
+
+Reference parity (SURVEY.md §6.1, §6.5): the reference's observability is
+stdout printing plus the distributed-process Mx tracing bus (per-event hooks
+on send/receive/spawn) [CH].  The TPU twin keeps all counters on-device
+(they live inside `LearnerState` and are reduced in `summarize`) and, on the
+host side, appends one JSON object per chunk to a JSONL stream — the
+structured twin of the Mx trace log.  `trace_scope` wraps phases in
+`jax.profiler.TraceAnnotation` so device profiles show deliver/vote/emit
+sections by name.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import pathlib
+import time
+from typing import Any, Iterator, Optional, TextIO
+
+import jax
+
+
+class MetricsLog:
+    """Append-only JSONL metrics stream with a wall-clock and tick context."""
+
+    def __init__(self, path: "str | pathlib.Path | None" = None) -> None:
+        self._fh: Optional[TextIO] = None
+        if path is not None:
+            p = pathlib.Path(path)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = p.open("a")
+        self._t0 = time.monotonic()
+
+    def emit(self, event: str, **fields: Any) -> dict[str, Any]:
+        rec = {"event": event, "t_wall": round(time.monotonic() - self._t0, 4)}
+        rec.update(fields)
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        return rec
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+@contextlib.contextmanager
+def trace_scope(name: str) -> Iterator[None]:
+    """Named region in device profiles (no-op overhead when not profiling)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

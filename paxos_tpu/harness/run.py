@@ -26,16 +26,31 @@ def get_step_fn(protocol: str) -> Callable:
         from paxos_tpu.protocols.paxos import paxos_step
 
         return paxos_step
+    if protocol == "multipaxos":
+        from paxos_tpu.protocols.multipaxos import multipaxos_step
+
+        return multipaxos_step
     raise ValueError(f"unknown protocol: {protocol!r}")
 
 
-def init_state(cfg: SimConfig) -> PaxosState:
+def init_state(cfg: SimConfig):
+    if cfg.protocol == "multipaxos":
+        from paxos_tpu.core.mp_state import MultiPaxosState
+
+        return MultiPaxosState.init(
+            cfg.n_inst,
+            cfg.n_prop,
+            cfg.n_acc,
+            cfg.log_len,
+            k=cfg.k_slots,
+            lease_init=cfg.fault.lease_len,
+        )
     return PaxosState.init(cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots)
 
 
 def init_plan(cfg: SimConfig) -> FaultPlan:
     key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 1)
-    return FaultPlan.sample(key, cfg.fault, cfg.n_inst, cfg.n_acc)
+    return FaultPlan.sample(key, cfg.fault, cfg.n_inst, cfg.n_acc, cfg.n_prop)
 
 
 def base_key(cfg: SimConfig) -> jax.Array:
@@ -68,31 +83,37 @@ def summarize(state: PaxosState) -> dict[str, Any]:
     Reductions run on-device (sharded states psum automatically under jit);
     only scalars come back to the host.
     """
-    n_inst = state.learner.chosen.shape[0]
     lrn, prop = state.learner, state.proposer
-    chosen = lrn.chosen
-    decided = (prop.phase == DONE).any(axis=-1)
-    # A proposer that believes it decided v while the learner chose v' != v
-    # is a cross-role disagreement — counted as a safety signal.
-    disagree = (
-        (prop.phase == DONE) & chosen[:, None] & (prop.decided_val != lrn.chosen_val[:, None])
-    ).any(axis=-1)
-    mean_tick = jnp.where(
-        chosen.any(),
-        jnp.where(chosen, lrn.chosen_tick, 0).sum(dtype=jnp.float32)
-        / jnp.maximum(chosen.sum(), 1),
-        -1.0,
-    )
+    chosen = lrn.chosen  # (I,) single-decree, (I, L) multipaxos
+
+    # Shared, shape-polymorphic fields.
     out = {
-        "n_inst": n_inst,
+        "n_inst": chosen.shape[0],
         "ticks": state.tick,
         "chosen_frac": chosen.mean(dtype=jnp.float32),
-        "decided_frac": decided.mean(dtype=jnp.float32),
         "violations": lrn.violations.sum(),
         "evictions": lrn.evictions.sum(),
-        "proposer_disagree": disagree.sum(),
-        "mean_choose_tick": mean_tick,
+        "mean_choose_tick": jnp.where(
+            chosen.any(),
+            jnp.where(chosen, lrn.chosen_tick, 0).sum(dtype=jnp.float32)
+            / jnp.maximum(chosen.sum(), 1),
+            -1.0,
+        ),
     }
+
+    if chosen.ndim == 2:  # Multi-Paxos: chosen_frac is slot-level
+        out["decided_frac"] = chosen.all(axis=-1).mean(dtype=jnp.float32)  # full logs
+        out["proposer_disagree"] = jnp.zeros((), jnp.int32)  # n/a: leaders adopt
+    else:
+        out["decided_frac"] = (prop.phase == DONE).any(axis=-1).mean(dtype=jnp.float32)
+        # A proposer that believes it decided v while the learner chose v' != v
+        # is a cross-role disagreement — counted as a safety signal.
+        out["proposer_disagree"] = (
+            (prop.phase == DONE)
+            & chosen[:, None]
+            & (prop.decided_val != lrn.chosen_val[:, None])
+        ).any(axis=-1).sum()
+
     return {k: (v.item() if hasattr(v, "item") else v) for k, v in jax.device_get(out).items()}
 
 

@@ -1,0 +1,272 @@
+"""Multi-Paxos log replication — leader lease, crash, recovery (config 3).
+
+Same fused-tick structure as :mod:`paxos_tpu.protocols.paxos` (one message
+per acceptor per tick, commutative reply folds at proposers), extended with:
+
+- **Whole-log phase 1**: a candidate's ``Prepare(b)`` covers all L slots;
+  each ``Promise(b)`` carries the acceptor's full accepted-(ballot, value)
+  log, max-folded per slot into the new leader's recovery arrays.
+- **Slot-by-slot phase 2**: the leader re-proposes from slot 0, adopting the
+  highest accepted value per slot (re-confirming chosen slots re-chooses the
+  same value, so leadership changes are safe).  The leader re-broadcasts the
+  current slot's ``Accept`` every tick — idempotent at acceptors and
+  self-healing under message loss, so no per-slot retry machinery exists.
+- **Progress leases**: failure detection by observed progress (SURVEY.md
+  §4.4's declarative twin of monitors).  Every proposer watches the
+  instance's chosen-slot count; ``lease_len`` ticks without progress make a
+  follower start an election (staggered + jittered) and make a stale leader
+  demote itself.
+- **Leader crash windows** from the fault plan: a crashed proposer does
+  nothing and drops to follower on recovery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.check.mp_safety import mp_learner_observe
+from paxos_tpu.core import ballot as bal_mod
+from paxos_tpu.core.messages import ACCEPT, PREPARE
+from paxos_tpu.core.mp_state import CANDIDATE, FOLLOW, LEAD, MultiPaxosState
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.kernels.quorum import majority, quorum_reached
+from paxos_tpu.transport import inmemory_tpu as net
+
+
+def own_slot_value(pid: jnp.ndarray, slot: jnp.ndarray) -> jnp.ndarray:
+    """Distinct per (proposer, slot) command payload: duels are observable."""
+    return (pid + 1) * 1000 + slot
+
+
+def multipaxos_step(
+    state: MultiPaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+) -> MultiPaxosState:
+    n_inst, n_acc = state.acceptor.promised.shape
+    n_prop = state.proposer.bal.shape[1]
+    n_slots = state.log_len
+    quorum = majority(n_acc)
+
+    key = jax.random.fold_in(base_key, state.tick)
+    (k_sel, k_dup_req, k_hold_pr, k_hold_ac, k_drop_pr, k_drop_ac,
+     k_drop_prep, k_drop_acc, k_jit, k_back) = jax.random.split(key, 10)
+
+    acc = state.acceptor
+    prop = state.proposer
+    alive = plan.alive(state.tick)  # (I, A)
+    p_alive = plan.prop_alive(state.tick)  # (I, P)
+    equiv = plan.equivocate  # (I, A)
+
+    if cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
+        rec = plan.recovering(state.tick)
+        acc = acc.replace(
+            promised=jnp.where(rec, 0, acc.promised),
+            log_bal=jnp.where(rec[:, :, None], 0, acc.log_bal),
+            log_val=jnp.where(rec[:, :, None], 0, acc.log_val),
+        )
+
+    # ---- Reply delivery decided & cleared before new writes (no clobber) ----
+    prom_del = state.promises.present & (
+        jax.random.uniform(k_hold_pr, state.promises.present.shape) >= cfg.p_hold
+    )
+    accd_del = state.accepted.present & (
+        jax.random.uniform(k_hold_ac, state.accepted.present.shape) >= cfg.p_hold
+    )
+    promises = state.promises.replace(present=state.promises.present & ~prom_del)
+    accepted = state.accepted.replace(present=state.accepted.present & ~accd_del)
+
+    # ---- Acceptor half-tick ----
+    sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
+    sel = sel & alive[:, None, None, :]
+
+    def gather(x):
+        return jnp.where(sel, x, 0).sum(axis=(1, 2))
+
+    msg_bal = gather(state.requests.bal)  # (I, A)
+    msg_val = gather(state.requests.v1)  # (I, A)
+    msg_slot = gather(state.requests.v2)  # (I, A)
+    is_prep = sel[:, PREPARE].any(axis=1)
+    is_acc = sel[:, ACCEPT].any(axis=1)
+
+    ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
+    ok_prep = ok_prep_h | (is_prep & equiv)
+    ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised)
+    ok_acc = ok_acc_h | (is_acc & equiv)
+
+    promised = jnp.where(ok_prep_h, msg_bal, acc.promised)
+    promised = jnp.where(ok_acc_h, jnp.maximum(promised, msg_bal), promised)
+    oh_slot = jax.nn.one_hot(msg_slot, n_slots, dtype=jnp.bool_)  # (I, A, L)
+    wr = ok_acc[:, :, None] & oh_slot
+    log_bal = jnp.where(wr, msg_bal[:, :, None], acc.log_bal)
+    log_val = jnp.where(wr, msg_val[:, :, None], acc.log_val)
+
+    # Promise replies carry the acceptor's full log (equivocators hide theirs).
+    prom_send = sel[:, PREPARE] & ok_prep[:, None, :]  # (I, P, A)
+    if cfg.p_drop > 0.0:
+        prom_send = prom_send & (
+            jax.random.uniform(k_drop_pr, prom_send.shape) >= cfg.p_drop
+        )
+    payload_pb = jnp.where(equiv[:, :, None], 0, acc.log_bal)  # (I, A, L)
+    payload_pv = jnp.where(equiv[:, :, None], 0, acc.log_val)
+    promises = promises.replace(
+        present=promises.present | prom_send,
+        bal=jnp.where(prom_send, msg_bal[:, None, :], promises.bal),
+        pb=jnp.where(prom_send[..., None], payload_pb[:, None], promises.pb),
+        pv=jnp.where(prom_send[..., None], payload_pv[:, None], promises.pv),
+    )
+
+    accd_send = sel[:, ACCEPT] & ok_acc[:, None, :]  # (I, P, A)
+    if cfg.p_drop > 0.0:
+        accd_send = accd_send & (
+            jax.random.uniform(k_drop_ac, accd_send.shape) >= cfg.p_drop
+        )
+    accepted = accepted.replace(
+        present=accepted.present | accd_send,
+        bal=jnp.where(accd_send, msg_bal[:, None, :], accepted.bal),
+        slot=jnp.where(accd_send, msg_slot[:, None, :], accepted.slot),
+        val=jnp.where(accd_send, msg_val[:, None, :], accepted.val),
+    )
+
+    requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
+    acc = acc.replace(promised=promised, log_bal=log_bal, log_val=log_val)
+
+    # ---- Learner / checker ----
+    learner = mp_learner_observe(
+        state.learner, ok_acc, msg_bal, msg_slot, msg_val, state.tick, quorum
+    )
+    chosen_count = learner.chosen.sum(axis=-1, dtype=jnp.int32)  # (I,)
+
+    # ---- Proposer half-tick ----
+    bits = jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32)
+    cur_bal = prop.bal[:, :, None]  # (I, P, 1)
+
+    # Promises (phase 1): voter bits + per-slot max-fold of recovery pairs.
+    pv_ok = prom_del & (state.promises.bal == cur_bal) & (
+        prop.phase == CANDIDATE
+    )[:, :, None]  # (I, P, A)
+    heard = prop.heard | jnp.where(pv_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
+    cand_pb = jnp.where(pv_ok[..., None], state.promises.pb, 0)  # (I, P, A, L)
+    best_a = jnp.argmax(cand_pb, axis=2)  # (I, P, L)
+    cand_bal = jnp.take_along_axis(cand_pb, best_a[:, :, None, :], axis=2)[:, :, 0, :]
+    cand_val = jnp.take_along_axis(
+        jnp.where(pv_ok[..., None], state.promises.pv, 0), best_a[:, :, None, :], axis=2
+    )[:, :, 0, :]
+    improve = cand_bal > prop.recov_bal  # (I, P, L)
+    recov_bal = jnp.where(improve, cand_bal, prop.recov_bal)
+    recov_val = jnp.where(improve, cand_val, prop.recov_val)
+
+    # Accepted (phase 2): only votes for the slot currently being driven.
+    av_ok = (
+        accd_del
+        & (state.accepted.bal == cur_bal)
+        & (state.accepted.slot == prop.commit_idx[:, :, None])
+        & (prop.phase == LEAD)[:, :, None]
+    )
+    heard = heard | jnp.where(av_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
+
+    # Transitions.
+    p1_done = (prop.phase == CANDIDATE) & quorum_reached(heard, quorum)
+    slot_done = (
+        (prop.phase == LEAD)
+        & quorum_reached(heard, quorum)
+        & (prop.commit_idx < n_slots)
+    )
+
+    # Progress lease: any new chosen slot in this instance resets every
+    # proposer's suspicion timer.
+    progressed = chosen_count[:, None] > prop.last_chosen_count  # (I, P)
+    lease_timer = jnp.where(progressed, 0, prop.lease_timer + 1)
+    last_chosen_count = jnp.maximum(prop.last_chosen_count, chosen_count[:, None])
+
+    log_full = chosen_count[:, None] >= n_slots  # (I, P): nothing left to do
+    lease_out = lease_timer > cfg.lease_len
+
+    # Election trigger: staggered so proposers don't collide every time.
+    pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), prop.bal.shape)
+    jitter = jax.random.randint(k_jit, prop.bal.shape, 0, max(cfg.backoff_max, 1))
+    start_elec = (
+        (prop.phase == FOLLOW)
+        & p_alive
+        & ~log_full
+        & (lease_timer > cfg.lease_len + pid * 3 + jitter)
+    )
+    new_bal = bal_mod.make_ballot(bal_mod.ballot_round(prop.bal) + 1, pid)
+
+    # Candidate timeout: back to follower, retry later with the next ballot.
+    candidate_timer = jnp.where(prop.phase == CANDIDATE, prop.candidate_timer + 1, 0)
+    cand_fail = (prop.phase == CANDIDATE) & (candidate_timer > cfg.timeout) & ~p1_done
+
+    # Stale leader demotes itself after a lease of no progress.
+    demote = (prop.phase == LEAD) & lease_out & ~slot_done & ~log_full
+
+    phase = prop.phase
+    phase = jnp.where(start_elec, CANDIDATE, phase)
+    phase = jnp.where(p1_done, LEAD, phase)
+    phase = jnp.where(cand_fail | demote, FOLLOW, phase)
+    phase = jnp.where(~p_alive, FOLLOW, phase)  # crashed -> follower on recovery
+
+    bal_next = jnp.where(start_elec, new_bal, prop.bal)
+    commit_idx = jnp.where(p1_done, 0, prop.commit_idx)
+    commit_idx = jnp.where(slot_done, commit_idx + 1, commit_idx)
+    heard = jnp.where(p1_done | slot_done | start_elec | cand_fail | demote, 0, heard)
+    recov_bal = jnp.where(start_elec[:, :, None], 0, recov_bal)
+    recov_val = jnp.where(start_elec[:, :, None], 0, recov_val)
+    lease_timer = jnp.where(start_elec | p1_done | slot_done, 0, lease_timer)
+    # Failed candidacy / demotion: retreat below the election threshold by a
+    # random backoff so rivals separate instead of re-colliding every tick.
+    backoff = jax.random.randint(
+        k_back, lease_timer.shape, 0, 2 * max(cfg.backoff_max, 1)
+    )
+    lease_timer = jnp.where(cand_fail | demote, cfg.lease_len - backoff, lease_timer)
+    candidate_timer = jnp.where(start_elec, 0, candidate_timer)
+
+    # ---- Emit ----
+    # New candidates broadcast Prepare(b) once (retries via cand_fail cycle).
+    prep_mask = jnp.broadcast_to(
+        (start_elec & p_alive)[:, :, None], (n_inst, n_prop, n_acc)
+    )
+    requests = net.send(
+        requests, PREPARE,
+        send_mask=prep_mask,
+        bal=bal_next[:, :, None],
+        v1=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        key=k_drop_prep, p_drop=cfg.p_drop,
+    )
+    # Leaders re-broadcast the current slot's Accept every tick (idempotent,
+    # self-healing under loss).
+    is_lead = (phase == LEAD) & p_alive & (commit_idx < n_slots)
+    ci = jnp.minimum(commit_idx, n_slots - 1)
+    rb = jnp.take_along_axis(recov_bal, ci[:, :, None], axis=-1)[:, :, 0]
+    rv = jnp.take_along_axis(recov_val, ci[:, :, None], axis=-1)[:, :, 0]
+    pval = jnp.where(rb > 0, rv, own_slot_value(pid, ci))  # (I, P)
+    requests = net.send(
+        requests, ACCEPT,
+        send_mask=jnp.broadcast_to(is_lead[:, :, None], (n_inst, n_prop, n_acc)),
+        bal=bal_next[:, :, None],
+        v1=pval[:, :, None],
+        v2=ci[:, :, None],
+        key=k_drop_acc, p_drop=cfg.p_drop,
+    )
+
+    prop = prop.replace(
+        bal=bal_next,
+        phase=phase,
+        heard=heard,
+        commit_idx=commit_idx,
+        recov_bal=recov_bal,
+        recov_val=recov_val,
+        lease_timer=lease_timer,
+        last_chosen_count=last_chosen_count,
+        candidate_timer=candidate_timer,
+    )
+
+    return state.replace(
+        acceptor=acc,
+        proposer=prop,
+        learner=learner,
+        requests=requests,
+        promises=promises,
+        accepted=accepted,
+        tick=state.tick + 1,
+    )

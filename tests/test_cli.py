@@ -1,0 +1,55 @@
+"""CLI and observability: run subcommand, JSONL metrics, liveness stats."""
+
+import json
+
+import jax.numpy as jnp
+
+from paxos_tpu.check.liveness import chosen_tick_histogram, decided_by, stuck_mask
+from paxos_tpu.harness.cli import main
+from paxos_tpu.harness.config import config1_no_faults
+from paxos_tpu.harness.run import run
+
+
+def test_cli_run_writes_metrics_and_reports(tmp_path, capsys):
+    log = tmp_path / "m.jsonl"
+    rc = main([
+        "run", "--config", "config1", "--n-inst", "256", "--ticks", "32",
+        "--chunk", "16", "--log", str(log), "--until-all-chosen",
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["violations"] == 0
+    assert report["chosen_frac"] == 1.0
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "start" and kinds[-1] == "final"
+    assert "chunk" in kinds
+
+
+def test_cli_checkpoint_resume_roundtrip(tmp_path, capsys):
+    ck = tmp_path / "ck"
+    rc = main([
+        "run", "--config", "config1", "--n-inst", "128", "--ticks", "16",
+        "--chunk", "8", "--checkpoint-dir", str(ck),
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["run", "--resume", str(ck), "--ticks", "16", "--chunk", "8"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["ticks"] == 32  # resumed at 16, ran 16 more
+
+
+def test_liveness_stats():
+    _, state = run(
+        config1_no_faults(n_inst=256, seed=2),
+        until_all_chosen=True,
+        max_ticks=64,
+        return_state=True,
+    )
+    lrn = state.learner
+    assert float(decided_by(lrn, 64)) == 1.0
+    assert float(decided_by(lrn, 0)) < 1.0
+    hist = chosen_tick_histogram(lrn, n_bins=8, bin_width=8)
+    assert int(hist.sum()) == 256
+    assert not bool(stuck_mask(lrn, 64, state.tick).any())
