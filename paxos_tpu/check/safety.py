@@ -40,13 +40,27 @@ def learner_observe(
     ev_val: jnp.ndarray,  # (I, A) int32
     tick: jnp.ndarray,  # () int32
     quorum: int,
+    fast_quorum: int | None = None,
 ) -> LearnerState:
-    """Fold this tick's accept events into the learner table; update chosen/violations."""
+    """Fold this tick's accept events into the learner table; update chosen/violations.
+
+    With ``fast_quorum`` set (Fast Paxos), ballots of round 0 — the fast
+    round — need ``fast_quorum`` voters to be chosen; classic rounds (>= 1)
+    need ``quorum``.  Per-slot thresholds are recomputed from the table's
+    ballots, so one table serves both round kinds.
+    """
     n_acc = ev_flag.shape[1]
     lt_bal, lt_val, lt_mask = learner.lt_bal, learner.lt_val, learner.lt_mask
     evictions = learner.evictions
 
-    pre_chosen_slots = popcount(lt_mask) >= quorum  # (I, K)
+    def slot_quorum(bal: jnp.ndarray) -> jnp.ndarray | int:
+        if fast_quorum is None:
+            return quorum
+        from paxos_tpu.core.ballot import ballot_round
+
+        return jnp.where(ballot_round(bal) == 0, fast_quorum, quorum)
+
+    pre_chosen_slots = popcount(lt_mask) >= slot_quorum(lt_bal)  # (I, K)
 
     # At most one accept event per acceptor per tick (one-message-per-actor
     # scheduling), so an unrolled sequential fold over the small acceptors
@@ -71,7 +85,7 @@ def learner_observe(
         lt_mask = jnp.where(ins, bit, lt_mask)
         evictions = evictions + missed.astype(jnp.int32) + (do_insert & (min_bal != 0)).astype(jnp.int32)
 
-    chosen_slots = popcount(lt_mask) >= quorum  # (I, K)
+    chosen_slots = popcount(lt_mask) >= slot_quorum(lt_bal)  # (I, K)
     newly_chosen = chosen_slots & ~pre_chosen_slots
     any_new = newly_chosen.any(axis=-1)
 
@@ -115,4 +129,23 @@ def acceptor_invariants(
     bound = new.acc_bal > new.promised
     nilpair = (new.acc_bal == 0) & (new.acc_val != 0)
     bad = (mono | bound | nilpair) & honest
+    return bad.sum(axis=-1, dtype=jnp.int32)
+
+
+def raft_voter_invariants(old, new, honest: jnp.ndarray) -> jnp.ndarray:
+    """(I,) int32 count of per-tick Raft voter invariant breaks (honest lanes).
+
+    Over :class:`~paxos_tpu.core.raft_state.VoterState` transitions:
+
+    - vote-fence monotonicity: ``voted`` never decreases;
+    - entry bound: a stored entry's term never exceeds the vote fence
+      (appends raise ``voted`` to the entry's term);
+    - entry-term monotonicity: overwrites only by equal-or-higher terms;
+    - nil pair: an empty entry (term 0) never carries a value.
+    """
+    mono = new.voted < old.voted
+    bound = new.ent_term > new.voted
+    ent_mono = new.ent_term < old.ent_term
+    nilpair = (new.ent_term == 0) & (new.ent_val != 0)
+    bad = (mono | bound | ent_mono | nilpair) & honest
     return bad.sum(axis=-1, dtype=jnp.int32)

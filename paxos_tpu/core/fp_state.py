@@ -1,0 +1,124 @@
+"""Fast Paxos state — classic state plus per-value fast-round vote masks.
+
+Reference parity (SURVEY.md §3.3 `protocols/fastpaxos`, BASELINE config 5):
+the reference framework's pluggable-protocol story (the same actor runtime
+running different role loops) becomes a second step function over a state
+pytree that shares :class:`~paxos_tpu.core.state.AcceptorState`,
+:class:`~paxos_tpu.core.state.LearnerState` and the
+:class:`~paxos_tpu.core.messages.MsgBuf` wire format with single-decree
+Paxos, so the identical fault plan drives both (the config-5 sweep).
+
+Fast Paxos (Lamport, 2006) specifics carried per proposer lane:
+
+- the **fast round** is round 0, ballot ``make_ballot(0, 0)`` shared by all
+  proposers: everyone broadcasts ``Accept(fast_bal, own_val)`` immediately,
+  skipping phase 1; a value is chosen when a **fast quorum** (ceil(3n/4))
+  of acceptors votes for it.
+- on collision/loss, proposers fall back to **classic recovery** rounds
+  (>= 1) with majority quorums; phase-1 value selection needs, per value,
+  *which acceptors* reported it at the highest accepted ballot seen — the
+  ``rep_mask`` bitmask table replacing classic Paxos' single (best_bal,
+  best_val) running max.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from paxos_tpu.core.ballot import make_ballot
+from paxos_tpu.core.messages import ACCEPT, MsgBuf
+from paxos_tpu.core.state import AcceptorState, LearnerState
+
+# Proposer phases (P1/P2/DONE match core.state so summarize() is shared).
+P1 = 0  # classic recovery: prepare sent, collecting promises
+P2 = 1  # classic recovery: accept sent, collecting accepted
+DONE = 2  # observed a quorum of Accepted for its ballot
+FAST = 3  # fast round: Accept(fast_bal, own_val) sent, collecting accepted
+
+# Value encoding: proposer p proposes VALUE_BASE + p (see ProposerState.init).
+VALUE_BASE = 100
+
+
+def fast_ballot() -> jnp.ndarray:
+    """The shared round-0 ballot every proposer's fast Accept carries."""
+    return make_ballot(0, 0)
+
+
+@struct.dataclass
+class FastProposerState:
+    bal: jnp.ndarray  # (I, P) int32 current ballot (fast_ballot() in FAST)
+    phase: jnp.ndarray  # (I, P) int32 in {P1, P2, DONE, FAST}
+    own_val: jnp.ndarray  # (I, P) int32 value this proposer wants
+    prop_val: jnp.ndarray  # (I, P) int32 value sent in classic phase 2
+    heard: jnp.ndarray  # (I, P) int32 acceptor bitmask for current phase
+    best_bal: jnp.ndarray  # (I, P) int32 highest prev-accepted ballot seen in P1
+    rep_mask: jnp.ndarray  # (I, P, V) int32: acceptors reporting value v at best_bal
+    timer: jnp.ndarray  # (I, P) int32 ticks since phase start (<0: backoff)
+    decided_val: jnp.ndarray  # (I, P) int32 value this proposer saw decided
+
+    @classmethod
+    def init(cls, n_inst: int, n_prop: int) -> "FastProposerState":
+        def z():
+            return jnp.zeros((n_inst, n_prop), jnp.int32)
+
+        pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), (n_inst, n_prop))
+        return cls(
+            bal=jnp.broadcast_to(fast_ballot(), (n_inst, n_prop)),
+            phase=jnp.full((n_inst, n_prop), FAST, jnp.int32),
+            own_val=pid + VALUE_BASE,
+            prop_val=z(),
+            heard=z(),
+            best_bal=z(),
+            rep_mask=jnp.zeros((n_inst, n_prop, n_prop), jnp.int32),
+            timer=z(),
+            decided_val=z(),
+        )
+
+
+@struct.dataclass
+class FastPaxosState:
+    """Full simulator state for Fast Paxos: one pytree, scanned and sharded."""
+
+    acceptor: AcceptorState
+    proposer: FastProposerState
+    learner: LearnerState
+    requests: MsgBuf  # proposer -> acceptor (PREPARE / ACCEPT)
+    replies: MsgBuf  # acceptor -> proposer (PROMISE / ACCEPTED)
+    tick: jnp.ndarray  # () int32
+
+    @classmethod
+    def init(cls, n_inst: int, n_prop: int, n_acc: int, k: int = 8) -> "FastPaxosState":
+        from paxos_tpu.core.ballot import MAX_PROPOSERS
+        from paxos_tpu.utils.bitops import MAX_ACCEPTORS
+
+        if not 1 <= n_prop <= MAX_PROPOSERS:
+            raise ValueError(
+                f"n_prop={n_prop} exceeds ballot packing capacity {MAX_PROPOSERS}"
+            )
+        if not 1 <= n_acc <= MAX_ACCEPTORS:
+            raise ValueError(
+                f"n_acc={n_acc} exceeds voter bitmask capacity {MAX_ACCEPTORS}"
+            )
+        proposer = FastProposerState.init(n_inst, n_prop)
+        # The fast round is in flight at tick 0: every proposer's
+        # Accept(fast_bal, own_val) broadcast occupies its ACCEPT slots.
+        requests = MsgBuf.empty(n_inst, n_prop, n_acc)
+        shape = (n_inst, n_prop, n_acc)
+        requests = requests.replace(
+            bal=requests.bal.at[:, ACCEPT].set(
+                jnp.broadcast_to(proposer.bal[:, :, None], shape)
+            ),
+            v1=requests.v1.at[:, ACCEPT].set(
+                jnp.broadcast_to(proposer.own_val[:, :, None], shape)
+            ),
+            present=requests.present.at[:, ACCEPT].set(True),
+        )
+        return cls(
+            acceptor=AcceptorState.init(n_inst, n_acc),
+            proposer=proposer,
+            learner=LearnerState.init(n_inst, k),
+            requests=requests,
+            replies=MsgBuf.empty(n_inst, n_prop, n_acc),
+            tick=jnp.zeros((), jnp.int32),
+        )

@@ -1,0 +1,138 @@
+"""Raft-core state — voters with single-slot logs, candidates with terms.
+
+Reference parity (SURVEY.md §3.3 `protocols/raftcore`, BASELINE config 5):
+the cross-protocol sweep runs Raft's *vote kernel* — leader election with
+the log-comparison election restriction, then append/ack replication of one
+log entry — through the same scheduler/transport/fault machinery as Paxos,
+over the same (instances, proposers, acceptors) topology: proposer lanes
+are candidates/leaders, acceptor lanes are voters that also store the
+replicated entry.
+
+Terms are packed ballots (:mod:`paxos_tpu.core.ballot`): proposer-unique
+and totally ordered, so "at most one vote per term" becomes "grant only
+ballots strictly above the last granted one" with no extra votedFor cell.
+Entry terms reuse the same encoding, making Raft's up-to-date comparison
+(``candidate_last_term >= voter_entry_term`` in the single-slot case) an
+integer compare — the same compare unit the quorum kernel runs on.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from paxos_tpu.core.ballot import make_ballot
+from paxos_tpu.core.messages import MsgBuf
+from paxos_tpu.core.state import LearnerState
+
+# Candidate phases (values match core.state.P1/P2/DONE so summarize() and
+# liveness stats are shared across protocols).
+CAND = 0  # soliciting votes (RequestVote broadcast out)
+LEAD = 1  # elected; appending the entry (AppendEntries broadcast out)
+DONE = 2  # observed a majority of acks: entry committed
+
+# Request kinds (candidate -> voter)
+REQVOTE = 0  # bal=candidate term, v1=candidate's entry term (0 = empty log)
+APPEND = 1  # bal=leader term, v1=entry value
+# Reply kinds (voter -> candidate)
+VOTE = 0  # bal=requested term, v1=(payload_term << 1) | granted, v2=entry val
+ACK = 1  # bal=leader term, v1=entry value
+
+VALUE_BASE = 100  # candidate p proposes VALUE_BASE + p when its log is empty
+
+
+@struct.dataclass
+class VoterState:
+    """(I, A) per-voter durable state.
+
+    ``voted`` is the Paxos-promise-shaped cell: the highest term this voter
+    has either granted a vote to or accepted an append from.  Raising it on
+    append (not just on grant) is what fences stale leaders, mirroring
+    Raft's currentTerm update on AppendEntries.
+    """
+
+    voted: jnp.ndarray  # (I, A) int32 packed term; 0 = none yet
+    ent_term: jnp.ndarray  # (I, A) int32 packed term of stored entry; 0 = empty
+    ent_val: jnp.ndarray  # (I, A) int32 stored entry value
+
+    @classmethod
+    def init(cls, n_inst: int, n_acc: int) -> "VoterState":
+        def z():
+            return jnp.zeros((n_inst, n_acc), jnp.int32)
+
+        return cls(voted=z(), ent_term=z(), ent_val=z())
+
+
+@struct.dataclass
+class CandidateState:
+    bal: jnp.ndarray  # (I, P) int32 current term (packed ballot)
+    phase: jnp.ndarray  # (I, P) int32 in {CAND, LEAD, DONE}
+    own_val: jnp.ndarray  # (I, P) int32 value proposed if log empty
+    prop_val: jnp.ndarray  # (I, P) int32 value being appended while LEAD
+    heard: jnp.ndarray  # (I, P) int32 voter bitmask (grants in CAND, acks in LEAD)
+    ent_term: jnp.ndarray  # (I, P) int32 candidate's own log entry term
+    ent_val: jnp.ndarray  # (I, P) int32 candidate's own log entry value
+    timer: jnp.ndarray  # (I, P) int32 ticks since phase start (<0: backoff)
+    decided_val: jnp.ndarray  # (I, P) int32 value this candidate saw committed
+
+    @classmethod
+    def init(cls, n_inst: int, n_prop: int) -> "CandidateState":
+        def z():
+            return jnp.zeros((n_inst, n_prop), jnp.int32)
+
+        pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), (n_inst, n_prop))
+        return cls(
+            bal=make_ballot(jnp.zeros_like(pid), pid),
+            phase=z(),  # CAND
+            own_val=pid + VALUE_BASE,
+            prop_val=z(),
+            heard=z(),
+            ent_term=z(),
+            ent_val=z(),
+            timer=z(),
+            decided_val=z(),
+        )
+
+
+@struct.dataclass
+class RaftState:
+    """Full simulator state for Raft-core: one pytree, scanned and sharded."""
+
+    acceptor: VoterState  # named `acceptor` so sharding/summaries are uniform
+    proposer: CandidateState  # likewise
+    learner: LearnerState
+    requests: MsgBuf  # candidate -> voter (REQVOTE / APPEND)
+    replies: MsgBuf  # voter -> candidate (VOTE / ACK)
+    tick: jnp.ndarray  # () int32
+
+    @classmethod
+    def init(cls, n_inst: int, n_prop: int, n_acc: int, k: int = 8) -> "RaftState":
+        from paxos_tpu.core.ballot import MAX_PROPOSERS
+        from paxos_tpu.utils.bitops import MAX_ACCEPTORS
+
+        if not 1 <= n_prop <= MAX_PROPOSERS:
+            raise ValueError(
+                f"n_prop={n_prop} exceeds ballot packing capacity {MAX_PROPOSERS}"
+            )
+        if not 1 <= n_acc <= MAX_ACCEPTORS:
+            raise ValueError(
+                f"n_acc={n_acc} exceeds voter bitmask capacity {MAX_ACCEPTORS}"
+            )
+        proposer = CandidateState.init(n_inst, n_prop)
+        # Every candidate opens with a RequestVote broadcast in flight.
+        requests = MsgBuf.empty(n_inst, n_prop, n_acc)
+        shape = (n_inst, n_prop, n_acc)
+        requests = requests.replace(
+            bal=requests.bal.at[:, REQVOTE].set(
+                jnp.broadcast_to(proposer.bal[:, :, None], shape)
+            ),
+            present=requests.present.at[:, REQVOTE].set(True),
+        )
+        return cls(
+            acceptor=VoterState.init(n_inst, n_acc),
+            proposer=proposer,
+            learner=LearnerState.init(n_inst, k),
+            requests=requests,
+            replies=MsgBuf.empty(n_inst, n_prop, n_acc),
+            tick=jnp.zeros((), jnp.int32),
+        )

@@ -9,6 +9,7 @@ report as JSON — the batch analog of "print the decided value".
     python -m paxos_tpu run --config config2 --n-inst 65536 --ticks 400
     python -m paxos_tpu run --config config4 --log metrics.jsonl
     python -m paxos_tpu run --resume ckpt_dir --ticks 200
+    python -m paxos_tpu sweep --n-inst 65536 --ticks 1024
 """
 
 from __future__ import annotations
@@ -45,6 +46,16 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--checkpoint-dir", default=None)
     r.add_argument("--checkpoint-every", type=int, default=0, help="ticks (0=off)")
     r.add_argument("--resume", default=None, help="checkpoint dir to resume from")
+
+    s = sub.add_parser(
+        "sweep",
+        help="config 5: Paxos vs Fast-Paxos vs Raft-core, identical fault masks",
+    )
+    s.add_argument("--n-inst", type=int, default=65_536)
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--ticks", type=int, default=1024, help="max ticks per protocol")
+    s.add_argument("--chunk", type=int, default=64)
+    s.add_argument("--log", default=None, help="JSONL metrics path")
     return p
 
 
@@ -116,10 +127,53 @@ def cmd_run(args: argparse.Namespace) -> int:
     return 0 if report["violations"] == 0 else 2
 
 
+def cmd_sweep(args: argparse.Namespace) -> int:
+    """Run the three vote kernels on the same fault schedule; print one JSON
+    comparison (the sweep analog of 'print the decided value')."""
+    from paxos_tpu.harness import config as cfg_mod
+    from paxos_tpu.harness.metrics import MetricsLog
+    from paxos_tpu.harness.run import run
+
+    log = MetricsLog(args.log)
+    results = {}
+    worst = 0
+    for cfg in cfg_mod.config5_sweep(n_inst=args.n_inst, seed=args.seed):
+        rep = run(
+            cfg,
+            until_all_chosen=True,
+            max_ticks=args.ticks,
+            chunk=args.chunk,
+        )
+        log.emit("protocol", protocol=cfg.protocol, **rep)
+        results[cfg.protocol] = rep
+        worst = max(worst, rep["violations"])
+
+    def liveness_key(p: str):
+        # More decided instances wins; among equals, earlier decisions win.
+        # An undecided protocol reports mean_choose_tick -1.0 — rank it last.
+        rep = results[p]
+        mean = rep["mean_choose_tick"]
+        return (-rep["chosen_frac"], mean if mean >= 0 else float("inf"))
+
+    out = {
+        "sweep": "config5",
+        "n_inst": args.n_inst,
+        "seed": args.seed,
+        "protocols": results,
+        "liveness_rank": sorted(results, key=liveness_key),
+    }
+    log.emit("final", **out)
+    log.close()
+    print(json.dumps(out))
+    return 0 if worst == 0 else 2
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "run":
         return cmd_run(args)
+    if args.cmd == "sweep":
+        return cmd_sweep(args)
     return 1
 
 

@@ -30,6 +30,14 @@ def get_step_fn(protocol: str) -> Callable:
         from paxos_tpu.protocols.multipaxos import multipaxos_step
 
         return multipaxos_step
+    if protocol == "fastpaxos":
+        from paxos_tpu.protocols.fastpaxos import fastpaxos_step
+
+        return fastpaxos_step
+    if protocol == "raftcore":
+        from paxos_tpu.protocols.raftcore import raftcore_step
+
+        return raftcore_step
     raise ValueError(f"unknown protocol: {protocol!r}")
 
 
@@ -45,6 +53,14 @@ def init_state(cfg: SimConfig):
             k=cfg.k_slots,
             lease_init=cfg.fault.lease_len,
         )
+    if cfg.protocol == "fastpaxos":
+        from paxos_tpu.core.fp_state import FastPaxosState
+
+        return FastPaxosState.init(cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots)
+    if cfg.protocol == "raftcore":
+        from paxos_tpu.core.raft_state import RaftState
+
+        return RaftState.init(cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots)
     return PaxosState.init(cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots)
 
 

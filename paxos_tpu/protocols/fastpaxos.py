@@ -1,0 +1,268 @@
+"""Fast Paxos — fast rounds, collision recovery — as one fused array program.
+
+Reference parity (SURVEY.md §3.3, §8.2 M7; BASELINE config 5): the protocol
+sweep runs different vote kernels through the identical scheduler, transport
+and fault machinery as :mod:`paxos_tpu.protocols.paxos`; this module is the
+Fast Paxos (Lamport, "Fast Paxos", 2006) variant:
+
+- **Fast round (round 0)**: proposers skip phase 1 and broadcast
+  ``Accept(fast_bal, own_val)`` directly; an acceptor votes for the first
+  value it sees at a ballot (vote-at-most-once-per-round replaces classic
+  Paxos' idempotent re-accept), and a value needs a **fast quorum**
+  ``ceil(3n/4)`` (``kernels.quorum.fast_quorum``) to be chosen.
+- **Collision recovery**: a proposer that times out starts a classic round
+  (>= 1) with majority quorums.  Phase-1 value selection implements the
+  coordinated-recovery rule: value ``v`` *could have been chosen* at the
+  highest reported ballot ``k`` iff the acceptors that reported voting ``v``
+  at ``k`` plus the acceptors not yet heard from could contain a fast
+  quorum — ``count(v) + (n - heard) >= fast_quorum``.  If some value is
+  choosable the proposer must adopt it (with a fast quorum at ceil(3n/4)
+  and a majority phase-1 quorum, at most one value can be choosable);
+  otherwise nothing was or can be chosen at ``k`` and its own value is safe.
+
+The learner applies the per-round-kind threshold (fast quorum for round 0,
+majority for classic rounds) via ``learner_observe(..., fast_quorum=...)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.check.safety import acceptor_invariants, learner_observe
+from paxos_tpu.core import ballot as bal_mod
+from paxos_tpu.core.fp_state import (
+    DONE,
+    FAST,
+    P1,
+    P2,
+    VALUE_BASE,
+    FastPaxosState,
+)
+from paxos_tpu.core.messages import ACCEPT, ACCEPTED, PREPARE, PROMISE
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.kernels.quorum import fast_quorum, majority, quorum_reached
+from paxos_tpu.transport import inmemory_tpu as net
+from paxos_tpu.utils.bitops import popcount
+
+
+def fastpaxos_step(
+    state: FastPaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+) -> FastPaxosState:
+    """Advance every instance by one scheduler tick."""
+    n_inst, n_acc = state.acceptor.promised.shape
+    n_prop = state.proposer.bal.shape[1]
+    quorum = majority(n_acc)
+    fquorum = fast_quorum(n_acc)
+
+    key = jax.random.fold_in(base_key, state.tick)
+    (k_sel, k_dup_req, k_hold, k_dup_rep, k_drop_prom, k_drop_accd,
+     k_drop_p1, k_drop_p2, k_backoff) = jax.random.split(key, 9)
+
+    acc = state.acceptor
+    alive = plan.alive(state.tick)  # (I, A)
+    equiv = plan.equivocate  # (I, A)
+
+    if cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
+        rec = plan.recovering(state.tick)
+        acc = acc.replace(
+            promised=jnp.where(rec, 0, acc.promised),
+            acc_bal=jnp.where(rec, 0, acc.acc_bal),
+            acc_val=jnp.where(rec, 0, acc.acc_val),
+        )
+    acc_pre = acc
+
+    # Reply delivery decided & delivered slots cleared BEFORE new writes
+    # (same no-clobber discipline as protocols.paxos).
+    delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
+    replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
+
+    # ---- Acceptor half-tick ----
+    sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
+    sel = sel & alive[:, None, None, :]
+
+    def gather(x):
+        return jnp.where(sel, x, 0).sum(axis=(1, 2))
+
+    msg_bal = gather(state.requests.bal)  # (I, A)
+    msg_val = gather(state.requests.v1)  # (I, A)
+    is_prep = sel[:, PREPARE].any(axis=1)
+    is_acc = sel[:, ACCEPT].any(axis=1)
+
+    ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
+    ok_prep = ok_prep_h | (is_prep & equiv)
+    # Vote at most once per ballot: with multiple proposers sharing the fast
+    # ballot, an acceptor must not switch values within a round.  Re-accepting
+    # the identical (ballot, value) stays idempotent (duplicate deliveries).
+    revote = (msg_bal > acc.acc_bal) | (
+        (msg_bal == acc.acc_bal) & (msg_val == acc.acc_val)
+    )
+    ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised) & revote
+    ok_acc = ok_acc_h | (is_acc & equiv)
+
+    promised = jnp.where(ok_prep_h, msg_bal, acc.promised)
+    promised = jnp.where(ok_acc_h, jnp.maximum(promised, msg_bal), promised)
+    acc_bal = jnp.where(ok_acc, msg_bal, acc.acc_bal)
+    acc_val = jnp.where(ok_acc, msg_val, acc.acc_val)
+
+    prom_payload_bal = jnp.where(equiv, 0, acc.acc_bal)  # pre-update pair
+    prom_payload_val = jnp.where(equiv, 0, acc.acc_val)
+    replies = net.send(
+        replies, PROMISE,
+        send_mask=sel[:, PREPARE] & ok_prep[:, None, :],
+        bal=msg_bal[:, None, :],
+        v1=prom_payload_bal[:, None, :],
+        v2=prom_payload_val[:, None, :],
+        key=k_drop_prom, p_drop=cfg.p_drop,
+    )
+    replies = net.send(
+        replies, ACCEPTED,
+        send_mask=sel[:, ACCEPT] & ok_acc[:, None, :],
+        bal=msg_bal[:, None, :],
+        v1=msg_val[:, None, :],
+        v2=jnp.zeros_like(msg_val)[:, None, :],
+        key=k_drop_accd, p_drop=cfg.p_drop,
+    )
+    requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
+    acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
+
+    # ---- Learner / safety checker (fast-quorum-aware thresholds) ----
+    learner = learner_observe(
+        state.learner, ok_acc, msg_bal, msg_val, state.tick, quorum,
+        fast_quorum=fquorum,
+    )
+    inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
+    learner = learner.replace(violations=learner.violations + inv_viol)
+
+    # ---- Proposer half-tick ----
+    prop = state.proposer
+    bits = jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32)  # (A,)
+
+    cur_bal = prop.bal[:, :, None]  # (I, P, 1)
+    prom_ok = (
+        delivered[:, PROMISE]
+        & (state.replies.bal[:, PROMISE] == cur_bal)
+        & (prop.phase == P1)[:, :, None]
+    )  # (I, P, A)
+    accd_ok = (
+        delivered[:, ACCEPTED]
+        & (state.replies.bal[:, ACCEPTED] == cur_bal)
+        & ((prop.phase == P2) | (prop.phase == FAST))[:, :, None]
+    )
+    heard = (
+        prop.heard
+        | jnp.where(prom_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
+        | jnp.where(accd_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
+    )
+
+    # Phase-1 recovery fold: per-value acceptor bitmask at the highest
+    # reported accepted ballot.  Exact sequential fold over the small
+    # acceptors axis (<= MAX_ACCEPTORS), carried across ticks in rep_mask.
+    best_bal, rep_mask = prop.best_bal, prop.rep_mask
+    for a in range(n_acc):
+        pb = state.replies.v1[:, PROMISE, :, a]  # (I, P) prev-accepted ballot
+        pv = state.replies.v2[:, PROMISE, :, a]  # (I, P) prev-accepted value
+        valid = (
+            prom_ok[:, :, a]
+            & (pb > 0)
+            & (pv >= VALUE_BASE)
+            & (pv < VALUE_BASE + n_prop)
+        )
+        vid = jnp.clip(pv - VALUE_BASE, 0, n_prop - 1)  # (I, P)
+        higher = valid & (pb > best_bal)
+        rep_mask = jnp.where(higher[:, :, None], 0, rep_mask)
+        best_bal = jnp.where(higher, pb, best_bal)
+        same = valid & (pb == best_bal)
+        vhot = jax.nn.one_hot(vid, n_prop, dtype=jnp.bool_)  # (I, P, V)
+        rep_mask = rep_mask | jnp.where(
+            same[:, :, None] & vhot, jnp.asarray(1 << a, jnp.int32), 0
+        )
+
+    # Phase transitions.
+    fast_done = (prop.phase == FAST) & (popcount(heard) >= fquorum)
+    p1_done = (prop.phase == P1) & quorum_reached(heard, quorum)
+    p2_done = (prop.phase == P2) & quorum_reached(heard, quorum)
+
+    # Recovery value, by the round kind of the highest reported ballot k:
+    # - k classic (round >= 1): classic Paxos — adopt k's value (unique:
+    #   one owner per classic ballot proposes one value).
+    # - k fast (round 0): adopt the choosable value if one exists, else own.
+    # - nothing reported: own value.
+    unheard = n_acc - popcount(heard)  # (I, P)
+    cnt = popcount(rep_mask)  # (I, P, V)
+    choosable = (rep_mask != 0) & (cnt + unheard[:, :, None] >= fquorum)
+    any_ch = choosable.any(axis=-1)
+    pick_fast = jnp.argmax(choosable, axis=-1).astype(jnp.int32) + VALUE_BASE
+    pick_classic = (
+        jnp.argmax(rep_mask != 0, axis=-1).astype(jnp.int32) + VALUE_BASE
+    )
+    is_fast_k = bal_mod.ballot_round(best_bal) == 0
+    v_fast = jnp.where(any_ch, pick_fast, prop.own_val)
+    v_recover = jnp.where(
+        best_bal > 0,
+        jnp.where(is_fast_k, v_fast, pick_classic),
+        prop.own_val,
+    )
+
+    timer = jnp.where(prop.phase == DONE, prop.timer, prop.timer + 1)
+    expired = (
+        (prop.phase != DONE)
+        & ~p1_done & ~p2_done & ~fast_done
+        & (timer > cfg.timeout)
+    )
+    backoff = jax.random.randint(
+        k_backoff, timer.shape, 0, max(cfg.backoff_max, 1), jnp.int32
+    )
+    pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), timer.shape)
+    new_bal = bal_mod.make_ballot(bal_mod.ballot_round(prop.bal) + 1, pid)
+
+    phase = jnp.where(p1_done, P2, prop.phase)
+    phase = jnp.where(p2_done | fast_done, DONE, phase)
+    phase = jnp.where(expired, P1, phase)
+    prop_val = jnp.where(p1_done, v_recover, prop.prop_val)
+    decided_val = jnp.where(p2_done, prop.prop_val, prop.decided_val)
+    decided_val = jnp.where(fast_done, prop.own_val, decided_val)
+    bal_next = jnp.where(expired, new_bal, prop.bal)
+    heard = jnp.where(p1_done | expired, 0, heard)
+    best_bal = jnp.where(expired, 0, best_bal)
+    rep_mask = jnp.where(expired[:, :, None], 0, rep_mask)
+    timer = jnp.where(p1_done, 0, timer)
+    timer = jnp.where(expired, -backoff, timer)
+
+    # Emit: classic ACCEPT on phase-1 completion, PREPARE on retry.
+    requests = net.send(
+        requests, ACCEPT,
+        send_mask=jnp.broadcast_to(p1_done[:, :, None], (n_inst, n_prop, n_acc)),
+        bal=prop.bal[:, :, None],
+        v1=prop_val[:, :, None],
+        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        key=k_drop_p2, p_drop=cfg.p_drop,
+    )
+    requests = net.send(
+        requests, PREPARE,
+        send_mask=jnp.broadcast_to(expired[:, :, None], (n_inst, n_prop, n_acc)),
+        bal=bal_next[:, :, None],
+        v1=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        key=k_drop_p1, p_drop=cfg.p_drop,
+    )
+
+    prop = prop.replace(
+        bal=bal_next,
+        phase=phase,
+        prop_val=prop_val,
+        heard=heard,
+        best_bal=best_bal,
+        rep_mask=rep_mask,
+        timer=timer,
+        decided_val=decided_val,
+    )
+
+    return state.replace(
+        acceptor=acc,
+        proposer=prop,
+        learner=learner,
+        requests=requests,
+        replies=replies,
+        tick=state.tick + 1,
+    )

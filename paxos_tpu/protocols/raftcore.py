@@ -1,0 +1,240 @@
+"""Raft-core vote kernel — leader election + single-entry commit (config 5).
+
+Reference parity (SURVEY.md §3.3, §8.2 M7): the third vote kernel of the
+cross-protocol sweep, behind the shared step-fn interface and driven by the
+identical scheduler/transport/fault machinery as the Paxos variants.
+
+What is Raft here (vs. the Paxos kernels):
+
+- **Election restriction**: a voter grants ``RequestVote(term, cand_last)``
+  only if the candidate's log is at least as up-to-date — in the
+  single-slot case, ``cand_last >= voter.ent_term`` (integer compare on
+  packed terms).  This is the Raft-distinctive admission rule the sweep is
+  meant to contrast with Paxos' unconditional promise.
+- **One vote per term**: terms are proposer-unique packed ballots, so
+  "vote once per term" is "grant only strictly increasing terms"
+  (``term > voted``); a voter also raises ``voted`` when accepting an
+  append, fencing stale leaders (Raft's currentTerm bump).
+- **Heartbeat-style replication**: an elected leader re-broadcasts
+  ``AppendEntries(term, value)`` every tick (idempotent at voters,
+  self-healing under loss); commit = majority of acks at the leader's term.
+
+Vote replies (grants *and* denials) carry the voter's stored entry; a
+candidate adopts the highest-term entry it hears.  Grants alone make the
+adopted entry safe by the Paxos phase-1 argument (vote majorities intersect
+stored majorities); denial-borne entries are gossip that only accelerates
+convergence — any entry at term t was proposed by t's unique leader, whose
+value is inductively safe, and the election restriction blocks candidates
+whose adopted entry is staler than a committed majority's.
+
+Safety oracle: the shared learner counts append-accept events per (term,
+value) with majority quorums — agreement violations (two values committed)
+and voter-local invariant breaks (``raft_voter_invariants``) both count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.check.safety import learner_observe, raft_voter_invariants
+from paxos_tpu.core import ballot as bal_mod
+from paxos_tpu.core.raft_state import (
+    ACK,
+    APPEND,
+    CAND,
+    DONE,
+    LEAD,
+    REQVOTE,
+    VOTE,
+    RaftState,
+)
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.kernels.quorum import majority, quorum_reached
+from paxos_tpu.transport import inmemory_tpu as net
+
+
+def raftcore_step(
+    state: RaftState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+) -> RaftState:
+    """Advance every instance by one scheduler tick."""
+    n_inst, n_acc = state.acceptor.voted.shape
+    n_prop = state.proposer.bal.shape[1]
+    quorum = majority(n_acc)
+
+    key = jax.random.fold_in(base_key, state.tick)
+    (k_sel, k_dup_req, k_hold, k_dup_rep, k_drop_vote, k_drop_ack,
+     k_drop_rv, k_drop_ap, k_backoff) = jax.random.split(key, 9)
+
+    voter = state.acceptor
+    alive = plan.alive(state.tick)  # (I, A)
+    equiv = plan.equivocate  # (I, A)
+
+    if cfg.amnesia:  # bug injection: voter forgets durable state on recovery
+        rec = plan.recovering(state.tick)
+        voter = voter.replace(
+            voted=jnp.where(rec, 0, voter.voted),
+            ent_term=jnp.where(rec, 0, voter.ent_term),
+            ent_val=jnp.where(rec, 0, voter.ent_val),
+        )
+    voter_pre = voter
+
+    delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
+    replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
+
+    # ---- Voter half-tick: select one request per (instance, voter) ----
+    sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
+    sel = sel & alive[:, None, None, :]
+
+    def gather(x):
+        return jnp.where(sel, x, 0).sum(axis=(1, 2))
+
+    msg_bal = gather(state.requests.bal)  # (I, A)
+    msg_v1 = gather(state.requests.v1)  # (I, A): REQVOTE cand_last / APPEND value
+    is_rv = sel[:, REQVOTE].any(axis=1)  # (I, A)
+    is_ap = sel[:, APPEND].any(axis=1)
+
+    # RequestVote: one vote per term + election restriction.  Equivocators
+    # grant everything and hide their entry (config-4-style double vote).
+    grant_h = is_rv & ~equiv & (msg_bal > voter.voted) & (msg_v1 >= voter.ent_term)
+    grant = grant_h | (is_rv & equiv)
+    # AppendEntries: accept from any term not below the vote fence.
+    ok_ap_h = is_ap & ~equiv & (msg_bal >= voter.voted)
+    ok_ap = ok_ap_h | (is_ap & equiv)
+
+    voted = jnp.where(grant_h, msg_bal, voter.voted)
+    voted = jnp.where(ok_ap_h, jnp.maximum(voted, msg_bal), voted)
+    ent_term = jnp.where(ok_ap, msg_bal, voter.ent_term)
+    ent_val = jnp.where(ok_ap, msg_v1, voter.ent_val)
+
+    # Vote replies go to every solicitor (grant or denial), carrying the
+    # voter's pre-update entry: (ent_term << 1) | granted, entry value.
+    vote_payload_t = jnp.where(equiv, 0, voter.ent_term)  # (I, A)
+    vote_payload_v = jnp.where(equiv, 0, voter.ent_val)
+    replies = net.send(
+        replies, VOTE,
+        send_mask=sel[:, REQVOTE],
+        bal=msg_bal[:, None, :],
+        v1=(vote_payload_t * 2 + grant.astype(jnp.int32))[:, None, :],
+        v2=vote_payload_v[:, None, :],
+        key=k_drop_vote, p_drop=cfg.p_drop,
+    )
+    replies = net.send(
+        replies, ACK,
+        send_mask=sel[:, APPEND] & ok_ap[:, None, :],
+        bal=msg_bal[:, None, :],
+        v1=msg_v1[:, None, :],
+        v2=jnp.zeros_like(msg_v1)[:, None, :],
+        key=k_drop_ack, p_drop=cfg.p_drop,
+    )
+    requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
+    voter = voter.replace(voted=voted, ent_term=ent_term, ent_val=ent_val)
+
+    # ---- Learner / safety checker (append-accept events, majority commit) ----
+    learner = learner_observe(
+        state.learner, ok_ap, msg_bal, msg_v1, state.tick, quorum
+    )
+    inv_viol = raft_voter_invariants(voter_pre, voter, honest=~equiv)
+    learner = learner.replace(violations=learner.violations + inv_viol)
+
+    # ---- Candidate half-tick: fold all delivered replies ----
+    cand = state.proposer
+    bits = jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32)  # (A,)
+
+    cur_bal = cand.bal[:, :, None]  # (I, P, 1)
+    vote_ok = (
+        delivered[:, VOTE]
+        & (state.replies.bal[:, VOTE] == cur_bal)
+        & (cand.phase == CAND)[:, :, None]
+    )  # (I, P, A)
+    granted = vote_ok & (state.replies.v1[:, VOTE] % 2 == 1)
+    ack_ok = (
+        delivered[:, ACK]
+        & (state.replies.bal[:, ACK] == cur_bal)
+        & (cand.phase == LEAD)[:, :, None]
+    )
+    heard = (
+        cand.heard
+        | jnp.where(granted, bits, 0).sum(axis=-1, dtype=jnp.int32)
+        | jnp.where(ack_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
+    )
+
+    # Adopt the highest-term entry among vote replies (grants and denials).
+    rep_t = jnp.where(vote_ok, state.replies.v1[:, VOTE] // 2, 0)  # (I, P, A)
+    best_a = jnp.argmax(rep_t, axis=-1)  # (I, P)
+    cand_t = jnp.take_along_axis(rep_t, best_a[..., None], axis=-1)[..., 0]
+    cand_v = jnp.take_along_axis(
+        jnp.where(vote_ok, state.replies.v2[:, VOTE], 0), best_a[..., None], axis=-1
+    )[..., 0]
+    upgrade = cand_t > cand.ent_term
+    ent_term_c = jnp.where(upgrade, cand_t, cand.ent_term)
+    ent_val_c = jnp.where(upgrade, cand_v, cand.ent_val)
+
+    # Phase transitions.
+    elected = (cand.phase == CAND) & quorum_reached(heard, quorum)
+    committed = (cand.phase == LEAD) & quorum_reached(heard, quorum)
+
+    timer = jnp.where(cand.phase == DONE, cand.timer, cand.timer + 1)
+    expired = (
+        (cand.phase != DONE) & ~elected & ~committed & (timer > cfg.timeout)
+    )
+    backoff = jax.random.randint(
+        k_backoff, timer.shape, 0, max(cfg.backoff_max, 1), jnp.int32
+    )
+    pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), timer.shape)
+    new_bal = bal_mod.make_ballot(bal_mod.ballot_round(cand.bal) + 1, pid)
+
+    # A new leader proposes its adopted entry if it has one, else its own
+    # value, and records that proposal as its own log entry at its term.
+    v_lead = jnp.where(ent_term_c > 0, ent_val_c, cand.own_val)
+    phase = jnp.where(elected, LEAD, cand.phase)
+    phase = jnp.where(committed, DONE, phase)
+    phase = jnp.where(expired, CAND, phase)
+    prop_val = jnp.where(elected, v_lead, cand.prop_val)
+    decided_val = jnp.where(committed, cand.prop_val, cand.decided_val)
+    ent_term_c = jnp.where(elected, cand.bal, ent_term_c)
+    ent_val_c = jnp.where(elected, v_lead, ent_val_c)
+    bal_next = jnp.where(expired, new_bal, cand.bal)
+    heard = jnp.where(elected | expired, 0, heard)
+    timer = jnp.where(elected, 0, timer)
+    timer = jnp.where(expired, -backoff, timer)
+
+    # Emit: leaders re-broadcast AppendEntries every tick; expired candidates
+    # broadcast RequestVote at the next term, declaring their entry term.
+    is_lead = phase == LEAD
+    requests = net.send(
+        requests, APPEND,
+        send_mask=jnp.broadcast_to(is_lead[:, :, None], (n_inst, n_prop, n_acc)),
+        bal=bal_next[:, :, None],
+        v1=prop_val[:, :, None],
+        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        key=k_drop_ap, p_drop=cfg.p_drop,
+    )
+    requests = net.send(
+        requests, REQVOTE,
+        send_mask=jnp.broadcast_to(expired[:, :, None], (n_inst, n_prop, n_acc)),
+        bal=bal_next[:, :, None],
+        v1=ent_term_c[:, :, None],
+        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        key=k_drop_rv, p_drop=cfg.p_drop,
+    )
+
+    cand = cand.replace(
+        bal=bal_next,
+        phase=phase,
+        prop_val=prop_val,
+        heard=heard,
+        ent_term=ent_term_c,
+        ent_val=ent_val_c,
+        timer=timer,
+        decided_val=decided_val,
+    )
+
+    return state.replace(
+        acceptor=voter,
+        proposer=cand,
+        learner=learner,
+        requests=requests,
+        replies=replies,
+        tick=state.tick + 1,
+    )

@@ -1,0 +1,99 @@
+"""Fast Paxos: fast-path decisions, collision recovery, checker falsifiability.
+
+SURVEY.md §5.2: property/invariant tests over random fault masks plus
+adversarial configs; the checker itself is validated by injecting
+equivocation (it must light up).
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from paxos_tpu.core.fp_state import DONE, VALUE_BASE
+from paxos_tpu.faults.injector import FaultConfig
+from paxos_tpu.harness.config import SimConfig
+from paxos_tpu.harness.run import run
+
+
+def fp_cfg(n_inst=1024, n_prop=2, n_acc=5, seed=0, **fault_kw):
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=n_prop,
+        n_acc=n_acc,
+        seed=seed,
+        protocol="fastpaxos",
+        fault=FaultConfig(**fault_kw),
+    )
+
+
+def test_fast_path_no_faults_single_proposer():
+    """One proposer, clean network: every instance decides via the fast round."""
+    cfg = fp_cfg(n_inst=512, n_prop=1, n_acc=5)
+    report, state = run(cfg, until_all_chosen=True, max_ticks=64, return_state=True)
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["chosen_frac"] == 1.0
+    # The sole value is proposer 0's; chosen in the fast round (ballot round 0
+    # needs ceil(3*5/4)=4 acceptors, reachable by tick ~2 with no faults).
+    assert bool((state.learner.chosen_val == VALUE_BASE).all())
+    assert report["mean_choose_tick"] < 8.0
+    assert bool((state.proposer.phase == DONE).all())
+
+
+def test_dueling_proposers_collision_recovery():
+    """Two proposers race the fast round; collided lanes recover classically."""
+    cfg = fp_cfg(n_inst=2048, n_prop=2, n_acc=5, p_idle=0.2, p_hold=0.2)
+    report, state = run(
+        cfg, until_all_chosen=True, max_ticks=2048, return_state=True
+    )
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["chosen_frac"] == 1.0
+    assert report["proposer_disagree"] == 0
+    vals = state.learner.chosen_val
+    assert bool(((vals >= VALUE_BASE) & (vals < VALUE_BASE + 2)).all())
+    # With two proposers colliding at 3-of-5 vs 2-of-5, some lanes MUST have
+    # needed classic recovery: those chose at a classic (round >= 1) ballot,
+    # visible as a later chosen_tick than any pure-fast decision.
+    assert report["mean_choose_tick"] > 2.0
+
+
+def test_chaos_safety():
+    """Drop + dup + idle + hold + acceptor crashes: zero violations."""
+    cfg = fp_cfg(
+        n_inst=2048,
+        n_prop=2,
+        n_acc=5,
+        seed=3,
+        p_drop=0.1,
+        p_dup=0.1,
+        p_idle=0.2,
+        p_hold=0.2,
+        p_crash=0.2,
+        crash_max_start=64,
+        crash_max_len=32,
+    )
+    report = run(cfg, total_ticks=512)
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    # Liveness under chaos: most lanes should still decide.
+    assert report["chosen_frac"] > 0.9
+
+
+def test_equivocation_lights_up_checker():
+    """Config-4-style falsifiability: equivocating acceptors double-vote in the
+    fast round, so conflicting values can both reach a fast quorum — the
+    checker must catch it."""
+    cfg = fp_cfg(
+        n_inst=4096, n_prop=2, n_acc=5, seed=1, p_idle=0.2, p_equiv=0.5
+    )
+    report = run(cfg, total_ticks=256)
+    assert report["violations"] > 0
+
+
+def test_deterministic_replay():
+    """Same seed => bit-identical outcome (SURVEY.md §6.2 determinism)."""
+    cfg = fp_cfg(n_inst=256, n_prop=2, n_acc=5, seed=7, p_drop=0.1, p_idle=0.2)
+    r1, s1 = run(cfg, total_ticks=200, return_state=True)
+    r2, s2 = run(cfg, total_ticks=200, return_state=True)
+    assert r1 == r2
+    assert bool(jnp.array_equal(s1.learner.chosen_val, s2.learner.chosen_val))

@@ -1,0 +1,130 @@
+"""Raft-core: election, commit, the election restriction, leader completeness.
+
+SURVEY.md §5.2: property tests under random fault masks plus hand-built
+adversarial states (the known-answer tests that break wrong implementations:
+a stale candidate must not win against a majority that holds a committed
+entry, and the eventual leader must re-propose that entry, not its own).
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from paxos_tpu.core.ballot import make_ballot
+from paxos_tpu.core.raft_state import CAND, DONE, VALUE_BASE, RaftState
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.harness.config import SimConfig
+from paxos_tpu.harness.run import base_key, init_plan, run, run_chunk
+from paxos_tpu.protocols.raftcore import raftcore_step
+
+
+def raft_cfg(n_inst=1024, n_prop=2, n_acc=5, seed=0, **fault_kw):
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=n_prop,
+        n_acc=n_acc,
+        seed=seed,
+        protocol="raftcore",
+        fault=FaultConfig(**fault_kw),
+    )
+
+
+def test_single_candidate_no_faults():
+    """One candidate, clean network: elected then committed within a few ticks."""
+    cfg = raft_cfg(n_inst=512, n_prop=1, n_acc=5)
+    report, state = run(cfg, until_all_chosen=True, max_ticks=64, return_state=True)
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["chosen_frac"] == 1.0
+    assert bool((state.learner.chosen_val == VALUE_BASE).all())
+    assert bool((state.proposer.phase == DONE).all())
+
+
+def test_dueling_candidates_with_drops():
+    """Two candidates race elections under loss/idle/hold: agreement holds."""
+    cfg = raft_cfg(
+        n_inst=2048, n_prop=2, n_acc=5, p_drop=0.1, p_idle=0.2, p_hold=0.2
+    )
+    report, state = run(
+        cfg, until_all_chosen=True, max_ticks=2048, return_state=True
+    )
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["chosen_frac"] == 1.0
+    assert report["proposer_disagree"] == 0
+    vals = state.learner.chosen_val
+    assert bool(((vals >= VALUE_BASE) & (vals < VALUE_BASE + 2)).all())
+
+
+def test_chaos_safety():
+    """Drop + dup + idle + hold + voter crashes: zero violations."""
+    cfg = raft_cfg(
+        n_inst=2048,
+        n_prop=2,
+        n_acc=5,
+        seed=3,
+        p_drop=0.1,
+        p_dup=0.1,
+        p_idle=0.2,
+        p_hold=0.2,
+        p_crash=0.2,
+        crash_max_start=64,
+        crash_max_len=32,
+    )
+    report = run(cfg, total_ticks=512)
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["chosen_frac"] > 0.9
+
+
+def test_election_restriction_and_leader_completeness():
+    """A candidate with a stale log must lose to voters holding a committed
+    entry, and the eventually elected leader must re-propose that entry.
+
+    Adversarial hand-built state (SURVEY.md §5.2.3): voters 0-2 (a majority)
+    hold entry (b0, 777); the sole candidate starts with an empty log, so
+    its first candidacies are denied by the majority (election restriction).
+    Denial gossip teaches it the entry; once its term passes b0 it wins and
+    MUST commit 777 — committing its own value is the classic
+    leader-completeness bug.
+    """
+    cfg = raft_cfg(n_inst=8, n_prop=1, n_acc=5, timeout=6, backoff_max=2)
+    state = RaftState.init(cfg.n_inst, cfg.n_prop, cfg.n_acc)
+    b0 = int(make_ballot(3, 0))
+    seeded = jnp.zeros((cfg.n_inst, cfg.n_acc), jnp.bool_).at[:, :3].set(True)
+    state = state.replace(
+        acceptor=state.acceptor.replace(
+            voted=jnp.where(seeded, b0, state.acceptor.voted),
+            ent_term=jnp.where(seeded, b0, state.acceptor.ent_term),
+            ent_val=jnp.where(seeded, 777, state.acceptor.ent_val),
+        )
+    )
+    plan = FaultPlan.none(cfg.n_inst, cfg.n_acc, cfg.n_prop)
+    key = base_key(cfg)
+
+    # Early: the stale candidate cannot have been elected yet.
+    state = run_chunk(state, key, plan, cfg.fault, 4, raftcore_step)
+    assert bool((state.proposer.phase == CAND).all())
+    assert not bool(state.learner.chosen.any())
+
+    state = run_chunk(state, key, plan, cfg.fault, 200, raftcore_step)
+    assert bool(state.learner.chosen.all())
+    assert bool((state.learner.chosen_val == 777).all())
+    assert int(state.learner.violations.sum()) == 0
+
+
+def test_equivocation_lights_up_checker():
+    """Double-granting/accepting voters let two leaders commit conflicting
+    values — the checker must catch it (config-4 falsifiability)."""
+    cfg = raft_cfg(
+        n_inst=4096, n_prop=2, n_acc=5, seed=1, p_idle=0.2, p_equiv=0.5
+    )
+    report = run(cfg, total_ticks=256)
+    assert report["violations"] > 0
+
+
+def test_deterministic_replay():
+    cfg = raft_cfg(n_inst=256, n_prop=2, n_acc=5, seed=7, p_drop=0.1, p_idle=0.2)
+    r1, s1 = run(cfg, total_ticks=200, return_state=True)
+    r2, s2 = run(cfg, total_ticks=200, return_state=True)
+    assert r1 == r2
+    assert bool(jnp.array_equal(s1.learner.chosen_val, s2.learner.chosen_val))
