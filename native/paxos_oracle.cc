@@ -1,0 +1,290 @@
+// Native differential oracle: event-driven single-decree Paxos in C++.
+//
+// Reference parity (SURVEY.md §3.1 native-code note, §5.2.1): the reference
+// stack is pure Haskell — its "native runtime" is GHC itself — so the new
+// framework's native tier is not a port but a TPU-adjacent toolchain piece:
+// an independently written, sanitizer-friendly golden model that fuzzes the
+// same protocol the JAX kernels implement, at millions of scheduler events
+// per second on the host CPU.  It triangulates three implementations
+// (C++ oracle, Python golden model, batched JAX kernels): all must satisfy
+// agreement + validity on every seed.
+//
+// Deliberately mirrors the *semantics*, not the code, of
+// paxos_tpu/cpu_ref/golden.py: asynchronous scheduler = seeded random choice
+// among enabled events (deliver one in-flight message, or fire one proposer
+// timeout), network = multiset with drop/duplicate faults, safety recomputed
+// from the full accept-event history.
+//
+// Build: g++ -O2 -shared -fPIC -o libpaxos_oracle.so paxos_oracle.cc
+// ABI: see run_batch / bench_steps at the bottom (plain C, ctypes-friendly).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// splitmix64 + xorshift: tiny, seedable, independent of any Python RNG.
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed + 0x9e3779b97f4a7c15ull) {
+    next();
+    next();
+  }
+  uint64_t next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ull);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+    return z ^ (z >> 31);
+  }
+  // Uniform double in [0, 1).
+  double uniform() { return (next() >> 11) * 0x1.0p-53; }
+  // Uniform int in [0, n).
+  int below(int n) { return static_cast<int>(next() % static_cast<uint64_t>(n)); }
+};
+
+constexpr int kMaxProposers = 8;  // matches paxos_tpu.core.ballot.MAX_PROPOSERS
+constexpr int kValueBase = 100;   // proposer p proposes kValueBase + p
+
+inline int make_ballot(int rnd, int pid) { return rnd * kMaxProposers + pid + 1; }
+
+enum Kind : uint8_t { PREPARE, PROMISE, ACCEPT, ACCEPTED };
+
+struct Msg {
+  Kind kind;
+  int8_t src;  // proposer id for requests, acceptor id for replies
+  int8_t dst;
+  int32_t bal;
+  int32_t val;
+  int32_t prev_bal;
+  int32_t prev_val;
+};
+
+struct Acceptor {
+  int32_t promised = 0;
+  int32_t acc_bal = 0;
+  int32_t acc_val = 0;
+};
+
+struct Proposer {
+  enum Phase { P1, P2, DONE };
+  int pid;
+  int32_t own_val;
+  int rnd = 0;
+  int32_t bal;
+  Phase phase = P1;
+  uint32_t heard = 0;  // acceptor bitmask, like the device kernels
+  int32_t best_bal = 0;
+  int32_t best_val = 0;
+  int32_t prop_val = 0;
+  int32_t decided_val = -1;
+
+  explicit Proposer(int p) : pid(p), own_val(kValueBase + p), bal(make_ballot(0, p)) {}
+};
+
+struct Result {
+  int32_t decided;
+  int32_t agreement_ok;
+  int32_t validity_ok;
+  int32_t n_chosen;
+  int32_t steps;
+};
+
+struct Sim {
+  int n_prop, n_acc, quorum;
+  double p_drop, p_dup, timeout_weight;
+  Rng rng;
+  std::vector<Acceptor> acceptors;
+  std::vector<Proposer> proposers;
+  std::vector<Msg> network;
+  // Accept-event history: acceptor bitmask per (ballot, value), linear table
+  // (ballot counts stay tiny at single-instance scale).
+  std::vector<int32_t> ev_bal, ev_val;
+  std::vector<uint32_t> ev_mask;
+
+  Sim(uint64_t seed, int np, int na, double pd, double pdup, double tw)
+      : n_prop(np), n_acc(na), quorum(na / 2 + 1), p_drop(pd), p_dup(pdup),
+        timeout_weight(tw), rng(seed) {
+    acceptors.resize(n_acc);
+    for (int p = 0; p < n_prop; ++p) proposers.emplace_back(p);
+    for (auto& p : proposers) broadcast(p, PREPARE);
+  }
+
+  void offer(const Msg& m) {
+    if (rng.uniform() >= p_drop) network.push_back(m);
+  }
+
+  void broadcast(Proposer& p, Kind kind) {
+    for (int a = 0; a < n_acc; ++a) {
+      offer(Msg{kind, static_cast<int8_t>(p.pid), static_cast<int8_t>(a), p.bal,
+                p.prop_val, 0, 0});
+    }
+  }
+
+  void record_accept(int acc, int32_t bal, int32_t val) {
+    for (size_t i = 0; i < ev_bal.size(); ++i) {
+      if (ev_bal[i] == bal && ev_val[i] == val) {
+        ev_mask[i] |= 1u << acc;
+        return;
+      }
+    }
+    ev_bal.push_back(bal);
+    ev_val.push_back(val);
+    ev_mask.push_back(1u << acc);
+  }
+
+  void dispatch(const Msg& m) {
+    switch (m.kind) {
+      case PREPARE: {
+        Acceptor& a = acceptors[m.dst];
+        if (m.bal > a.promised) {
+          a.promised = m.bal;
+          offer(Msg{PROMISE, m.dst, m.src, m.bal, 0, a.acc_bal, a.acc_val});
+        }
+        break;
+      }
+      case ACCEPT: {
+        Acceptor& a = acceptors[m.dst];
+        if (m.bal >= a.promised) {
+          a.promised = a.promised > m.bal ? a.promised : m.bal;
+          a.acc_bal = m.bal;
+          a.acc_val = m.val;
+          record_accept(m.dst, m.bal, m.val);
+          offer(Msg{ACCEPTED, m.dst, m.src, m.bal, m.val, 0, 0});
+        }
+        break;
+      }
+      case PROMISE: {
+        Proposer& p = proposers[m.dst];
+        if (p.phase != Proposer::P1 || m.bal != p.bal) break;
+        p.heard |= 1u << m.src;
+        if (m.prev_bal > p.best_bal) {
+          p.best_bal = m.prev_bal;
+          p.best_val = m.prev_val;
+        }
+        if (__builtin_popcount(p.heard) >= quorum) {
+          p.phase = Proposer::P2;
+          p.heard = 0;
+          p.prop_val = p.best_bal > 0 ? p.best_val : p.own_val;
+          broadcast(p, ACCEPT);
+        }
+        break;
+      }
+      case ACCEPTED: {
+        Proposer& p = proposers[m.dst];
+        if (p.phase != Proposer::P2 || m.bal != p.bal) break;
+        p.heard |= 1u << m.src;
+        if (__builtin_popcount(p.heard) >= quorum) {
+          p.phase = Proposer::DONE;
+          p.decided_val = p.prop_val;
+        }
+        break;
+      }
+    }
+  }
+
+  bool all_done() const {
+    for (const auto& p : proposers)
+      if (p.phase != Proposer::DONE) return false;
+    return true;
+  }
+
+  Result run(int max_steps) {
+    int steps = 0;
+    while (steps < max_steps && !all_done()) {
+      ++steps;
+      if (!network.empty() && rng.uniform() >= timeout_weight) {
+        int i = rng.below(static_cast<int>(network.size()));
+        Msg m = network[i];
+        if (rng.uniform() >= p_dup) {  // not duplicated: consume the slot
+          network[i] = network.back();
+          network.pop_back();
+        }
+        dispatch(m);
+      } else {
+        // Fire one live proposer's timeout.
+        int live = 0;
+        for (const auto& p : proposers) live += p.phase != Proposer::DONE;
+        if (live == 0) break;
+        int pick = rng.below(live);
+        for (auto& p : proposers) {
+          if (p.phase == Proposer::DONE) continue;
+          if (pick-- == 0) {
+            ++p.rnd;
+            p.bal = make_ballot(p.rnd, p.pid);
+            p.phase = Proposer::P1;
+            p.heard = 0;
+            p.best_bal = p.best_val = 0;
+            broadcast(p, PREPARE);
+            break;
+          }
+        }
+      }
+    }
+
+    // Omniscient oracle over the full accept history.
+    int n_chosen = 0;
+    int32_t chosen_val = -1;
+    bool validity = true;
+    for (size_t i = 0; i < ev_bal.size(); ++i) {
+      if (__builtin_popcount(ev_mask[i]) >= quorum) {
+        if (n_chosen == 0 || ev_val[i] != chosen_val) ++n_chosen;
+        chosen_val = ev_val[i];
+        validity &= ev_val[i] >= kValueBase && ev_val[i] < kValueBase + n_prop;
+      }
+    }
+    bool agreement = n_chosen <= 1;
+    for (const auto& p : proposers) {
+      if (p.decided_val >= 0)
+        agreement &= n_chosen == 1 && p.decided_val == chosen_val;
+    }
+    return Result{all_done() ? 1 : 0, agreement ? 1 : 0, validity ? 1 : 0,
+                  n_chosen, steps};
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Packing limits: voter sets are uint32 bitmasks; ballots pack (round, pid)
+// with kMaxProposers.  Out-of-range topologies would silently corrupt
+// verdicts (shift UB / ballot collisions) — fail loudly instead.
+static bool valid_topology(int32_t n_prop, int32_t n_acc) {
+  return n_prop >= 1 && n_prop <= kMaxProposers && n_acc >= 1 && n_acc <= 32;
+}
+
+// Runs `n_runs` independent seeded instances; fills `out` with 5 int32 per
+// run: decided, agreement_ok, validity_ok, n_chosen, steps.  On an invalid
+// topology every field is set to -1 (the Python wrapper validates first).
+void run_batch(uint64_t seed0, int32_t n_runs, int32_t n_prop, int32_t n_acc,
+               double p_drop, double p_dup, double timeout_weight,
+               int32_t max_steps, int32_t* out) {
+  if (!valid_topology(n_prop, n_acc)) {
+    for (int32_t i = 0; i < 5 * n_runs; ++i) out[i] = -1;
+    return;
+  }
+  for (int32_t r = 0; r < n_runs; ++r) {
+    Sim sim(seed0 + static_cast<uint64_t>(r), n_prop, n_acc, p_drop, p_dup,
+            timeout_weight);
+    Result res = sim.run(max_steps);
+    std::memcpy(out + 5 * r, &res, sizeof(res));
+  }
+}
+
+// CPU-reference throughput: total scheduler events processed across
+// `n_runs` instances (the number BASELINE.md's config-1 row asks for).
+int64_t bench_steps(uint64_t seed0, int32_t n_runs, int32_t n_prop,
+                    int32_t n_acc, double p_drop, double p_dup,
+                    double timeout_weight, int32_t max_steps) {
+  if (!valid_topology(n_prop, n_acc)) return -1;
+  int64_t total = 0;
+  for (int32_t r = 0; r < n_runs; ++r) {
+    Sim sim(seed0 + static_cast<uint64_t>(r), n_prop, n_acc, p_drop, p_dup,
+            timeout_weight);
+    total += sim.run(max_steps).steps;
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,152 @@
+"""ctypes binding for the native C++ differential oracle.
+
+Reference parity (SURVEY.md §3.1 native-code note): the framework's native
+tier — ``native/paxos_oracle.cc`` — compiled on demand with the system
+toolchain (no pip deps) and loaded via ctypes.  Used by the differential
+tests to triangulate the JAX kernels against an implementation that shares
+no code, no RNG, and no language with them, and to measure the CPU-reference
+throughput row of BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import pathlib
+import subprocess
+import tempfile
+
+import numpy as np
+
+_SRC = pathlib.Path(__file__).resolve().parents[2] / "native" / "paxos_oracle.cc"
+_LIB: ctypes.CDLL | None = None
+
+
+def _build() -> pathlib.Path:
+    """Compile the oracle into a cached shared library; rebuild on source change."""
+    cache = pathlib.Path(tempfile.gettempdir()) / "paxos_tpu_native"
+    cache.mkdir(exist_ok=True)
+    lib = cache / f"libpaxos_oracle_{_SRC.stat().st_mtime_ns}.so"
+    if not lib.exists():
+        # Compile to a unique temp name, then atomically rename: a killed or
+        # racing build can never leave a truncated .so at the final path.
+        with tempfile.NamedTemporaryFile(
+            dir=cache, suffix=".so.tmp", delete=False
+        ) as tmp:
+            tmp_path = pathlib.Path(tmp.name)
+        proc = subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", str(tmp_path), str(_SRC)],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            tmp_path.unlink(missing_ok=True)
+            raise RuntimeError(f"g++ failed building {_SRC}:\n{proc.stderr}")
+        tmp_path.replace(lib)
+    return lib
+
+
+def _load() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        lib = ctypes.CDLL(str(_build()))
+        lib.run_batch.argtypes = [
+            ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.run_batch.restype = None
+        lib.bench_steps.argtypes = lib.run_batch.argtypes[:-1]
+        lib.bench_steps.restype = ctypes.c_int64
+        _LIB = lib
+    return _LIB
+
+
+def _check_topology(n_prop: int, n_acc: int) -> None:
+    # Mirrors the C++ side's packing limits: voter sets live in uint32
+    # bitmasks and ballots pack (round, pid) with kMaxProposers = 8.
+    if not 1 <= n_prop <= 8:
+        raise ValueError(f"n_prop={n_prop} outside oracle ballot capacity [1, 8]")
+    if not 1 <= n_acc <= 32:
+        raise ValueError(f"n_acc={n_acc} outside oracle bitmask capacity [1, 32]")
+
+
+@dataclasses.dataclass(frozen=True)
+class OracleBatch:
+    """Per-run results over a seed range, as numpy arrays of shape (n_runs,)."""
+
+    decided: np.ndarray
+    agreement_ok: np.ndarray
+    validity_ok: np.ndarray
+    n_chosen: np.ndarray
+    steps: np.ndarray
+
+
+def run_native_batch(
+    seed0: int,
+    n_runs: int,
+    n_prop: int = 2,
+    n_acc: int = 3,
+    p_drop: float = 0.0,
+    p_dup: float = 0.0,
+    timeout_weight: float = 0.05,
+    max_steps: int = 20_000,
+) -> OracleBatch:
+    """Fuzz ``n_runs`` independent single-decree instances in native code."""
+    _check_topology(n_prop, n_acc)
+    lib = _load()
+    out = np.empty((n_runs, 5), dtype=np.int32)
+    lib.run_batch(
+        seed0, n_runs, n_prop, n_acc, p_drop, p_dup, timeout_weight, max_steps,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return OracleBatch(
+        decided=out[:, 0].astype(bool),
+        agreement_ok=out[:, 1].astype(bool),
+        validity_ok=out[:, 2].astype(bool),
+        n_chosen=out[:, 3],
+        steps=out[:, 4],
+    )
+
+
+def bench_native_steps(
+    seed0: int,
+    n_runs: int,
+    n_prop: int = 1,
+    n_acc: int = 3,
+    p_drop: float = 0.0,
+    p_dup: float = 0.0,
+    timeout_weight: float = 0.05,
+    max_steps: int = 20_000,
+) -> int:
+    """Total scheduler events processed (CPU-reference throughput numerator)."""
+    _check_topology(n_prop, n_acc)
+    return int(_load().bench_steps(
+        seed0, n_runs, n_prop, n_acc, p_drop, p_dup, timeout_weight, max_steps
+    ))
+
+
+def main() -> None:
+    """Reproduce the BASELINE.md CPU-reference row:
+
+        python -m paxos_tpu.cpu_ref.native
+    """
+    import json
+    import time
+
+    run_native_batch(0, 10)  # warm the build
+    t0 = time.perf_counter()
+    n_runs = 200_000
+    total = bench_native_steps(0, n_runs, n_prop=1, n_acc=3)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "cpu-ref config1 (1 proposer, 3 acceptors, no faults)",
+        "events_per_sec": round(total / dt, 1),
+        "decisions_per_sec": round(n_runs / dt, 1),
+        "events": total,
+        "seconds": round(dt, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

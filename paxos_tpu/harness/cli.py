@@ -32,6 +32,12 @@ CONFIGS = {
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="paxos_tpu")
+    p.add_argument(
+        "--platform",
+        choices=["default", "cpu"],
+        default="default",
+        help="force the JAX backend (cpu = run without an accelerator)",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     r = sub.add_parser("run", help="run a fuzzing campaign")
@@ -46,6 +52,12 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--checkpoint-dir", default=None)
     r.add_argument("--checkpoint-every", type=int, default=0, help="ticks (0=off)")
     r.add_argument("--resume", default=None, help="checkpoint dir to resume from")
+    r.add_argument("--trace", default=None, help="jax.profiler trace logdir")
+    r.add_argument(
+        "--events",
+        action="store_true",
+        help="per-chunk protocol event dump to stderr (debug; slows the loop)",
+    )
 
     s = sub.add_parser(
         "sweep",
@@ -63,6 +75,7 @@ def cmd_run(args: argparse.Namespace) -> int:
     import jax
 
     from paxos_tpu.harness import checkpoint as ckpt
+    from paxos_tpu.harness import trace as trace_mod
     from paxos_tpu.harness.metrics import MetricsLog
     from paxos_tpu.harness.run import (
         base_key,
@@ -101,20 +114,23 @@ def cmd_run(args: argparse.Namespace) -> int:
              n_inst=cfg.n_inst, protocol=cfg.protocol)
 
     done, since_ckpt = 0, 0
-    while done < args.ticks:
-        n = min(args.chunk, args.ticks - done)
-        state = run_chunk(state, key, plan, cfg.fault, n, step_fn)
-        done += n
-        since_ckpt += n
-        rep = summarize(state)
-        log.emit("chunk", **rep)
-        if args.checkpoint_every and since_ckpt >= args.checkpoint_every:
-            ckpt.save(args.checkpoint_dir, state, plan, cfg)
-            log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
-            since_ckpt = 0
-        # Exact check (a float32 mean can round to != 1.0 at huge scales).
-        if args.until_all_chosen and bool(state.learner.chosen.all()):
-            break
+    with trace_mod.profile(args.trace):
+        while done < args.ticks:
+            n = min(args.chunk, args.ticks - done)
+            state = run_chunk(state, key, plan, cfg.fault, n, step_fn)
+            done += n
+            since_ckpt += n
+            rep = summarize(state)
+            log.emit("chunk", **rep)
+            if args.events:
+                trace_mod.event_dump(state)
+            if args.checkpoint_every and since_ckpt >= args.checkpoint_every:
+                ckpt.save(args.checkpoint_dir, state, plan, cfg)
+                log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
+                since_ckpt = 0
+            # Exact check (a float32 mean can round to != 1.0 at huge scales).
+            if args.until_all_chosen and bool(state.learner.chosen.all()):
+                break
 
     report = summarize(state)
     report["config_fingerprint"] = cfg.fingerprint()
@@ -170,6 +186,12 @@ def cmd_sweep(args: argparse.Namespace) -> int:
 
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.platform == "cpu":
+        # Must happen before any backend use; an env var alone does not stick
+        # because the image's sitecustomize pins the platform list.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     if args.cmd == "run":
         return cmd_run(args)
     if args.cmd == "sweep":

@@ -1,0 +1,62 @@
+"""Tracing / profiling — the management-and-tracing subsystem's TPU twin.
+
+Reference parity (SURVEY.md §6.1): `distributed-process` ships an Mx tracing
+subsystem (per-event hooks on send/receive/spawn/died, trace-to-console)
+[CH].  Here the equivalents are:
+
+- :func:`profile`: a context manager around ``jax.profiler.trace`` — XLA op
+  and memory timelines for a run window, viewable in TensorBoard/Perfetto
+  (`--trace DIR` on the CLI).
+- Named phases: every protocol step function wraps its reply-delivery,
+  request-selection, and checker regions in ``jax.named_scope`` (scopes
+  ``deliver`` / ``acceptor_select`` / ``learner_check``; the unscoped tail
+  of a step is the proposer fold), so profiler timelines show protocol
+  phases instead of a fused soup of HLO ops.
+- :func:`event_dump`: an optional per-chunk host callback printing decided
+  counts and active-ballot histograms — the batch analog of per-event trace
+  logging, behind a flag because host callbacks serialize the device loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def profile(logdir: str | None) -> Iterator[None]:
+    """Wrap a run window in a JAX profiler trace (no-op when logdir is None)."""
+    if not logdir:
+        yield
+        return
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def event_dump(state, stream=sys.stderr) -> None:
+    """Print one JSON line of per-chunk protocol events (host-side readback).
+
+    Works for any protocol state (single-decree or Multi-Paxos learner
+    shapes); intended for debugging runs, not the hot path.
+    """
+    lrn = state.learner
+    chosen = lrn.chosen
+    bal = state.proposer.bal
+    # Active-ballot histogram over proposer rounds (SURVEY.md §6.1).
+    from paxos_tpu.core.ballot import ballot_round
+
+    rounds = ballot_round(bal)
+    rec = {
+        "tick": int(state.tick),
+        "chosen": int(chosen.sum()),
+        "chosen_total": int(chosen.size),
+        "violations": int(lrn.violations.sum()),
+        "round_mean": float(jnp.mean(rounds.astype(jnp.float32))),
+        "round_max": int(jnp.max(rounds)),
+    }
+    print(json.dumps(rec), file=stream)

@@ -74,12 +74,14 @@ def fastpaxos_step(
 
     # Reply delivery decided & delivered slots cleared BEFORE new writes
     # (same no-clobber discipline as protocols.paxos).
-    delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
-    replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
+    with jax.named_scope("deliver"):
+        delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
+        replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
 
     # ---- Acceptor half-tick ----
-    sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-    sel = sel & alive[:, None, None, :]
+    with jax.named_scope("acceptor_select"):
+        sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
+        sel = sel & alive[:, None, None, :]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(1, 2))
@@ -127,12 +129,13 @@ def fastpaxos_step(
     acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
 
     # ---- Learner / safety checker (fast-quorum-aware thresholds) ----
-    learner = learner_observe(
-        state.learner, ok_acc, msg_bal, msg_val, state.tick, quorum,
-        fast_quorum=fquorum,
-    )
-    inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
-    learner = learner.replace(violations=learner.violations + inv_viol)
+    with jax.named_scope("learner_check"):
+        learner = learner_observe(
+            state.learner, ok_acc, msg_bal, msg_val, state.tick, quorum,
+            fast_quorum=fquorum,
+        )
+        inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
+        learner = learner.replace(violations=learner.violations + inv_viol)
 
     # ---- Proposer half-tick ----
     prop = state.proposer

@@ -66,18 +66,20 @@ def multipaxos_step(
         )
 
     # ---- Reply delivery decided & cleared before new writes (no clobber) ----
-    prom_del = state.promises.present & (
-        jax.random.uniform(k_hold_pr, state.promises.present.shape) >= cfg.p_hold
-    )
-    accd_del = state.accepted.present & (
-        jax.random.uniform(k_hold_ac, state.accepted.present.shape) >= cfg.p_hold
-    )
-    promises = state.promises.replace(present=state.promises.present & ~prom_del)
-    accepted = state.accepted.replace(present=state.accepted.present & ~accd_del)
+    with jax.named_scope("deliver"):
+        prom_del = state.promises.present & (
+            jax.random.uniform(k_hold_pr, state.promises.present.shape) >= cfg.p_hold
+        )
+        accd_del = state.accepted.present & (
+            jax.random.uniform(k_hold_ac, state.accepted.present.shape) >= cfg.p_hold
+        )
+        promises = state.promises.replace(present=state.promises.present & ~prom_del)
+        accepted = state.accepted.replace(present=state.accepted.present & ~accd_del)
 
     # ---- Acceptor half-tick ----
-    sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-    sel = sel & alive[:, None, None, :]
+    with jax.named_scope("acceptor_select"):
+        sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
+        sel = sel & alive[:, None, None, :]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(1, 2))
@@ -131,10 +133,11 @@ def multipaxos_step(
     acc = acc.replace(promised=promised, log_bal=log_bal, log_val=log_val)
 
     # ---- Learner / checker ----
-    learner = mp_learner_observe(
-        state.learner, ok_acc, msg_bal, msg_slot, msg_val, state.tick, quorum
-    )
-    chosen_count = learner.chosen.sum(axis=-1, dtype=jnp.int32)  # (I,)
+    with jax.named_scope("learner_check"):
+        learner = mp_learner_observe(
+            state.learner, ok_acc, msg_bal, msg_slot, msg_val, state.tick, quorum
+        )
+        chosen_count = learner.chosen.sum(axis=-1, dtype=jnp.int32)  # (I,)
 
     # ---- Proposer half-tick ----
     bits = jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32)

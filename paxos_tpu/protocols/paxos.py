@@ -68,12 +68,14 @@ def paxos_step(
     # acceptor half-tick writes new replies: otherwise a reply written this
     # tick could land in a slot being consumed and be lost even on a
     # fault-free network.  Proposers read payloads from the pre-tick buffer.
-    delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
-    replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
+    with jax.named_scope("deliver"):
+        delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
+        replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
 
     # ---- Acceptor half-tick: select one request per (instance, acceptor) ----
-    sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-    sel = sel & alive[:, None, None, :]  # crashed acceptors process nothing
+    with jax.named_scope("acceptor_select"):
+        sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
+        sel = sel & alive[:, None, None, :]  # crashed acceptors process nothing
 
     # Gather the selected message's fields onto (I, A).
     def gather(x):
@@ -120,11 +122,12 @@ def paxos_step(
     acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
 
     # ---- Learner / safety checker (omniscient: sees accept events directly) ----
-    learner = learner_observe(
-        state.learner, ok_acc, msg_bal, msg_val, state.tick, quorum
-    )
-    inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
-    learner = learner.replace(violations=learner.violations + inv_viol)
+    with jax.named_scope("learner_check"):
+        learner = learner_observe(
+            state.learner, ok_acc, msg_bal, msg_val, state.tick, quorum
+        )
+        inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
+        learner = learner.replace(violations=learner.violations + inv_viol)
 
     # ---- Proposer half-tick: fold all delivered replies ----
     prop = state.proposer

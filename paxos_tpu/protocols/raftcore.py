@@ -79,12 +79,14 @@ def raftcore_step(
         )
     voter_pre = voter
 
-    delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
-    replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
+    with jax.named_scope("deliver"):
+        delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
+        replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
 
     # ---- Voter half-tick: select one request per (instance, voter) ----
-    sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-    sel = sel & alive[:, None, None, :]
+    with jax.named_scope("acceptor_select"):
+        sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
+        sel = sel & alive[:, None, None, :]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(1, 2))
@@ -131,11 +133,12 @@ def raftcore_step(
     voter = voter.replace(voted=voted, ent_term=ent_term, ent_val=ent_val)
 
     # ---- Learner / safety checker (append-accept events, majority commit) ----
-    learner = learner_observe(
-        state.learner, ok_ap, msg_bal, msg_v1, state.tick, quorum
-    )
-    inv_viol = raft_voter_invariants(voter_pre, voter, honest=~equiv)
-    learner = learner.replace(violations=learner.violations + inv_viol)
+    with jax.named_scope("learner_check"):
+        learner = learner_observe(
+            state.learner, ok_ap, msg_bal, msg_v1, state.tick, quorum
+        )
+        inv_viol = raft_voter_invariants(voter_pre, voter, honest=~equiv)
+        learner = learner.replace(violations=learner.violations + inv_viol)
 
     # ---- Candidate half-tick: fold all delivered replies ----
     cand = state.proposer
