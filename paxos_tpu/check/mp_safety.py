@@ -3,76 +3,71 @@
 The same agreement oracle as :mod:`paxos_tpu.check.safety`, lifted to a log
 axis: every (instance, slot) pair is its own consensus instance, tracked by
 a K-row (ballot, value) -> voter-bitmask table.  Accept events carry a slot
-index; the fold is an unrolled loop over the (small) acceptors axis with a
-one-hot scatter over slots — fixed shapes, no gathers with dynamic extents.
+index; the fold is an unrolled loop over the (small) acceptors axis with
+one-hot slot masks — fixed shapes, instance-minor layout (L, K, I), no
+gathers with dynamic extents.
 """
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
+from paxos_tpu.check.safety import first_true
 from paxos_tpu.core.mp_state import MPLearnerState
 from paxos_tpu.utils.bitops import popcount
 
 
 def mp_learner_observe(
     learner: MPLearnerState,
-    ev_flag: jnp.ndarray,  # (I, A) bool — acceptor a accepted this tick
-    ev_bal: jnp.ndarray,  # (I, A) int32
-    ev_slot: jnp.ndarray,  # (I, A) int32 log slot index
-    ev_val: jnp.ndarray,  # (I, A) int32
+    ev_flag: jnp.ndarray,  # (A, I) bool — acceptor a accepted this tick
+    ev_bal: jnp.ndarray,  # (A, I) int32
+    ev_slot: jnp.ndarray,  # (A, I) int32 log slot index
+    ev_val: jnp.ndarray,  # (A, I) int32
     tick: jnp.ndarray,
     quorum: int,
 ) -> MPLearnerState:
-    n_acc = ev_flag.shape[1]
-    n_slots = learner.lt_bal.shape[1]
-    k = learner.lt_bal.shape[2]
+    n_acc = ev_flag.shape[0]
+    n_slots, k, _ = learner.lt_bal.shape
     lt_bal, lt_val, lt_mask = learner.lt_bal, learner.lt_val, learner.lt_mask
     evictions = learner.evictions
+    slot_ids = jnp.arange(n_slots, dtype=jnp.int32)[:, None]  # (L, 1)
 
-    pre_chosen_rows = popcount(lt_mask) >= quorum  # (I, L, K)
+    pre_chosen_rows = popcount(lt_mask) >= quorum  # (L, K, I)
 
     for a in range(n_acc):
-        b, s, v = ev_bal[:, a], ev_slot[:, a], ev_val[:, a]
-        f = ev_flag[:, a] & (b > 0)
-        oh_slot = jax.nn.one_hot(s, n_slots, dtype=jnp.bool_)  # (I, L)
+        b, s, v = ev_bal[a], ev_slot[a], ev_val[a]  # (I,)
+        f = ev_flag[a] & (b > 0)
+        oh_slot = s[None] == slot_ids  # (L, I)
 
         # Re-confirmations of an already-chosen value carry no violation
         # potential (agreement compares against chosen_val; the same value
         # cannot disagree) — skip them to keep table pressure (evictions)
         # proportional to genuinely competing proposals.
-        ch_s = jnp.take_along_axis(learner.chosen, s[:, None], axis=1)[:, 0]
-        cv_s = jnp.take_along_axis(learner.chosen_val, s[:, None], axis=1)[:, 0]
+        ch_s = (learner.chosen & oh_slot).any(axis=0)  # (I,)
+        cv_s = jnp.where(oh_slot, learner.chosen_val, 0).sum(axis=0)  # (I,)
         f = f & ~(ch_s & (v == cv_s))
 
         match = (
-            (lt_bal == b[:, None, None])
-            & (lt_val == v[:, None, None])
-            & oh_slot[:, :, None]
-            & f[:, None, None]
-        )  # (I, L, K)
-        any_match = match.any(axis=(1, 2))  # (I,)
+            (lt_bal == b[None, None])
+            & (lt_val == v[None, None])
+            & oh_slot[:, None]
+            & f[None, None]
+        )  # (L, K, I)
+        any_match = match.any(axis=(0, 1))  # (I,)
 
         # Candidate insertion row: the min-ballot row of the event's slot.
-        row_bal = jnp.take_along_axis(
-            lt_bal, jnp.broadcast_to(s[:, None, None], (s.shape[0], 1, k)), axis=1
-        )[:, 0, :]  # (I, K)
-        min_row = jnp.argmin(row_bal, axis=-1)  # (I,)
-        min_bal = jnp.take_along_axis(row_bal, min_row[:, None], axis=-1)[:, 0]
+        row_bal = jnp.where(oh_slot[:, None], lt_bal, 0).sum(axis=0)  # (K, I)
+        min_bal = row_bal.min(axis=0)  # (I,)
+        ins_row = first_true(row_bal == min_bal[None], axis=0)  # (K, I)
         can_insert = (min_bal == 0) | (b > min_bal)
         do_insert = f & ~any_match & can_insert
         missed = f & ~any_match & ~can_insert
         bit = jnp.asarray(1 << a, jnp.int32)
 
         lt_mask = jnp.where(match, lt_mask | bit, lt_mask)
-        ins = (
-            oh_slot[:, :, None]
-            & jax.nn.one_hot(min_row, k, dtype=jnp.bool_)[:, None, :]
-            & do_insert[:, None, None]
-        )
-        lt_bal = jnp.where(ins, b[:, None, None], lt_bal)
-        lt_val = jnp.where(ins, v[:, None, None], lt_val)
+        ins = oh_slot[:, None] & ins_row[None] & do_insert[None, None]  # (L, K, I)
+        lt_bal = jnp.where(ins, b[None, None], lt_bal)
+        lt_val = jnp.where(ins, v[None, None], lt_val)
         lt_mask = jnp.where(ins, bit, lt_mask)
         evictions = (
             evictions
@@ -80,12 +75,11 @@ def mp_learner_observe(
             + (do_insert & (min_bal != 0)).astype(jnp.int32)
         )
 
-    chosen_rows = popcount(lt_mask) >= quorum  # (I, L, K)
+    chosen_rows = popcount(lt_mask) >= quorum  # (L, K, I)
     newly = chosen_rows & ~pre_chosen_rows
-    any_new = newly.any(axis=-1)  # (I, L)
+    any_new = newly.any(axis=1)  # (L, I)
 
-    first_idx = jnp.argmax(newly, axis=-1)  # (I, L)
-    first_val = jnp.take_along_axis(lt_val, first_idx[..., None], axis=-1)[..., 0]
+    first_val = jnp.where(first_true(newly, axis=1), lt_val, 0).sum(axis=1)  # (L, I)
 
     chosen_val = jnp.where(
         learner.chosen, learner.chosen_val, jnp.where(any_new, first_val, 0)
@@ -96,8 +90,8 @@ def mp_learner_observe(
     )
 
     viol = (
-        (newly & (lt_val != chosen_val[..., None]) & chosen[..., None])
-        .sum(axis=(1, 2), dtype=jnp.int32)
+        (newly & (lt_val != chosen_val[:, None]) & chosen[:, None])
+        .sum(axis=(0, 1), dtype=jnp.int32)
     )
 
     return learner.replace(

@@ -22,22 +22,30 @@ Completeness bound: the table holds K pairs, evicting the lowest ballot;
 ``evictions`` counts both evictions and rejected inserts.  A run with
 ``evictions == 0`` (all tests and all BASELINE configs) has a *complete*
 checker: no accept event escaped quorum accounting.
+
+Layout: tables are (K, I) — instance-minor like everything else — so the
+table fold is pure elementwise work plus tiny cross-sublane reductions over
+K; slot argmins become min+cumsum first-slot masks, never gathers.
 """
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from paxos_tpu.core.state import AcceptorState, LearnerState
 from paxos_tpu.utils.bitops import popcount
 
 
+def first_true(mask: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
+    """Boolean mask selecting the first True along ``axis`` (all-False-safe)."""
+    return mask & (jnp.cumsum(mask, axis=axis) == 1)
+
+
 def learner_observe(
     learner: LearnerState,
-    ev_flag: jnp.ndarray,  # (I, A) bool: acceptor a accepted something this tick
-    ev_bal: jnp.ndarray,  # (I, A) int32
-    ev_val: jnp.ndarray,  # (I, A) int32
+    ev_flag: jnp.ndarray,  # (A, I) bool: acceptor a accepted something this tick
+    ev_bal: jnp.ndarray,  # (A, I) int32
+    ev_val: jnp.ndarray,  # (A, I) int32
     tick: jnp.ndarray,  # () int32
     quorum: int,
     fast_quorum: int | None = None,
@@ -49,7 +57,7 @@ def learner_observe(
     need ``quorum``.  Per-slot thresholds are recomputed from the table's
     ballots, so one table serves both round kinds.
     """
-    n_acc = ev_flag.shape[1]
+    n_acc = ev_flag.shape[0]
     lt_bal, lt_val, lt_mask = learner.lt_bal, learner.lt_val, learner.lt_mask
     evictions = learner.evictions
 
@@ -60,48 +68,52 @@ def learner_observe(
 
         return jnp.where(ballot_round(bal) == 0, fast_quorum, quorum)
 
-    pre_chosen_slots = popcount(lt_mask) >= slot_quorum(lt_bal)  # (I, K)
+    pre_chosen_slots = popcount(lt_mask) >= slot_quorum(lt_bal)  # (K, I)
 
     # At most one accept event per acceptor per tick (one-message-per-actor
     # scheduling), so an unrolled sequential fold over the small acceptors
     # axis is exact: a second acceptor hitting a just-inserted pair matches it.
     for a in range(n_acc):
-        b, v, f = ev_bal[:, a], ev_val[:, a], ev_flag[:, a]
+        b, v, f = ev_bal[a], ev_val[a], ev_flag[a]  # (I,)
         f = f & (b > 0)
-        match = (lt_bal == b[:, None]) & (lt_val == v[:, None]) & (b[:, None] > 0)
-        any_match = match.any(axis=-1)
-        min_slot = jnp.argmin(lt_bal, axis=-1)  # empty slots (bal 0) win first
-        min_bal = jnp.take_along_axis(lt_bal, min_slot[:, None], axis=-1)[:, 0]
+        match = (lt_bal == b[None]) & (lt_val == v[None]) & (b[None] > 0)  # (K, I)
+        any_match = match.any(axis=0)  # (I,)
+        min_bal = lt_bal.min(axis=0)  # (I,); empty slots (bal 0) win first
+        ins_slot = first_true(lt_bal == min_bal[None], axis=0)  # (K, I)
         can_insert = (min_bal == 0) | (b > min_bal)
         do_insert = f & ~any_match & can_insert
         missed = f & ~any_match & ~can_insert
         bit = jnp.asarray(1 << a, jnp.int32)
 
-        lt_mask = jnp.where(match & f[:, None], lt_mask | bit, lt_mask)
-        ins = jax.nn.one_hot(min_slot, lt_bal.shape[1], dtype=jnp.bool_)
-        ins = ins & do_insert[:, None]
-        lt_bal = jnp.where(ins, b[:, None], lt_bal)
-        lt_val = jnp.where(ins, v[:, None], lt_val)
+        lt_mask = jnp.where(match & f[None], lt_mask | bit, lt_mask)
+        ins = ins_slot & do_insert[None]
+        lt_bal = jnp.where(ins, b[None], lt_bal)
+        lt_val = jnp.where(ins, v[None], lt_val)
         lt_mask = jnp.where(ins, bit, lt_mask)
-        evictions = evictions + missed.astype(jnp.int32) + (do_insert & (min_bal != 0)).astype(jnp.int32)
+        evictions = (
+            evictions
+            + missed.astype(jnp.int32)
+            + (do_insert & (min_bal != 0)).astype(jnp.int32)
+        )
 
-    chosen_slots = popcount(lt_mask) >= slot_quorum(lt_bal)  # (I, K)
+    chosen_slots = popcount(lt_mask) >= slot_quorum(lt_bal)  # (K, I)
     newly_chosen = chosen_slots & ~pre_chosen_slots
-    any_new = newly_chosen.any(axis=-1)
+    any_new = newly_chosen.any(axis=0)  # (I,)
 
     # First newly chosen value (slot order is arbitrary but deterministic).
-    first_idx = jnp.argmax(newly_chosen, axis=-1)
-    first_val = jnp.take_along_axis(lt_val, first_idx[:, None], axis=-1)[:, 0]
+    first_val = jnp.where(first_true(newly_chosen, axis=0), lt_val, 0).sum(axis=0)
 
-    chosen_val = jnp.where(learner.chosen, learner.chosen_val, jnp.where(any_new, first_val, 0))
+    chosen_val = jnp.where(
+        learner.chosen, learner.chosen_val, jnp.where(any_new, first_val, 0)
+    )
     chosen = learner.chosen | any_new
     chosen_tick = jnp.where(
         learner.chosen, learner.chosen_tick, jnp.where(any_new, tick, -1)
     )
 
     # Agreement: every newly chosen slot must carry THE chosen value.
-    viol = (newly_chosen & (lt_val != chosen_val[:, None]) & chosen[:, None]).sum(
-        axis=-1, dtype=jnp.int32
+    viol = (newly_chosen & (lt_val != chosen_val[None]) & chosen[None]).sum(
+        axis=0, dtype=jnp.int32
     )
 
     return learner.replace(
@@ -129,7 +141,7 @@ def acceptor_invariants(
     bound = new.acc_bal > new.promised
     nilpair = (new.acc_bal == 0) & (new.acc_val != 0)
     bad = (mono | bound | nilpair) & honest
-    return bad.sum(axis=-1, dtype=jnp.int32)
+    return bad.sum(axis=0, dtype=jnp.int32)
 
 
 def raft_voter_invariants(old, new, honest: jnp.ndarray) -> jnp.ndarray:
@@ -148,4 +160,4 @@ def raft_voter_invariants(old, new, honest: jnp.ndarray) -> jnp.ndarray:
     ent_mono = new.ent_term < old.ent_term
     nilpair = (new.ent_term == 0) & (new.ent_val != 0)
     bad = (mono | bound | ent_mono | nilpair) & honest
-    return bad.sum(axis=-1, dtype=jnp.int32)
+    return bad.sum(axis=0, dtype=jnp.int32)

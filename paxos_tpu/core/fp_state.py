@@ -47,30 +47,32 @@ def fast_ballot() -> jnp.ndarray:
 
 @struct.dataclass
 class FastProposerState:
-    bal: jnp.ndarray  # (I, P) int32 current ballot (fast_ballot() in FAST)
-    phase: jnp.ndarray  # (I, P) int32 in {P1, P2, DONE, FAST}
-    own_val: jnp.ndarray  # (I, P) int32 value this proposer wants
-    prop_val: jnp.ndarray  # (I, P) int32 value sent in classic phase 2
-    heard: jnp.ndarray  # (I, P) int32 acceptor bitmask for current phase
-    best_bal: jnp.ndarray  # (I, P) int32 highest prev-accepted ballot seen in P1
-    rep_mask: jnp.ndarray  # (I, P, V) int32: acceptors reporting value v at best_bal
-    timer: jnp.ndarray  # (I, P) int32 ticks since phase start (<0: backoff)
-    decided_val: jnp.ndarray  # (I, P) int32 value this proposer saw decided
+    bal: jnp.ndarray  # (P, I) int32 current ballot (fast_ballot() in FAST)
+    phase: jnp.ndarray  # (P, I) int32 in {P1, P2, DONE, FAST}
+    own_val: jnp.ndarray  # (P, I) int32 value this proposer wants
+    prop_val: jnp.ndarray  # (P, I) int32 value sent in classic phase 2
+    heard: jnp.ndarray  # (P, I) int32 acceptor bitmask for current phase
+    best_bal: jnp.ndarray  # (P, I) int32 highest prev-accepted ballot seen in P1
+    rep_mask: jnp.ndarray  # (P, V, I) int32: acceptors reporting value v at best_bal
+    timer: jnp.ndarray  # (P, I) int32 ticks since phase start (<0: backoff)
+    decided_val: jnp.ndarray  # (P, I) int32 value this proposer saw decided
 
     @classmethod
     def init(cls, n_inst: int, n_prop: int) -> "FastProposerState":
         def z():
-            return jnp.zeros((n_inst, n_prop), jnp.int32)
+            return jnp.zeros((n_prop, n_inst), jnp.int32)
 
-        pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), (n_inst, n_prop))
+        pid = jnp.broadcast_to(
+            jnp.arange(n_prop, dtype=jnp.int32)[:, None], (n_prop, n_inst)
+        )
         return cls(
-            bal=jnp.broadcast_to(fast_ballot(), (n_inst, n_prop)),
-            phase=jnp.full((n_inst, n_prop), FAST, jnp.int32),
+            bal=jnp.broadcast_to(fast_ballot(), (n_prop, n_inst)),
+            phase=jnp.full((n_prop, n_inst), FAST, jnp.int32),
             own_val=pid + VALUE_BASE,
             prop_val=z(),
             heard=z(),
             best_bal=z(),
-            rep_mask=jnp.zeros((n_inst, n_prop, n_prop), jnp.int32),
+            rep_mask=jnp.zeros((n_prop, n_prop, n_inst), jnp.int32),
             timer=z(),
             decided_val=z(),
         )
@@ -104,15 +106,15 @@ class FastPaxosState:
         # The fast round is in flight at tick 0: every proposer's
         # Accept(fast_bal, own_val) broadcast occupies its ACCEPT slots.
         requests = MsgBuf.empty(n_inst, n_prop, n_acc)
-        shape = (n_inst, n_prop, n_acc)
+        shape = (n_prop, n_acc, n_inst)
         requests = requests.replace(
-            bal=requests.bal.at[:, ACCEPT].set(
-                jnp.broadcast_to(proposer.bal[:, :, None], shape)
+            bal=requests.bal.at[ACCEPT].set(
+                jnp.broadcast_to(proposer.bal[:, None], shape)
             ),
-            v1=requests.v1.at[:, ACCEPT].set(
-                jnp.broadcast_to(proposer.own_val[:, :, None], shape)
+            v1=requests.v1.at[ACCEPT].set(
+                jnp.broadcast_to(proposer.own_val[:, None], shape)
             ),
-            present=requests.present.at[:, ACCEPT].set(True),
+            present=requests.present.at[ACCEPT].set(True),
         )
         return cls(
             acceptor=AcceptorState.init(n_inst, n_acc),

@@ -15,10 +15,16 @@ Two buffer families, each with a ``kind`` axis of size 2:
 - replies,  acceptor→proposer:  kind 0 = PROMISE(bal, prev_bal, prev_val),
                                 kind 1 = ACCEPTED(bal, val)
 
-Array shape is ``(instances, 2, n_prop, n_acc)`` throughout; int32 payloads,
+Array shape is ``(2, n_prop, n_acc, instances)`` throughout; int32 payloads,
 bool presence.  Asynchrony (delay, reordering, duplication, loss) is realized
 by the transport's per-tick masks over these slots, not by queues — see
 ``paxos_tpu.transport.inmemory_tpu``.
+
+Layout note (TPU): ``instances`` is the LAST axis of every array in the
+framework.  The minor (lane) dimension of a TPU vector register holds 128
+elements; with the huge instances axis minor, every elementwise op runs at
+full lane occupancy, where an ``(I, ..., 5)`` layout would waste 123/128
+lanes (measured ~9x step-time difference at 1M instances).
 """
 
 from __future__ import annotations
@@ -42,14 +48,14 @@ class MsgBuf:
     the kind (see module docstring); ``present`` marks occupied slots.
     """
 
-    bal: jnp.ndarray  # (I, 2, P, A) int32
-    v1: jnp.ndarray  # (I, 2, P, A) int32
-    v2: jnp.ndarray  # (I, 2, P, A) int32
-    present: jnp.ndarray  # (I, 2, P, A) bool
+    bal: jnp.ndarray  # (2, P, A, I) int32
+    v1: jnp.ndarray  # (2, P, A, I) int32
+    v2: jnp.ndarray  # (2, P, A, I) int32
+    present: jnp.ndarray  # (2, P, A, I) bool
 
     @classmethod
     def empty(cls, n_inst: int, n_prop: int, n_acc: int) -> "MsgBuf":
-        shape = (n_inst, 2, n_prop, n_acc)
+        shape = (2, n_prop, n_acc, n_inst)
         # Fresh buffer per field: aliased leaves break buffer donation.
         return cls(
             bal=jnp.zeros(shape, jnp.int32),

@@ -36,47 +36,47 @@ LEAD = 2  # distinguished leader, driving slots
 
 @struct.dataclass
 class MPAcceptorState:
-    promised: jnp.ndarray  # (I, A) int32 — one promise covers every slot
-    log_bal: jnp.ndarray  # (I, A, L) int32 accepted ballot per slot
-    log_val: jnp.ndarray  # (I, A, L) int32 accepted value per slot
+    promised: jnp.ndarray  # (A, I) int32 — one promise covers every slot
+    log_bal: jnp.ndarray  # (A, L, I) int32 accepted ballot per slot
+    log_val: jnp.ndarray  # (A, L, I) int32 accepted value per slot
 
     @classmethod
     def init(cls, n_inst: int, n_acc: int, log_len: int) -> "MPAcceptorState":
         return cls(
-            promised=jnp.zeros((n_inst, n_acc), jnp.int32),
-            log_bal=jnp.zeros((n_inst, n_acc, log_len), jnp.int32),
-            log_val=jnp.zeros((n_inst, n_acc, log_len), jnp.int32),
+            promised=jnp.zeros((n_acc, n_inst), jnp.int32),
+            log_bal=jnp.zeros((n_acc, log_len, n_inst), jnp.int32),
+            log_val=jnp.zeros((n_acc, log_len, n_inst), jnp.int32),
         )
 
 
 @struct.dataclass
 class MPProposerState:
-    bal: jnp.ndarray  # (I, P) int32 current ballot
-    phase: jnp.ndarray  # (I, P) int32 in {FOLLOW, CANDIDATE, LEAD}
-    heard: jnp.ndarray  # (I, P) int32 acceptor bitmask (phase-1 or current slot)
-    commit_idx: jnp.ndarray  # (I, P) int32 next slot this leader drives
-    recov_bal: jnp.ndarray  # (I, P, L) int32 highest accepted ballot per slot (from promises)
-    recov_val: jnp.ndarray  # (I, P, L) int32 its value
-    lease_timer: jnp.ndarray  # (I, P) int32 ticks since observed progress
-    last_chosen_count: jnp.ndarray  # (I, P) int32 chosen slots last observed
-    candidate_timer: jnp.ndarray  # (I, P) int32 ticks spent as candidate
+    bal: jnp.ndarray  # (P, I) int32 current ballot
+    phase: jnp.ndarray  # (P, I) int32 in {FOLLOW, CANDIDATE, LEAD}
+    heard: jnp.ndarray  # (P, I) int32 acceptor bitmask (phase-1 or current slot)
+    commit_idx: jnp.ndarray  # (P, I) int32 next slot this leader drives
+    recov_bal: jnp.ndarray  # (P, L, I) int32 highest accepted ballot per slot (from promises)
+    recov_val: jnp.ndarray  # (P, L, I) int32 its value
+    lease_timer: jnp.ndarray  # (P, I) int32 ticks since observed progress
+    last_chosen_count: jnp.ndarray  # (P, I) int32 chosen slots last observed
+    candidate_timer: jnp.ndarray  # (P, I) int32 ticks spent as candidate
 
     @classmethod
     def init(
         cls, n_inst: int, n_prop: int, log_len: int, lease_init: int = 0
     ) -> "MPProposerState":
         def z():
-            return jnp.zeros((n_inst, n_prop), jnp.int32)
+            return jnp.zeros((n_prop, n_inst), jnp.int32)
 
         return cls(
             bal=z(),  # NIL: nobody has a ballot until first election
             phase=z(),  # FOLLOW
             heard=z(),
             commit_idx=z(),
-            recov_bal=jnp.zeros((n_inst, n_prop, log_len), jnp.int32),
-            recov_val=jnp.zeros((n_inst, n_prop, log_len), jnp.int32),
+            recov_bal=jnp.zeros((n_prop, log_len, n_inst), jnp.int32),
+            recov_val=jnp.zeros((n_prop, log_len, n_inst), jnp.int32),
             # Head start: the first election should not wait a full lease.
-            lease_timer=jnp.full((n_inst, n_prop), lease_init, jnp.int32),
+            lease_timer=jnp.full((n_prop, n_inst), lease_init, jnp.int32),
             last_chosen_count=z(),
             candidate_timer=z(),
         )
@@ -90,27 +90,27 @@ class MPLearnerState:
     Multi-Paxos uses few ballots per slot; evictions are counted).
     """
 
-    lt_bal: jnp.ndarray  # (I, L, K) int32
-    lt_val: jnp.ndarray  # (I, L, K) int32
-    lt_mask: jnp.ndarray  # (I, L, K) int32
-    chosen: jnp.ndarray  # (I, L) bool
-    chosen_val: jnp.ndarray  # (I, L) int32
-    chosen_tick: jnp.ndarray  # (I, L) int32 (-1 if not chosen)
+    lt_bal: jnp.ndarray  # (L, K, I) int32
+    lt_val: jnp.ndarray  # (L, K, I) int32
+    lt_mask: jnp.ndarray  # (L, K, I) int32
+    chosen: jnp.ndarray  # (L, I) bool
+    chosen_val: jnp.ndarray  # (L, I) int32
+    chosen_tick: jnp.ndarray  # (L, I) int32 (-1 if not chosen)
     violations: jnp.ndarray  # (I,) int32
     evictions: jnp.ndarray  # (I,) int32
 
     @classmethod
     def init(cls, n_inst: int, log_len: int, k: int = 4) -> "MPLearnerState":
         def zk():
-            return jnp.zeros((n_inst, log_len, k), jnp.int32)
+            return jnp.zeros((log_len, k, n_inst), jnp.int32)
 
         return cls(
             lt_bal=zk(),
             lt_val=zk(),
             lt_mask=zk(),
-            chosen=jnp.zeros((n_inst, log_len), jnp.bool_),
-            chosen_val=jnp.zeros((n_inst, log_len), jnp.int32),
-            chosen_tick=jnp.full((n_inst, log_len), -1, jnp.int32),
+            chosen=jnp.zeros((log_len, n_inst), jnp.bool_),
+            chosen_val=jnp.zeros((log_len, n_inst), jnp.int32),
+            chosen_tick=jnp.full((log_len, n_inst), -1, jnp.int32),
             violations=jnp.zeros((n_inst,), jnp.int32),
             evictions=jnp.zeros((n_inst,), jnp.int32),
         )
@@ -120,18 +120,18 @@ class MPLearnerState:
 class PromiseBuf:
     """Promise replies with full-log recovery payload: one slot per (p, a) edge."""
 
-    present: jnp.ndarray  # (I, P, A) bool
-    bal: jnp.ndarray  # (I, P, A) int32 — the promised ballot
-    pb: jnp.ndarray  # (I, P, A, L) int32 — accepted ballot per log slot
-    pv: jnp.ndarray  # (I, P, A, L) int32 — accepted value per log slot
+    present: jnp.ndarray  # (P, A, I) bool
+    bal: jnp.ndarray  # (P, A, I) int32 — the promised ballot
+    pb: jnp.ndarray  # (P, A, L, I) int32 — accepted ballot per log slot
+    pv: jnp.ndarray  # (P, A, L, I) int32 — accepted value per log slot
 
     @classmethod
     def empty(cls, n_inst: int, n_prop: int, n_acc: int, log_len: int) -> "PromiseBuf":
         return cls(
-            present=jnp.zeros((n_inst, n_prop, n_acc), jnp.bool_),
-            bal=jnp.zeros((n_inst, n_prop, n_acc), jnp.int32),
-            pb=jnp.zeros((n_inst, n_prop, n_acc, log_len), jnp.int32),
-            pv=jnp.zeros((n_inst, n_prop, n_acc, log_len), jnp.int32),
+            present=jnp.zeros((n_prop, n_acc, n_inst), jnp.bool_),
+            bal=jnp.zeros((n_prop, n_acc, n_inst), jnp.int32),
+            pb=jnp.zeros((n_prop, n_acc, log_len, n_inst), jnp.int32),
+            pv=jnp.zeros((n_prop, n_acc, log_len, n_inst), jnp.int32),
         )
 
 
@@ -139,18 +139,18 @@ class PromiseBuf:
 class AcceptedBuf:
     """Accepted replies: (ballot, slot, value) per (p, a) edge."""
 
-    present: jnp.ndarray  # (I, P, A) bool
-    bal: jnp.ndarray  # (I, P, A) int32
-    slot: jnp.ndarray  # (I, P, A) int32
-    val: jnp.ndarray  # (I, P, A) int32
+    present: jnp.ndarray  # (P, A, I) bool
+    bal: jnp.ndarray  # (P, A, I) int32
+    slot: jnp.ndarray  # (P, A, I) int32
+    val: jnp.ndarray  # (P, A, I) int32
 
     @classmethod
     def empty(cls, n_inst: int, n_prop: int, n_acc: int) -> "AcceptedBuf":
         return cls(
-            present=jnp.zeros((n_inst, n_prop, n_acc), jnp.bool_),
-            bal=jnp.zeros((n_inst, n_prop, n_acc), jnp.int32),
-            slot=jnp.zeros((n_inst, n_prop, n_acc), jnp.int32),
-            val=jnp.zeros((n_inst, n_prop, n_acc), jnp.int32),
+            present=jnp.zeros((n_prop, n_acc, n_inst), jnp.bool_),
+            bal=jnp.zeros((n_prop, n_acc, n_inst), jnp.int32),
+            slot=jnp.zeros((n_prop, n_acc, n_inst), jnp.int32),
+            val=jnp.zeros((n_prop, n_acc, n_inst), jnp.int32),
         )
 
 
@@ -195,4 +195,4 @@ class MultiPaxosState:
 
     @property
     def log_len(self) -> int:
-        return self.acceptor.log_bal.shape[2]
+        return self.acceptor.log_bal.shape[1]

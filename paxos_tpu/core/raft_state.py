@@ -43,7 +43,7 @@ VALUE_BASE = 100  # candidate p proposes VALUE_BASE + p when its log is empty
 
 @struct.dataclass
 class VoterState:
-    """(I, A) per-voter durable state.
+    """(A, I) per-voter durable state.
 
     ``voted`` is the Paxos-promise-shaped cell: the highest term this voter
     has either granted a vote to or accepted an append from.  Raising it on
@@ -51,36 +51,38 @@ class VoterState:
     Raft's currentTerm update on AppendEntries.
     """
 
-    voted: jnp.ndarray  # (I, A) int32 packed term; 0 = none yet
-    ent_term: jnp.ndarray  # (I, A) int32 packed term of stored entry; 0 = empty
-    ent_val: jnp.ndarray  # (I, A) int32 stored entry value
+    voted: jnp.ndarray  # (A, I) int32 packed term; 0 = none yet
+    ent_term: jnp.ndarray  # (A, I) int32 packed term of stored entry; 0 = empty
+    ent_val: jnp.ndarray  # (A, I) int32 stored entry value
 
     @classmethod
     def init(cls, n_inst: int, n_acc: int) -> "VoterState":
         def z():
-            return jnp.zeros((n_inst, n_acc), jnp.int32)
+            return jnp.zeros((n_acc, n_inst), jnp.int32)
 
         return cls(voted=z(), ent_term=z(), ent_val=z())
 
 
 @struct.dataclass
 class CandidateState:
-    bal: jnp.ndarray  # (I, P) int32 current term (packed ballot)
-    phase: jnp.ndarray  # (I, P) int32 in {CAND, LEAD, DONE}
-    own_val: jnp.ndarray  # (I, P) int32 value proposed if log empty
-    prop_val: jnp.ndarray  # (I, P) int32 value being appended while LEAD
-    heard: jnp.ndarray  # (I, P) int32 voter bitmask (grants in CAND, acks in LEAD)
-    ent_term: jnp.ndarray  # (I, P) int32 candidate's own log entry term
-    ent_val: jnp.ndarray  # (I, P) int32 candidate's own log entry value
-    timer: jnp.ndarray  # (I, P) int32 ticks since phase start (<0: backoff)
-    decided_val: jnp.ndarray  # (I, P) int32 value this candidate saw committed
+    bal: jnp.ndarray  # (P, I) int32 current term (packed ballot)
+    phase: jnp.ndarray  # (P, I) int32 in {CAND, LEAD, DONE}
+    own_val: jnp.ndarray  # (P, I) int32 value proposed if log empty
+    prop_val: jnp.ndarray  # (P, I) int32 value being appended while LEAD
+    heard: jnp.ndarray  # (P, I) int32 voter bitmask (grants in CAND, acks in LEAD)
+    ent_term: jnp.ndarray  # (P, I) int32 candidate's own log entry term
+    ent_val: jnp.ndarray  # (P, I) int32 candidate's own log entry value
+    timer: jnp.ndarray  # (P, I) int32 ticks since phase start (<0: backoff)
+    decided_val: jnp.ndarray  # (P, I) int32 value this candidate saw committed
 
     @classmethod
     def init(cls, n_inst: int, n_prop: int) -> "CandidateState":
         def z():
-            return jnp.zeros((n_inst, n_prop), jnp.int32)
+            return jnp.zeros((n_prop, n_inst), jnp.int32)
 
-        pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), (n_inst, n_prop))
+        pid = jnp.broadcast_to(
+            jnp.arange(n_prop, dtype=jnp.int32)[:, None], (n_prop, n_inst)
+        )
         return cls(
             bal=make_ballot(jnp.zeros_like(pid), pid),
             phase=z(),  # CAND
@@ -121,12 +123,12 @@ class RaftState:
         proposer = CandidateState.init(n_inst, n_prop)
         # Every candidate opens with a RequestVote broadcast in flight.
         requests = MsgBuf.empty(n_inst, n_prop, n_acc)
-        shape = (n_inst, n_prop, n_acc)
+        shape = (n_prop, n_acc, n_inst)
         requests = requests.replace(
-            bal=requests.bal.at[:, REQVOTE].set(
-                jnp.broadcast_to(proposer.bal[:, :, None], shape)
+            bal=requests.bal.at[REQVOTE].set(
+                jnp.broadcast_to(proposer.bal[:, None], shape)
             ),
-            present=requests.present.at[:, REQVOTE].set(True),
+            present=requests.present.at[REQVOTE].set(True),
         )
         return cls(
             acceptor=VoterState.init(n_inst, n_acc),

@@ -5,17 +5,18 @@ loop-carried state becomes a field of a batched dataclass over the
 ``instances`` axis:
 
 - Acceptor process state (``promisedBallot``, ``acceptedBallot``,
-  ``acceptedValue``) -> :class:`AcceptorState`, shape ``(I, A)``.
+  ``acceptedValue``) -> :class:`AcceptorState`, shape ``(A, I)``.
 - Proposer process state (current ballot, phase, collected promises, the
-  value to propose, retry timer) -> :class:`ProposerState`, shape ``(I, P)``.
+  value to propose, retry timer) -> :class:`ProposerState`, shape ``(P, I)``.
 - Learner process state (per-ballot Accepted counts) -> :class:`LearnerState`,
   a bounded top-K table of (ballot, value) -> acceptor-bitmask, shape
-  ``(I, K)`` — the on-device twin of the learner's quorum counting, and the
+  ``(K, I)`` — the on-device twin of the learner's quorum counting, and the
   substrate of the safety checker (``paxos_tpu.check.safety``).
 
 Everything is int32/bool; NIL ballots/values are 0.  All dataclasses are
 immutable flax pytrees, so the whole simulator state is one pytree that
-``lax.scan`` carries and ``pjit`` shards on its leading axis.
+``lax.scan`` carries and ``pjit`` shards on its trailing ``instances`` axis
+(instance-minor layout — see ``core.messages`` for why).
 """
 
 from __future__ import annotations
@@ -34,37 +35,39 @@ DONE = 2  # proposer observed a quorum of Accepted for its ballot
 
 @struct.dataclass
 class AcceptorState:
-    promised: jnp.ndarray  # (I, A) int32 ballot; highest ballot promised
-    acc_bal: jnp.ndarray  # (I, A) int32 ballot of last accepted proposal
-    acc_val: jnp.ndarray  # (I, A) int32 value of last accepted proposal
+    promised: jnp.ndarray  # (A, I) int32 ballot; highest ballot promised
+    acc_bal: jnp.ndarray  # (A, I) int32 ballot of last accepted proposal
+    acc_val: jnp.ndarray  # (A, I) int32 value of last accepted proposal
 
     @classmethod
     def init(cls, n_inst: int, n_acc: int) -> "AcceptorState":
         # Fresh buffer per field: aliased leaves break buffer donation.
         def z():
-            return jnp.zeros((n_inst, n_acc), jnp.int32)
+            return jnp.zeros((n_acc, n_inst), jnp.int32)
 
         return cls(promised=z(), acc_bal=z(), acc_val=z())
 
 
 @struct.dataclass
 class ProposerState:
-    bal: jnp.ndarray  # (I, P) int32 current ballot
-    phase: jnp.ndarray  # (I, P) int32 in {P1, P2, DONE}
-    own_val: jnp.ndarray  # (I, P) int32 value this proposer wants
-    prop_val: jnp.ndarray  # (I, P) int32 value sent in phase 2 (else NIL)
-    heard: jnp.ndarray  # (I, P) int32 acceptor bitmask for current phase
-    best_bal: jnp.ndarray  # (I, P) int32 highest prev-accepted ballot seen
-    best_val: jnp.ndarray  # (I, P) int32 its value
-    timer: jnp.ndarray  # (I, P) int32 ticks since phase start (can be <0: backoff)
-    decided_val: jnp.ndarray  # (I, P) int32 value this proposer saw decided
+    bal: jnp.ndarray  # (P, I) int32 current ballot
+    phase: jnp.ndarray  # (P, I) int32 in {P1, P2, DONE}
+    own_val: jnp.ndarray  # (P, I) int32 value this proposer wants
+    prop_val: jnp.ndarray  # (P, I) int32 value sent in phase 2 (else NIL)
+    heard: jnp.ndarray  # (P, I) int32 acceptor bitmask for current phase
+    best_bal: jnp.ndarray  # (P, I) int32 highest prev-accepted ballot seen
+    best_val: jnp.ndarray  # (P, I) int32 its value
+    timer: jnp.ndarray  # (P, I) int32 ticks since phase start (can be <0: backoff)
+    decided_val: jnp.ndarray  # (P, I) int32 value this proposer saw decided
 
     @classmethod
     def init(cls, n_inst: int, n_prop: int) -> "ProposerState":
         def z():
-            return jnp.zeros((n_inst, n_prop), jnp.int32)
+            return jnp.zeros((n_prop, n_inst), jnp.int32)
 
-        pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), (n_inst, n_prop))
+        pid = jnp.broadcast_to(
+            jnp.arange(n_prop, dtype=jnp.int32)[:, None], (n_prop, n_inst)
+        )
         return cls(
             bal=make_ballot(jnp.zeros_like(pid), pid),  # all start at round 0
             phase=z(),  # P1
@@ -88,9 +91,9 @@ class LearnerState:
     checker's completeness bound was hit, which adversarial configs keep at 0).
     """
 
-    lt_bal: jnp.ndarray  # (I, K) int32
-    lt_val: jnp.ndarray  # (I, K) int32
-    lt_mask: jnp.ndarray  # (I, K) int32 acceptor bitmask
+    lt_bal: jnp.ndarray  # (K, I) int32
+    lt_val: jnp.ndarray  # (K, I) int32
+    lt_mask: jnp.ndarray  # (K, I) int32 acceptor bitmask
     chosen: jnp.ndarray  # (I,) bool: some value has been chosen
     chosen_val: jnp.ndarray  # (I,) int32: the first chosen value
     chosen_tick: jnp.ndarray  # (I,) int32: tick of first choice (-1 if none)
@@ -100,7 +103,7 @@ class LearnerState:
     @classmethod
     def init(cls, n_inst: int, k: int = 8) -> "LearnerState":
         def zk():
-            return jnp.zeros((n_inst, k), jnp.int32)
+            return jnp.zeros((k, n_inst), jnp.int32)
 
         def zi():
             return jnp.zeros((n_inst,), jnp.int32)
@@ -147,11 +150,11 @@ class PaxosState:
         # send (Prepare b)` before the first `receiveWait` — SURVEY.md §4.2).
         requests = MsgBuf.empty(n_inst, n_prop, n_acc)
         prep_bal = jnp.broadcast_to(
-            proposer.bal[:, :, None], (n_inst, n_prop, n_acc)
+            proposer.bal[:, None, :], (n_prop, n_acc, n_inst)
         )
         requests = requests.replace(
-            bal=requests.bal.at[:, 0].set(prep_bal),  # kind 0 == PREPARE
-            present=requests.present.at[:, 0].set(True),
+            bal=requests.bal.at[0].set(prep_bal),  # kind 0 == PREPARE
+            present=requests.present.at[0].set(True),
         )
         return cls(
             acceptor=AcceptorState.init(n_inst, n_acc),
@@ -164,12 +167,12 @@ class PaxosState:
 
     @property
     def n_inst(self) -> int:
-        return self.acceptor.promised.shape[0]
-
-    @property
-    def n_acc(self) -> int:
         return self.acceptor.promised.shape[1]
 
     @property
+    def n_acc(self) -> int:
+        return self.acceptor.promised.shape[0]
+
+    @property
     def n_prop(self) -> int:
-        return self.proposer.bal.shape[1]
+        return self.proposer.bal.shape[0]

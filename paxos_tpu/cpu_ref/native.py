@@ -24,8 +24,10 @@ _LIB: ctypes.CDLL | None = None
 
 def _build() -> pathlib.Path:
     """Compile the oracle into a cached shared library; rebuild on source change."""
-    cache = pathlib.Path(tempfile.gettempdir()) / "paxos_tpu_native"
-    cache.mkdir(exist_ok=True)
+    # Repo-local, user-private cache: a fixed world-shared /tmp path could be
+    # pre-created (or pre-populated with a matching .so) by another local user.
+    cache = _SRC.parent / ".build"
+    cache.mkdir(exist_ok=True, mode=0o700)
     lib = cache / f"libpaxos_oracle_{_SRC.stat().st_mtime_ns}.so"
     if not lib.exists():
         # Compile to a unique temp name, then atomically rename: a killed or
@@ -64,9 +66,14 @@ def _load() -> ctypes.CDLL:
 
 def _check_topology(n_prop: int, n_acc: int) -> None:
     # Mirrors the C++ side's packing limits: voter sets live in uint32
-    # bitmasks and ballots pack (round, pid) with kMaxProposers = 8.
-    if not 1 <= n_prop <= 8:
-        raise ValueError(f"n_prop={n_prop} outside oracle ballot capacity [1, 8]")
+    # bitmasks and ballots pack (round, pid) with kMaxProposers matching the
+    # JAX kernels' single source of truth (tests assert the parity).
+    from paxos_tpu.core.ballot import MAX_PROPOSERS
+
+    if not 1 <= n_prop <= MAX_PROPOSERS:
+        raise ValueError(
+            f"n_prop={n_prop} outside oracle ballot capacity [1, {MAX_PROPOSERS}]"
+        )
     if not 1 <= n_acc <= 32:
         raise ValueError(f"n_acc={n_acc} outside oracle bitmask capacity [1, 32]")
 

@@ -5,7 +5,7 @@ from the actor runtime — monitors/links deliver ``ProcessMonitorNotification``
 when a process or node dies, and fault *injection* means actually killing OS
 processes [CH].  Here both collapse into data:
 
-- **Static plan** (:class:`FaultPlan`): per-(instance, acceptor) crash windows
+- **Static plan** (:class:`FaultPlan`): per-(acceptor, instance) crash windows
   and Byzantine-equivocation flags, sampled once per run from a PRNG key.
   "Failure detection" needs no detector — the quorum kernel simply sees fewer
   live votes (SURVEY.md §4.4).
@@ -64,20 +64,20 @@ class FaultConfig:
 class FaultPlan:
     """Per-run static fault schedule (device arrays, shard with the state)."""
 
-    crash_start: jnp.ndarray  # (I, A) int32 tick; NEVER if no crash
-    crash_end: jnp.ndarray  # (I, A) int32 tick; NEVER if crash is permanent
-    equivocate: jnp.ndarray  # (I, A) bool
-    pcrash_start: jnp.ndarray  # (I, P) int32 — proposer (leader) crash window
-    pcrash_end: jnp.ndarray  # (I, P) int32
+    crash_start: jnp.ndarray  # (A, I) int32 tick; NEVER if no crash
+    crash_end: jnp.ndarray  # (A, I) int32 tick; NEVER if crash is permanent
+    equivocate: jnp.ndarray  # (A, I) bool
+    pcrash_start: jnp.ndarray  # (P, I) int32 — proposer (leader) crash window
+    pcrash_end: jnp.ndarray  # (P, I) int32
 
     @classmethod
     def none(cls, n_inst: int, n_acc: int, n_prop: int = 1) -> "FaultPlan":
         return cls(
-            crash_start=jnp.full((n_inst, n_acc), NEVER, jnp.int32),
-            crash_end=jnp.full((n_inst, n_acc), NEVER, jnp.int32),
-            equivocate=jnp.zeros((n_inst, n_acc), jnp.bool_),
-            pcrash_start=jnp.full((n_inst, n_prop), NEVER, jnp.int32),
-            pcrash_end=jnp.full((n_inst, n_prop), NEVER, jnp.int32),
+            crash_start=jnp.full((n_acc, n_inst), NEVER, jnp.int32),
+            crash_end=jnp.full((n_acc, n_inst), NEVER, jnp.int32),
+            equivocate=jnp.zeros((n_acc, n_inst), jnp.bool_),
+            pcrash_start=jnp.full((n_prop, n_inst), NEVER, jnp.int32),
+            pcrash_end=jnp.full((n_prop, n_inst), NEVER, jnp.int32),
         )
 
     @classmethod
@@ -105,9 +105,9 @@ class FaultPlan:
             )
             return c_start, c_end
 
-        crash_start, crash_end = windows(k_crash, (n_inst, n_acc), cfg.p_crash)
-        pcrash_start, pcrash_end = windows(kp, (n_inst, n_prop), cfg.p_crash_prop)
-        equivocate = jax.random.uniform(k_eq, (n_inst, n_acc)) < cfg.p_equiv
+        crash_start, crash_end = windows(k_crash, (n_acc, n_inst), cfg.p_crash)
+        pcrash_start, pcrash_end = windows(kp, (n_prop, n_inst), cfg.p_crash_prop)
+        equivocate = jax.random.uniform(k_eq, (n_acc, n_inst)) < cfg.p_equiv
         return cls(
             crash_start=crash_start,
             crash_end=crash_end,
@@ -117,13 +117,13 @@ class FaultPlan:
         )
 
     def alive(self, tick: jnp.ndarray) -> jnp.ndarray:
-        """(I, A) bool: acceptor is up at ``tick``."""
+        """(A, I) bool: acceptor is up at ``tick``."""
         return ~((self.crash_start <= tick) & (tick < self.crash_end))
 
     def prop_alive(self, tick: jnp.ndarray) -> jnp.ndarray:
-        """(I, P) bool: proposer is up at ``tick``."""
+        """(P, I) bool: proposer is up at ``tick``."""
         return ~((self.pcrash_start <= tick) & (tick < self.pcrash_end))
 
     def recovering(self, tick: jnp.ndarray) -> jnp.ndarray:
-        """(I, A) bool: acceptor comes back up exactly at ``tick`` (for amnesia)."""
+        """(A, I) bool: acceptor comes back up exactly at ``tick`` (for amnesia)."""
         return self.crash_end == tick

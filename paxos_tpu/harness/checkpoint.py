@@ -28,6 +28,11 @@ from paxos_tpu.core.state import PaxosState
 from paxos_tpu.faults.injector import FaultPlan
 from paxos_tpu.harness.config import SimConfig
 
+# On-disk array-layout schema.  Bumped when state array axis order changes
+# (e.g. the instance-minor refactor); restore() refuses snapshots from a
+# different schema with a clear message instead of a deep orbax shape error.
+LAYOUT_VERSION = "instance-minor-v2"
+
 
 def save(
     path: str | pathlib.Path,
@@ -47,7 +52,8 @@ def save(
             },
             force=True,
         )
-    (path / "simconfig.json").write_text(json.dumps(dataclasses.asdict(cfg)))
+    meta = dataclasses.asdict(cfg) | {"layout_version": LAYOUT_VERSION}
+    (path / "simconfig.json").write_text(json.dumps(meta))
 
 
 def restore(
@@ -56,6 +62,13 @@ def restore(
     """Read a snapshot back; arrays land on the default device, unsharded."""
     path = pathlib.Path(path).absolute()
     raw = json.loads((path / "simconfig.json").read_text())
+    found = raw.pop("layout_version", "pre-instance-minor")
+    if found != LAYOUT_VERSION:
+        raise ValueError(
+            f"checkpoint at {path} uses array-layout schema {found!r}, this "
+            f"build expects {LAYOUT_VERSION!r}; re-run the campaign from "
+            "scratch (state array axis order changed)"
+        )
     fault = raw.pop("fault")
     from paxos_tpu.faults.injector import FaultConfig
 

@@ -100,11 +100,11 @@ def summarize(state: PaxosState) -> dict[str, Any]:
     only scalars come back to the host.
     """
     lrn, prop = state.learner, state.proposer
-    chosen = lrn.chosen  # (I,) single-decree, (I, L) multipaxos
+    chosen = lrn.chosen  # (I,) single-decree, (L, I) multipaxos
 
     # Shared, shape-polymorphic fields.
     out = {
-        "n_inst": chosen.shape[0],
+        "n_inst": chosen.shape[-1],
         "ticks": state.tick,
         "chosen_frac": chosen.mean(dtype=jnp.float32),
         "violations": lrn.violations.sum(),
@@ -118,17 +118,17 @@ def summarize(state: PaxosState) -> dict[str, Any]:
     }
 
     if chosen.ndim == 2:  # Multi-Paxos: chosen_frac is slot-level
-        out["decided_frac"] = chosen.all(axis=-1).mean(dtype=jnp.float32)  # full logs
+        out["decided_frac"] = chosen.all(axis=0).mean(dtype=jnp.float32)  # full logs
         out["proposer_disagree"] = jnp.zeros((), jnp.int32)  # n/a: leaders adopt
     else:
-        out["decided_frac"] = (prop.phase == DONE).any(axis=-1).mean(dtype=jnp.float32)
+        out["decided_frac"] = (prop.phase == DONE).any(axis=0).mean(dtype=jnp.float32)
         # A proposer that believes it decided v while the learner chose v' != v
         # is a cross-role disagreement — counted as a safety signal.
         out["proposer_disagree"] = (
             (prop.phase == DONE)
-            & chosen[:, None]
-            & (prop.decided_val != lrn.chosen_val[:, None])
-        ).any(axis=-1).sum()
+            & chosen[None]
+            & (prop.decided_val != lrn.chosen_val[None])
+        ).any(axis=0).sum()
 
     return {k: (v.item() if hasattr(v, "item") else v) for k, v in jax.device_get(out).items()}
 

@@ -31,11 +31,15 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
 
 
 def state_sharding(tree: Any, mesh: Mesh, n_inst: int) -> Any:
-    """Per-leaf shardings: leading ``instances`` axis sharded, scalars replicated."""
+    """Per-leaf shardings: trailing ``instances`` axis sharded, scalars replicated.
+
+    The framework's arrays are instance-minor (``core.messages``), so the
+    sharded axis is the LAST one of every instance-carrying leaf.
+    """
 
     def leaf_sharding(x):
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n_inst:
-            return NamedSharding(mesh, P(INSTANCES_AXIS, *([None] * (x.ndim - 1))))
+        if getattr(x, "ndim", 0) >= 1 and x.shape[-1] == n_inst:
+            return NamedSharding(mesh, P(*([None] * (x.ndim - 1)), INSTANCES_AXIS))
         return NamedSharding(mesh, P())
 
     return jax.tree.map(leaf_sharding, tree)

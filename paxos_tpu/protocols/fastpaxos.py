@@ -50,8 +50,8 @@ def fastpaxos_step(
     state: FastPaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
 ) -> FastPaxosState:
     """Advance every instance by one scheduler tick."""
-    n_inst, n_acc = state.acceptor.promised.shape
-    n_prop = state.proposer.bal.shape[1]
+    n_acc, n_inst = state.acceptor.promised.shape
+    n_prop = state.proposer.bal.shape[0]
     quorum = majority(n_acc)
     fquorum = fast_quorum(n_acc)
 
@@ -60,8 +60,8 @@ def fastpaxos_step(
      k_drop_p1, k_drop_p2, k_backoff) = jax.random.split(key, 9)
 
     acc = state.acceptor
-    alive = plan.alive(state.tick)  # (I, A)
-    equiv = plan.equivocate  # (I, A)
+    alive = plan.alive(state.tick)  # (A, I)
+    equiv = plan.equivocate  # (A, I)
 
     if cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
         rec = plan.recovering(state.tick)
@@ -81,15 +81,15 @@ def fastpaxos_step(
     # ---- Acceptor half-tick ----
     with jax.named_scope("acceptor_select"):
         sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-        sel = sel & alive[:, None, None, :]
+        sel = sel & alive[None, None]
 
     def gather(x):
-        return jnp.where(sel, x, 0).sum(axis=(1, 2))
+        return jnp.where(sel, x, 0).sum(axis=(0, 1))
 
-    msg_bal = gather(state.requests.bal)  # (I, A)
-    msg_val = gather(state.requests.v1)  # (I, A)
-    is_prep = sel[:, PREPARE].any(axis=1)
-    is_acc = sel[:, ACCEPT].any(axis=1)
+    msg_bal = gather(state.requests.bal)  # (A, I)
+    msg_val = gather(state.requests.v1)  # (A, I)
+    is_prep = sel[PREPARE].any(axis=0)
+    is_acc = sel[ACCEPT].any(axis=0)
 
     ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
     ok_prep = ok_prep_h | (is_prep & equiv)
@@ -111,18 +111,18 @@ def fastpaxos_step(
     prom_payload_val = jnp.where(equiv, 0, acc.acc_val)
     replies = net.send(
         replies, PROMISE,
-        send_mask=sel[:, PREPARE] & ok_prep[:, None, :],
-        bal=msg_bal[:, None, :],
-        v1=prom_payload_bal[:, None, :],
-        v2=prom_payload_val[:, None, :],
+        send_mask=sel[PREPARE] & ok_prep[None],
+        bal=msg_bal[None],
+        v1=prom_payload_bal[None],
+        v2=prom_payload_val[None],
         key=k_drop_prom, p_drop=cfg.p_drop,
     )
     replies = net.send(
         replies, ACCEPTED,
-        send_mask=sel[:, ACCEPT] & ok_acc[:, None, :],
-        bal=msg_bal[:, None, :],
-        v1=msg_val[:, None, :],
-        v2=jnp.zeros_like(msg_val)[:, None, :],
+        send_mask=sel[ACCEPT] & ok_acc[None],
+        bal=msg_bal[None],
+        v1=msg_val[None],
+        v2=jnp.zeros_like(msg_val)[None],
         key=k_drop_accd, p_drop=cfg.p_drop,
     )
     requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
@@ -139,46 +139,49 @@ def fastpaxos_step(
 
     # ---- Proposer half-tick ----
     prop = state.proposer
-    bits = jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32)  # (A,)
+    bits = (jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32))[
+        None, :, None
+    ]  # (1, A, 1)
 
-    cur_bal = prop.bal[:, :, None]  # (I, P, 1)
+    cur_bal = prop.bal[:, None]  # (P, 1, I)
     prom_ok = (
-        delivered[:, PROMISE]
-        & (state.replies.bal[:, PROMISE] == cur_bal)
-        & (prop.phase == P1)[:, :, None]
-    )  # (I, P, A)
+        delivered[PROMISE]
+        & (state.replies.bal[PROMISE] == cur_bal)
+        & (prop.phase == P1)[:, None]
+    )  # (P, A, I)
     accd_ok = (
-        delivered[:, ACCEPTED]
-        & (state.replies.bal[:, ACCEPTED] == cur_bal)
-        & ((prop.phase == P2) | (prop.phase == FAST))[:, :, None]
+        delivered[ACCEPTED]
+        & (state.replies.bal[ACCEPTED] == cur_bal)
+        & ((prop.phase == P2) | (prop.phase == FAST))[:, None]
     )
     heard = (
         prop.heard
-        | jnp.where(prom_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
-        | jnp.where(accd_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
+        | jnp.where(prom_ok, bits, 0).sum(axis=1, dtype=jnp.int32)
+        | jnp.where(accd_ok, bits, 0).sum(axis=1, dtype=jnp.int32)
     )
 
     # Phase-1 recovery fold: per-value acceptor bitmask at the highest
     # reported accepted ballot.  Exact sequential fold over the small
     # acceptors axis (<= MAX_ACCEPTORS), carried across ticks in rep_mask.
     best_bal, rep_mask = prop.best_bal, prop.rep_mask
+    vids = jnp.arange(n_prop, dtype=jnp.int32)[None, :, None]  # (1, V, 1)
     for a in range(n_acc):
-        pb = state.replies.v1[:, PROMISE, :, a]  # (I, P) prev-accepted ballot
-        pv = state.replies.v2[:, PROMISE, :, a]  # (I, P) prev-accepted value
+        pb = state.replies.v1[PROMISE, :, a]  # (P, I) prev-accepted ballot
+        pv = state.replies.v2[PROMISE, :, a]  # (P, I) prev-accepted value
         valid = (
-            prom_ok[:, :, a]
+            prom_ok[:, a]
             & (pb > 0)
             & (pv >= VALUE_BASE)
             & (pv < VALUE_BASE + n_prop)
         )
-        vid = jnp.clip(pv - VALUE_BASE, 0, n_prop - 1)  # (I, P)
+        vid = jnp.clip(pv - VALUE_BASE, 0, n_prop - 1)  # (P, I)
         higher = valid & (pb > best_bal)
-        rep_mask = jnp.where(higher[:, :, None], 0, rep_mask)
+        rep_mask = jnp.where(higher[:, None], 0, rep_mask)
         best_bal = jnp.where(higher, pb, best_bal)
         same = valid & (pb == best_bal)
-        vhot = jax.nn.one_hot(vid, n_prop, dtype=jnp.bool_)  # (I, P, V)
+        vhot = vid[:, None] == vids  # (P, V, I)
         rep_mask = rep_mask | jnp.where(
-            same[:, :, None] & vhot, jnp.asarray(1 << a, jnp.int32), 0
+            same[:, None] & vhot, jnp.asarray(1 << a, jnp.int32), 0
         )
 
     # Phase transitions.
@@ -191,13 +194,13 @@ def fastpaxos_step(
     #   one owner per classic ballot proposes one value).
     # - k fast (round 0): adopt the choosable value if one exists, else own.
     # - nothing reported: own value.
-    unheard = n_acc - popcount(heard)  # (I, P)
-    cnt = popcount(rep_mask)  # (I, P, V)
-    choosable = (rep_mask != 0) & (cnt + unheard[:, :, None] >= fquorum)
-    any_ch = choosable.any(axis=-1)
-    pick_fast = jnp.argmax(choosable, axis=-1).astype(jnp.int32) + VALUE_BASE
+    unheard = n_acc - popcount(heard)  # (P, I)
+    cnt = popcount(rep_mask)  # (P, V, I)
+    choosable = (rep_mask != 0) & (cnt + unheard[:, None] >= fquorum)
+    any_ch = choosable.any(axis=1)
+    pick_fast = jnp.argmax(choosable, axis=1).astype(jnp.int32) + VALUE_BASE
     pick_classic = (
-        jnp.argmax(rep_mask != 0, axis=-1).astype(jnp.int32) + VALUE_BASE
+        jnp.argmax(rep_mask != 0, axis=1).astype(jnp.int32) + VALUE_BASE
     )
     is_fast_k = bal_mod.ballot_round(best_bal) == 0
     v_fast = jnp.where(any_ch, pick_fast, prop.own_val)
@@ -216,7 +219,9 @@ def fastpaxos_step(
     backoff = jax.random.randint(
         k_backoff, timer.shape, 0, max(cfg.backoff_max, 1), jnp.int32
     )
-    pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), timer.shape)
+    pid = jnp.broadcast_to(
+        jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
+    )
     new_bal = bal_mod.make_ballot(bal_mod.ballot_round(prop.bal) + 1, pid)
 
     phase = jnp.where(p1_done, P2, prop.phase)
@@ -228,25 +233,25 @@ def fastpaxos_step(
     bal_next = jnp.where(expired, new_bal, prop.bal)
     heard = jnp.where(p1_done | expired, 0, heard)
     best_bal = jnp.where(expired, 0, best_bal)
-    rep_mask = jnp.where(expired[:, :, None], 0, rep_mask)
+    rep_mask = jnp.where(expired[:, None], 0, rep_mask)
     timer = jnp.where(p1_done, 0, timer)
     timer = jnp.where(expired, -backoff, timer)
 
     # Emit: classic ACCEPT on phase-1 completion, PREPARE on retry.
     requests = net.send(
         requests, ACCEPT,
-        send_mask=jnp.broadcast_to(p1_done[:, :, None], (n_inst, n_prop, n_acc)),
-        bal=prop.bal[:, :, None],
-        v1=prop_val[:, :, None],
-        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        send_mask=jnp.broadcast_to(p1_done[:, None], (n_prop, n_acc, n_inst)),
+        bal=prop.bal[:, None],
+        v1=prop_val[:, None],
+        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         key=k_drop_p2, p_drop=cfg.p_drop,
     )
     requests = net.send(
         requests, PREPARE,
-        send_mask=jnp.broadcast_to(expired[:, :, None], (n_inst, n_prop, n_acc)),
-        bal=bal_next[:, :, None],
-        v1=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
-        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        send_mask=jnp.broadcast_to(expired[:, None], (n_prop, n_acc, n_inst)),
+        bal=bal_next[:, None],
+        v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
+        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         key=k_drop_p1, p_drop=cfg.p_drop,
     )
 

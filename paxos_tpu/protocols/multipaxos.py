@@ -42,8 +42,8 @@ def own_slot_value(pid: jnp.ndarray, slot: jnp.ndarray) -> jnp.ndarray:
 def multipaxos_step(
     state: MultiPaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
 ) -> MultiPaxosState:
-    n_inst, n_acc = state.acceptor.promised.shape
-    n_prop = state.proposer.bal.shape[1]
+    n_acc, n_inst = state.acceptor.promised.shape
+    n_prop = state.proposer.bal.shape[0]
     n_slots = state.log_len
     quorum = majority(n_acc)
 
@@ -53,42 +53,38 @@ def multipaxos_step(
 
     acc = state.acceptor
     prop = state.proposer
-    alive = plan.alive(state.tick)  # (I, A)
-    p_alive = plan.prop_alive(state.tick)  # (I, P)
-    equiv = plan.equivocate  # (I, A)
+    alive = plan.alive(state.tick)  # (A, I)
+    p_alive = plan.prop_alive(state.tick)  # (P, I)
+    equiv = plan.equivocate  # (A, I)
 
     if cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
         rec = plan.recovering(state.tick)
         acc = acc.replace(
             promised=jnp.where(rec, 0, acc.promised),
-            log_bal=jnp.where(rec[:, :, None], 0, acc.log_bal),
-            log_val=jnp.where(rec[:, :, None], 0, acc.log_val),
+            log_bal=jnp.where(rec[:, None], 0, acc.log_bal),
+            log_val=jnp.where(rec[:, None], 0, acc.log_val),
         )
 
     # ---- Reply delivery decided & cleared before new writes (no clobber) ----
     with jax.named_scope("deliver"):
-        prom_del = state.promises.present & (
-            jax.random.uniform(k_hold_pr, state.promises.present.shape) >= cfg.p_hold
-        )
-        accd_del = state.accepted.present & (
-            jax.random.uniform(k_hold_ac, state.accepted.present.shape) >= cfg.p_hold
-        )
+        prom_del = net.hold_mask(state.promises.present, k_hold_pr, cfg.p_hold)
+        accd_del = net.hold_mask(state.accepted.present, k_hold_ac, cfg.p_hold)
         promises = state.promises.replace(present=state.promises.present & ~prom_del)
         accepted = state.accepted.replace(present=state.accepted.present & ~accd_del)
 
     # ---- Acceptor half-tick ----
     with jax.named_scope("acceptor_select"):
         sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-        sel = sel & alive[:, None, None, :]
+        sel = sel & alive[None, None]
 
     def gather(x):
-        return jnp.where(sel, x, 0).sum(axis=(1, 2))
+        return jnp.where(sel, x, 0).sum(axis=(0, 1))
 
-    msg_bal = gather(state.requests.bal)  # (I, A)
-    msg_val = gather(state.requests.v1)  # (I, A)
-    msg_slot = gather(state.requests.v2)  # (I, A)
-    is_prep = sel[:, PREPARE].any(axis=1)
-    is_acc = sel[:, ACCEPT].any(axis=1)
+    msg_bal = gather(state.requests.bal)  # (A, I)
+    msg_val = gather(state.requests.v1)  # (A, I)
+    msg_slot = gather(state.requests.v2)  # (A, I)
+    is_prep = sel[PREPARE].any(axis=0)
+    is_acc = sel[ACCEPT].any(axis=0)
 
     ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
     ok_prep = ok_prep_h | (is_prep & equiv)
@@ -97,36 +93,37 @@ def multipaxos_step(
 
     promised = jnp.where(ok_prep_h, msg_bal, acc.promised)
     promised = jnp.where(ok_acc_h, jnp.maximum(promised, msg_bal), promised)
-    oh_slot = jax.nn.one_hot(msg_slot, n_slots, dtype=jnp.bool_)  # (I, A, L)
-    wr = ok_acc[:, :, None] & oh_slot
-    log_bal = jnp.where(wr, msg_bal[:, :, None], acc.log_bal)
-    log_val = jnp.where(wr, msg_val[:, :, None], acc.log_val)
+    slot_ids = jnp.arange(n_slots, dtype=jnp.int32)[None, :, None]  # (1, L, 1)
+    oh_slot = msg_slot[:, None] == slot_ids  # (A, L, I)
+    wr = ok_acc[:, None] & oh_slot
+    log_bal = jnp.where(wr, msg_bal[:, None], acc.log_bal)
+    log_val = jnp.where(wr, msg_val[:, None], acc.log_val)
 
     # Promise replies carry the acceptor's full log (equivocators hide theirs).
-    prom_send = sel[:, PREPARE] & ok_prep[:, None, :]  # (I, P, A)
+    prom_send = sel[PREPARE] & ok_prep[None]  # (P, A, I)
     if cfg.p_drop > 0.0:
-        prom_send = prom_send & (
-            jax.random.uniform(k_drop_pr, prom_send.shape) >= cfg.p_drop
+        prom_send = prom_send & ~net._bernoulli_bits(
+            k_drop_pr, prom_send.shape, cfg.p_drop
         )
-    payload_pb = jnp.where(equiv[:, :, None], 0, acc.log_bal)  # (I, A, L)
-    payload_pv = jnp.where(equiv[:, :, None], 0, acc.log_val)
+    payload_pb = jnp.where(equiv[:, None], 0, acc.log_bal)  # (A, L, I)
+    payload_pv = jnp.where(equiv[:, None], 0, acc.log_val)
     promises = promises.replace(
         present=promises.present | prom_send,
-        bal=jnp.where(prom_send, msg_bal[:, None, :], promises.bal),
-        pb=jnp.where(prom_send[..., None], payload_pb[:, None], promises.pb),
-        pv=jnp.where(prom_send[..., None], payload_pv[:, None], promises.pv),
+        bal=jnp.where(prom_send, msg_bal[None], promises.bal),
+        pb=jnp.where(prom_send[:, :, None], payload_pb[None], promises.pb),
+        pv=jnp.where(prom_send[:, :, None], payload_pv[None], promises.pv),
     )
 
-    accd_send = sel[:, ACCEPT] & ok_acc[:, None, :]  # (I, P, A)
+    accd_send = sel[ACCEPT] & ok_acc[None]  # (P, A, I)
     if cfg.p_drop > 0.0:
-        accd_send = accd_send & (
-            jax.random.uniform(k_drop_ac, accd_send.shape) >= cfg.p_drop
+        accd_send = accd_send & ~net._bernoulli_bits(
+            k_drop_ac, accd_send.shape, cfg.p_drop
         )
     accepted = accepted.replace(
         present=accepted.present | accd_send,
-        bal=jnp.where(accd_send, msg_bal[:, None, :], accepted.bal),
-        slot=jnp.where(accd_send, msg_slot[:, None, :], accepted.slot),
-        val=jnp.where(accd_send, msg_val[:, None, :], accepted.val),
+        bal=jnp.where(accd_send, msg_bal[None], accepted.bal),
+        slot=jnp.where(accd_send, msg_slot[None], accepted.slot),
+        val=jnp.where(accd_send, msg_val[None], accepted.val),
     )
 
     requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
@@ -137,24 +134,28 @@ def multipaxos_step(
         learner = mp_learner_observe(
             state.learner, ok_acc, msg_bal, msg_slot, msg_val, state.tick, quorum
         )
-        chosen_count = learner.chosen.sum(axis=-1, dtype=jnp.int32)  # (I,)
+        chosen_count = learner.chosen.sum(axis=0, dtype=jnp.int32)  # (I,)
 
     # ---- Proposer half-tick ----
-    bits = jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32)
-    cur_bal = prop.bal[:, :, None]  # (I, P, 1)
+    bits = (jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32))[
+        None, :, None
+    ]  # (1, A, 1)
+    cur_bal = prop.bal[:, None]  # (P, 1, I)
 
     # Promises (phase 1): voter bits + per-slot max-fold of recovery pairs.
     pv_ok = prom_del & (state.promises.bal == cur_bal) & (
         prop.phase == CANDIDATE
-    )[:, :, None]  # (I, P, A)
-    heard = prop.heard | jnp.where(pv_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
-    cand_pb = jnp.where(pv_ok[..., None], state.promises.pb, 0)  # (I, P, A, L)
-    best_a = jnp.argmax(cand_pb, axis=2)  # (I, P, L)
-    cand_bal = jnp.take_along_axis(cand_pb, best_a[:, :, None, :], axis=2)[:, :, 0, :]
-    cand_val = jnp.take_along_axis(
-        jnp.where(pv_ok[..., None], state.promises.pv, 0), best_a[:, :, None, :], axis=2
-    )[:, :, 0, :]
-    improve = cand_bal > prop.recov_bal  # (I, P, L)
+    )[:, None]  # (P, A, I)
+    heard = prop.heard | jnp.where(pv_ok, bits, 0).sum(axis=1, dtype=jnp.int32)
+    # Per-slot max-fold over acceptors; value rides along via the max-trick
+    # (at a given ballot all honest acceptors store one value per slot, and
+    # equivocators' payloads are zeroed; a zero max never improves).
+    cand_pb = jnp.where(pv_ok[:, :, None], state.promises.pb, 0)  # (P, A, L, I)
+    cand_bal = cand_pb.max(axis=1)  # (P, L, I)
+    cand_val = jnp.where(
+        (cand_pb == cand_bal[:, None]) & pv_ok[:, :, None], state.promises.pv, 0
+    ).max(axis=1)
+    improve = cand_bal > prop.recov_bal  # (P, L, I)
     recov_bal = jnp.where(improve, cand_bal, prop.recov_bal)
     recov_val = jnp.where(improve, cand_val, prop.recov_val)
 
@@ -162,10 +163,10 @@ def multipaxos_step(
     av_ok = (
         accd_del
         & (state.accepted.bal == cur_bal)
-        & (state.accepted.slot == prop.commit_idx[:, :, None])
-        & (prop.phase == LEAD)[:, :, None]
+        & (state.accepted.slot == prop.commit_idx[:, None])
+        & (prop.phase == LEAD)[:, None]
     )
-    heard = heard | jnp.where(av_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
+    heard = heard | jnp.where(av_ok, bits, 0).sum(axis=1, dtype=jnp.int32)
 
     # Transitions.
     p1_done = (prop.phase == CANDIDATE) & quorum_reached(heard, quorum)
@@ -177,15 +178,17 @@ def multipaxos_step(
 
     # Progress lease: any new chosen slot in this instance resets every
     # proposer's suspicion timer.
-    progressed = chosen_count[:, None] > prop.last_chosen_count  # (I, P)
+    progressed = chosen_count[None] > prop.last_chosen_count  # (P, I)
     lease_timer = jnp.where(progressed, 0, prop.lease_timer + 1)
-    last_chosen_count = jnp.maximum(prop.last_chosen_count, chosen_count[:, None])
+    last_chosen_count = jnp.maximum(prop.last_chosen_count, chosen_count[None])
 
-    log_full = chosen_count[:, None] >= n_slots  # (I, P): nothing left to do
+    log_full = chosen_count[None] >= n_slots  # (P, I): nothing left to do
     lease_out = lease_timer > cfg.lease_len
 
     # Election trigger: staggered so proposers don't collide every time.
-    pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), prop.bal.shape)
+    pid = jnp.broadcast_to(
+        jnp.arange(n_prop, dtype=jnp.int32)[:, None], prop.bal.shape
+    )
     jitter = jax.random.randint(k_jit, prop.bal.shape, 0, max(cfg.backoff_max, 1))
     start_elec = (
         (prop.phase == FOLLOW)
@@ -212,8 +215,8 @@ def multipaxos_step(
     commit_idx = jnp.where(p1_done, 0, prop.commit_idx)
     commit_idx = jnp.where(slot_done, commit_idx + 1, commit_idx)
     heard = jnp.where(p1_done | slot_done | start_elec | cand_fail | demote, 0, heard)
-    recov_bal = jnp.where(start_elec[:, :, None], 0, recov_bal)
-    recov_val = jnp.where(start_elec[:, :, None], 0, recov_val)
+    recov_bal = jnp.where(start_elec[:, None], 0, recov_bal)
+    recov_val = jnp.where(start_elec[:, None], 0, recov_val)
     lease_timer = jnp.where(start_elec | p1_done | slot_done, 0, lease_timer)
     # Failed candidacy / demotion: retreat below the election threshold by a
     # random backoff so rivals separate instead of re-colliding every tick.
@@ -226,29 +229,30 @@ def multipaxos_step(
     # ---- Emit ----
     # New candidates broadcast Prepare(b) once (retries via cand_fail cycle).
     prep_mask = jnp.broadcast_to(
-        (start_elec & p_alive)[:, :, None], (n_inst, n_prop, n_acc)
+        (start_elec & p_alive)[:, None], (n_prop, n_acc, n_inst)
     )
     requests = net.send(
         requests, PREPARE,
         send_mask=prep_mask,
-        bal=bal_next[:, :, None],
-        v1=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
-        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        bal=bal_next[:, None],
+        v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
+        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         key=k_drop_prep, p_drop=cfg.p_drop,
     )
     # Leaders re-broadcast the current slot's Accept every tick (idempotent,
     # self-healing under loss).
     is_lead = (phase == LEAD) & p_alive & (commit_idx < n_slots)
-    ci = jnp.minimum(commit_idx, n_slots - 1)
-    rb = jnp.take_along_axis(recov_bal, ci[:, :, None], axis=-1)[:, :, 0]
-    rv = jnp.take_along_axis(recov_val, ci[:, :, None], axis=-1)[:, :, 0]
-    pval = jnp.where(rb > 0, rv, own_slot_value(pid, ci))  # (I, P)
+    ci = jnp.minimum(commit_idx, n_slots - 1)  # (P, I)
+    ci_hot = ci[:, None] == jnp.arange(n_slots, dtype=jnp.int32)[None, :, None]
+    rb = jnp.where(ci_hot, recov_bal, 0).sum(axis=1)  # (P, I)
+    rv = jnp.where(ci_hot, recov_val, 0).sum(axis=1)
+    pval = jnp.where(rb > 0, rv, own_slot_value(pid, ci))  # (P, I)
     requests = net.send(
         requests, ACCEPT,
-        send_mask=jnp.broadcast_to(is_lead[:, :, None], (n_inst, n_prop, n_acc)),
-        bal=bal_next[:, :, None],
-        v1=pval[:, :, None],
-        v2=ci[:, :, None],
+        send_mask=jnp.broadcast_to(is_lead[:, None], (n_prop, n_acc, n_inst)),
+        bal=bal_next[:, None],
+        v1=pval[:, None],
+        v2=ci[:, None],
         key=k_drop_acc, p_drop=cfg.p_drop,
     )
 

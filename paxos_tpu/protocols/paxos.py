@@ -22,6 +22,10 @@ The famous killer interleavings survive vectorization:
   after the acceptor promised a higher ballot; `msg_bal >= promised` rejects.
 - *dueling proposers*: both proposers' PREPAREs race per tick; retries pick
   fresh ballots with randomized backoff.
+
+Layout: every array is instance-minor — acceptors (A, I), proposers (P, I),
+message slots (2, P, A, I) — so the whole tick is full-lane elementwise work
+(see ``core.messages``).
 """
 
 from __future__ import annotations
@@ -42,8 +46,8 @@ def paxos_step(
     state: PaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
 ) -> PaxosState:
     """Advance every instance by one scheduler tick."""
-    n_inst, n_acc = state.acceptor.promised.shape
-    n_prop = state.proposer.bal.shape[1]
+    n_acc, n_inst = state.acceptor.promised.shape
+    n_prop = state.proposer.bal.shape[0]
     quorum = majority(n_acc)
 
     # Keys depend only on (seed, tick): checkpoint/resume replays bit-exactly.
@@ -52,8 +56,8 @@ def paxos_step(
      k_drop_p1, k_drop_p2, k_backoff) = jax.random.split(key, 9)
 
     acc = state.acceptor
-    alive = plan.alive(state.tick)  # (I, A)
-    equiv = plan.equivocate  # (I, A)
+    alive = plan.alive(state.tick)  # (A, I)
+    equiv = plan.equivocate  # (A, I)
 
     if cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
         rec = plan.recovering(state.tick)
@@ -75,16 +79,16 @@ def paxos_step(
     # ---- Acceptor half-tick: select one request per (instance, acceptor) ----
     with jax.named_scope("acceptor_select"):
         sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-        sel = sel & alive[:, None, None, :]  # crashed acceptors process nothing
+        sel = sel & alive[None, None]  # crashed acceptors process nothing
 
-    # Gather the selected message's fields onto (I, A).
+    # Gather the selected message's fields onto (A, I).
     def gather(x):
-        return jnp.where(sel, x, 0).sum(axis=(1, 2))
+        return jnp.where(sel, x, 0).sum(axis=(0, 1))
 
-    msg_bal = gather(state.requests.bal)  # (I, A)
-    msg_val = gather(state.requests.v1)  # (I, A) (ACCEPT payload)
-    is_prep = sel[:, PREPARE].any(axis=1)  # (I, A)
-    is_acc = sel[:, ACCEPT].any(axis=1)  # (I, A)
+    msg_bal = gather(state.requests.bal)  # (A, I)
+    msg_val = gather(state.requests.v1)  # (A, I) (ACCEPT payload)
+    is_prep = sel[PREPARE].any(axis=0)  # (A, I)
+    is_acc = sel[ACCEPT].any(axis=0)  # (A, I)
 
     # PREPARE(b): honest promise iff b > promised; equivocators "promise"
     # unconditionally, never record it, and hide their accepted pair.
@@ -104,18 +108,18 @@ def paxos_step(
     prom_payload_val = jnp.where(equiv, 0, acc.acc_val)
     replies = net.send(
         replies, PROMISE,
-        send_mask=sel[:, PREPARE] & ok_prep[:, None, :],
-        bal=msg_bal[:, None, :],
-        v1=prom_payload_bal[:, None, :],
-        v2=prom_payload_val[:, None, :],
+        send_mask=sel[PREPARE] & ok_prep[None],
+        bal=msg_bal[None],
+        v1=prom_payload_bal[None],
+        v2=prom_payload_val[None],
         key=k_drop_prom, p_drop=cfg.p_drop,
     )
     replies = net.send(
         replies, ACCEPTED,
-        send_mask=sel[:, ACCEPT] & ok_acc[:, None, :],
-        bal=msg_bal[:, None, :],
-        v1=msg_val[:, None, :],
-        v2=jnp.zeros_like(msg_val)[:, None, :],
+        send_mask=sel[ACCEPT] & ok_acc[None],
+        bal=msg_bal[None],
+        v1=msg_val[None],
+        v2=jnp.zeros_like(msg_val)[None],
         key=k_drop_accd, p_drop=cfg.p_drop,
     )
     requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
@@ -131,32 +135,37 @@ def paxos_step(
 
     # ---- Proposer half-tick: fold all delivered replies ----
     prop = state.proposer
-    bits = (jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32))  # (A,)
+    # (1, A, 1) voter bit per acceptor, broadcast against (P, A, I).
+    bits = (jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32))[
+        None, :, None
+    ]
 
-    cur_bal = prop.bal[:, :, None]  # (I, P, 1)
+    cur_bal = prop.bal[:, None]  # (P, 1, I)
     prom_ok = (
-        delivered[:, PROMISE]
-        & (state.replies.bal[:, PROMISE] == cur_bal)
-        & (prop.phase == P1)[:, :, None]
-    )  # (I, P, A)
+        delivered[PROMISE]
+        & (state.replies.bal[PROMISE] == cur_bal)
+        & (prop.phase == P1)[:, None]
+    )  # (P, A, I)
     accd_ok = (
-        delivered[:, ACCEPTED]
-        & (state.replies.bal[:, ACCEPTED] == cur_bal)
-        & (prop.phase == P2)[:, :, None]
+        delivered[ACCEPTED]
+        & (state.replies.bal[ACCEPTED] == cur_bal)
+        & (prop.phase == P2)[:, None]
     )
     heard = (
         prop.heard
-        | jnp.where(prom_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
-        | jnp.where(accd_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
-    )
+        | jnp.where(prom_ok, bits, 0).sum(axis=1, dtype=jnp.int32)
+        | jnp.where(accd_ok, bits, 0).sum(axis=1, dtype=jnp.int32)
+    )  # (P, I)
 
-    # Highest previously-accepted (ballot, value) among valid promises.
-    prev_bal = jnp.where(prom_ok, state.replies.v1[:, PROMISE], 0)  # (I, P, A)
-    best_a = jnp.argmax(prev_bal, axis=-1)  # (I, P)
-    cand_bal = jnp.take_along_axis(prev_bal, best_a[..., None], axis=-1)[..., 0]
-    cand_val = jnp.take_along_axis(
-        jnp.where(prom_ok, state.replies.v2[:, PROMISE], 0), best_a[..., None], axis=-1
-    )[..., 0]
+    # Highest previously-accepted (ballot, value) among valid promises.  The
+    # value ride-along is a max-trick, not a gather: among slots achieving the
+    # max ballot the values agree (honest acceptors store one value per
+    # ballot; equivocators' payloads are zeroed), and a zero max means "none".
+    prev_bal = jnp.where(prom_ok, state.replies.v1[PROMISE], 0)  # (P, A, I)
+    cand_bal = prev_bal.max(axis=1)  # (P, I)
+    cand_val = jnp.where(
+        prev_bal == cand_bal[:, None], state.replies.v2[PROMISE], 0
+    ).max(axis=1)
     upgrade = cand_bal > prop.best_bal
     best_bal = jnp.where(upgrade, cand_bal, prop.best_bal)
     best_val = jnp.where(upgrade, cand_val, prop.best_val)
@@ -173,7 +182,9 @@ def paxos_step(
     backoff = jax.random.randint(
         k_backoff, timer.shape, 0, max(cfg.backoff_max, 1), jnp.int32
     )
-    pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), timer.shape)
+    pid = jnp.broadcast_to(
+        jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
+    )
     new_bal = bal_mod.make_ballot(bal_mod.ballot_round(prop.bal) + 1, pid)
 
     phase = jnp.where(p1_done, P2, prop.phase)
@@ -191,18 +202,18 @@ def paxos_step(
     # Emit: ACCEPT broadcast on phase-1 completion, PREPARE broadcast on retry.
     requests = net.send(
         requests, ACCEPT,
-        send_mask=jnp.broadcast_to(p1_done[:, :, None], (n_inst, n_prop, n_acc)),
-        bal=prop.bal[:, :, None],
-        v1=prop_val[:, :, None],
-        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        send_mask=jnp.broadcast_to(p1_done[:, None], (n_prop, n_acc, n_inst)),
+        bal=prop.bal[:, None],
+        v1=prop_val[:, None],
+        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         key=k_drop_p2, p_drop=cfg.p_drop,
     )
     requests = net.send(
         requests, PREPARE,
-        send_mask=jnp.broadcast_to(expired[:, :, None], (n_inst, n_prop, n_acc)),
-        bal=bal_next[:, :, None],
-        v1=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
-        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        send_mask=jnp.broadcast_to(expired[:, None], (n_prop, n_acc, n_inst)),
+        bal=bal_next[:, None],
+        v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
+        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         key=k_drop_p1, p_drop=cfg.p_drop,
     )
 

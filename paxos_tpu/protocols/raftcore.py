@@ -58,8 +58,8 @@ def raftcore_step(
     state: RaftState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
 ) -> RaftState:
     """Advance every instance by one scheduler tick."""
-    n_inst, n_acc = state.acceptor.voted.shape
-    n_prop = state.proposer.bal.shape[1]
+    n_acc, n_inst = state.acceptor.voted.shape
+    n_prop = state.proposer.bal.shape[0]
     quorum = majority(n_acc)
 
     key = jax.random.fold_in(base_key, state.tick)
@@ -67,8 +67,8 @@ def raftcore_step(
      k_drop_rv, k_drop_ap, k_backoff) = jax.random.split(key, 9)
 
     voter = state.acceptor
-    alive = plan.alive(state.tick)  # (I, A)
-    equiv = plan.equivocate  # (I, A)
+    alive = plan.alive(state.tick)  # (A, I)
+    equiv = plan.equivocate  # (A, I)
 
     if cfg.amnesia:  # bug injection: voter forgets durable state on recovery
         rec = plan.recovering(state.tick)
@@ -86,15 +86,15 @@ def raftcore_step(
     # ---- Voter half-tick: select one request per (instance, voter) ----
     with jax.named_scope("acceptor_select"):
         sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-        sel = sel & alive[:, None, None, :]
+        sel = sel & alive[None, None]
 
     def gather(x):
-        return jnp.where(sel, x, 0).sum(axis=(1, 2))
+        return jnp.where(sel, x, 0).sum(axis=(0, 1))
 
-    msg_bal = gather(state.requests.bal)  # (I, A)
-    msg_v1 = gather(state.requests.v1)  # (I, A): REQVOTE cand_last / APPEND value
-    is_rv = sel[:, REQVOTE].any(axis=1)  # (I, A)
-    is_ap = sel[:, APPEND].any(axis=1)
+    msg_bal = gather(state.requests.bal)  # (A, I)
+    msg_v1 = gather(state.requests.v1)  # (A, I): REQVOTE cand_last / APPEND value
+    is_rv = sel[REQVOTE].any(axis=0)  # (A, I)
+    is_ap = sel[APPEND].any(axis=0)
 
     # RequestVote: one vote per term + election restriction.  Equivocators
     # grant everything and hide their entry (config-4-style double vote).
@@ -111,22 +111,22 @@ def raftcore_step(
 
     # Vote replies go to every solicitor (grant or denial), carrying the
     # voter's pre-update entry: (ent_term << 1) | granted, entry value.
-    vote_payload_t = jnp.where(equiv, 0, voter.ent_term)  # (I, A)
+    vote_payload_t = jnp.where(equiv, 0, voter.ent_term)  # (A, I)
     vote_payload_v = jnp.where(equiv, 0, voter.ent_val)
     replies = net.send(
         replies, VOTE,
-        send_mask=sel[:, REQVOTE],
-        bal=msg_bal[:, None, :],
-        v1=(vote_payload_t * 2 + grant.astype(jnp.int32))[:, None, :],
-        v2=vote_payload_v[:, None, :],
+        send_mask=sel[REQVOTE],
+        bal=msg_bal[None],
+        v1=(vote_payload_t * 2 + grant.astype(jnp.int32))[None],
+        v2=vote_payload_v[None],
         key=k_drop_vote, p_drop=cfg.p_drop,
     )
     replies = net.send(
         replies, ACK,
-        send_mask=sel[:, APPEND] & ok_ap[:, None, :],
-        bal=msg_bal[:, None, :],
-        v1=msg_v1[:, None, :],
-        v2=jnp.zeros_like(msg_v1)[:, None, :],
+        send_mask=sel[APPEND] & ok_ap[None],
+        bal=msg_bal[None],
+        v1=msg_v1[None],
+        v2=jnp.zeros_like(msg_v1)[None],
         key=k_drop_ack, p_drop=cfg.p_drop,
     )
     requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
@@ -142,33 +142,36 @@ def raftcore_step(
 
     # ---- Candidate half-tick: fold all delivered replies ----
     cand = state.proposer
-    bits = jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32)  # (A,)
+    bits = (jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32))[
+        None, :, None
+    ]  # (1, A, 1)
 
-    cur_bal = cand.bal[:, :, None]  # (I, P, 1)
+    cur_bal = cand.bal[:, None]  # (P, 1, I)
     vote_ok = (
-        delivered[:, VOTE]
-        & (state.replies.bal[:, VOTE] == cur_bal)
-        & (cand.phase == CAND)[:, :, None]
-    )  # (I, P, A)
-    granted = vote_ok & (state.replies.v1[:, VOTE] % 2 == 1)
+        delivered[VOTE]
+        & (state.replies.bal[VOTE] == cur_bal)
+        & (cand.phase == CAND)[:, None]
+    )  # (P, A, I)
+    granted = vote_ok & (state.replies.v1[VOTE] % 2 == 1)
     ack_ok = (
-        delivered[:, ACK]
-        & (state.replies.bal[:, ACK] == cur_bal)
-        & (cand.phase == LEAD)[:, :, None]
+        delivered[ACK]
+        & (state.replies.bal[ACK] == cur_bal)
+        & (cand.phase == LEAD)[:, None]
     )
     heard = (
         cand.heard
-        | jnp.where(granted, bits, 0).sum(axis=-1, dtype=jnp.int32)
-        | jnp.where(ack_ok, bits, 0).sum(axis=-1, dtype=jnp.int32)
+        | jnp.where(granted, bits, 0).sum(axis=1, dtype=jnp.int32)
+        | jnp.where(ack_ok, bits, 0).sum(axis=1, dtype=jnp.int32)
     )
 
     # Adopt the highest-term entry among vote replies (grants and denials).
-    rep_t = jnp.where(vote_ok, state.replies.v1[:, VOTE] // 2, 0)  # (I, P, A)
-    best_a = jnp.argmax(rep_t, axis=-1)  # (I, P)
-    cand_t = jnp.take_along_axis(rep_t, best_a[..., None], axis=-1)[..., 0]
-    cand_v = jnp.take_along_axis(
-        jnp.where(vote_ok, state.replies.v2[:, VOTE], 0), best_a[..., None], axis=-1
-    )[..., 0]
+    # Max-trick value ride-along (one value per term — the term's unique
+    # leader proposed it), no gathers; a zero max never upgrades.
+    rep_t = jnp.where(vote_ok, state.replies.v1[VOTE] // 2, 0)  # (P, A, I)
+    cand_t = rep_t.max(axis=1)  # (P, I)
+    cand_v = jnp.where(
+        (rep_t == cand_t[:, None]) & vote_ok, state.replies.v2[VOTE], 0
+    ).max(axis=1)
     upgrade = cand_t > cand.ent_term
     ent_term_c = jnp.where(upgrade, cand_t, cand.ent_term)
     ent_val_c = jnp.where(upgrade, cand_v, cand.ent_val)
@@ -184,7 +187,9 @@ def raftcore_step(
     backoff = jax.random.randint(
         k_backoff, timer.shape, 0, max(cfg.backoff_max, 1), jnp.int32
     )
-    pid = jnp.broadcast_to(jnp.arange(n_prop, dtype=jnp.int32), timer.shape)
+    pid = jnp.broadcast_to(
+        jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
+    )
     new_bal = bal_mod.make_ballot(bal_mod.ballot_round(cand.bal) + 1, pid)
 
     # A new leader proposes its adopted entry if it has one, else its own
@@ -207,18 +212,18 @@ def raftcore_step(
     is_lead = phase == LEAD
     requests = net.send(
         requests, APPEND,
-        send_mask=jnp.broadcast_to(is_lead[:, :, None], (n_inst, n_prop, n_acc)),
-        bal=bal_next[:, :, None],
-        v1=prop_val[:, :, None],
-        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        send_mask=jnp.broadcast_to(is_lead[:, None], (n_prop, n_acc, n_inst)),
+        bal=bal_next[:, None],
+        v1=prop_val[:, None],
+        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         key=k_drop_ap, p_drop=cfg.p_drop,
     )
     requests = net.send(
         requests, REQVOTE,
-        send_mask=jnp.broadcast_to(expired[:, :, None], (n_inst, n_prop, n_acc)),
-        bal=bal_next[:, :, None],
-        v1=ent_term_c[:, :, None],
-        v2=jnp.zeros((n_inst, n_prop, 1), jnp.int32),
+        send_mask=jnp.broadcast_to(expired[:, None], (n_prop, n_acc, n_inst)),
+        bal=bal_next[:, None],
+        v1=ent_term_c[:, None],
+        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         key=k_drop_rv, p_drop=cfg.p_drop,
     )
 

@@ -32,36 +32,60 @@ import jax.numpy as jnp
 
 from paxos_tpu.core.messages import MsgBuf
 
+# Bernoulli masks are thresholds on raw uint32 PRNG bits: P(bits < t) with
+# t = round(p * 2^32).  Integer-exact, no float conversion pass, and ~2^-32
+# probability resolution — far finer than any fuzzing config needs.
+_TWO32 = float(1 << 32)
+
+
+def _bernoulli_bits(key: jax.Array, shape, p: float) -> jnp.ndarray:
+    """bool mask, True with probability ``p`` (uint32-threshold sampling)."""
+    thresh = jnp.uint32(min(int(round(p * _TWO32)), (1 << 32) - 1))
+    return jax.random.bits(key, shape, jnp.uint32) < thresh
+
 
 def select_one(present: jnp.ndarray, key: jax.Array, p_idle: float) -> jnp.ndarray:
     """Pick at most one present request per (instance, acceptor).
 
+    Selection is a max over per-slot random uint32 scores whose low bits are
+    replaced by the slot's (kind, proposer) index: scores within a (A, I)
+    fiber are therefore *distinct*, so ``score == fiber_max`` recovers the
+    winner as a mask directly — no transpose, no argmax, no one_hot, all in
+    the buffers' native instance-minor layout.  An all-zero fiber max means
+    "nothing present" (a present slot scores 0 only with prob ~2^-27, a
+    vanishing extra idle tick).
+
     Args:
-      present: (I, 2, P, A) bool — occupied request slots.
+      present: (2, P, A, I) bool — occupied request slots.
       key: PRNG key for this tick.
       p_idle: probability an acceptor processes nothing despite pending mail.
 
     Returns:
-      (I, 2, P, A) bool one-hot (per (I, A) fiber) selection mask.
+      (2, P, A, I) bool one-hot (per (A, I) fiber) selection mask.
     """
-    i, k, p, a = present.shape
+    k, p, a, i = present.shape
     k_sel, k_idle = jax.random.split(key)
-    # Uniform scores; absent slots can never win.
-    scores = jax.random.uniform(k_sel, present.shape)
-    scores = jnp.where(present, scores, -1.0)
-    # argmax over the flattened (kind, proposer) fiber for each (I, A).
-    flat = jnp.moveaxis(scores, 3, 1).reshape(i, a, k * p)  # (I, A, 2P)
-    winner = jnp.argmax(flat, axis=-1)  # (I, A)
-    onehot = jax.nn.one_hot(winner, k * p, dtype=jnp.bool_)  # (I, A, 2P)
-    onehot = jnp.moveaxis(onehot.reshape(i, a, k, p), 1, 3)  # (I, 2, P, A)
-    busy = jax.random.uniform(k_idle, (i, 1, 1, a)) >= p_idle
-    return onehot & present & busy
+    nbits = max((k * p - 1).bit_length(), 1)  # low bits reserved for slot id
+    sid = (
+        jax.lax.broadcasted_iota(jnp.uint32, present.shape, 0) * p
+        + jax.lax.broadcasted_iota(jnp.uint32, present.shape, 1)
+    )
+    rnd = jax.random.bits(k_sel, present.shape, jnp.uint32)
+    score = (rnd & jnp.uint32(~((1 << nbits) - 1) & 0xFFFFFFFF)) | sid
+    score = jnp.where(present, score, jnp.uint32(0))
+    fiber_max = score.max(axis=(0, 1), keepdims=True)  # (1, 1, A, I)
+    sel = present & (score == fiber_max) & (fiber_max > 0)
+    if p_idle > 0.0:
+        busy = ~_bernoulli_bits(k_idle, (1, 1, a, i), p_idle)
+        sel = sel & busy
+    return sel
 
 
 def hold_mask(present: jnp.ndarray, key: jax.Array, p_hold: float) -> jnp.ndarray:
     """(shape of present) bool: which present reply slots deliver this tick."""
-    deliver = jax.random.uniform(key, present.shape) >= p_hold
-    return present & deliver
+    if p_hold <= 0.0:
+        return present
+    return present & ~_bernoulli_bits(key, present.shape, p_hold)
 
 
 def send(
@@ -79,19 +103,18 @@ def send(
     Args:
       buf: the target buffer family.
       kind: request/reply kind index (0 or 1).
-      send_mask: (I, P, A) bool — which edges send this tick.
-      bal, v1, v2: (I, P, A) int32 payloads (broadcastable).
+      send_mask: (P, A, I) bool — which edges send this tick.
+      bal, v1, v2: (P, A, I) int32 payloads (broadcastable).
       key: PRNG key; p_drop: send-time loss probability.
     """
     if p_drop > 0.0:
-        kept = jax.random.uniform(key, send_mask.shape) >= p_drop
-        send_mask = send_mask & kept
-    zero = jnp.zeros_like(buf.bal[:, kind])
+        send_mask = send_mask & ~_bernoulli_bits(key, send_mask.shape, p_drop)
+    zero = jnp.zeros_like(buf.bal[kind])
     return buf.replace(
-        bal=buf.bal.at[:, kind].set(jnp.where(send_mask, bal + zero, buf.bal[:, kind])),
-        v1=buf.v1.at[:, kind].set(jnp.where(send_mask, v1 + zero, buf.v1[:, kind])),
-        v2=buf.v2.at[:, kind].set(jnp.where(send_mask, v2 + zero, buf.v2[:, kind])),
-        present=buf.present.at[:, kind].set(buf.present[:, kind] | send_mask),
+        bal=buf.bal.at[kind].set(jnp.where(send_mask, bal + zero, buf.bal[kind])),
+        v1=buf.v1.at[kind].set(jnp.where(send_mask, v1 + zero, buf.v1[kind])),
+        v2=buf.v2.at[kind].set(jnp.where(send_mask, v2 + zero, buf.v2[kind])),
+        present=buf.present.at[kind].set(buf.present[kind] | send_mask),
     )
 
 
@@ -101,10 +124,9 @@ def consume(
     """Clear slots that were processed this tick, except duplicated ones.
 
     Args:
-      taken: (I, 2, P, A) bool — slots whose message was processed.
+      taken: (2, P, A, I) bool — slots whose message was processed.
       p_dup: probability a processed slot stays in flight (duplicate delivery).
     """
     if p_dup > 0.0:
-        dup = jax.random.uniform(key, taken.shape) < p_dup
-        taken = taken & ~dup
+        taken = taken & ~_bernoulli_bits(key, taken.shape, p_dup)
     return buf.replace(present=buf.present & ~taken)

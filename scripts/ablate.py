@@ -1,0 +1,76 @@
+"""Ablation timing of paxos_step pieces on the current backend.
+
+Times run_chunk with parts of the step disabled to locate the hot spot.
+Not part of the library API; dev tool only.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_default_prng_impl", "rbg")
+
+from paxos_tpu.check.safety import acceptor_invariants, learner_observe  # noqa: E402
+from paxos_tpu.harness.config import config2_dueling_drop  # noqa: E402
+from paxos_tpu.harness.run import base_key, init_plan, init_state, run_chunk  # noqa: E402
+from paxos_tpu.protocols import paxos as px  # noqa: E402
+
+
+def timed(tag, step, cfg, chunk=32, reps=2):
+    step = functools.partial(step)  # fresh identity => run_chunk recompiles
+    state = init_state(cfg)
+    plan = init_plan(cfg)
+    key = base_key(cfg)
+    state = run_chunk(state, key, plan, cfg.fault, chunk, step)
+    int(state.tick)  # sync
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state = run_chunk(state, key, plan, cfg.fault, chunk, step)
+    _ = int(state.tick) + int(state.learner.violations.sum())
+    dt = (time.perf_counter() - t0) / (reps * chunk)
+    print(f"{tag:28s} {dt * 1e3:8.2f} ms/tick")
+    return dt
+
+
+def main():
+    n_inst = 1 << 20 if jax.devices()[0].platform != "cpu" else 1 << 14
+    cfg = config2_dueling_drop(n_inst=n_inst, seed=0)
+
+    timed("full", px.paxos_step, cfg)
+
+    # no learner/checker
+    real_observe = px.learner_observe
+    real_inv = px.acceptor_invariants
+    px.learner_observe = lambda l, *a, **k: l
+    px.acceptor_invariants = lambda *a, **k: jnp.int32(0)
+    timed("no-learner", px.paxos_step, cfg)
+    px.learner_observe = real_observe
+    px.acceptor_invariants = real_inv
+
+    # no transport sends (emit disabled)
+    real_send = px.net.send
+    px.net.send = lambda buf, *a, **k: buf
+    timed("no-sends", px.paxos_step, cfg)
+    px.net.send = real_send
+
+    # no acceptor select (nothing processed)
+    real_sel = px.net.select_one
+    px.net.select_one = lambda present, key, p: jnp.zeros_like(present)
+    timed("no-select", px.paxos_step, cfg)
+    px.net.select_one = real_sel
+
+    # no consume (buffers never cleared)
+    real_consume = px.net.consume
+    px.net.consume = lambda buf, *a, **k: buf
+    timed("no-consume", px.paxos_step, cfg)
+    px.net.consume = real_consume
+
+    # learner only (everything else identity-ish): approximate by full minus others
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Micro-probe: elementwise-chain throughput vs array layout (minor dim size).
+
+Hypothesis: (I, 2, P, A) state arrays (minor dim A=5) waste 123/128 lanes;
+instance-minor (2, P, A, I) layouts should run ~an order of magnitude faster.
+Dev tool only.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_default_prng_impl", "rbg")
+
+
+def chain(x, mask):
+    # A representative mix: compares, wheres, a small-axis reduce.
+    for _ in range(8):
+        y = jnp.where(mask, x + 1, x)
+        m = y.max(axis=REDUCE_AXES, keepdims=True)
+        x = jnp.where(y == m, x, y)
+    return x
+
+
+def bench(shape, reduce_axes, reps=10):
+    global REDUCE_AXES
+    REDUCE_AXES = reduce_axes
+    key = jax.random.PRNGKey(0)
+    x = jax.random.randint(key, shape, 0, 1000, jnp.int32)
+    mask = jax.random.bits(key, shape, jnp.uint32) < jnp.uint32(1 << 31)
+    f = jax.jit(chain)
+    r = f(x, mask)
+    r.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = f(x, mask)
+    int(r.ravel()[0])
+    dt = (time.perf_counter() - t0) / reps
+    n = 1
+    for s in shape:
+        n *= s
+    print(f"shape={str(shape):24s} reduce={str(reduce_axes):8s} "
+          f"{dt * 1e3:7.2f} ms  ({n / dt / 1e9:6.1f} Gelem/s)")
+
+
+def main():
+    i = 1 << 20
+    bench((i, 2, 2, 5), (1, 2))     # current layout, fiber reduce
+    bench((2, 2, 5, i), (0, 1))     # instance-minor
+    bench((i, 8), (1,))             # learner table, current
+    bench((8, i), (0,))             # learner table, instance-minor
+    bench((i, 2, 5), (1,))          # acceptor-ish
+    bench((2, 5, i), (0,))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Probe fixed per-dispatch overhead vs marginal compute on this backend."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    x = jnp.zeros((8, 128), jnp.float32)
+    f = jax.jit(lambda x: x + 1)
+    r = f(x)
+    r.block_until_ready()
+    for reps in (1, 10, 100):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            r = f(r)
+        float(r[0, 0])
+        dt = time.perf_counter() - t0
+        print(f"tiny-op reps={reps:4d}: {dt * 1e3:8.2f} ms total, "
+              f"{dt / reps * 1e3:7.2f} ms/call")
+
+    # Marginal cost of a big elementwise chain, amortized inside one call.
+    big = jax.random.bits(jax.random.PRNGKey(0), (2, 2, 5, 1 << 20), jnp.uint32)
+
+    def chain_n(x, n):
+        def body(i, x):
+            y = x ^ (x >> 7)
+            return y + jnp.uint32(i)
+
+        return jax.lax.fori_loop(0, n, body, x)
+
+    for n in (16, 256):
+        g = jax.jit(lambda x, n=n: chain_n(x, n))
+        r = g(big)
+        r.block_until_ready()
+        t0 = time.perf_counter()
+        r = g(big)
+        int(r.ravel()[0])
+        dt = time.perf_counter() - t0
+        per_pass = dt / n
+        gbps = big.size * 4 * 2 / per_pass / 1e9
+        print(f"fori chain n={n:4d}: {dt * 1e3:8.2f} ms, {per_pass * 1e6:7.1f} us/pass, "
+              f"~{gbps:6.0f} GB/s effective")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,8 +17,8 @@ def test_mp_no_faults_full_logs():
     assert report["evictions"] == 0
     assert report["decided_frac"] == 1.0  # every instance's full log chosen
     # Validity: chosen values are real proposals: (pid+1)*1000 + slot.
-    vals = state.learner.chosen_val  # (I, L)
-    slots = jnp.arange(vals.shape[1])[None, :]
+    vals = state.learner.chosen_val  # (L, I)
+    slots = jnp.arange(vals.shape[0])[:, None]
     pid = vals // 1000 - 1
     assert bool(((pid >= 0) & (pid < 2)).all())
     assert bool((vals % 1000 == slots).all())

@@ -90,7 +90,7 @@ def test_election_restriction_and_leader_completeness():
     cfg = raft_cfg(n_inst=8, n_prop=1, n_acc=5, timeout=6, backoff_max=2)
     state = RaftState.init(cfg.n_inst, cfg.n_prop, cfg.n_acc)
     b0 = int(make_ballot(3, 0))
-    seeded = jnp.zeros((cfg.n_inst, cfg.n_acc), jnp.bool_).at[:, :3].set(True)
+    seeded = jnp.zeros((cfg.n_acc, cfg.n_inst), jnp.bool_).at[:3, :].set(True)
     state = state.replace(
         acceptor=state.acceptor.replace(
             voted=jnp.where(seeded, b0, state.acceptor.voted),

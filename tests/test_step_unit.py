@@ -31,10 +31,10 @@ def fresh(n_inst=2, n_prop=1, n_acc=3):
 
 def put(buf, kind, p, a, bal, v1=0, v2=0):
     return buf.replace(
-        bal=buf.bal.at[:, kind, p, a].set(bal),
-        v1=buf.v1.at[:, kind, p, a].set(v1),
-        v2=buf.v2.at[:, kind, p, a].set(v2),
-        present=buf.present.at[:, kind, p, a].set(True),
+        bal=buf.bal.at[kind, p, a].set(bal),
+        v1=buf.v1.at[kind, p, a].set(v1),
+        v2=buf.v2.at[kind, p, a].set(v2),
+        present=buf.present.at[kind, p, a].set(True),
     )
 
 
@@ -44,16 +44,16 @@ def test_prepare_granted_and_rejected():
     b = int(make_ballot(1, 0))
     reqs = put(reqs, PREPARE, p=0, a=0, bal=b)
     # Instance 1's acceptor 0 already promised higher.
-    acc = s.acceptor.replace(promised=s.acceptor.promised.at[1, 0].set(b + 8))
+    acc = s.acceptor.replace(promised=s.acceptor.promised.at[0, 1].set(b + 8))
     s = s.replace(requests=reqs, acceptor=acc)
 
     s2 = paxos_step(s, KEY, plan, CFG)
     assert int(s2.acceptor.promised[0, 0]) == b  # granted
-    assert int(s2.acceptor.promised[1, 0]) == b + 8  # unchanged
-    assert bool(s2.replies.present[0, PROMISE, 0, 0])  # promise sent
-    assert not bool(s2.replies.present[1, PROMISE, 0, 0])  # silent reject
-    assert int(s2.replies.bal[0, PROMISE, 0, 0]) == b
-    assert not bool(s2.requests.present[0, PREPARE, 0, 0])  # consumed
+    assert int(s2.acceptor.promised[0, 1]) == b + 8  # unchanged
+    assert bool(s2.replies.present[PROMISE, 0, 0, 0])  # promise sent
+    assert not bool(s2.replies.present[PROMISE, 0, 0, 1])  # silent reject
+    assert int(s2.replies.bal[PROMISE, 0, 0, 0]) == b
+    assert not bool(s2.requests.present[PREPARE, 0, 0, 0])  # consumed
 
 
 def test_stale_accept_after_higher_promise_rejected():
@@ -68,7 +68,7 @@ def test_stale_accept_after_higher_promise_rejected():
     s2 = paxos_step(s, KEY, plan, CFG)
     assert int(s2.acceptor.acc_bal[0, 0]) == 0  # NOT accepted
     assert int(s2.acceptor.acc_val[0, 0]) == 0
-    assert not bool(s2.replies.present[0, ACCEPTED, 0, 0])
+    assert not bool(s2.replies.present[ACCEPTED, 0, 0, 0])
     assert int(s2.learner.lt_mask.sum()) == 0  # no accept event observed
     assert int(s2.learner.violations.sum()) == 0
 
@@ -78,15 +78,15 @@ def test_accept_at_or_above_promise_accepted():
     b = int(make_ballot(2, 0))
     reqs = s.requests.replace(present=jnp.zeros_like(s.requests.present))
     reqs = put(reqs, ACCEPT, p=0, a=1, bal=b, v1=42)
-    acc = s.acceptor.replace(promised=s.acceptor.promised.at[:, 1].set(b))
+    acc = s.acceptor.replace(promised=s.acceptor.promised.at[1, :].set(b))
     s = s.replace(requests=reqs, acceptor=acc)
 
     s2 = paxos_step(s, KEY, plan, CFG)
-    assert int(s2.acceptor.acc_bal[0, 1]) == b
-    assert int(s2.acceptor.acc_val[0, 1]) == 42
-    assert bool(s2.replies.present[0, ACCEPTED, 0, 1])
+    assert int(s2.acceptor.acc_bal[1, 0]) == b
+    assert int(s2.acceptor.acc_val[1, 0]) == 42
+    assert bool(s2.replies.present[ACCEPTED, 0, 1, 0])
     # Learner recorded the accept event for (b, 42) by acceptor 1.
-    assert int(s2.learner.lt_mask.sum(axis=-1)[0]) == 2  # bit 1
+    assert int(s2.learner.lt_mask.sum(axis=0)[0]) == 2  # bit 1
     assert int(s2.learner.violations.sum()) == 0
 
 
@@ -104,9 +104,9 @@ def test_proposer_adopts_highest_accepted_value():
     assert int(s2.proposer.phase[0, 0]) == P2  # quorum of 2/3 promises
     assert int(s2.proposer.prop_val[0, 0]) == 77  # adopted, NOT own value
     for a in range(3):
-        assert bool(s2.requests.present[0, ACCEPT, 0, a])
-        assert int(s2.requests.v1[0, ACCEPT, 0, a]) == 77
-        assert int(s2.requests.bal[0, ACCEPT, 0, a]) == b
+        assert bool(s2.requests.present[ACCEPT, 0, a, 0])
+        assert int(s2.requests.v1[ACCEPT, 0, a, 0]) == 77
+        assert int(s2.requests.bal[ACCEPT, 0, a, 0]) == b
 
 
 def test_proposer_decides_on_accepted_quorum():
@@ -141,4 +141,4 @@ def test_stale_ballot_replies_ignored():
     s2 = paxos_step(s, KEY, plan, CFG)
     assert int(s2.proposer.heard[0, 0]) == 0
     assert int(s2.proposer.phase[0, 0]) == P1
-    assert not bool(s2.replies.present[0, PROMISE, 0, 0])  # consumed anyway
+    assert not bool(s2.replies.present[PROMISE, 0, 0, 0])  # consumed anyway
