@@ -50,11 +50,22 @@ class FaultConfig:
     crash_max_len: int = 16  # window length ~ U[1, crash_max_len]
     crash_forever: bool = False  # never recover instead
     amnesia: bool = False  # (bug injection) lose acceptor state on recovery
+    # Network partition (sampled once per run): within a per-instance window
+    # the nodes are split into two sides; messages crossing the cut stall
+    # in flight (delivery blocked, nothing lost) until the partition heals.
+    p_part: float = 0.0  # per instance: a partition episode occurs
+    part_max_start: int = 32  # episode start ~ U[0, part_max_start)
+    part_max_len: int = 16  # episode length ~ U[1, part_max_len]
     # Byzantine (config 4)
     p_equiv: float = 0.0  # per (instance, acceptor): equivocates forever
     # Proposer timing
     timeout: int = 10  # ticks in a phase before retrying with higher ballot
     backoff_max: int = 8  # retry backoff ~ U[0, backoff_max) extra ticks
+    # Flexible Paxos (protocols/paxos only): phase-1 / phase-2 quorum sizes.
+    # 0 means the classic majority.  Safe iff q1 + q2 > n_acc — running an
+    # unsafe pair is a supported bug-injection mode the checker must catch.
+    q1: int = 0
+    q2: int = 0
     # Multi-Paxos leader lease (ticks without chosen-count progress before
     # followers suspect the leader / a leader demotes itself)
     lease_len: int = 24
@@ -69,6 +80,10 @@ class FaultPlan:
     equivocate: jnp.ndarray  # (A, I) bool
     pcrash_start: jnp.ndarray  # (P, I) int32 — proposer (leader) crash window
     pcrash_end: jnp.ndarray  # (P, I) int32
+    part_start: jnp.ndarray  # (I,) int32 — partition window; NEVER if none
+    part_end: jnp.ndarray  # (I,) int32
+    aside: jnp.ndarray  # (A, I) bool — acceptor's side of the cut
+    pside: jnp.ndarray  # (P, I) bool — proposer's side of the cut
 
     @classmethod
     def none(cls, n_inst: int, n_acc: int, n_prop: int = 1) -> "FaultPlan":
@@ -78,6 +93,10 @@ class FaultPlan:
             equivocate=jnp.zeros((n_acc, n_inst), jnp.bool_),
             pcrash_start=jnp.full((n_prop, n_inst), NEVER, jnp.int32),
             pcrash_end=jnp.full((n_prop, n_inst), NEVER, jnp.int32),
+            part_start=jnp.full((n_inst,), NEVER, jnp.int32),
+            part_end=jnp.full((n_inst,), NEVER, jnp.int32),
+            aside=jnp.zeros((n_acc, n_inst), jnp.bool_),
+            pside=jnp.zeros((n_prop, n_inst), jnp.bool_),
         )
 
     @classmethod
@@ -89,7 +108,7 @@ class FaultPlan:
         n_acc: int,
         n_prop: int = 1,
     ) -> "FaultPlan":
-        k_crash, k_eq, kp = jax.random.split(key, 3)
+        k_crash, k_eq, kp, k_part, k_side = jax.random.split(key, 5)
 
         def windows(k, shape, p):
             k1, k2, k3 = jax.random.split(k, 3)
@@ -108,17 +127,42 @@ class FaultPlan:
         crash_start, crash_end = windows(k_crash, (n_acc, n_inst), cfg.p_crash)
         pcrash_start, pcrash_end = windows(kp, (n_prop, n_inst), cfg.p_crash_prop)
         equivocate = jax.random.uniform(k_eq, (n_acc, n_inst)) < cfg.p_equiv
+
+        kp1, kp2, kp3 = jax.random.split(k_part, 3)
+        parts = jax.random.uniform(kp1, (n_inst,)) < cfg.p_part
+        pstart = jax.random.randint(kp2, (n_inst,), 0, max(cfg.part_max_start, 1))
+        plen = jax.random.randint(kp3, (n_inst,), 1, max(cfg.part_max_len, 1) + 1)
+        part_start = jnp.where(parts, pstart, NEVER)
+        part_end = jnp.where(parts, jnp.minimum(pstart + plen, NEVER - 1), NEVER)
+        ka, kpr = jax.random.split(k_side)
+        aside = jax.random.uniform(ka, (n_acc, n_inst)) < 0.5
+        pside = jax.random.uniform(kpr, (n_prop, n_inst)) < 0.5
         return cls(
             crash_start=crash_start,
             crash_end=crash_end,
             equivocate=equivocate,
             pcrash_start=pcrash_start,
             pcrash_end=pcrash_end,
+            part_start=part_start,
+            part_end=part_end,
+            aside=aside,
+            pside=pside,
         )
 
     def alive(self, tick: jnp.ndarray) -> jnp.ndarray:
         """(A, I) bool: acceptor is up at ``tick``."""
         return ~((self.crash_start <= tick) & (tick < self.crash_end))
+
+    def link_ok(self, tick: jnp.ndarray) -> jnp.ndarray:
+        """(P, A, I) bool: the proposer<->acceptor link delivers at ``tick``.
+
+        False only inside the instance's partition window for pairs on
+        opposite sides of the cut; in-flight messages are not dropped, they
+        stall until the partition heals (delivery masks AND with this).
+        """
+        cut = (self.part_start <= tick) & (tick < self.part_end)  # (I,)
+        same = self.pside[:, None] == self.aside[None]  # (P, A, I)
+        return same | ~cut[None, None]
 
     def prop_alive(self, tick: jnp.ndarray) -> jnp.ndarray:
         """(P, I) bool: proposer is up at ``tick``."""

@@ -28,10 +28,11 @@ from paxos_tpu.core.state import PaxosState
 from paxos_tpu.faults.injector import FaultPlan
 from paxos_tpu.harness.config import SimConfig
 
-# On-disk array-layout schema.  Bumped when state array axis order changes
-# (e.g. the instance-minor refactor); restore() refuses snapshots from a
-# different schema with a clear message instead of a deep orbax shape error.
-LAYOUT_VERSION = "instance-minor-v2"
+# On-disk snapshot schema.  Bumped whenever the state/plan pytree changes
+# shape or structure (axis order, new FaultPlan fields, ...); restore()
+# refuses snapshots from a different schema with a clear message instead of
+# a deep orbax structure error.
+LAYOUT_VERSION = "instance-minor-v3"  # v3: FaultPlan partition fields
 
 
 def save(

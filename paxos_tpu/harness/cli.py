@@ -27,6 +27,11 @@ CONFIGS = {
     "config2": config_mod.config2_dueling_drop,
     "config3": config_mod.config3_multipaxos,
     "config4": config_mod.config4_byzantine,
+    "partition": config_mod.config_partition,
+    # Flexible Paxos: safe (4+2 > 5) and deliberately unsafe (2+2 <= 5)
+    # quorum pairs; the unsafe one exists to prove the checker catches it.
+    "flex-safe": lambda **kw: config_mod.config_flex(4, 2, **kw),
+    "flex-unsafe": lambda **kw: config_mod.config_flex(2, 2, **kw),
 }
 
 
@@ -68,6 +73,16 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--ticks", type=int, default=1024, help="max ticks per protocol")
     s.add_argument("--chunk", type=int, default=64)
     s.add_argument("--log", default=None, help="JSONL metrics path")
+
+    k = sub.add_parser(
+        "shrink",
+        help="delta-debug a violating config's fault plan to a minimal repro",
+    )
+    k.add_argument("--config", choices=sorted(CONFIGS), default="config4")
+    k.add_argument("--n-inst", type=int, default=None)
+    k.add_argument("--seed", type=int, default=0)
+    k.add_argument("--ticks", type=int, default=512, help="violation search budget")
+    k.add_argument("--chunk", type=int, default=32)
     return p
 
 
@@ -184,6 +199,33 @@ def cmd_sweep(args: argparse.Namespace) -> int:
     return 0 if worst == 0 else 2
 
 
+def cmd_shrink(args: argparse.Namespace) -> int:
+    """Minimize a failing fault schedule and print the repro as JSON."""
+    from paxos_tpu.harness.shrink import replay, shrink
+
+    kw = {"seed": args.seed}
+    if args.n_inst:
+        kw["n_inst"] = args.n_inst
+    cfg = CONFIGS[args.config](**kw)
+    result = shrink(
+        cfg, max_ticks=args.ticks, chunk=args.chunk,
+        log=lambda s: print(f"# {s}", file=sys.stderr),
+    )
+    if result is None:
+        print(json.dumps({"config": args.config, "violation": False}))
+        return 0
+    out = {
+        "config": args.config,
+        "violation": True,
+        "config_fingerprint": cfg.fingerprint(),
+        "seed": args.seed,
+        "replays": replay(cfg, result, chunk=args.chunk),
+        **result.to_json(),
+    }
+    print(json.dumps(out))
+    return 2
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.platform == "cpu":
@@ -196,6 +238,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_run(args)
     if args.cmd == "sweep":
         return cmd_sweep(args)
+    if args.cmd == "shrink":
+        return cmd_shrink(args)
     return 1
 
 

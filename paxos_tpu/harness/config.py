@@ -88,6 +88,46 @@ def config4_byzantine(n_inst: int = 4096, seed: int = 0) -> SimConfig:
     )
 
 
+def config_partition(n_inst: int = 65_536, seed: int = 0) -> SimConfig:
+    """Network partitions: per-instance bipartition windows + drop + duels.
+
+    Messages crossing the cut stall until the partition heals
+    (``FaultPlan.link_ok``); safety must hold throughout and liveness must
+    resume after healing.
+    """
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        seed=seed,
+        fault=FaultConfig(
+            p_drop=0.05,
+            p_idle=0.1,
+            p_hold=0.1,
+            p_part=0.8,
+            part_max_start=40,
+            part_max_len=30,
+        ),
+    )
+
+
+def config_flex(
+    q1: int, q2: int, n_inst: int = 16_384, seed: int = 0
+) -> SimConfig:
+    """Flexible Paxos: explicit phase-1/phase-2 quorums over 5 acceptors.
+
+    Safe iff ``q1 + q2 > 5``; an unsafe pair is a supported bug-injection
+    mode that must light up the safety checker (grid quorums, FPaxos).
+    """
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        seed=seed,
+        fault=FaultConfig(p_idle=0.2, p_hold=0.2, q1=q1, q2=q2),
+    )
+
+
 def config5_sweep(n_inst: int = 65_536, seed: int = 0) -> tuple[SimConfig, ...]:
     """Config 5: Paxos vs Fast-Paxos vs Raft-core under identical fault masks."""
     fault = FaultConfig(p_drop=0.1, p_idle=0.2, p_hold=0.2)

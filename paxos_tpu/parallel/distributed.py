@@ -1,0 +1,111 @@
+"""Multi-host scale-out: process bootstrap + ICI/DCN-aware device ordering.
+
+Reference parity (SURVEY.md §3.2, §6.8): the reference scales out by adding
+OS processes found via SimpleLocalnet's UDP-multicast discovery and talks
+TCP between them [B][CH].  The TPU twin scales out by adding *hosts* to one
+multi-controller JAX program: :func:`init_distributed` is the discovery
+step (coordinator rendezvous instead of multicast), and the mesh built by
+:func:`make_instances_mesh` spans every chip of every host.
+
+Because instances are embarrassingly parallel, the step function needs no
+cross-chip traffic at all; the only collectives are the scalar metric
+reductions in ``summarize``.  The mesh is still built DCN-aware: devices
+are ordered slice-major (``mesh_utils.create_hybrid_device_mesh``), so a
+tree-reduction runs over ICI within each slice first and crosses the
+slow DCN once per slice — the standard multi-slice recipe.
+
+Single-host (and the CPU test rig) passes through unchanged: with one
+process and no slice metadata every helper degrades to the plain 1-D mesh
+of ``paxos_tpu.parallel.mesh``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from paxos_tpu.parallel.mesh import INSTANCES_AXIS
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join the multi-host program; returns this process's index.
+
+    No-op (returns 0) when unconfigured — single-host runs and the unit-test
+    rig never touch the distributed runtime.  On TPU pods the three
+    arguments are normally inferred from the environment, so
+    ``init_distributed()`` alone suffices; explicit values support
+    DCN-connected CPU/GPU fleets.
+    """
+    if coordinator_address is None and jax.process_count() == 1:
+        env_ok = False
+        try:
+            import jax._src.clusters as clusters
+
+            env_ok = any(
+                c.is_env_present() for c in clusters.ClusterEnv._cluster_types
+            )
+        except Exception:
+            env_ok = False
+        if not env_ok:
+            return 0
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_index()
+
+
+def slice_major_devices(
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> list[jax.Device]:
+    """All devices ordered slice-major: same-slice chips are adjacent.
+
+    Shard k of the instances axis lands on ``devices[k]``, so adjacent
+    shards share a slice and reductions tree up over ICI before touching
+    DCN.  Devices without slice metadata (single slice, CPU) keep their
+    default order.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if any(getattr(d, "slice_index", None) is None for d in devices):
+        return devices
+    return sorted(devices, key=lambda d: (d.slice_index, d.id))
+
+
+def make_instances_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D ``instances`` mesh over every chip of every host, DCN-aware.
+
+    Uses ``mesh_utils.create_hybrid_device_mesh`` when multiple slices are
+    present (it validates per-slice symmetry), else a plain ordered mesh.
+    """
+    devices = slice_major_devices(devices)
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if len(slice_ids) > 1 and None not in slice_ids:
+        from jax.experimental import mesh_utils
+
+        per_slice = len(devices) // len(slice_ids)
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(per_slice,),
+            dcn_mesh_shape=(len(slice_ids),),
+            devices=devices,
+        )
+        return Mesh(arr.reshape(-1), (INSTANCES_AXIS,))
+    return Mesh(np.asarray(devices), (INSTANCES_AXIS,))
+
+
+def process_local_batch(n_inst: int) -> int:
+    """Instances this process materializes under full sharding.
+
+    With multi-controller JAX each process only allocates its addressable
+    shard; host-side planning (e.g. checkpoint sizing) uses this.
+    """
+    return n_inst // jax.process_count()

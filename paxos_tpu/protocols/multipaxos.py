@@ -66,9 +66,14 @@ def multipaxos_step(
         )
 
     # ---- Reply delivery decided & cleared before new writes (no clobber) ----
+    link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
+
     with jax.named_scope("deliver"):
         prom_del = net.hold_mask(state.promises.present, k_hold_pr, cfg.p_hold)
         accd_del = net.hold_mask(state.accepted.present, k_hold_ac, cfg.p_hold)
+        if link is not None:  # partitioned links stall replies in flight
+            prom_del = prom_del & link
+            accd_del = accd_del & link
         promises = state.promises.replace(present=state.promises.present & ~prom_del)
         accepted = state.accepted.replace(present=state.accepted.present & ~accd_del)
 
@@ -76,6 +81,8 @@ def multipaxos_step(
     with jax.named_scope("acceptor_select"):
         sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
         sel = sel & alive[None, None]
+        if link is not None:  # partitioned links stall requests in flight
+            sel = sel & link[None]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(0, 1))

@@ -49,6 +49,11 @@ def paxos_step(
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     quorum = majority(n_acc)
+    # Flexible Paxos: explicit phase-1/phase-2 quorums (0 = classic majority).
+    # Safe iff q1 + q2 > n_acc; unsafe pairs are a bug-injection mode the
+    # checker must catch (see tests/test_flexpaxos.py).
+    q1 = cfg.q1 or quorum
+    q2 = cfg.q2 or quorum
 
     # Keys depend only on (seed, tick): checkpoint/resume replays bit-exactly.
     key = jax.random.fold_in(base_key, state.tick)
@@ -72,14 +77,20 @@ def paxos_step(
     # acceptor half-tick writes new replies: otherwise a reply written this
     # tick could land in a slot being consumed and be lost even on a
     # fault-free network.  Proposers read payloads from the pre-tick buffer.
+    link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
+
     with jax.named_scope("deliver"):
         delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
+        if link is not None:  # partitioned links stall replies in flight
+            delivered = delivered & link[None]
         replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
 
     # ---- Acceptor half-tick: select one request per (instance, acceptor) ----
     with jax.named_scope("acceptor_select"):
         sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
         sel = sel & alive[None, None]  # crashed acceptors process nothing
+        if link is not None:  # partitioned links stall requests in flight
+            sel = sel & link[None]
 
     # Gather the selected message's fields onto (A, I).
     def gather(x):
@@ -128,7 +139,7 @@ def paxos_step(
     # ---- Learner / safety checker (omniscient: sees accept events directly) ----
     with jax.named_scope("learner_check"):
         learner = learner_observe(
-            state.learner, ok_acc, msg_bal, msg_val, state.tick, quorum
+            state.learner, ok_acc, msg_bal, msg_val, state.tick, q2
         )
         inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
         learner = learner.replace(violations=learner.violations + inv_viol)
@@ -171,8 +182,8 @@ def paxos_step(
     best_val = jnp.where(upgrade, cand_val, prop.best_val)
 
     # Phase transitions.
-    p1_done = (prop.phase == P1) & quorum_reached(heard, quorum)
-    p2_done = (prop.phase == P2) & quorum_reached(heard, quorum)
+    p1_done = (prop.phase == P1) & quorum_reached(heard, q1)
+    p2_done = (prop.phase == P2) & quorum_reached(heard, q2)
     v_chosen_by_p1 = jnp.where(best_bal > 0, best_val, prop.own_val)
 
     timer = jnp.where(prop.phase == DONE, prop.timer, prop.timer + 1)

@@ -79,14 +79,20 @@ def raftcore_step(
         )
     voter_pre = voter
 
+    link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
+
     with jax.named_scope("deliver"):
         delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
+        if link is not None:  # partitioned links stall replies in flight
+            delivered = delivered & link[None]
         replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
 
     # ---- Voter half-tick: select one request per (instance, voter) ----
     with jax.named_scope("acceptor_select"):
         sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
         sel = sel & alive[None, None]
+        if link is not None:  # partitioned links stall requests in flight
+            sel = sel & link[None]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(0, 1))

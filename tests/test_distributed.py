@@ -1,0 +1,40 @@
+"""Multi-host helpers degrade correctly on the single-process CPU rig."""
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.harness.config import config2_dueling_drop
+from paxos_tpu.harness.run import base_key, get_step_fn, init_plan, init_state, run_chunk
+from paxos_tpu.parallel.distributed import (
+    init_distributed,
+    make_instances_mesh,
+    process_local_batch,
+    slice_major_devices,
+)
+from paxos_tpu.parallel.mesh import shard_pytree
+
+
+def test_init_noop_single_process():
+    assert init_distributed() == 0  # must not try to rendezvous
+
+
+def test_slice_major_order_is_stable_without_slices():
+    devs = jax.devices()
+    assert slice_major_devices(devs) == list(devs)
+
+
+def test_instances_mesh_spans_all_devices_and_runs():
+    mesh = make_instances_mesh()
+    assert mesh.devices.size == len(jax.devices())
+
+    cfg = config2_dueling_drop(n_inst=16 * mesh.devices.size, seed=0)
+    state = shard_pytree(init_state(cfg), mesh, cfg.n_inst)
+    plan = shard_pytree(init_plan(cfg), mesh, cfg.n_inst)
+    step = get_step_fn(cfg.protocol)
+    state = run_chunk(state, base_key(cfg), plan, cfg.fault, 4, step)
+    assert len(state.acceptor.promised.sharding.device_set) == mesh.devices.size
+    assert int(state.tick) == 4
+
+
+def test_process_local_batch():
+    assert process_local_batch(1 << 20) == (1 << 20) // jax.process_count()

@@ -1,0 +1,30 @@
+"""Flexible Paxos (FPaxos): separate phase-1/phase-2 quorums.
+
+Safety requires every phase-1 quorum to intersect every phase-2 quorum:
+q1 + q2 > n.  The safe pair must fuzz clean; the unsafe pair must light up
+the agreement checker — the falsifiability twin of config 4.
+"""
+
+from paxos_tpu.harness.config import config_flex
+from paxos_tpu.harness.run import run
+
+
+def test_flex_safe_quorums_clean():
+    # q1=4, q2=2 over 5 acceptors: intersecting (4 + 2 > 5) => safe.
+    report = run(
+        config_flex(4, 2, n_inst=8192, seed=11),
+        until_all_chosen=True,
+        max_ticks=512,
+    )
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["chosen_frac"] == 1.0
+    assert report["proposer_disagree"] == 0
+
+
+def test_flex_unsafe_quorums_trip_checker():
+    # q1=2, q2=2 over 5 acceptors: 2 + 2 <= 5, quorums need not intersect —
+    # dueling proposers can each get a disjoint phase-2 quorum for different
+    # values.  The checker MUST catch the agreement break.
+    report = run(config_flex(2, 2, n_inst=8192, seed=11), total_ticks=256)
+    assert report["violations"] > 0
