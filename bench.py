@@ -22,6 +22,8 @@ def main() -> None:
     # sampling; streams stay deterministic per (seed, tick) within the impl.
     jax.config.update("jax_default_prng_impl", "rbg")
 
+    import jax.numpy as jnp
+
     from paxos_tpu.harness.config import config2_dueling_drop
     from paxos_tpu.harness.run import (
         base_key,
@@ -34,23 +36,37 @@ def main() -> None:
     platform = jax.devices()[0].platform
     n_inst = 1 << 20 if platform != "cpu" else 1 << 14  # 1,048,576 on TPU
     cfg = config2_dueling_drop(n_inst=n_inst, seed=0)
-    step = get_step_fn(cfg.protocol)
 
     state = init_state(cfg)
     plan = init_plan(cfg)
-    key = base_key(cfg)
+
+    # Engine: the fused Pallas path (whole chunk resident in VMEM) on TPU;
+    # the scanned XLA path on CPU (Mosaic doesn't target host CPUs).
+    engine = "fused" if platform == "tpu" else "xla"
+    if engine == "fused":
+        from paxos_tpu.kernels.fused_tick import fused_paxos_chunk
+
+        def advance(s, n):
+            return fused_paxos_chunk(s, jnp.int32(cfg.seed), plan, cfg.fault, n)
+
+    else:
+        step = get_step_fn(cfg.protocol)
+        key = base_key(cfg)
+
+        def advance(s, n):
+            return run_chunk(s, key, plan, cfg.fault, n, step)
 
     chunk = 64
     # Warmup: compile + one chunk.  NOTE: timing must end with a device->host
     # readback, not block_until_ready — on the axon tunnel backend
     # block_until_ready can return before execution finishes.
-    state = run_chunk(state, key, plan, cfg.fault, chunk, step)
+    state = advance(state, chunk)
     int(state.tick)
 
     timed_chunks = 4
     t0 = time.perf_counter()
     for _ in range(timed_chunks):
-        state = run_chunk(state, key, plan, cfg.fault, chunk, step)
+        state = advance(state, chunk)
     violations = int(state.learner.violations.sum())  # forces completion
     dt = time.perf_counter() - t0
 
@@ -66,6 +82,7 @@ def main() -> None:
         "ticks": ticks,
         "seconds": round(dt, 4),
         "platform": platform,
+        "engine": engine,
         "violations": violations,
         "config_fingerprint": cfg.fingerprint(),
     }

@@ -37,8 +37,20 @@ from paxos_tpu.utils.bitops import popcount
 
 
 def first_true(mask: jnp.ndarray, axis: int = 0) -> jnp.ndarray:
-    """Boolean mask selecting the first True along ``axis`` (all-False-safe)."""
-    return mask & (jnp.cumsum(mask, axis=axis) == 1)
+    """Boolean mask selecting the first True along ``axis`` (all-False-safe).
+
+    Positions are unique, so "first" is an exact min-of-masked-iota plus an
+    equality — full-shape elementwise ops and one small reduce, with no
+    slicing/stacking/cumsum (this function is traced inside the fused Pallas
+    engine, where those fail to lower).
+    """
+    import jax
+
+    idx = jax.lax.broadcasted_iota(jnp.int32, mask.shape, axis)
+    none = jnp.int32(mask.shape[axis])  # > every real index
+    masked = jnp.where(mask, idx, none)
+    first = masked.min(axis=axis, keepdims=True)
+    return mask & (masked == first)
 
 
 def learner_observe(

@@ -47,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     r = sub.add_parser("run", help="run a fuzzing campaign")
     r.add_argument("--config", choices=sorted(CONFIGS), default="config2")
+    r.add_argument(
+        "--engine",
+        choices=["xla", "fused"],
+        default="xla",
+        help="fused = whole-chunk Pallas kernel (paxos protocol, TPU)",
+    )
     r.add_argument("--n-inst", type=int, default=None, help="override instance count")
     r.add_argument("--seed", type=int, default=0)
     r.add_argument("--ticks", type=int, default=256, help="total scheduler ticks")
@@ -123,16 +129,42 @@ def cmd_run(args: argparse.Namespace) -> int:
         plan = shard_pytree(plan, mesh, cfg.n_inst)
         log.emit("mesh", devices=len(mesh.devices))
 
-    step_fn = get_step_fn(cfg.protocol)
-    key = base_key(cfg)
+    if args.engine == "fused":
+        if cfg.protocol != "paxos":
+            print("error: --engine fused supports the paxos protocol only",
+                  file=sys.stderr)
+            return 1
+        if jax.devices()[0].platform == "cpu":
+            print("error: --engine fused needs a TPU (Mosaic does not target "
+                  "host CPUs); drop --platform cpu or use --engine xla",
+                  file=sys.stderr)
+            return 1
+        if args.shard:
+            print("error: --engine fused is single-chip for now; drop --shard",
+                  file=sys.stderr)
+            return 1
+        import jax.numpy as jnp
+
+        from paxos_tpu.kernels.fused_tick import fused_paxos_chunk
+
+        def advance(s, n):
+            return fused_paxos_chunk(s, jnp.int32(cfg.seed), plan, cfg.fault, n)
+
+    else:
+        step_fn = get_step_fn(cfg.protocol)
+        key = base_key(cfg)
+
+        def advance(s, n):
+            return run_chunk(s, key, plan, cfg.fault, n, step_fn)
+
     log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
-             n_inst=cfg.n_inst, protocol=cfg.protocol)
+             n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)
 
     done, since_ckpt = 0, 0
     with trace_mod.profile(args.trace):
         while done < args.ticks:
             n = min(args.chunk, args.ticks - done)
-            state = run_chunk(state, key, plan, cfg.fault, n, step_fn)
+            state = advance(state, n)
             done += n
             since_ckpt += n
             rep = summarize(state)

@@ -1,0 +1,310 @@
+"""Fused multi-tick Pallas engine for single-decree Paxos.
+
+The XLA engine (`harness.run.run_chunk`) scans `apply_tick` over ticks with
+the full state pytree as the scan carry: every tick reads and writes the
+whole state in HBM (~1.6 GB/tick at 1M instances), which bounds throughput
+at HBM bandwidth / tick.
+
+This module removes that bound: one `pallas_call` keeps a block of
+instances' ENTIRE state resident in VMEM and advances it `n_ticks` ticks
+before writing back — HBM traffic drops from `2 * state * n_ticks` to
+`2 * state` per chunk, and the per-tick fault masks come from the on-core
+hardware PRNG (`pltpu.prng_random_bits`) instead of materialized
+`jax.random` draws.
+
+Protocol semantics are NOT reimplemented: the kernel traces the very same
+:func:`paxos_tpu.protocols.paxos.apply_tick` the XLA engine scans — only
+the mask source differs, so the two engines explore the same adversarial
+schedule space with different (but equally deterministic) random streams.
+Determinism: the PRNG is reseeded per (seed, tick, block) via a splitmix
+hash, so a chunk replays bit-identically regardless of chunk size, and
+checkpoint/resume stays exact as long as the block size is kept.
+
+Reference parity (SURVEY.md §8.2.5, §8.4.4): this is the "Pallas fallback
+for deliver+vote if XLA doesn't reach the throughput target" milestone —
+generalized to the whole tick, which profiling showed is the right fusion
+boundary (the scan carry's HBM round-trip, not any single op, is the cost).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paxos_tpu.core.state import PaxosState
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.protocols.paxos import TickMasks, apply_tick
+
+
+DEFAULT_BLOCK = 1024
+
+
+def _i32(c: int) -> jnp.ndarray:
+    """int32 constant with the bit pattern of the (possibly >2^31) literal."""
+    c &= 0xFFFFFFFF
+    return jnp.int32(c - (1 << 32) if c >= (1 << 31) else c)
+
+
+def _shr(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Logical (not arithmetic) right shift on int32."""
+    return jax.lax.shift_right_logical(x, jnp.int32(k))
+
+
+def _mix(seed: jnp.ndarray, tick: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """splitmix32-style scalar hash -> per-(seed, tick, block) PRNG seed.
+
+    All-int32: wrapping int32 mul/add is arithmetic mod 2^32 (same bits as
+    uint32), and Mosaic handles signed vectors/scalars natively where
+    unsigned ones hit unimplemented paths.
+    """
+    h = (
+        seed.astype(jnp.int32) * _i32(0x9E3779B1)
+        + tick.astype(jnp.int32) * _i32(0x85EBCA77)
+        + block.astype(jnp.int32) * _i32(0xC2B2AE3D)
+        + _i32(0x165667B1)
+    )
+    h = h ^ _shr(h, 16)
+    h = h * _i32(0x7FEB352D)
+    h = h ^ _shr(h, 15)
+    return h
+
+
+def _linear_index(shape) -> jnp.ndarray:
+    """int32 linear position of every element (broadcasted_iota — TPU-safe)."""
+    idx = jnp.zeros(shape, jnp.int32)
+    stride = 1
+    for d in range(len(shape) - 1, -1, -1):
+        idx = idx + jax.lax.broadcasted_iota(jnp.int32, shape, d) * jnp.int32(stride)
+        stride *= shape[d]
+    return idx
+
+
+def counter_bits(seed: jnp.ndarray, stream: int, shape) -> jnp.ndarray:
+    """Stateless uniform int32 bits = murmur3-style hash of (seed, position).
+
+    A counter-based PRNG in pure elementwise jnp (int32 arithmetic mod 2^32;
+    logical shifts): identical results whether traced inside a Pallas
+    kernel, under the Pallas TPU interpreter, or in plain XLA — which is
+    what makes the fused engine's schedule stream testable bit-for-bit
+    against a non-Pallas reference (the hardware PRNG
+    `pltpu.prng_random_bits` is a zero stub under the interpreter, and
+    Mosaic's unsigned-vector support is partial).
+    """
+    x = _linear_index(shape) + _i32(0x9E3779B9 * (stream + 1))
+    x = x ^ (seed.astype(jnp.int32) * _i32(0x85EBCA6B))
+    x = x ^ _shr(x, 16)
+    x = x * _i32(0x7FEB352D)
+    x = x ^ _shr(x, 15)
+    x = x * _i32(0x846CA68B)
+    x = x ^ _shr(x, 16)
+    return x
+
+
+def _bern(seed: jnp.ndarray, stream: int, shape, p: float) -> jnp.ndarray:
+    """True w.p. ``p``: biased-int32 compare of counter bits vs threshold."""
+    t = min(int(round(p * float(1 << 32))), (1 << 32) - 1)
+    # Map the unsigned comparison bits_u < t into int32 order by flipping
+    # the sign bit of both sides.
+    bits = counter_bits(seed, stream, shape) ^ _i32(0x80000000)
+    return bits < _i32(t ^ 0x80000000)
+
+
+def _sample_masks_counter(
+    cfg: FaultConfig, seed: jnp.ndarray, n_prop: int, n_acc: int, blk: int
+) -> TickMasks:
+    """A tick's masks from :func:`counter_bits` keyed by a per-tick seed."""
+    slot = (2, n_prop, n_acc, blk)
+    edge = (n_prop, n_acc, blk)
+
+    def hit(stream, shape, p):
+        if p <= 0.0:
+            return None
+        return _bern(seed, stream, shape, p)
+
+    def miss(stream, shape, p):
+        m = hit(stream, shape, p)
+        return None if m is None else ~m
+
+    return TickMasks(
+        sel_score=counter_bits(seed, 0, slot),
+        busy=miss(1, (1, 1, n_acc, blk), cfg.p_idle),
+        deliver=miss(2, slot, cfg.p_hold),
+        dup_req=hit(3, slot, cfg.p_dup),
+        dup_rep=hit(4, slot, cfg.p_dup),
+        keep_prom=miss(5, edge, cfg.p_drop),
+        keep_accd=miss(6, edge, cfg.p_drop),
+        keep_p1=miss(7, edge, cfg.p_drop),
+        keep_p2=miss(8, edge, cfg.p_drop),
+        # Non-negative int32 bits modulo the (small) backoff range.
+        backoff=(
+            (counter_bits(seed, 9, (n_prop, blk)) & jnp.int32(0x7FFFFFFF))
+            % jnp.int32(max(cfg.backoff_max, 1))
+        ),
+    )
+
+
+def _split_tick(state: PaxosState):
+    """Flatten the state with the scalar ``tick`` leaf separated out.
+
+    Returns (treedef, array_leaves, tick, tick_pos) where ``array_leaves``
+    preserves flatten order minus the tick leaf.
+    """
+    leaves, treedef = jax.tree.flatten(state)
+    tick_pos = [i for i, l in enumerate(leaves) if getattr(l, "ndim", None) == 0]
+    assert len(tick_pos) == 1, "expected exactly one scalar leaf (tick)"
+    ti = tick_pos[0]
+    return treedef, leaves[:ti] + leaves[ti + 1 :], leaves[ti], ti
+
+
+def _kernel(cfg, n_ticks, treedef, tick_pos, n_state, plan_def, *refs):
+    seed_ref, tick_ref = refs[0], refs[1]
+    state_refs = refs[2 : 2 + n_state]
+    plan_refs = refs[2 + n_state : 2 + n_state + plan_def.num_leaves]
+    out_refs = refs[2 + n_state + plan_def.num_leaves :]
+
+    seed0 = seed_ref[0, 0]
+    tick0 = tick_ref[0, 0]
+    blk_id = pl.program_id(0)
+
+    plan: FaultPlan = jax.tree.unflatten(plan_def, [r[...] for r in plan_refs])
+    vals = [r[...] for r in state_refs]
+    leaves = vals[:tick_pos] + [tick0] + vals[tick_pos:]
+    state: PaxosState = jax.tree.unflatten(treedef, leaves)
+    n_prop, blk = state.proposer.bal.shape
+    n_acc = state.acceptor.promised.shape[0]
+
+    # Mosaic cannot legalize bool (i1) vectors in the scf.for carry; round
+    # bool leaves through int32 across the loop boundary (free-ish VPU
+    # converts, same (8,128) tiling as the rest of the carry).
+    def pack(st):
+        return jax.tree.map(
+            lambda x: x.astype(jnp.int32) if x.dtype == jnp.bool_ else x, st
+        )
+
+    def unpack(st_i, proto):
+        return jax.tree.map(
+            lambda x, p: x.astype(jnp.bool_) if p.dtype == jnp.bool_ else x,
+            st_i,
+            proto,
+        )
+
+    def body(t, st_i):
+        st = unpack(st_i, state)
+        tick_seed = _mix(seed0, st.tick, blk_id)
+        masks = _sample_masks_counter(cfg, tick_seed, n_prop, n_acc, blk)
+        return pack(apply_tick(st, masks, plan, cfg))
+
+    state = unpack(jax.lax.fori_loop(0, n_ticks, body, pack(state)), state)
+
+    out = treedef.flatten_up_to(state)
+    new_tick = out.pop(tick_pos)
+    for r, v in zip(out_refs[:-1], out):
+        r[...] = v
+    # Scalar tick rides in SMEM; every grid step writes the same value.
+    out_refs[-1][0, 0] = new_tick
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_ticks", "block", "interpret"),
+    donate_argnums=(0,),
+)
+def fused_paxos_chunk(
+    state: PaxosState,
+    seed: jnp.ndarray,
+    plan: FaultPlan,
+    cfg: FaultConfig,
+    n_ticks: int,
+    block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> PaxosState:
+    """Advance ``n_ticks`` ticks fully in VMEM; returns the new state.
+
+    ``seed`` is an int32 scalar (the campaign seed); per-(tick, block)
+    streams are derived on-core.  ``block`` instances are processed per grid
+    step and must divide ``n_inst``.
+    """
+    n_inst = state.n_inst
+    block = min(block, n_inst)
+    if n_inst % block:
+        raise ValueError(f"n_inst={n_inst} not divisible by block={block}")
+    grid = n_inst // block
+
+    treedef, s_leaves, tick, tick_pos = _split_tick(state)
+    p_leaves, plan_def = jax.tree.flatten(plan)
+
+    def vspec(leaf):
+        lead = leaf.shape[:-1]
+        return pl.BlockSpec(
+            (*lead, block),
+            lambda i, nl=len(lead): (0,) * nl + (i,),
+            memory_space=pltpu.VMEM,
+        )
+
+    sspec = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+
+    in_specs = (
+        [sspec, sspec]
+        + [vspec(l) for l in s_leaves]
+        + [vspec(l) for l in p_leaves]
+    )
+    out_specs = [vspec(l) for l in s_leaves] + [sspec]
+    out_shape = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in s_leaves] + [
+        jax.ShapeDtypeStruct((1, 1), jnp.int32)
+    ]
+    # Donate state arrays into their output slots (in-place in HBM).
+    aliases = {2 + k: k for k in range(len(s_leaves))}
+
+    kernel = functools.partial(
+        _kernel, cfg, n_ticks, treedef, tick_pos, len(s_leaves), plan_def
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        # TPU interpret mode (not the generic interpreter): it emulates the
+        # TPU-specific primitives (prng_seed/prng_random_bits) on CPU, which
+        # is what the CPU test rig runs equivalence checks under.
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(
+        jnp.reshape(jnp.asarray(seed, jnp.int32), (1, 1)),
+        jnp.reshape(tick, (1, 1)),
+        *s_leaves,
+        *p_leaves,
+    )
+    new_leaves = list(outs[:-1])
+    new_leaves.insert(tick_pos, outs[-1][0, 0])
+    return jax.tree.unflatten(treedef, new_leaves)
+
+
+def reference_chunk(
+    state: PaxosState,
+    seed: jnp.ndarray,
+    plan: FaultPlan,
+    cfg: FaultConfig,
+    n_ticks: int,
+) -> PaxosState:
+    """Non-Pallas replay of the fused engine's exact schedule (single block).
+
+    Runs the identical `apply_tick` + `counter_bits` stream in plain XLA for
+    a state that fits one block (``blk_id = 0``): the fused kernel must
+    produce bit-identical results — the equivalence oracle for the Pallas
+    lowering itself (tests/test_fused.py).
+    """
+    n_prop = state.proposer.bal.shape[0]
+    n_acc, n_inst = state.acceptor.promised.shape
+    seed = jnp.asarray(seed, jnp.int32)
+
+    def body(t, st):
+        tick_seed = _mix(seed, st.tick, jnp.int32(0))
+        masks = _sample_masks_counter(cfg, tick_seed, n_prop, n_acc, n_inst)
+        return apply_tick(st, masks, plan, cfg)
+
+    return jax.lax.fori_loop(0, n_ticks, body, state)

@@ -80,7 +80,10 @@ def fastpaxos_step(
         delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
         if link is not None:  # partitioned links stall replies in flight
             delivered = delivered & link[None]
-        replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
+        replies = net.consume(
+            state.replies, delivered,
+            stay=net.stay_mask(k_dup_rep, delivered.shape, cfg.p_dup),
+        )
 
     # ---- Acceptor half-tick ----
     with jax.named_scope("acceptor_select"):
@@ -121,7 +124,7 @@ def fastpaxos_step(
         bal=msg_bal[None],
         v1=prom_payload_bal[None],
         v2=prom_payload_val[None],
-        key=k_drop_prom, p_drop=cfg.p_drop,
+        keep=net.keep_mask(k_drop_prom, (n_prop, n_acc, n_inst), cfg.p_drop),
     )
     replies = net.send(
         replies, ACCEPTED,
@@ -129,9 +132,11 @@ def fastpaxos_step(
         bal=msg_bal[None],
         v1=msg_val[None],
         v2=jnp.zeros_like(msg_val)[None],
-        key=k_drop_accd, p_drop=cfg.p_drop,
+        keep=net.keep_mask(k_drop_accd, (n_prop, n_acc, n_inst), cfg.p_drop),
     )
-    requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
+    requests = net.consume(
+        state.requests, sel, stay=net.stay_mask(k_dup_req, sel.shape, cfg.p_dup)
+    )
     acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
 
     # ---- Learner / safety checker (fast-quorum-aware thresholds) ----
@@ -250,7 +255,7 @@ def fastpaxos_step(
         bal=prop.bal[:, None],
         v1=prop_val[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        key=k_drop_p2, p_drop=cfg.p_drop,
+        keep=net.keep_mask(k_drop_p2, (n_prop, n_acc, n_inst), cfg.p_drop),
     )
     requests = net.send(
         requests, PREPARE,
@@ -258,7 +263,7 @@ def fastpaxos_step(
         bal=bal_next[:, None],
         v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        key=k_drop_p1, p_drop=cfg.p_drop,
+        keep=net.keep_mask(k_drop_p1, (n_prop, n_acc, n_inst), cfg.p_drop),
     )
 
     prop = prop.replace(

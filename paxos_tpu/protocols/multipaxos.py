@@ -133,7 +133,9 @@ def multipaxos_step(
         val=jnp.where(accd_send, msg_val[None], accepted.val),
     )
 
-    requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
+    requests = net.consume(
+        state.requests, sel, stay=net.stay_mask(k_dup_req, sel.shape, cfg.p_dup)
+    )
     acc = acc.replace(promised=promised, log_bal=log_bal, log_val=log_val)
 
     # ---- Learner / checker ----
@@ -244,7 +246,7 @@ def multipaxos_step(
         bal=bal_next[:, None],
         v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        key=k_drop_prep, p_drop=cfg.p_drop,
+        keep=net.keep_mask(k_drop_prep, (n_prop, n_acc, n_inst), cfg.p_drop),
     )
     # Leaders re-broadcast the current slot's Accept every tick (idempotent,
     # self-healing under loss).
@@ -260,7 +262,7 @@ def multipaxos_step(
         bal=bal_next[:, None],
         v1=pval[:, None],
         v2=ci[:, None],
-        key=k_drop_acc, p_drop=cfg.p_drop,
+        keep=net.keep_mask(k_drop_acc, (n_prop, n_acc, n_inst), cfg.p_drop),
     )
 
     prop = prop.replace(

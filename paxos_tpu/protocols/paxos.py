@@ -23,6 +23,13 @@ The famous killer interleavings survive vectorization:
 - *dueling proposers*: both proposers' PREPAREs race per tick; retries pick
   fresh ballots with randomized backoff.
 
+Structure: the tick is split into :func:`sample_masks` (all of a tick's
+randomness, drawn with ``jax.random``) and :func:`apply_tick` (the pure
+protocol transition over pre-sampled masks).  The fused Pallas engine
+(``kernels/fused_tick``) re-uses :func:`apply_tick` verbatim, swapping only
+the mask source for the on-core hardware PRNG — one source of truth for the
+protocol semantics.
+
 Layout: every array is instance-minor — acceptors (A, I), proposers (P, I),
 message slots (2, P, A, I) — so the whole tick is full-lane elementwise work
 (see ``core.messages``).
@@ -30,8 +37,11 @@ message slots (2, P, A, I) — so the whole tick is full-lane elementwise work
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
+from flax import struct
 
 from paxos_tpu.check.safety import acceptor_invariants, learner_observe
 from paxos_tpu.core import ballot as bal_mod
@@ -42,10 +52,65 @@ from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
 
 
-def paxos_step(
-    state: PaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+@struct.dataclass
+class TickMasks:
+    """One tick's worth of pre-sampled randomness (instance-minor shapes).
+
+    ``None`` members mean "fault disabled" — the corresponding branch is
+    skipped entirely at trace time (all mask presence is decided by the
+    static :class:`FaultConfig`).
+    """
+
+    sel_score: jnp.ndarray  # (2, P, A, I) uint32 — request-selection entropy
+    busy: Optional[jnp.ndarray]  # (1, 1, A, I) bool — False = acceptor idles
+    deliver: Optional[jnp.ndarray]  # (2, P, A, I) bool — reply not held
+    dup_req: Optional[jnp.ndarray]  # (2, P, A, I) bool — request redelivered
+    dup_rep: Optional[jnp.ndarray]  # (2, P, A, I) bool — reply redelivered
+    keep_prom: Optional[jnp.ndarray]  # (P, A, I) bool — PROMISE not dropped
+    keep_accd: Optional[jnp.ndarray]  # (P, A, I) bool — ACCEPTED not dropped
+    keep_p1: Optional[jnp.ndarray]  # (P, A, I) bool — PREPARE not dropped
+    keep_p2: Optional[jnp.ndarray]  # (P, A, I) bool — ACCEPT not dropped
+    backoff: jnp.ndarray  # (P, I) int32 — retry backoff draw
+
+
+def sample_masks(
+    key: jax.Array, cfg: FaultConfig, n_prop: int, n_acc: int, n_inst: int
+) -> TickMasks:
+    """Draw a tick's masks with ``jax.random`` (the XLA engine's source)."""
+    (k_sel, k_idle, k_dup_req, k_hold, k_dup_rep, k_drop_prom, k_drop_accd,
+     k_drop_p1, k_drop_p2, k_backoff) = jax.random.split(key, 10)
+    slot = (2, n_prop, n_acc, n_inst)
+    edge = (n_prop, n_acc, n_inst)
+
+    def hit(k, shape, p):  # True with probability p, or None when disabled
+        if p <= 0.0:
+            return None
+        return jax.random.bits(k, shape, jnp.uint32) < net.bern_threshold(p)
+
+    def miss(k, shape, p):  # True with probability 1-p, or None when disabled
+        m = hit(k, shape, p)
+        return None if m is None else ~m
+
+    return TickMasks(
+        sel_score=jax.random.bits(k_sel, slot, jnp.uint32),
+        busy=miss(k_idle, (1, 1, n_acc, n_inst), cfg.p_idle),
+        deliver=miss(k_hold, slot, cfg.p_hold),
+        dup_req=hit(k_dup_req, slot, cfg.p_dup),
+        dup_rep=hit(k_dup_rep, slot, cfg.p_dup),
+        keep_prom=miss(k_drop_prom, edge, cfg.p_drop),
+        keep_accd=miss(k_drop_accd, edge, cfg.p_drop),
+        keep_p1=miss(k_drop_p1, edge, cfg.p_drop),
+        keep_p2=miss(k_drop_p2, edge, cfg.p_drop),
+        backoff=jax.random.randint(
+            k_backoff, (n_prop, n_inst), 0, max(cfg.backoff_max, 1), jnp.int32
+        ),
+    )
+
+
+def apply_tick(
+    state: PaxosState, masks: TickMasks, plan: FaultPlan, cfg: FaultConfig
 ) -> PaxosState:
-    """Advance every instance by one scheduler tick."""
+    """The pure protocol transition for one tick over pre-sampled masks."""
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     quorum = majority(n_acc)
@@ -54,11 +119,6 @@ def paxos_step(
     # checker must catch (see tests/test_flexpaxos.py).
     q1 = cfg.q1 or quorum
     q2 = cfg.q2 or quorum
-
-    # Keys depend only on (seed, tick): checkpoint/resume replays bit-exactly.
-    key = jax.random.fold_in(base_key, state.tick)
-    (k_sel, k_dup_req, k_hold, k_dup_rep, k_drop_prom, k_drop_accd,
-     k_drop_p1, k_drop_p2, k_backoff) = jax.random.split(key, 9)
 
     acc = state.acceptor
     alive = plan.alive(state.tick)  # (A, I)
@@ -79,18 +139,18 @@ def paxos_step(
     # fault-free network.  Proposers read payloads from the pre-tick buffer.
     link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
 
-    with jax.named_scope("deliver"):
-        delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
-        if link is not None:  # partitioned links stall replies in flight
-            delivered = delivered & link[None]
-        replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
+    delivered = state.replies.present
+    if masks.deliver is not None:
+        delivered = delivered & masks.deliver
+    if link is not None:  # partitioned links stall replies in flight
+        delivered = delivered & link[None]
+    replies = net.consume(state.replies, delivered, stay=masks.dup_rep)
 
     # ---- Acceptor half-tick: select one request per (instance, acceptor) ----
-    with jax.named_scope("acceptor_select"):
-        sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-        sel = sel & alive[None, None]  # crashed acceptors process nothing
-        if link is not None:  # partitioned links stall requests in flight
-            sel = sel & link[None]
+    sel = net.select_from_scores(state.requests.present, masks.sel_score, masks.busy)
+    sel = sel & alive[None, None]  # crashed acceptors process nothing
+    if link is not None:  # partitioned links stall requests in flight
+        sel = sel & link[None]
 
     # Gather the selected message's fields onto (A, I).
     def gather(x):
@@ -123,7 +183,7 @@ def paxos_step(
         bal=msg_bal[None],
         v1=prom_payload_bal[None],
         v2=prom_payload_val[None],
-        key=k_drop_prom, p_drop=cfg.p_drop,
+        keep=masks.keep_prom,
     )
     replies = net.send(
         replies, ACCEPTED,
@@ -131,18 +191,17 @@ def paxos_step(
         bal=msg_bal[None],
         v1=msg_val[None],
         v2=jnp.zeros_like(msg_val)[None],
-        key=k_drop_accd, p_drop=cfg.p_drop,
+        keep=masks.keep_accd,
     )
-    requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
+    requests = net.consume(state.requests, sel, stay=masks.dup_req)
     acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
 
     # ---- Learner / safety checker (omniscient: sees accept events directly) ----
-    with jax.named_scope("learner_check"):
-        learner = learner_observe(
-            state.learner, ok_acc, msg_bal, msg_val, state.tick, q2
-        )
-        inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
-        learner = learner.replace(violations=learner.violations + inv_viol)
+    learner = learner_observe(
+        state.learner, ok_acc, msg_bal, msg_val, state.tick, q2
+    )
+    inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
+    learner = learner.replace(violations=learner.violations + inv_viol)
 
     # ---- Proposer half-tick: fold all delivered replies ----
     prop = state.proposer
@@ -190,9 +249,6 @@ def paxos_step(
     expired = (
         (prop.phase != DONE) & ~p1_done & ~p2_done & (timer > cfg.timeout)
     )
-    backoff = jax.random.randint(
-        k_backoff, timer.shape, 0, max(cfg.backoff_max, 1), jnp.int32
-    )
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
     )
@@ -208,7 +264,7 @@ def paxos_step(
     best_bal = jnp.where(expired, 0, best_bal)
     best_val = jnp.where(expired, 0, best_val)
     timer = jnp.where(p1_done, 0, timer)
-    timer = jnp.where(expired, -backoff, timer)
+    timer = jnp.where(expired, -masks.backoff, timer)
 
     # Emit: ACCEPT broadcast on phase-1 completion, PREPARE broadcast on retry.
     requests = net.send(
@@ -217,7 +273,7 @@ def paxos_step(
         bal=prop.bal[:, None],
         v1=prop_val[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        key=k_drop_p2, p_drop=cfg.p_drop,
+        keep=masks.keep_p2,
     )
     requests = net.send(
         requests, PREPARE,
@@ -225,7 +281,7 @@ def paxos_step(
         bal=bal_next[:, None],
         v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        key=k_drop_p1, p_drop=cfg.p_drop,
+        keep=masks.keep_p1,
     )
 
     prop = prop.replace(
@@ -247,3 +303,15 @@ def paxos_step(
         replies=replies,
         tick=state.tick + 1,
     )
+
+
+def paxos_step(
+    state: PaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+) -> PaxosState:
+    """Advance every instance by one scheduler tick (XLA engine)."""
+    n_acc, n_inst = state.acceptor.promised.shape
+    n_prop = state.proposer.bal.shape[0]
+    # Keys depend only on (seed, tick): checkpoint/resume replays bit-exactly.
+    key = jax.random.fold_in(base_key, state.tick)
+    masks = sample_masks(key, cfg, n_prop, n_acc, n_inst)
+    return apply_tick(state, masks, plan, cfg)

@@ -85,7 +85,10 @@ def raftcore_step(
         delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
         if link is not None:  # partitioned links stall replies in flight
             delivered = delivered & link[None]
-        replies = net.consume(state.replies, delivered, k_dup_rep, cfg.p_dup)
+        replies = net.consume(
+            state.replies, delivered,
+            stay=net.stay_mask(k_dup_rep, delivered.shape, cfg.p_dup),
+        )
 
     # ---- Voter half-tick: select one request per (instance, voter) ----
     with jax.named_scope("acceptor_select"):
@@ -125,7 +128,7 @@ def raftcore_step(
         bal=msg_bal[None],
         v1=(vote_payload_t * 2 + grant.astype(jnp.int32))[None],
         v2=vote_payload_v[None],
-        key=k_drop_vote, p_drop=cfg.p_drop,
+        keep=net.keep_mask(k_drop_vote, (n_prop, n_acc, n_inst), cfg.p_drop),
     )
     replies = net.send(
         replies, ACK,
@@ -133,9 +136,11 @@ def raftcore_step(
         bal=msg_bal[None],
         v1=msg_v1[None],
         v2=jnp.zeros_like(msg_v1)[None],
-        key=k_drop_ack, p_drop=cfg.p_drop,
+        keep=net.keep_mask(k_drop_ack, (n_prop, n_acc, n_inst), cfg.p_drop),
     )
-    requests = net.consume(state.requests, sel, k_dup_req, cfg.p_dup)
+    requests = net.consume(
+        state.requests, sel, stay=net.stay_mask(k_dup_req, sel.shape, cfg.p_dup)
+    )
     voter = voter.replace(voted=voted, ent_term=ent_term, ent_val=ent_val)
 
     # ---- Learner / safety checker (append-accept events, majority commit) ----
@@ -222,7 +227,7 @@ def raftcore_step(
         bal=bal_next[:, None],
         v1=prop_val[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        key=k_drop_ap, p_drop=cfg.p_drop,
+        keep=net.keep_mask(k_drop_ap, (n_prop, n_acc, n_inst), cfg.p_drop),
     )
     requests = net.send(
         requests, REQVOTE,
@@ -230,7 +235,7 @@ def raftcore_step(
         bal=bal_next[:, None],
         v1=ent_term_c[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        key=k_drop_rv, p_drop=cfg.p_drop,
+        keep=net.keep_mask(k_drop_rv, (n_prop, n_acc, n_inst), cfg.p_drop),
     )
 
     cand = cand.replace(

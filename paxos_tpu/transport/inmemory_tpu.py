@@ -23,9 +23,17 @@ and a tick's delivery decisions are PRNG masks:
 
 Send-time drop and duplication masks complete the fault model (SURVEY.md
 §6.8).  Everything is fixed-shape; no host round-trips.
+
+The randomness is split from the mechanics: the pure functions
+(:func:`select_from_scores`, :func:`send`, :func:`consume`) consume
+pre-sampled masks, so the same transport drives both the XLA path
+(masks from ``jax.random``) and the fused Pallas path (masks from the
+on-core hardware PRNG, ``kernels/fused_tick``).
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,14 +46,34 @@ from paxos_tpu.core.messages import MsgBuf
 _TWO32 = float(1 << 32)
 
 
+def bern_threshold(p: float) -> jnp.ndarray:
+    """uint32 threshold t with P(bits < t) = ``p`` for uniform uint32 bits."""
+    return jnp.uint32(min(int(round(p * _TWO32)), (1 << 32) - 1))
+
+
 def _bernoulli_bits(key: jax.Array, shape, p: float) -> jnp.ndarray:
     """bool mask, True with probability ``p`` (uint32-threshold sampling)."""
-    thresh = jnp.uint32(min(int(round(p * _TWO32)), (1 << 32) - 1))
-    return jax.random.bits(key, shape, jnp.uint32) < thresh
+    return jax.random.bits(key, shape, jnp.uint32) < bern_threshold(p)
 
 
-def select_one(present: jnp.ndarray, key: jax.Array, p_idle: float) -> jnp.ndarray:
-    """Pick at most one present request per (instance, acceptor).
+def keep_mask(key: jax.Array, shape, p_drop: float) -> Optional[jnp.ndarray]:
+    """Send-time survival mask: None when lossless, else True = delivered."""
+    if p_drop <= 0.0:
+        return None
+    return ~_bernoulli_bits(key, shape, p_drop)
+
+
+def stay_mask(key: jax.Array, shape, p_dup: float) -> Optional[jnp.ndarray]:
+    """Duplicate mask: None when off, else True = processed slot stays."""
+    if p_dup <= 0.0:
+        return None
+    return _bernoulli_bits(key, shape, p_dup)
+
+
+def select_from_scores(
+    present: jnp.ndarray, score_bits: jnp.ndarray, busy: Optional[jnp.ndarray]
+) -> jnp.ndarray:
+    """Pick at most one present request per (instance, acceptor) — pure part.
 
     Selection is a max over per-slot random uint32 scores whose low bits are
     replaced by the slot's (kind, proposer) index: scores within a (A, I)
@@ -57,28 +85,44 @@ def select_one(present: jnp.ndarray, key: jax.Array, p_idle: float) -> jnp.ndarr
 
     Args:
       present: (2, P, A, I) bool — occupied request slots.
-      key: PRNG key for this tick.
-      p_idle: probability an acceptor processes nothing despite pending mail.
+      score_bits: (2, P, A, I) uint32 — this tick's raw selection entropy.
+      busy: optional (1, 1, A, I) bool — False = acceptor idles this tick.
 
     Returns:
       (2, P, A, I) bool one-hot (per (A, I) fiber) selection mask.
     """
     k, p, a, i = present.shape
-    k_sel, k_idle = jax.random.split(key)
     nbits = max((k * p - 1).bit_length(), 1)  # low bits reserved for slot id
     sid = (
-        jax.lax.broadcasted_iota(jnp.uint32, present.shape, 0) * p
-        + jax.lax.broadcasted_iota(jnp.uint32, present.shape, 1)
+        jax.lax.broadcasted_iota(jnp.int32, present.shape, 0) * p
+        + jax.lax.broadcasted_iota(jnp.int32, present.shape, 1)
     )
-    rnd = jax.random.bits(k_sel, present.shape, jnp.uint32)
-    score = (rnd & jnp.uint32(~((1 << nbits) - 1) & 0xFFFFFFFF)) | sid
-    score = jnp.where(present, score, jnp.uint32(0))
+    # All-int32 scoring (Mosaic has neither unsigned reductions nor clean
+    # unsigned register casts): random int32 bits give a uniform total order
+    # directly, the slot id in the low bits makes scores distinct per fiber,
+    # and INT32_MIN is the exact "absent" sentinel.  A present slot whose
+    # masked bits happen to equal the sentinel pattern simply idles one tick
+    # (prob ~2^-27 per fiber — vanishing).
+    bits_i = score_bits.astype(jnp.int32)  # wraps: bit-preserving
+    score = (bits_i & jnp.int32(~((1 << nbits) - 1))) | sid
+    neg_inf = jnp.iinfo(jnp.int32).min
+    score = jnp.where(present, score, neg_inf)
     fiber_max = score.max(axis=(0, 1), keepdims=True)  # (1, 1, A, I)
-    sel = present & (score == fiber_max) & (fiber_max > 0)
-    if p_idle > 0.0:
-        busy = ~_bernoulli_bits(k_idle, (1, 1, a, i), p_idle)
+    sel = present & (score == fiber_max) & (fiber_max > neg_inf)
+    if busy is not None:
         sel = sel & busy
     return sel
+
+
+def select_one(present: jnp.ndarray, key: jax.Array, p_idle: float) -> jnp.ndarray:
+    """Sample selection entropy with ``jax.random`` and select (XLA path)."""
+    k, p, a, i = present.shape
+    k_sel, k_idle = jax.random.split(key)
+    scores = jax.random.bits(k_sel, present.shape, jnp.uint32)
+    busy = None
+    if p_idle > 0.0:
+        busy = ~_bernoulli_bits(k_idle, (1, 1, a, i), p_idle)
+    return select_from_scores(present, scores, busy)
 
 
 def hold_mask(present: jnp.ndarray, key: jax.Array, p_hold: float) -> jnp.ndarray:
@@ -95,8 +139,7 @@ def send(
     bal: jnp.ndarray,
     v1: jnp.ndarray,
     v2: jnp.ndarray,
-    key: jax.Array,
-    p_drop: float,
+    keep: Optional[jnp.ndarray] = None,
 ) -> MsgBuf:
     """Write messages of ``kind`` into their slots (overwriting), minus drops.
 
@@ -105,28 +148,47 @@ def send(
       kind: request/reply kind index (0 or 1).
       send_mask: (P, A, I) bool — which edges send this tick.
       bal, v1, v2: (P, A, I) int32 payloads (broadcastable).
-      key: PRNG key; p_drop: send-time loss probability.
+      keep: optional (P, A, I) bool — send-time survival (False = dropped).
     """
-    if p_drop > 0.0:
-        send_mask = send_mask & ~_bernoulli_bits(key, send_mask.shape, p_drop)
+    if keep is not None:
+        send_mask = send_mask & keep
+
+    kind_hot = (
+        jax.lax.broadcasted_iota(jnp.int32, buf.bal.shape, 0) == kind
+    )  # (2, P, A, I)
+
+    def set_kind(arr, new_slice):
+        # Static-index update along the size-2 kind axis as a full-shape
+        # where over an iota mask — NOT `.at[kind].set` (lowers to scatter)
+        # and NOT stack/concat (invalid register casts): Mosaic, the Pallas
+        # TPU compiler, only lowers the elementwise form cleanly.
+        return jnp.where(
+            kind_hot, jnp.broadcast_to(new_slice[None], arr.shape), arr
+        )
+
     zero = jnp.zeros_like(buf.bal[kind])
+    # `present` is monotone (old | sent), so its kind-axis update is pure
+    # boolean algebra — Mosaic rejects select_n on bool vectors, which rules
+    # out jnp.where/set_kind for the bool leaf.
+    sent_full = kind_hot & jnp.broadcast_to(send_mask[None], buf.present.shape)
     return buf.replace(
-        bal=buf.bal.at[kind].set(jnp.where(send_mask, bal + zero, buf.bal[kind])),
-        v1=buf.v1.at[kind].set(jnp.where(send_mask, v1 + zero, buf.v1[kind])),
-        v2=buf.v2.at[kind].set(jnp.where(send_mask, v2 + zero, buf.v2[kind])),
-        present=buf.present.at[kind].set(buf.present[kind] | send_mask),
+        bal=set_kind(buf.bal, jnp.where(send_mask, bal + zero, buf.bal[kind])),
+        v1=set_kind(buf.v1, jnp.where(send_mask, v1 + zero, buf.v1[kind])),
+        v2=set_kind(buf.v2, jnp.where(send_mask, v2 + zero, buf.v2[kind])),
+        present=buf.present | sent_full,
     )
 
 
 def consume(
-    buf: MsgBuf, taken: jnp.ndarray, key: jax.Array, p_dup: float
+    buf: MsgBuf, taken: jnp.ndarray, stay: Optional[jnp.ndarray] = None
 ) -> MsgBuf:
     """Clear slots that were processed this tick, except duplicated ones.
 
     Args:
       taken: (2, P, A, I) bool — slots whose message was processed.
-      p_dup: probability a processed slot stays in flight (duplicate delivery).
+      stay: optional (2, P, A, I) bool — True = processed slot remains in
+        flight anyway (duplicate delivery).
     """
-    if p_dup > 0.0:
-        taken = taken & ~_bernoulli_bits(key, taken.shape, p_dup)
+    if stay is not None:
+        taken = taken & ~stay
     return buf.replace(present=buf.present & ~taken)

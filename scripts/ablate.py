@@ -57,11 +57,12 @@ def main():
     timed("no-sends", px.paxos_step, cfg)
     px.net.send = real_send
 
-    # no acceptor select (nothing processed)
-    real_sel = px.net.select_one
-    px.net.select_one = lambda present, key, p: jnp.zeros_like(present)
+    # no acceptor select (nothing processed); apply_tick selects via
+    # select_from_scores (the pure half of the old select_one)
+    real_sel = px.net.select_from_scores
+    px.net.select_from_scores = lambda present, bits, busy: jnp.zeros_like(present)
     timed("no-select", px.paxos_step, cfg)
-    px.net.select_one = real_sel
+    px.net.select_from_scores = real_sel
 
     # no consume (buffers never cleared)
     real_consume = px.net.consume
