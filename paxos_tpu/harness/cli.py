@@ -51,7 +51,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--engine",
         choices=["xla", "fused"],
         default="xla",
-        help="fused = whole-chunk Pallas kernel (paxos protocol, TPU)",
+        help="fused = whole-chunk Pallas kernel (TPU, single-chip)",
     )
     r.add_argument("--n-inst", type=int, default=None, help="override instance count")
     r.add_argument("--seed", type=int, default=0)
@@ -130,13 +130,9 @@ def cmd_run(args: argparse.Namespace) -> int:
         log.emit("mesh", devices=len(mesh.devices))
 
     if args.engine == "fused":
-        if cfg.protocol != "paxos":
-            print("error: --engine fused supports the paxos protocol only",
-                  file=sys.stderr)
-            return 1
-        if jax.devices()[0].platform == "cpu":
+        if jax.devices()[0].platform != "tpu":
             print("error: --engine fused needs a TPU (Mosaic does not target "
-                  "host CPUs); drop --platform cpu or use --engine xla",
+                  "other backends); use --engine xla",
                   file=sys.stderr)
             return 1
         if args.shard:
@@ -145,10 +141,12 @@ def cmd_run(args: argparse.Namespace) -> int:
             return 1
         import jax.numpy as jnp
 
-        from paxos_tpu.kernels.fused_tick import fused_paxos_chunk
+        from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+
+        fused = FUSED_CHUNKS[cfg.protocol]
 
         def advance(s, n):
-            return fused_paxos_chunk(s, jnp.int32(cfg.seed), plan, cfg.fault, n)
+            return fused(s, jnp.int32(cfg.seed), plan, cfg.fault, n)
 
     else:
         step_fn = get_step_fn(cfg.protocol)

@@ -150,16 +150,16 @@ def run(
 
     ``engine`` selects the execution path: ``"xla"`` scans the step function
     (any protocol, any platform); ``"fused"`` runs the whole chunk inside
-    one Pallas kernel with state resident in VMEM (single-decree paxos on
-    TPU; ~3-4x faster — see ``kernels/fused_tick``).
+    one Pallas kernel with state resident in VMEM (any protocol, TPU;
+    ~3-4x faster — see ``kernels/fused_tick``).
     """
     if engine == "fused":
-        if cfg.protocol != "paxos":
-            raise ValueError("engine='fused' supports protocol='paxos' only")
-        from paxos_tpu.kernels.fused_tick import fused_paxos_chunk
+        from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+
+        fused = FUSED_CHUNKS[cfg.protocol]
 
         def advance(state, n):
-            return fused_paxos_chunk(state, jnp.int32(cfg.seed), plan, cfg.fault, n)
+            return fused(state, jnp.int32(cfg.seed), plan, cfg.fault, n)
 
     elif engine == "xla":
         step_fn = get_step_fn(cfg.protocol)

@@ -1,0 +1,91 @@
+"""Counter-based stateless PRNG shared by the fused engines.
+
+A murmur3-finalizer hash of (seed, element position) in pure elementwise
+int32 jnp: identical bits whether traced inside a Pallas kernel, under the
+Pallas TPU interpreter, or in plain XLA.  That one property is what makes
+the fused engines testable — a non-Pallas replay of the same stream is a
+bit-exact oracle for the Mosaic lowering (``fused_tick.reference_chunk``).
+
+All arithmetic is int32: wrapping int32 mul/add is arithmetic mod 2^32
+(same bits as uint32), logical shifts go through
+``lax.shift_right_logical``, and unsigned comparisons become biased-int32
+comparisons — Mosaic handles signed vectors natively where unsigned ones
+hit unimplemented lowering paths (no unsigned reductions, invalid register
+casts).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def i32(c: int) -> jnp.ndarray:
+    """int32 constant with the bit pattern of the (possibly >2^31) literal."""
+    c &= 0xFFFFFFFF
+    return jnp.int32(c - (1 << 32) if c >= (1 << 31) else c)
+
+
+def shr(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Logical (not arithmetic) right shift on int32."""
+    return jax.lax.shift_right_logical(x, jnp.int32(k))
+
+
+def mix(seed: jnp.ndarray, tick: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """splitmix32-style scalar hash -> per-(seed, tick, block) stream seed."""
+    h = (
+        seed.astype(jnp.int32) * i32(0x9E3779B1)
+        + tick.astype(jnp.int32) * i32(0x85EBCA77)
+        + block.astype(jnp.int32) * i32(0xC2B2AE3D)
+        + i32(0x165667B1)
+    )
+    h = h ^ shr(h, 16)
+    h = h * i32(0x7FEB352D)
+    h = h ^ shr(h, 15)
+    return h
+
+
+def _linear_index(shape) -> jnp.ndarray:
+    """int32 linear position of every element (broadcasted_iota — TPU-safe)."""
+    idx = jnp.zeros(shape, jnp.int32)
+    stride = 1
+    for d in range(len(shape) - 1, -1, -1):
+        idx = idx + jax.lax.broadcasted_iota(jnp.int32, shape, d) * jnp.int32(stride)
+        stride *= shape[d]
+    return idx
+
+
+def counter_bits(seed: jnp.ndarray, stream: int, shape) -> jnp.ndarray:
+    """Stateless uniform int32 bits = hash of (seed, stream, position)."""
+    x = _linear_index(shape) + i32(0x9E3779B9 * (stream + 1))
+    x = x ^ (seed.astype(jnp.int32) * i32(0x85EBCA6B))
+    x = x ^ shr(x, 16)
+    x = x * i32(0x7FEB352D)
+    x = x ^ shr(x, 15)
+    x = x * i32(0x846CA68B)
+    x = x ^ shr(x, 16)
+    return x
+
+
+def bern(seed: jnp.ndarray, stream: int, shape, p: float):
+    """bool, True w.p. ``p``; None when ``p <= 0`` (branch pruned at trace)."""
+    if p <= 0.0:
+        return None
+    t = min(int(round(p * float(1 << 32))), (1 << 32) - 1)
+    # Map the unsigned comparison bits_u < t into int32 order by flipping
+    # the sign bit of both sides.
+    bits = counter_bits(seed, stream, shape) ^ i32(0x80000000)
+    return bits < i32(t ^ 0x80000000)
+
+
+def bern_not(seed: jnp.ndarray, stream: int, shape, p: float):
+    """bool, True w.p. ``1-p``; None when ``p <= 0``."""
+    m = bern(seed, stream, shape, p)
+    return None if m is None else ~m
+
+
+def randint(seed: jnp.ndarray, stream: int, shape, n: int) -> jnp.ndarray:
+    """int32 in [0, n) — non-negative bits modulo the (small) range."""
+    return (counter_bits(seed, stream, shape) & jnp.int32(0x7FFFFFFF)) % jnp.int32(
+        max(n, 1)
+    )

@@ -1,153 +1,63 @@
-"""Fused multi-tick Pallas engine for single-decree Paxos.
+"""Fused multi-tick Pallas engine — whole chunks resident in VMEM.
 
-The XLA engine (`harness.run.run_chunk`) scans `apply_tick` over ticks with
-the full state pytree as the scan carry: every tick reads and writes the
-whole state in HBM (~1.6 GB/tick at 1M instances), which bounds throughput
-at HBM bandwidth / tick.
+The XLA engine (`harness.run.run_chunk`) scans a protocol's tick function
+with the full state pytree as the scan carry: every tick reads and writes
+the whole state in HBM (~1.6 GB/tick at 1M single-decree instances), which
+bounds throughput at HBM bandwidth / tick.
 
 This module removes that bound: one `pallas_call` keeps a block of
 instances' ENTIRE state resident in VMEM and advances it `n_ticks` ticks
 before writing back — HBM traffic drops from `2 * state * n_ticks` to
-`2 * state` per chunk, and the per-tick fault masks come from the on-core
-hardware PRNG (`pltpu.prng_random_bits`) instead of materialized
-`jax.random` draws.
+`2 * state` per chunk — with per-tick fault masks drawn on-core from the
+counter PRNG (`kernels/counter_prng`).
 
-Protocol semantics are NOT reimplemented: the kernel traces the very same
-:func:`paxos_tpu.protocols.paxos.apply_tick` the XLA engine scans — only
-the mask source differs, so the two engines explore the same adversarial
-schedule space with different (but equally deterministic) random streams.
-Determinism: the PRNG is reseeded per (seed, tick, block) via a splitmix
+The machinery is generic over protocols: :func:`fused_chunk` takes the
+protocol's pure transition (``apply_fn(state, masks, plan, cfg)``) and its
+counter-mask sampler (``mask_fn(cfg, tick_seed, state)``) as static
+arguments, and per-protocol wrappers bind them.  Protocol semantics are NOT
+reimplemented — each kernel traces the very same ``apply_*`` function the
+XLA engine scans; only the mask source differs, so the two engines explore
+the same adversarial schedule space with different (but equally
+deterministic) random streams.
+
+Determinism: the stream is reseeded per (seed, tick, block) via a splitmix
 hash, so a chunk replays bit-identically regardless of chunk size, and
 checkpoint/resume stays exact as long as the block size is kept.
+:func:`reference_chunk` replays the identical stream in plain XLA — the
+bit-exact oracle for the Mosaic lowering itself (tests/test_fused.py).
 
 Reference parity (SURVEY.md §8.2.5, §8.4.4): this is the "Pallas fallback
-for deliver+vote if XLA doesn't reach the throughput target" milestone —
-generalized to the whole tick, which profiling showed is the right fusion
-boundary (the scan carry's HBM round-trip, not any single op, is the cost).
+if XLA doesn't reach the throughput target" milestone — generalized to the
+whole tick, which profiling showed is the right fusion boundary (the scan
+carry's HBM round-trip, not any single op, is the cost).
+
+Mosaic notes (kept OUT of this file, in the shared protocol/transport code,
+so both engines trace identical programs): no scatter (`.at[i].set` on a
+static index becomes an iota-masked where), no bool `select_n` (monotone
+bool updates use pure OR algebra), no unsigned reductions (selection scores
+are int32 with an INT32_MIN absent sentinel), no cumsum/stack in
+`first_true` (min-of-masked-iota instead), and no bool (i1) vectors in the
+`scf.for` carry (this file round-trips bool leaves through int32 across the
+tick loop).
 """
 
 from __future__ import annotations
 
 import functools
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from paxos_tpu.core.state import PaxosState
 from paxos_tpu.faults.injector import FaultConfig, FaultPlan
-from paxos_tpu.protocols.paxos import TickMasks, apply_tick
-
+from paxos_tpu.kernels.counter_prng import mix
 
 DEFAULT_BLOCK = 1024
 
 
-def _i32(c: int) -> jnp.ndarray:
-    """int32 constant with the bit pattern of the (possibly >2^31) literal."""
-    c &= 0xFFFFFFFF
-    return jnp.int32(c - (1 << 32) if c >= (1 << 31) else c)
-
-
-def _shr(x: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Logical (not arithmetic) right shift on int32."""
-    return jax.lax.shift_right_logical(x, jnp.int32(k))
-
-
-def _mix(seed: jnp.ndarray, tick: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
-    """splitmix32-style scalar hash -> per-(seed, tick, block) PRNG seed.
-
-    All-int32: wrapping int32 mul/add is arithmetic mod 2^32 (same bits as
-    uint32), and Mosaic handles signed vectors/scalars natively where
-    unsigned ones hit unimplemented paths.
-    """
-    h = (
-        seed.astype(jnp.int32) * _i32(0x9E3779B1)
-        + tick.astype(jnp.int32) * _i32(0x85EBCA77)
-        + block.astype(jnp.int32) * _i32(0xC2B2AE3D)
-        + _i32(0x165667B1)
-    )
-    h = h ^ _shr(h, 16)
-    h = h * _i32(0x7FEB352D)
-    h = h ^ _shr(h, 15)
-    return h
-
-
-def _linear_index(shape) -> jnp.ndarray:
-    """int32 linear position of every element (broadcasted_iota — TPU-safe)."""
-    idx = jnp.zeros(shape, jnp.int32)
-    stride = 1
-    for d in range(len(shape) - 1, -1, -1):
-        idx = idx + jax.lax.broadcasted_iota(jnp.int32, shape, d) * jnp.int32(stride)
-        stride *= shape[d]
-    return idx
-
-
-def counter_bits(seed: jnp.ndarray, stream: int, shape) -> jnp.ndarray:
-    """Stateless uniform int32 bits = murmur3-style hash of (seed, position).
-
-    A counter-based PRNG in pure elementwise jnp (int32 arithmetic mod 2^32;
-    logical shifts): identical results whether traced inside a Pallas
-    kernel, under the Pallas TPU interpreter, or in plain XLA — which is
-    what makes the fused engine's schedule stream testable bit-for-bit
-    against a non-Pallas reference (the hardware PRNG
-    `pltpu.prng_random_bits` is a zero stub under the interpreter, and
-    Mosaic's unsigned-vector support is partial).
-    """
-    x = _linear_index(shape) + _i32(0x9E3779B9 * (stream + 1))
-    x = x ^ (seed.astype(jnp.int32) * _i32(0x85EBCA6B))
-    x = x ^ _shr(x, 16)
-    x = x * _i32(0x7FEB352D)
-    x = x ^ _shr(x, 15)
-    x = x * _i32(0x846CA68B)
-    x = x ^ _shr(x, 16)
-    return x
-
-
-def _bern(seed: jnp.ndarray, stream: int, shape, p: float) -> jnp.ndarray:
-    """True w.p. ``p``: biased-int32 compare of counter bits vs threshold."""
-    t = min(int(round(p * float(1 << 32))), (1 << 32) - 1)
-    # Map the unsigned comparison bits_u < t into int32 order by flipping
-    # the sign bit of both sides.
-    bits = counter_bits(seed, stream, shape) ^ _i32(0x80000000)
-    return bits < _i32(t ^ 0x80000000)
-
-
-def _sample_masks_counter(
-    cfg: FaultConfig, seed: jnp.ndarray, n_prop: int, n_acc: int, blk: int
-) -> TickMasks:
-    """A tick's masks from :func:`counter_bits` keyed by a per-tick seed."""
-    slot = (2, n_prop, n_acc, blk)
-    edge = (n_prop, n_acc, blk)
-
-    def hit(stream, shape, p):
-        if p <= 0.0:
-            return None
-        return _bern(seed, stream, shape, p)
-
-    def miss(stream, shape, p):
-        m = hit(stream, shape, p)
-        return None if m is None else ~m
-
-    return TickMasks(
-        sel_score=counter_bits(seed, 0, slot),
-        busy=miss(1, (1, 1, n_acc, blk), cfg.p_idle),
-        deliver=miss(2, slot, cfg.p_hold),
-        dup_req=hit(3, slot, cfg.p_dup),
-        dup_rep=hit(4, slot, cfg.p_dup),
-        keep_prom=miss(5, edge, cfg.p_drop),
-        keep_accd=miss(6, edge, cfg.p_drop),
-        keep_p1=miss(7, edge, cfg.p_drop),
-        keep_p2=miss(8, edge, cfg.p_drop),
-        # Non-negative int32 bits modulo the (small) backoff range.
-        backoff=(
-            (counter_bits(seed, 9, (n_prop, blk)) & jnp.int32(0x7FFFFFFF))
-            % jnp.int32(max(cfg.backoff_max, 1))
-        ),
-    )
-
-
-def _split_tick(state: PaxosState):
+def _split_tick(state: Any):
     """Flatten the state with the scalar ``tick`` leaf separated out.
 
     Returns (treedef, array_leaves, tick, tick_pos) where ``array_leaves``
@@ -160,7 +70,10 @@ def _split_tick(state: PaxosState):
     return treedef, leaves[:ti] + leaves[ti + 1 :], leaves[ti], ti
 
 
-def _kernel(cfg, n_ticks, treedef, tick_pos, n_state, plan_def, *refs):
+def _kernel(
+    cfg, n_ticks, apply_fn, mask_fn, treedef, tick_pos, n_state, plan_def,
+    s_1d, p_1d, *refs,
+):
     seed_ref, tick_ref = refs[0], refs[1]
     state_refs = refs[2 : 2 + n_state]
     plan_refs = refs[2 + n_state : 2 + n_state + plan_def.num_leaves]
@@ -170,12 +83,17 @@ def _kernel(cfg, n_ticks, treedef, tick_pos, n_state, plan_def, *refs):
     tick0 = tick_ref[0, 0]
     blk_id = pl.program_id(0)
 
-    plan: FaultPlan = jax.tree.unflatten(plan_def, [r[...] for r in plan_refs])
-    vals = [r[...] for r in state_refs]
+    # 1-D leaves ride as (1, I) so the block size is not pinned to the XLA
+    # 1024-element 1-D tiling (see fused_chunk); squeeze them back here.
+    plan: FaultPlan = jax.tree.unflatten(
+        plan_def,
+        [r[...][0] if i in p_1d else r[...] for i, r in enumerate(plan_refs)],
+    )
+    vals = [
+        r[...][0] if i in s_1d else r[...] for i, r in enumerate(state_refs)
+    ]
     leaves = vals[:tick_pos] + [tick0] + vals[tick_pos:]
-    state: PaxosState = jax.tree.unflatten(treedef, leaves)
-    n_prop, blk = state.proposer.bal.shape
-    n_acc = state.acceptor.promised.shape[0]
+    state = jax.tree.unflatten(treedef, leaves)
 
     # Mosaic cannot legalize bool (i1) vectors in the scf.for carry; round
     # bool leaves through int32 across the loop boundary (free-ish VPU
@@ -194,41 +112,45 @@ def _kernel(cfg, n_ticks, treedef, tick_pos, n_state, plan_def, *refs):
 
     def body(t, st_i):
         st = unpack(st_i, state)
-        tick_seed = _mix(seed0, st.tick, blk_id)
-        masks = _sample_masks_counter(cfg, tick_seed, n_prop, n_acc, blk)
-        return pack(apply_tick(st, masks, plan, cfg))
+        tick_seed = mix(seed0, st.tick, blk_id)
+        masks = mask_fn(cfg, tick_seed, st)
+        return pack(apply_fn(st, masks, plan, cfg))
 
     state = unpack(jax.lax.fori_loop(0, n_ticks, body, pack(state)), state)
 
     out = treedef.flatten_up_to(state)
     new_tick = out.pop(tick_pos)
-    for r, v in zip(out_refs[:-1], out):
-        r[...] = v
+    for i, (r, v) in enumerate(zip(out_refs[:-1], out)):
+        r[...] = v[None] if i in s_1d else v
     # Scalar tick rides in SMEM; every grid step writes the same value.
     out_refs[-1][0, 0] = new_tick
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "n_ticks", "block", "interpret"),
+    static_argnames=("cfg", "n_ticks", "apply_fn", "mask_fn", "block", "interpret"),
     donate_argnums=(0,),
 )
-def fused_paxos_chunk(
-    state: PaxosState,
+def fused_chunk(
+    state: Any,
     seed: jnp.ndarray,
     plan: FaultPlan,
     cfg: FaultConfig,
     n_ticks: int,
+    apply_fn: Callable,
+    mask_fn: Callable,
     block: int = DEFAULT_BLOCK,
     interpret: bool = False,
-) -> PaxosState:
+) -> Any:
     """Advance ``n_ticks`` ticks fully in VMEM; returns the new state.
 
     ``seed`` is an int32 scalar (the campaign seed); per-(tick, block)
     streams are derived on-core.  ``block`` instances are processed per grid
-    step and must divide ``n_inst``.
+    step and must divide ``n_inst``; 1-D state leaves pin it to the XLA
+    1024-element tiling at large sizes, so the default is rarely worth
+    changing.
     """
-    n_inst = state.n_inst
+    n_inst = jax.tree.leaves(state)[0].shape[-1]
     block = min(block, n_inst)
     if n_inst % block:
         raise ValueError(f"n_inst={n_inst} not divisible by block={block}")
@@ -236,6 +158,17 @@ def fused_paxos_chunk(
 
     treedef, s_leaves, tick, tick_pos = _split_tick(state)
     p_leaves, plan_def = jax.tree.flatten(plan)
+
+    # Lift 1-D (I,) leaves to (1, I): as 1-D operands their XLA layout tiles
+    # in 1024-element units, which forbids any block != 1024; as (1, I) they
+    # tile (8, 128) like everything else and any 128-multiple block works.
+    # Only done when needed — the boundary reshapes cost ~10% on the paxos
+    # path, and a 1024-aligned block matches the native 1-D tiling anyway.
+    lift = block % 1024 != 0
+    s_1d = frozenset(i for i, l in enumerate(s_leaves) if lift and l.ndim == 1)
+    p_1d = frozenset(i for i, l in enumerate(p_leaves) if lift and l.ndim == 1)
+    s_lift = [l[None] if i in s_1d else l for i, l in enumerate(s_leaves)]
+    p_lift = [l[None] if i in p_1d else l for i, l in enumerate(p_leaves)]
 
     def vspec(leaf):
         lead = leaf.shape[:-1]
@@ -249,18 +182,19 @@ def fused_paxos_chunk(
 
     in_specs = (
         [sspec, sspec]
-        + [vspec(l) for l in s_leaves]
-        + [vspec(l) for l in p_leaves]
+        + [vspec(l) for l in s_lift]
+        + [vspec(l) for l in p_lift]
     )
-    out_specs = [vspec(l) for l in s_leaves] + [sspec]
-    out_shape = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in s_leaves] + [
+    out_specs = [vspec(l) for l in s_lift] + [sspec]
+    out_shape = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in s_lift] + [
         jax.ShapeDtypeStruct((1, 1), jnp.int32)
     ]
     # Donate state arrays into their output slots (in-place in HBM).
-    aliases = {2 + k: k for k in range(len(s_leaves))}
+    aliases = {2 + k: k for k in range(len(s_lift))}
 
     kernel = functools.partial(
-        _kernel, cfg, n_ticks, treedef, tick_pos, len(s_leaves), plan_def
+        _kernel, cfg, n_ticks, apply_fn, mask_fn, treedef, tick_pos,
+        len(s_lift), plan_def, s_1d, p_1d,
     )
     outs = pl.pallas_call(
         kernel,
@@ -269,42 +203,117 @@ def fused_paxos_chunk(
         out_specs=out_specs,
         out_shape=out_shape,
         input_output_aliases=aliases,
-        # TPU interpret mode (not the generic interpreter): it emulates the
-        # TPU-specific primitives (prng_seed/prng_random_bits) on CPU, which
-        # is what the CPU test rig runs equivalence checks under.
+        # TPU interpret mode (not the generic interpreter): it emulates
+        # TPU-specific primitives on CPU, which is what the CPU test rig
+        # runs equivalence checks under.
         interpret=pltpu.InterpretParams() if interpret else False,
     )(
         jnp.reshape(jnp.asarray(seed, jnp.int32), (1, 1)),
         jnp.reshape(tick, (1, 1)),
-        *s_leaves,
-        *p_leaves,
+        *s_lift,
+        *p_lift,
     )
-    new_leaves = list(outs[:-1])
+    new_leaves = [
+        o[0] if i in s_1d else o for i, o in enumerate(outs[:-1])
+    ]
     new_leaves.insert(tick_pos, outs[-1][0, 0])
     return jax.tree.unflatten(treedef, new_leaves)
 
 
 def reference_chunk(
-    state: PaxosState,
+    state: Any,
     seed: jnp.ndarray,
     plan: FaultPlan,
     cfg: FaultConfig,
     n_ticks: int,
-) -> PaxosState:
+    apply_fn: Callable | None = None,
+    mask_fn: Callable | None = None,
+) -> Any:
     """Non-Pallas replay of the fused engine's exact schedule (single block).
 
-    Runs the identical `apply_tick` + `counter_bits` stream in plain XLA for
+    Runs the identical ``apply_fn`` + counter-PRNG stream in plain XLA for
     a state that fits one block (``blk_id = 0``): the fused kernel must
     produce bit-identical results — the equivalence oracle for the Pallas
-    lowering itself (tests/test_fused.py).
+    lowering itself (tests/test_fused.py).  Defaults to single-decree paxos.
     """
-    n_prop = state.proposer.bal.shape[0]
-    n_acc, n_inst = state.acceptor.promised.shape
+    if apply_fn is None or mask_fn is None:
+        from paxos_tpu.protocols.paxos import apply_tick, counter_masks
+
+        apply_fn = apply_fn or apply_tick
+        mask_fn = mask_fn or counter_masks
     seed = jnp.asarray(seed, jnp.int32)
 
     def body(t, st):
-        tick_seed = _mix(seed, st.tick, jnp.int32(0))
-        masks = _sample_masks_counter(cfg, tick_seed, n_prop, n_acc, n_inst)
-        return apply_tick(st, masks, plan, cfg)
+        tick_seed = mix(seed, st.tick, jnp.int32(0))
+        return apply_fn(st, mask_fn(cfg, tick_seed, st), plan, cfg)
 
     return jax.lax.fori_loop(0, n_ticks, body, state)
+
+
+# ---- Per-protocol bindings -------------------------------------------------
+
+
+def fused_paxos_chunk(
+    state, seed, plan, cfg, n_ticks, block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+):
+    """Single-decree Paxos on the fused engine."""
+    from paxos_tpu.protocols.paxos import apply_tick, counter_masks
+
+    return fused_chunk(
+        state, seed, plan, cfg, n_ticks, apply_tick, counter_masks,
+        block=block, interpret=interpret,
+    )
+
+
+def fused_fastpaxos_chunk(
+    state, seed, plan, cfg, n_ticks, block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+):
+    """Fast Paxos on the fused engine (shares paxos mask shapes)."""
+    from paxos_tpu.protocols.fastpaxos import apply_tick_fast
+    from paxos_tpu.protocols.paxos import counter_masks
+
+    return fused_chunk(
+        state, seed, plan, cfg, n_ticks, apply_tick_fast, counter_masks,
+        block=block, interpret=interpret,
+    )
+
+
+def fused_raftcore_chunk(
+    state, seed, plan, cfg, n_ticks, block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+):
+    """Raft-core on the fused engine (shares paxos mask shapes)."""
+    from paxos_tpu.protocols.paxos import counter_masks
+    from paxos_tpu.protocols.raftcore import apply_tick_raft
+
+    return fused_chunk(
+        state, seed, plan, cfg, n_ticks, apply_tick_raft, counter_masks,
+        block=block, interpret=interpret,
+    )
+
+
+def fused_multipaxos_chunk(
+    state, seed, plan, cfg, n_ticks, block: int = 256,
+    interpret: bool = False,
+):
+    """Multi-Paxos log replication on the fused engine.
+
+    Per-instance state is ~5x single-decree (logs + full-log promise
+    payloads), so the default block is smaller to fit VMEM.
+    """
+    from paxos_tpu.protocols.multipaxos import apply_tick_mp, mp_counter_masks
+
+    return fused_chunk(
+        state, seed, plan, cfg, n_ticks, apply_tick_mp, mp_counter_masks,
+        block=block, interpret=interpret,
+    )
+
+
+FUSED_CHUNKS = {
+    "paxos": fused_paxos_chunk,
+    "fastpaxos": fused_fastpaxos_chunk,
+    "raftcore": fused_raftcore_chunk,
+    "multipaxos": fused_multipaxos_chunk,
+}

@@ -46,18 +46,14 @@ from paxos_tpu.transport import inmemory_tpu as net
 from paxos_tpu.utils.bitops import popcount
 
 
-def fastpaxos_step(
-    state: FastPaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+def apply_tick_fast(
+    state: FastPaxosState, masks, plan: FaultPlan, cfg: FaultConfig
 ) -> FastPaxosState:
-    """Advance every instance by one scheduler tick."""
+    """The pure Fast-Paxos transition for one tick over pre-sampled masks."""
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     quorum = majority(n_acc)
     fquorum = fast_quorum(n_acc)
-
-    key = jax.random.fold_in(base_key, state.tick)
-    (k_sel, k_dup_req, k_hold, k_dup_rep, k_drop_prom, k_drop_accd,
-     k_drop_p1, k_drop_p2, k_backoff) = jax.random.split(key, 9)
 
     acc = state.acceptor
     alive = plan.alive(state.tick)  # (A, I)
@@ -76,21 +72,18 @@ def fastpaxos_step(
     # (same no-clobber discipline as protocols.paxos).
     link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
 
-    with jax.named_scope("deliver"):
-        delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
-        if link is not None:  # partitioned links stall replies in flight
-            delivered = delivered & link[None]
-        replies = net.consume(
-            state.replies, delivered,
-            stay=net.stay_mask(k_dup_rep, delivered.shape, cfg.p_dup),
-        )
+    delivered = state.replies.present
+    if masks.deliver is not None:
+        delivered = delivered & masks.deliver
+    if link is not None:  # partitioned links stall replies in flight
+        delivered = delivered & link[None]
+    replies = net.consume(state.replies, delivered, stay=masks.dup_rep)
 
     # ---- Acceptor half-tick ----
-    with jax.named_scope("acceptor_select"):
-        sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-        sel = sel & alive[None, None]
-        if link is not None:  # partitioned links stall requests in flight
-            sel = sel & link[None]
+    sel = net.select_from_scores(state.requests.present, masks.sel_score, masks.busy)
+    sel = sel & alive[None, None]
+    if link is not None:  # partitioned links stall requests in flight
+        sel = sel & link[None]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(0, 1))
@@ -124,7 +117,7 @@ def fastpaxos_step(
         bal=msg_bal[None],
         v1=prom_payload_bal[None],
         v2=prom_payload_val[None],
-        keep=net.keep_mask(k_drop_prom, (n_prop, n_acc, n_inst), cfg.p_drop),
+        keep=masks.keep_prom,
     )
     replies = net.send(
         replies, ACCEPTED,
@@ -132,11 +125,9 @@ def fastpaxos_step(
         bal=msg_bal[None],
         v1=msg_val[None],
         v2=jnp.zeros_like(msg_val)[None],
-        keep=net.keep_mask(k_drop_accd, (n_prop, n_acc, n_inst), cfg.p_drop),
+        keep=masks.keep_accd,
     )
-    requests = net.consume(
-        state.requests, sel, stay=net.stay_mask(k_dup_req, sel.shape, cfg.p_dup)
-    )
+    requests = net.consume(state.requests, sel, stay=masks.dup_req)
     acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
 
     # ---- Learner / safety checker (fast-quorum-aware thresholds) ----
@@ -209,9 +200,19 @@ def fastpaxos_step(
     cnt = popcount(rep_mask)  # (P, V, I)
     choosable = (rep_mask != 0) & (cnt + unheard[:, None] >= fquorum)
     any_ch = choosable.any(axis=1)
-    pick_fast = jnp.argmax(choosable, axis=1).astype(jnp.int32) + VALUE_BASE
+    # First-set value id via first_true + masked sum (argmax does not lower
+    # in Mosaic); an all-False column sums to 0 and is guarded by any_ch /
+    # best_bal > 0 downstream, matching argmax's pick-0 behavior.
+    from paxos_tpu.check.safety import first_true
+
+    vids = jnp.arange(n_prop, dtype=jnp.int32)[None, :, None]  # (1, V, 1)
+    pick_fast = (
+        jnp.where(first_true(choosable, axis=1), vids, 0).sum(axis=1)
+        + VALUE_BASE
+    )
     pick_classic = (
-        jnp.argmax(rep_mask != 0, axis=1).astype(jnp.int32) + VALUE_BASE
+        jnp.where(first_true(rep_mask != 0, axis=1), vids, 0).sum(axis=1)
+        + VALUE_BASE
     )
     is_fast_k = bal_mod.ballot_round(best_bal) == 0
     v_fast = jnp.where(any_ch, pick_fast, prop.own_val)
@@ -226,9 +227,6 @@ def fastpaxos_step(
         (prop.phase != DONE)
         & ~p1_done & ~p2_done & ~fast_done
         & (timer > cfg.timeout)
-    )
-    backoff = jax.random.randint(
-        k_backoff, timer.shape, 0, max(cfg.backoff_max, 1), jnp.int32
     )
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
@@ -246,7 +244,7 @@ def fastpaxos_step(
     best_bal = jnp.where(expired, 0, best_bal)
     rep_mask = jnp.where(expired[:, None], 0, rep_mask)
     timer = jnp.where(p1_done, 0, timer)
-    timer = jnp.where(expired, -backoff, timer)
+    timer = jnp.where(expired, -masks.backoff, timer)
 
     # Emit: classic ACCEPT on phase-1 completion, PREPARE on retry.
     requests = net.send(
@@ -255,7 +253,7 @@ def fastpaxos_step(
         bal=prop.bal[:, None],
         v1=prop_val[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=net.keep_mask(k_drop_p2, (n_prop, n_acc, n_inst), cfg.p_drop),
+        keep=masks.keep_p2,
     )
     requests = net.send(
         requests, PREPARE,
@@ -263,7 +261,7 @@ def fastpaxos_step(
         bal=bal_next[:, None],
         v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=net.keep_mask(k_drop_p1, (n_prop, n_acc, n_inst), cfg.p_drop),
+        keep=masks.keep_p1,
     )
 
     prop = prop.replace(
@@ -285,3 +283,20 @@ def fastpaxos_step(
         replies=replies,
         tick=state.tick + 1,
     )
+
+
+def fastpaxos_step(
+    state: FastPaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+) -> FastPaxosState:
+    """Advance every instance by one scheduler tick (XLA engine).
+
+    Fast Paxos shares single-decree paxos' mask shapes, so it reuses its
+    samplers (`protocols.paxos.sample_masks` / `counter_masks`).
+    """
+    from paxos_tpu.protocols.paxos import sample_masks
+
+    n_acc, n_inst = state.acceptor.promised.shape
+    n_prop = state.proposer.bal.shape[0]
+    key = jax.random.fold_in(base_key, state.tick)
+    masks = sample_masks(key, cfg, n_prop, n_acc, n_inst)
+    return apply_tick_fast(state, masks, plan, cfg)

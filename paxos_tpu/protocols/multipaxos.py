@@ -22,8 +22,11 @@ per acceptor per tick, commutative reply folds at proposers), extended with:
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
+from flax import struct
 
 from paxos_tpu.check.mp_safety import mp_learner_observe
 from paxos_tpu.core import ballot as bal_mod
@@ -39,17 +42,84 @@ def own_slot_value(pid: jnp.ndarray, slot: jnp.ndarray) -> jnp.ndarray:
     return (pid + 1) * 1000 + slot
 
 
-def multipaxos_step(
-    state: MultiPaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+@struct.dataclass
+class MPTickMasks:
+    """One Multi-Paxos tick's pre-sampled randomness (instance-minor)."""
+
+    sel_score: jnp.ndarray  # (2, P, A, I) int32 — request-selection entropy
+    busy: Optional[jnp.ndarray]  # (1, 1, A, I) bool — False = acceptor idles
+    dup_req: Optional[jnp.ndarray]  # (2, P, A, I) bool — request redelivered
+    prom_deliver: Optional[jnp.ndarray]  # (P, A, I) bool — promise not held
+    accd_deliver: Optional[jnp.ndarray]  # (P, A, I) bool — accepted not held
+    keep_prom: Optional[jnp.ndarray]  # (P, A, I) bool — PROMISE not dropped
+    keep_accd: Optional[jnp.ndarray]  # (P, A, I) bool — ACCEPTED not dropped
+    keep_prep: Optional[jnp.ndarray]  # (P, A, I) bool — PREPARE not dropped
+    keep_acc: Optional[jnp.ndarray]  # (P, A, I) bool — ACCEPT not dropped
+    jitter: jnp.ndarray  # (P, I) int32 — election-threshold jitter
+    backoff: jnp.ndarray  # (P, I) int32 — post-failure retreat draw
+
+
+def sample_mp_masks(
+    key: jax.Array, cfg: FaultConfig, n_prop: int, n_acc: int, n_inst: int
+) -> MPTickMasks:
+    """Draw a tick's masks with ``jax.random`` (the XLA engine's source)."""
+    (k_sel, k_idle, k_dup_req, k_hold_pr, k_hold_ac, k_drop_pr, k_drop_ac,
+     k_drop_prep, k_drop_acc, k_jit, k_back) = jax.random.split(key, 11)
+    slot = (2, n_prop, n_acc, n_inst)
+    edge = (n_prop, n_acc, n_inst)
+
+    return MPTickMasks(
+        sel_score=jax.random.bits(k_sel, slot, jnp.uint32).astype(jnp.int32),
+        busy=net.keep_mask(k_idle, (1, 1, n_acc, n_inst), cfg.p_idle),
+        dup_req=net.stay_mask(k_dup_req, slot, cfg.p_dup),
+        prom_deliver=net.keep_mask(k_hold_pr, edge, cfg.p_hold),
+        accd_deliver=net.keep_mask(k_hold_ac, edge, cfg.p_hold),
+        keep_prom=net.keep_mask(k_drop_pr, edge, cfg.p_drop),
+        keep_accd=net.keep_mask(k_drop_ac, edge, cfg.p_drop),
+        keep_prep=net.keep_mask(k_drop_prep, edge, cfg.p_drop),
+        keep_acc=net.keep_mask(k_drop_acc, edge, cfg.p_drop),
+        jitter=jax.random.randint(
+            k_jit, (n_prop, n_inst), 0, max(cfg.backoff_max, 1), jnp.int32
+        ),
+        backoff=jax.random.randint(
+            k_back, (n_prop, n_inst), 0, 2 * max(cfg.backoff_max, 1), jnp.int32
+        ),
+    )
+
+
+def mp_counter_masks(cfg: FaultConfig, tick_seed: jax.Array, state) -> MPTickMasks:
+    """Draw a tick's masks from the counter PRNG (the fused engine's source)."""
+    from paxos_tpu.kernels import counter_prng as cp
+
+    n_acc, n_inst = state.acceptor.promised.shape
+    n_prop = state.proposer.bal.shape[0]
+    slot = (2, n_prop, n_acc, n_inst)
+    edge = (n_prop, n_acc, n_inst)
+    return MPTickMasks(
+        sel_score=cp.counter_bits(tick_seed, 0, slot),
+        busy=cp.bern_not(tick_seed, 1, (1, 1, n_acc, n_inst), cfg.p_idle),
+        dup_req=cp.bern(tick_seed, 2, slot, cfg.p_dup),
+        prom_deliver=cp.bern_not(tick_seed, 3, edge, cfg.p_hold),
+        accd_deliver=cp.bern_not(tick_seed, 4, edge, cfg.p_hold),
+        keep_prom=cp.bern_not(tick_seed, 5, edge, cfg.p_drop),
+        keep_accd=cp.bern_not(tick_seed, 6, edge, cfg.p_drop),
+        keep_prep=cp.bern_not(tick_seed, 7, edge, cfg.p_drop),
+        keep_acc=cp.bern_not(tick_seed, 8, edge, cfg.p_drop),
+        jitter=cp.randint(tick_seed, 9, (n_prop, n_inst), max(cfg.backoff_max, 1)),
+        backoff=cp.randint(
+            tick_seed, 10, (n_prop, n_inst), 2 * max(cfg.backoff_max, 1)
+        ),
+    )
+
+
+def apply_tick_mp(
+    state: MultiPaxosState, masks: MPTickMasks, plan: FaultPlan, cfg: FaultConfig
 ) -> MultiPaxosState:
+    """The pure Multi-Paxos transition for one tick over pre-sampled masks."""
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     n_slots = state.log_len
     quorum = majority(n_acc)
-
-    key = jax.random.fold_in(base_key, state.tick)
-    (k_sel, k_dup_req, k_hold_pr, k_hold_ac, k_drop_pr, k_drop_ac,
-     k_drop_prep, k_drop_acc, k_jit, k_back) = jax.random.split(key, 10)
 
     acc = state.acceptor
     prop = state.proposer
@@ -68,21 +138,23 @@ def multipaxos_step(
     # ---- Reply delivery decided & cleared before new writes (no clobber) ----
     link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
 
-    with jax.named_scope("deliver"):
-        prom_del = net.hold_mask(state.promises.present, k_hold_pr, cfg.p_hold)
-        accd_del = net.hold_mask(state.accepted.present, k_hold_ac, cfg.p_hold)
-        if link is not None:  # partitioned links stall replies in flight
-            prom_del = prom_del & link
-            accd_del = accd_del & link
-        promises = state.promises.replace(present=state.promises.present & ~prom_del)
-        accepted = state.accepted.replace(present=state.accepted.present & ~accd_del)
+    prom_del = state.promises.present
+    if masks.prom_deliver is not None:
+        prom_del = prom_del & masks.prom_deliver
+    accd_del = state.accepted.present
+    if masks.accd_deliver is not None:
+        accd_del = accd_del & masks.accd_deliver
+    if link is not None:  # partitioned links stall replies in flight
+        prom_del = prom_del & link
+        accd_del = accd_del & link
+    promises = state.promises.replace(present=state.promises.present & ~prom_del)
+    accepted = state.accepted.replace(present=state.accepted.present & ~accd_del)
 
     # ---- Acceptor half-tick ----
-    with jax.named_scope("acceptor_select"):
-        sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-        sel = sel & alive[None, None]
-        if link is not None:  # partitioned links stall requests in flight
-            sel = sel & link[None]
+    sel = net.select_from_scores(state.requests.present, masks.sel_score, masks.busy)
+    sel = sel & alive[None, None]
+    if link is not None:  # partitioned links stall requests in flight
+        sel = sel & link[None]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(0, 1))
@@ -108,10 +180,8 @@ def multipaxos_step(
 
     # Promise replies carry the acceptor's full log (equivocators hide theirs).
     prom_send = sel[PREPARE] & ok_prep[None]  # (P, A, I)
-    if cfg.p_drop > 0.0:
-        prom_send = prom_send & ~net._bernoulli_bits(
-            k_drop_pr, prom_send.shape, cfg.p_drop
-        )
+    if masks.keep_prom is not None:
+        prom_send = prom_send & masks.keep_prom
     payload_pb = jnp.where(equiv[:, None], 0, acc.log_bal)  # (A, L, I)
     payload_pv = jnp.where(equiv[:, None], 0, acc.log_val)
     promises = promises.replace(
@@ -122,10 +192,8 @@ def multipaxos_step(
     )
 
     accd_send = sel[ACCEPT] & ok_acc[None]  # (P, A, I)
-    if cfg.p_drop > 0.0:
-        accd_send = accd_send & ~net._bernoulli_bits(
-            k_drop_ac, accd_send.shape, cfg.p_drop
-        )
+    if masks.keep_accd is not None:
+        accd_send = accd_send & masks.keep_accd
     accepted = accepted.replace(
         present=accepted.present | accd_send,
         bal=jnp.where(accd_send, msg_bal[None], accepted.bal),
@@ -133,9 +201,7 @@ def multipaxos_step(
         val=jnp.where(accd_send, msg_val[None], accepted.val),
     )
 
-    requests = net.consume(
-        state.requests, sel, stay=net.stay_mask(k_dup_req, sel.shape, cfg.p_dup)
-    )
+    requests = net.consume(state.requests, sel, stay=masks.dup_req)
     acc = acc.replace(promised=promised, log_bal=log_bal, log_val=log_val)
 
     # ---- Learner / checker ----
@@ -198,7 +264,7 @@ def multipaxos_step(
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], prop.bal.shape
     )
-    jitter = jax.random.randint(k_jit, prop.bal.shape, 0, max(cfg.backoff_max, 1))
+    jitter = masks.jitter
     start_elec = (
         (prop.phase == FOLLOW)
         & p_alive
@@ -229,10 +295,9 @@ def multipaxos_step(
     lease_timer = jnp.where(start_elec | p1_done | slot_done, 0, lease_timer)
     # Failed candidacy / demotion: retreat below the election threshold by a
     # random backoff so rivals separate instead of re-colliding every tick.
-    backoff = jax.random.randint(
-        k_back, lease_timer.shape, 0, 2 * max(cfg.backoff_max, 1)
+    lease_timer = jnp.where(
+        cand_fail | demote, cfg.lease_len - masks.backoff, lease_timer
     )
-    lease_timer = jnp.where(cand_fail | demote, cfg.lease_len - backoff, lease_timer)
     candidate_timer = jnp.where(start_elec, 0, candidate_timer)
 
     # ---- Emit ----
@@ -246,7 +311,7 @@ def multipaxos_step(
         bal=bal_next[:, None],
         v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=net.keep_mask(k_drop_prep, (n_prop, n_acc, n_inst), cfg.p_drop),
+        keep=masks.keep_prep,
     )
     # Leaders re-broadcast the current slot's Accept every tick (idempotent,
     # self-healing under loss).
@@ -262,7 +327,7 @@ def multipaxos_step(
         bal=bal_next[:, None],
         v1=pval[:, None],
         v2=ci[:, None],
-        keep=net.keep_mask(k_drop_acc, (n_prop, n_acc, n_inst), cfg.p_drop),
+        keep=masks.keep_acc,
     )
 
     prop = prop.replace(
@@ -286,3 +351,14 @@ def multipaxos_step(
         accepted=accepted,
         tick=state.tick + 1,
     )
+
+
+def multipaxos_step(
+    state: MultiPaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+) -> MultiPaxosState:
+    """Advance every instance by one scheduler tick (XLA engine)."""
+    n_acc, n_inst = state.acceptor.promised.shape
+    n_prop = state.proposer.bal.shape[0]
+    key = jax.random.fold_in(base_key, state.tick)
+    masks = sample_mp_masks(key, cfg, n_prop, n_acc, n_inst)
+    return apply_tick_mp(state, masks, plan, cfg)

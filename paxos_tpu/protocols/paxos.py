@@ -82,28 +82,49 @@ def sample_masks(
     slot = (2, n_prop, n_acc, n_inst)
     edge = (n_prop, n_acc, n_inst)
 
-    def hit(k, shape, p):  # True with probability p, or None when disabled
-        if p <= 0.0:
-            return None
-        return jax.random.bits(k, shape, jnp.uint32) < net.bern_threshold(p)
-
-    def miss(k, shape, p):  # True with probability 1-p, or None when disabled
-        m = hit(k, shape, p)
-        return None if m is None else ~m
-
     return TickMasks(
         sel_score=jax.random.bits(k_sel, slot, jnp.uint32),
-        busy=miss(k_idle, (1, 1, n_acc, n_inst), cfg.p_idle),
-        deliver=miss(k_hold, slot, cfg.p_hold),
-        dup_req=hit(k_dup_req, slot, cfg.p_dup),
-        dup_rep=hit(k_dup_rep, slot, cfg.p_dup),
-        keep_prom=miss(k_drop_prom, edge, cfg.p_drop),
-        keep_accd=miss(k_drop_accd, edge, cfg.p_drop),
-        keep_p1=miss(k_drop_p1, edge, cfg.p_drop),
-        keep_p2=miss(k_drop_p2, edge, cfg.p_drop),
+        busy=net.keep_mask(k_idle, (1, 1, n_acc, n_inst), cfg.p_idle),
+        deliver=net.keep_mask(k_hold, slot, cfg.p_hold),
+        dup_req=net.stay_mask(k_dup_req, slot, cfg.p_dup),
+        dup_rep=net.stay_mask(k_dup_rep, slot, cfg.p_dup),
+        keep_prom=net.keep_mask(k_drop_prom, edge, cfg.p_drop),
+        keep_accd=net.keep_mask(k_drop_accd, edge, cfg.p_drop),
+        keep_p1=net.keep_mask(k_drop_p1, edge, cfg.p_drop),
+        keep_p2=net.keep_mask(k_drop_p2, edge, cfg.p_drop),
         backoff=jax.random.randint(
             k_backoff, (n_prop, n_inst), 0, max(cfg.backoff_max, 1), jnp.int32
         ),
+    )
+
+
+def counter_masks(
+    cfg: FaultConfig, tick_seed: jax.Array, state: PaxosState
+) -> TickMasks:
+    """Draw a tick's masks from the counter PRNG (the fused engine's source).
+
+    Same mask shapes and probabilities as :func:`sample_masks`, different
+    (but equally deterministic) stream; pure jnp, so it traces identically
+    inside Pallas kernels and in plain XLA (``kernels/counter_prng``).
+    """
+    from paxos_tpu.kernels import counter_prng as cp
+
+    # Shapes from the request buffer: present in every protocol state that
+    # shares these mask shapes (paxos, fastpaxos, raftcore).
+    _, n_prop, n_acc, n_inst = state.requests.present.shape
+    slot = (2, n_prop, n_acc, n_inst)
+    edge = (n_prop, n_acc, n_inst)
+    return TickMasks(
+        sel_score=cp.counter_bits(tick_seed, 0, slot),
+        busy=cp.bern_not(tick_seed, 1, (1, 1, n_acc, n_inst), cfg.p_idle),
+        deliver=cp.bern_not(tick_seed, 2, slot, cfg.p_hold),
+        dup_req=cp.bern(tick_seed, 3, slot, cfg.p_dup),
+        dup_rep=cp.bern(tick_seed, 4, slot, cfg.p_dup),
+        keep_prom=cp.bern_not(tick_seed, 5, edge, cfg.p_drop),
+        keep_accd=cp.bern_not(tick_seed, 6, edge, cfg.p_drop),
+        keep_p1=cp.bern_not(tick_seed, 7, edge, cfg.p_drop),
+        keep_p2=cp.bern_not(tick_seed, 8, edge, cfg.p_drop),
+        backoff=cp.randint(tick_seed, 9, (n_prop, n_inst), max(cfg.backoff_max, 1)),
     )
 
 

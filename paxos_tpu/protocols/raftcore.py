@@ -54,17 +54,17 @@ from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
 
 
-def raftcore_step(
-    state: RaftState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+def apply_tick_raft(
+    state: RaftState, masks, plan: FaultPlan, cfg: FaultConfig
 ) -> RaftState:
-    """Advance every instance by one scheduler tick."""
+    """The pure Raft-core transition for one tick over pre-sampled masks.
+
+    Mask roles map onto paxos' ``TickMasks`` fields: keep_prom -> VOTE,
+    keep_accd -> ACK, keep_p1 -> REQVOTE, keep_p2 -> APPEND.
+    """
     n_acc, n_inst = state.acceptor.voted.shape
     n_prop = state.proposer.bal.shape[0]
     quorum = majority(n_acc)
-
-    key = jax.random.fold_in(base_key, state.tick)
-    (k_sel, k_dup_req, k_hold, k_dup_rep, k_drop_vote, k_drop_ack,
-     k_drop_rv, k_drop_ap, k_backoff) = jax.random.split(key, 9)
 
     voter = state.acceptor
     alive = plan.alive(state.tick)  # (A, I)
@@ -81,21 +81,18 @@ def raftcore_step(
 
     link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
 
-    with jax.named_scope("deliver"):
-        delivered = net.hold_mask(state.replies.present, k_hold, cfg.p_hold)
-        if link is not None:  # partitioned links stall replies in flight
-            delivered = delivered & link[None]
-        replies = net.consume(
-            state.replies, delivered,
-            stay=net.stay_mask(k_dup_rep, delivered.shape, cfg.p_dup),
-        )
+    delivered = state.replies.present
+    if masks.deliver is not None:
+        delivered = delivered & masks.deliver
+    if link is not None:  # partitioned links stall replies in flight
+        delivered = delivered & link[None]
+    replies = net.consume(state.replies, delivered, stay=masks.dup_rep)
 
     # ---- Voter half-tick: select one request per (instance, voter) ----
-    with jax.named_scope("acceptor_select"):
-        sel = net.select_one(state.requests.present, k_sel, cfg.p_idle)
-        sel = sel & alive[None, None]
-        if link is not None:  # partitioned links stall requests in flight
-            sel = sel & link[None]
+    sel = net.select_from_scores(state.requests.present, masks.sel_score, masks.busy)
+    sel = sel & alive[None, None]
+    if link is not None:  # partitioned links stall requests in flight
+        sel = sel & link[None]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(0, 1))
@@ -128,7 +125,7 @@ def raftcore_step(
         bal=msg_bal[None],
         v1=(vote_payload_t * 2 + grant.astype(jnp.int32))[None],
         v2=vote_payload_v[None],
-        keep=net.keep_mask(k_drop_vote, (n_prop, n_acc, n_inst), cfg.p_drop),
+        keep=masks.keep_prom,
     )
     replies = net.send(
         replies, ACK,
@@ -136,11 +133,9 @@ def raftcore_step(
         bal=msg_bal[None],
         v1=msg_v1[None],
         v2=jnp.zeros_like(msg_v1)[None],
-        keep=net.keep_mask(k_drop_ack, (n_prop, n_acc, n_inst), cfg.p_drop),
+        keep=masks.keep_accd,
     )
-    requests = net.consume(
-        state.requests, sel, stay=net.stay_mask(k_dup_req, sel.shape, cfg.p_dup)
-    )
+    requests = net.consume(state.requests, sel, stay=masks.dup_req)
     voter = voter.replace(voted=voted, ent_term=ent_term, ent_val=ent_val)
 
     # ---- Learner / safety checker (append-accept events, majority commit) ----
@@ -195,9 +190,6 @@ def raftcore_step(
     expired = (
         (cand.phase != DONE) & ~elected & ~committed & (timer > cfg.timeout)
     )
-    backoff = jax.random.randint(
-        k_backoff, timer.shape, 0, max(cfg.backoff_max, 1), jnp.int32
-    )
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
     )
@@ -216,7 +208,7 @@ def raftcore_step(
     bal_next = jnp.where(expired, new_bal, cand.bal)
     heard = jnp.where(elected | expired, 0, heard)
     timer = jnp.where(elected, 0, timer)
-    timer = jnp.where(expired, -backoff, timer)
+    timer = jnp.where(expired, -masks.backoff, timer)
 
     # Emit: leaders re-broadcast AppendEntries every tick; expired candidates
     # broadcast RequestVote at the next term, declaring their entry term.
@@ -227,7 +219,7 @@ def raftcore_step(
         bal=bal_next[:, None],
         v1=prop_val[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=net.keep_mask(k_drop_ap, (n_prop, n_acc, n_inst), cfg.p_drop),
+        keep=masks.keep_p2,
     )
     requests = net.send(
         requests, REQVOTE,
@@ -235,7 +227,7 @@ def raftcore_step(
         bal=bal_next[:, None],
         v1=ent_term_c[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=net.keep_mask(k_drop_rv, (n_prop, n_acc, n_inst), cfg.p_drop),
+        keep=masks.keep_p1,
     )
 
     cand = cand.replace(
@@ -257,3 +249,16 @@ def raftcore_step(
         replies=replies,
         tick=state.tick + 1,
     )
+
+
+def raftcore_step(
+    state: RaftState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+) -> RaftState:
+    """Advance every instance by one scheduler tick (XLA engine)."""
+    from paxos_tpu.protocols.paxos import sample_masks
+
+    n_acc, n_inst = state.acceptor.voted.shape
+    n_prop = state.proposer.bal.shape[0]
+    key = jax.random.fold_in(base_key, state.tick)
+    masks = sample_masks(key, cfg, n_prop, n_acc, n_inst)
+    return apply_tick_raft(state, masks, plan, cfg)

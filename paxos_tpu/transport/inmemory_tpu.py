@@ -114,24 +114,6 @@ def select_from_scores(
     return sel
 
 
-def select_one(present: jnp.ndarray, key: jax.Array, p_idle: float) -> jnp.ndarray:
-    """Sample selection entropy with ``jax.random`` and select (XLA path)."""
-    k, p, a, i = present.shape
-    k_sel, k_idle = jax.random.split(key)
-    scores = jax.random.bits(k_sel, present.shape, jnp.uint32)
-    busy = None
-    if p_idle > 0.0:
-        busy = ~_bernoulli_bits(k_idle, (1, 1, a, i), p_idle)
-    return select_from_scores(present, scores, busy)
-
-
-def hold_mask(present: jnp.ndarray, key: jax.Array, p_hold: float) -> jnp.ndarray:
-    """(shape of present) bool: which present reply slots deliver this tick."""
-    if p_hold <= 0.0:
-        return present
-    return present & ~_bernoulli_bits(key, present.shape, p_hold)
-
-
 def send(
     buf: MsgBuf,
     kind: int,

@@ -14,12 +14,13 @@ from paxos_tpu.harness.config import SimConfig
 from paxos_tpu.harness.run import run
 
 
-def fp_cfg(n_inst=1024, n_prop=2, n_acc=5, seed=0, **fault_kw):
+def fp_cfg(n_inst=1024, n_prop=2, n_acc=5, seed=0, k_slots=8, **fault_kw):
     return SimConfig(
         n_inst=n_inst,
         n_prop=n_prop,
         n_acc=n_acc,
         seed=seed,
+        k_slots=k_slots,
         protocol="fastpaxos",
         fault=FaultConfig(**fault_kw),
     )
@@ -64,6 +65,9 @@ def test_chaos_safety():
         n_prop=2,
         n_acc=5,
         seed=3,
+        # Long chaotic duels visit many (ballot, value) pairs; keep the
+        # checker's completeness bound (evictions == 0) with a deeper table.
+        k_slots=12,
         p_drop=0.1,
         p_dup=0.1,
         p_idle=0.2,

@@ -70,6 +70,39 @@ def test_fused_stream_equivocation_trips_checker():
     assert int(state.learner.violations.sum()) > 0
 
 
+def test_pallas_lowering_bitexact_all_protocols():
+    """Every protocol's fused kernel == its XLA reference, faults on."""
+    from paxos_tpu.harness.config import SimConfig
+    from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+    from paxos_tpu.protocols.fastpaxos import apply_tick_fast
+    from paxos_tpu.protocols.multipaxos import apply_tick_mp, mp_counter_masks
+    from paxos_tpu.protocols.paxos import counter_masks
+    from paxos_tpu.protocols.raftcore import apply_tick_raft
+
+    fns = {
+        "fastpaxos": (apply_tick_fast, counter_masks),
+        "raftcore": (apply_tick_raft, counter_masks),
+        "multipaxos": (apply_tick_mp, mp_counter_masks),
+    }
+    fault = FaultConfig(p_drop=0.1, p_idle=0.2, p_hold=0.2, lease_len=10)
+    for protocol, (apply_fn, mask_fn) in fns.items():
+        cfg = SimConfig(
+            n_inst=32, n_prop=2, n_acc=3, log_len=4, seed=7,
+            protocol=protocol, fault=fault,
+        )
+        plan = init_plan(cfg)
+        sp = FUSED_CHUNKS[protocol](
+            init_state(cfg), jnp.int32(7), plan, cfg.fault, 32,
+            block=32, interpret=True,
+        )
+        sr = reference_chunk(
+            init_state(cfg), jnp.int32(7), plan, cfg.fault, 32,
+            apply_fn=apply_fn, mask_fn=mask_fn,
+        )
+        assert _trees_equal(sp, sr) == [], protocol
+        assert int(sp.tick) == 32, protocol
+
+
 def test_fused_stream_chunk_split_invariant():
     """Seeds derive from (seed, tick, block): 2x24 ticks == 1x48 ticks."""
     cfg = config2_dueling_drop(n_inst=256, seed=9)

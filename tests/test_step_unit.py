@@ -1,7 +1,7 @@
 """Deterministic known-answer tests for the paxos step.
 
 With at most one in-flight request per acceptor and p_idle = p_hold = 0, the
-adversarial scheduler has no freedom: `select_one` must pick the lone
+adversarial scheduler has no freedom: selection must pick the lone
 message and replies deliver the next tick.  That determinism lets us
 hand-construct the interleavings that famously break wrong Paxos
 implementations (SURVEY.md §5.2.3) and assert exact state transitions.
