@@ -51,7 +51,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--engine",
         choices=["xla", "fused"],
         default="xla",
-        help="fused = whole-chunk Pallas kernel (TPU, single-chip)",
+        help="fused = whole-chunk Pallas kernel (TPU; works with --shard)",
     )
     r.add_argument("--n-inst", type=int, default=None, help="override instance count")
     r.add_argument("--seed", type=int, default=0)
@@ -135,18 +135,26 @@ def cmd_run(args: argparse.Namespace) -> int:
                   "other backends); use --engine xla",
                   file=sys.stderr)
             return 1
-        if args.shard:
-            print("error: --engine fused is single-chip for now; drop --shard",
-                  file=sys.stderr)
-            return 1
         import jax.numpy as jnp
 
-        from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+        if args.shard:
+            from paxos_tpu.kernels.fused_tick import fused_chunk_sharded, fused_fns
 
-        fused = FUSED_CHUNKS[cfg.protocol]
+            apply_fn, mask_fn, blk = fused_fns(cfg.protocol)
 
-        def advance(s, n):
-            return fused(s, jnp.int32(cfg.seed), plan, cfg.fault, n)
+            def advance(s, n):
+                return fused_chunk_sharded(
+                    s, jnp.int32(cfg.seed), plan, cfg.fault, n,
+                    apply_fn, mask_fn, mesh, block=blk,
+                )
+
+        else:
+            from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+
+            fused = FUSED_CHUNKS[cfg.protocol]
+
+            def advance(s, n):
+                return fused(s, jnp.int32(cfg.seed), plan, cfg.fault, n)
 
     else:
         step_fn = get_step_fn(cfg.protocol)

@@ -74,14 +74,17 @@ def _kernel(
     cfg, n_ticks, apply_fn, mask_fn, treedef, tick_pos, n_state, plan_def,
     s_1d, p_1d, *refs,
 ):
-    seed_ref, tick_ref = refs[0], refs[1]
-    state_refs = refs[2 : 2 + n_state]
-    plan_refs = refs[2 + n_state : 2 + n_state + plan_def.num_leaves]
-    out_refs = refs[2 + n_state + plan_def.num_leaves :]
+    seed_ref, tick_ref, blk0_ref = refs[0], refs[1], refs[2]
+    state_refs = refs[3 : 3 + n_state]
+    plan_refs = refs[3 + n_state : 3 + n_state + plan_def.num_leaves]
+    out_refs = refs[3 + n_state + plan_def.num_leaves :]
 
     seed0 = seed_ref[0, 0]
     tick0 = tick_ref[0, 0]
-    blk_id = pl.program_id(0)
+    # Global block id: the shard's block offset (0 single-chip; set by the
+    # sharded wrapper under shard_map) plus the grid position, so every
+    # block across every chip draws a distinct stream.
+    blk_id = blk0_ref[0, 0] + pl.program_id(0)
 
     # 1-D leaves ride as (1, I) so the block size is not pinned to the XLA
     # 1024-element 1-D tiling (see fused_chunk); squeeze them back here.
@@ -141,6 +144,7 @@ def fused_chunk(
     mask_fn: Callable,
     block: int = DEFAULT_BLOCK,
     interpret: bool = False,
+    block_offset: "jnp.ndarray | int" = 0,
 ) -> Any:
     """Advance ``n_ticks`` ticks fully in VMEM; returns the new state.
 
@@ -181,7 +185,7 @@ def fused_chunk(
     sspec = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
 
     in_specs = (
-        [sspec, sspec]
+        [sspec, sspec, sspec]
         + [vspec(l) for l in s_lift]
         + [vspec(l) for l in p_lift]
     )
@@ -190,7 +194,7 @@ def fused_chunk(
         jax.ShapeDtypeStruct((1, 1), jnp.int32)
     ]
     # Donate state arrays into their output slots (in-place in HBM).
-    aliases = {2 + k: k for k in range(len(s_lift))}
+    aliases = {3 + k: k for k in range(len(s_lift))}
 
     kernel = functools.partial(
         _kernel, cfg, n_ticks, apply_fn, mask_fn, treedef, tick_pos,
@@ -210,6 +214,7 @@ def fused_chunk(
     )(
         jnp.reshape(jnp.asarray(seed, jnp.int32), (1, 1)),
         jnp.reshape(tick, (1, 1)),
+        jnp.reshape(jnp.asarray(block_offset, jnp.int32), (1, 1)),
         *s_lift,
         *p_lift,
     )
@@ -236,11 +241,15 @@ def reference_chunk(
     produce bit-identical results — the equivalence oracle for the Pallas
     lowering itself (tests/test_fused.py).  Defaults to single-decree paxos.
     """
-    if apply_fn is None or mask_fn is None:
+    if (apply_fn is None) != (mask_fn is None):
+        raise ValueError(
+            "pass apply_fn and mask_fn together: mixing one protocol's "
+            "transition with another's mask sampler is never meaningful"
+        )
+    if apply_fn is None:
         from paxos_tpu.protocols.paxos import apply_tick, counter_masks
 
-        apply_fn = apply_fn or apply_tick
-        mask_fn = mask_fn or counter_masks
+        apply_fn, mask_fn = apply_tick, counter_masks
     seed = jnp.asarray(seed, jnp.int32)
 
     def body(t, st):
@@ -250,70 +259,132 @@ def reference_chunk(
     return jax.lax.fori_loop(0, n_ticks, body, state)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "n_ticks", "apply_fn", "mask_fn", "mesh", "block",
+        "blocks_per_shard", "interpret",
+    ),
+    donate_argnums=(0,),
+)
+def _sharded_impl(
+    state, seed, plan, *, cfg, n_ticks, apply_fn, mask_fn, mesh, block,
+    blocks_per_shard, interpret,
+):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from paxos_tpu.parallel.mesh import INSTANCES_AXIS
+
+    n_inst = jax.tree.leaves(state)[0].shape[-1]
+
+    def leaf_spec(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[-1] == n_inst:
+            return P(*([None] * (x.ndim - 1)), INSTANCES_AXIS)
+        return P()
+
+    state_spec = jax.tree.map(leaf_spec, state)
+    plan_spec = jax.tree.map(leaf_spec, plan)
+
+    def local_fn(st, sd, pln):
+        off = jax.lax.axis_index(INSTANCES_AXIS) * blocks_per_shard
+        return fused_chunk(
+            st, sd, pln, cfg, n_ticks, apply_fn, mask_fn,
+            block=block, interpret=interpret, block_offset=off,
+        )
+
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(state_spec, P(), plan_spec),
+        out_specs=state_spec,
+        check_vma=False,
+    )(state, seed, plan)
+
+
+def fused_chunk_sharded(
+    state: Any,
+    seed: jnp.ndarray,
+    plan: FaultPlan,
+    cfg: FaultConfig,
+    n_ticks: int,
+    apply_fn: Callable,
+    mask_fn: Callable,
+    mesh,
+    block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> Any:
+    """Multi-chip fused engine: one fused kernel per shard under shard_map.
+
+    Instances are independent, so the mapped body needs no collectives; each
+    shard's kernel gets its global block offset (``axis_index * blocks per
+    shard``) so every block on every chip draws a distinct counter-PRNG
+    stream — a sharded run equals an unsharded run at the same block size,
+    shard-for-shard (tests/test_fused.py).  ``state``/``plan`` must already
+    be sharded over the mesh's ``instances`` axis (``parallel.mesh``).
+
+    The implementation is a module-level jit (all bindings static), so a
+    campaign's per-chunk calls hit the compile cache and donate the state.
+    """
+    n_inst = jax.tree.leaves(state)[0].shape[-1]
+    local = n_inst // int(mesh.devices.size)
+    block = min(block, local)
+    if local % block:
+        raise ValueError(f"local n_inst={local} not divisible by block={block}")
+    return _sharded_impl(
+        state, jnp.asarray(seed, jnp.int32), plan,
+        cfg=cfg, n_ticks=n_ticks, apply_fn=apply_fn, mask_fn=mask_fn,
+        mesh=mesh, block=block, blocks_per_shard=local // block,
+        interpret=interpret,
+    )
+
+
 # ---- Per-protocol bindings -------------------------------------------------
 
 
-def fused_paxos_chunk(
-    state, seed, plan, cfg, n_ticks, block: int = DEFAULT_BLOCK,
-    interpret: bool = False,
-):
-    """Single-decree Paxos on the fused engine."""
-    from paxos_tpu.protocols.paxos import apply_tick, counter_masks
+def fused_fns(protocol: str):
+    """(apply_fn, mask_fn, default_block) for a protocol — the ONE place a
+    protocol is bound to the fused engine (both the per-protocol wrappers in
+    ``FUSED_CHUNKS`` and the sharded CLI path read from here)."""
+    if protocol == "paxos":
+        from paxos_tpu.protocols.paxos import apply_tick, counter_masks
 
-    return fused_chunk(
-        state, seed, plan, cfg, n_ticks, apply_tick, counter_masks,
-        block=block, interpret=interpret,
-    )
+        return apply_tick, counter_masks, DEFAULT_BLOCK
+    if protocol == "fastpaxos":
+        from paxos_tpu.protocols.fastpaxos import apply_tick_fast
+        from paxos_tpu.protocols.paxos import counter_masks
 
+        return apply_tick_fast, counter_masks, DEFAULT_BLOCK
+    if protocol == "raftcore":
+        from paxos_tpu.protocols.paxos import counter_masks
+        from paxos_tpu.protocols.raftcore import apply_tick_raft
 
-def fused_fastpaxos_chunk(
-    state, seed, plan, cfg, n_ticks, block: int = DEFAULT_BLOCK,
-    interpret: bool = False,
-):
-    """Fast Paxos on the fused engine (shares paxos mask shapes)."""
-    from paxos_tpu.protocols.fastpaxos import apply_tick_fast
-    from paxos_tpu.protocols.paxos import counter_masks
+        return apply_tick_raft, counter_masks, DEFAULT_BLOCK
+    if protocol == "multipaxos":
+        from paxos_tpu.protocols.multipaxos import apply_tick_mp, mp_counter_masks
 
-    return fused_chunk(
-        state, seed, plan, cfg, n_ticks, apply_tick_fast, counter_masks,
-        block=block, interpret=interpret,
-    )
+        return apply_tick_mp, mp_counter_masks, 256
+    raise ValueError(f"unknown protocol: {protocol!r}")
 
 
-def fused_raftcore_chunk(
-    state, seed, plan, cfg, n_ticks, block: int = DEFAULT_BLOCK,
-    interpret: bool = False,
-):
-    """Raft-core on the fused engine (shares paxos mask shapes)."""
-    from paxos_tpu.protocols.paxos import counter_masks
-    from paxos_tpu.protocols.raftcore import apply_tick_raft
+def _make_chunk(protocol: str) -> Callable:
+    def chunk(state, seed, plan, cfg, n_ticks, block=None, interpret=False):
+        apply_fn, mask_fn, default_block = fused_fns(protocol)
+        return fused_chunk(
+            state, seed, plan, cfg, n_ticks, apply_fn, mask_fn,
+            block=default_block if block is None else block,
+            interpret=interpret,
+        )
 
-    return fused_chunk(
-        state, seed, plan, cfg, n_ticks, apply_tick_raft, counter_masks,
-        block=block, interpret=interpret,
-    )
-
-
-def fused_multipaxos_chunk(
-    state, seed, plan, cfg, n_ticks, block: int = 256,
-    interpret: bool = False,
-):
-    """Multi-Paxos log replication on the fused engine.
-
-    Per-instance state is ~5x single-decree (logs + full-log promise
-    payloads), so the default block is smaller to fit VMEM.
-    """
-    from paxos_tpu.protocols.multipaxos import apply_tick_mp, mp_counter_masks
-
-    return fused_chunk(
-        state, seed, plan, cfg, n_ticks, apply_tick_mp, mp_counter_masks,
-        block=block, interpret=interpret,
-    )
+    chunk.__name__ = f"fused_{protocol}_chunk"
+    chunk.__doc__ = f"{protocol} on the fused engine (binding: fused_fns)."
+    return chunk
 
 
 FUSED_CHUNKS = {
-    "paxos": fused_paxos_chunk,
-    "fastpaxos": fused_fastpaxos_chunk,
-    "raftcore": fused_raftcore_chunk,
-    "multipaxos": fused_multipaxos_chunk,
+    p: _make_chunk(p) for p in ("paxos", "fastpaxos", "raftcore", "multipaxos")
 }
+fused_paxos_chunk = FUSED_CHUNKS["paxos"]
+fused_fastpaxos_chunk = FUSED_CHUNKS["fastpaxos"]
+fused_raftcore_chunk = FUSED_CHUNKS["raftcore"]
+fused_multipaxos_chunk = FUSED_CHUNKS["multipaxos"]

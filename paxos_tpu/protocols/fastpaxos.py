@@ -205,7 +205,6 @@ def apply_tick_fast(
     # best_bal > 0 downstream, matching argmax's pick-0 behavior.
     from paxos_tpu.check.safety import first_true
 
-    vids = jnp.arange(n_prop, dtype=jnp.int32)[None, :, None]  # (1, V, 1)
     pick_fast = (
         jnp.where(first_true(choosable, axis=1), vids, 0).sum(axis=1)
         + VALUE_BASE

@@ -103,6 +103,38 @@ def test_pallas_lowering_bitexact_all_protocols():
         assert int(sp.tick) == 32, protocol
 
 
+def test_fused_sharded_matches_unsharded():
+    """shard_map'd fused engine == single-device fused at the same block."""
+    from paxos_tpu.kernels.fused_tick import fused_chunk_sharded
+    from paxos_tpu.parallel.mesh import make_mesh, shard_pytree
+    from paxos_tpu.protocols.paxos import apply_tick, counter_masks
+
+    devices = jax.devices()[:4]
+    mesh = make_mesh(devices)
+    cfg = config2_dueling_drop(n_inst=64, seed=2)
+    plan = init_plan(cfg)
+
+    single = fused_paxos_chunk(
+        init_state(cfg), jnp.int32(2), plan, cfg.fault, 24,
+        block=16, interpret=True,
+    )
+    sharded = fused_chunk_sharded(
+        shard_pytree(init_state(cfg), mesh, cfg.n_inst),
+        jnp.int32(2),
+        shard_pytree(plan, mesh, cfg.n_inst),
+        cfg.fault,
+        24,
+        apply_tick,
+        counter_masks,
+        mesh,
+        block=16,
+        interpret=True,
+    )
+    assert len(single.acceptor.promised.sharding.device_set) == 1
+    assert len(sharded.acceptor.promised.sharding.device_set) == 4
+    assert _trees_equal(single, jax.device_get(sharded)) == []
+
+
 def test_fused_stream_chunk_split_invariant():
     """Seeds derive from (seed, tick, block): 2x24 ticks == 1x48 ticks."""
     cfg = config2_dueling_drop(n_inst=256, seed=9)
