@@ -27,3 +27,25 @@ the reference mount was empty at survey time; provenance labels per §0):
 __version__ = "0.1.0"
 
 from paxos_tpu.core import ballot  # noqa: F401
+
+
+def __getattr__(name):
+    """Lazy top-level API: ``paxos_tpu.run`` / ``soak`` / ``shrink`` /
+    ``SimConfig`` without paying the harness import at package import."""
+    if name == "run":
+        from paxos_tpu.harness.run import run
+
+        return run
+    if name == "soak":
+        from paxos_tpu.harness.soak import soak
+
+        return soak
+    if name == "shrink":
+        from paxos_tpu.harness.shrink import shrink
+
+        return shrink
+    if name == "SimConfig":
+        from paxos_tpu.harness.config import SimConfig
+
+        return SimConfig
+    raise AttributeError(f"module 'paxos_tpu' has no attribute {name!r}")

@@ -61,11 +61,17 @@ class FaultConfig:
     # Proposer timing
     timeout: int = 10  # ticks in a phase before retrying with higher ballot
     backoff_max: int = 8  # retry backoff ~ U[0, backoff_max) extra ticks
-    # Flexible Paxos (protocols/paxos only): phase-1 / phase-2 quorum sizes.
-    # 0 means the classic majority.  Safe iff q1 + q2 > n_acc — running an
-    # unsafe pair is a supported bug-injection mode the checker must catch.
+    # Flexible Paxos (protocols/paxos + fastpaxos): phase-1 / phase-2 quorum
+    # sizes.  0 means the classic majority.  Safe iff q1 + q2 > n_acc —
+    # running an unsafe pair is a supported bug-injection mode the checker
+    # must catch.
     q1: int = 0
     q2: int = 0
+    # Fast Flexible Paxos (protocols/fastpaxos): fast-round quorum size.
+    # 0 means the classic ceil(3n/4).  Safe iff ALSO q1 + 2*q_fast > 2*n_acc
+    # (a phase-1 quorum must see a majority of any two fast quorums'
+    # intersection); unsafe triples are bug-injection modes.
+    q_fast: int = 0
     # Multi-Paxos leader lease (ticks without chosen-count progress before
     # followers suspect the leader / a leader demotes itself)
     lease_len: int = 24

@@ -32,6 +32,10 @@ CONFIGS = {
     # quorum pairs; the unsafe one exists to prove the checker catches it.
     "flex-safe": lambda **kw: config_mod.config_flex(4, 2, **kw),
     "flex-unsafe": lambda **kw: config_mod.config_flex(2, 2, **kw),
+    # Fast Flexible Paxos (arXiv:2008.02671): classic q1/q2 + fast quorum.
+    # Safe: 4+2>5 and 4+2*4>10.  Unsafe: q1=2 with q_fast=3 (2+6 <= 10).
+    "ffp-safe": lambda **kw: config_mod.config_ffp(4, 2, 4, **kw),
+    "ffp-unsafe": lambda **kw: config_mod.config_ffp(2, 2, 3, **kw),
 }
 
 
@@ -79,6 +83,18 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--ticks", type=int, default=1024, help="max ticks per protocol")
     s.add_argument("--chunk", type=int, default=64)
     s.add_argument("--log", default=None, help="JSONL metrics path")
+
+    so = sub.add_parser(
+        "soak",
+        help="rotate seeds until N instance-rounds accumulate; tally violations",
+    )
+    so.add_argument("--config", choices=sorted(CONFIGS), default="config2")
+    so.add_argument("--engine", choices=["xla", "fused"], default="fused")
+    so.add_argument("--n-inst", type=int, default=None)
+    so.add_argument("--seed", type=int, default=0)
+    so.add_argument("--target-rounds", type=float, default=1e9)
+    so.add_argument("--ticks-per-seed", type=int, default=256)
+    so.add_argument("--chunk", type=int, default=64)
 
     k = sub.add_parser(
         "shrink",
@@ -237,6 +253,33 @@ def cmd_sweep(args: argparse.Namespace) -> int:
     return 0 if worst == 0 else 2
 
 
+def cmd_soak(args: argparse.Namespace) -> int:
+    """Accumulate instance-rounds across rotating seeds; exit 2 on violations."""
+    import jax
+
+    from paxos_tpu.harness.soak import soak
+
+    if args.engine == "fused" and jax.devices()[0].platform != "tpu":
+        print("error: --engine fused needs a TPU; use --engine xla",
+              file=sys.stderr)
+        return 1
+    kw = {"seed": args.seed}
+    if args.n_inst:
+        kw["n_inst"] = args.n_inst
+    cfg = CONFIGS[args.config](**kw)
+    report = soak(
+        cfg,
+        target_rounds=args.target_rounds,
+        ticks_per_seed=args.ticks_per_seed,
+        chunk=args.chunk,
+        engine=args.engine,
+        log=lambda s: print(f"# {s}", file=sys.stderr),
+    )
+    report["config"] = args.config
+    print(json.dumps(report))
+    return 0 if report["violations"] == 0 else 2
+
+
 def cmd_shrink(args: argparse.Namespace) -> int:
     """Minimize a failing fault schedule and print the repro as JSON."""
     from paxos_tpu.harness.shrink import replay, shrink
@@ -276,6 +319,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_run(args)
     if args.cmd == "sweep":
         return cmd_sweep(args)
+    if args.cmd == "soak":
+        return cmd_soak(args)
     if args.cmd == "shrink":
         return cmd_shrink(args)
     return 1

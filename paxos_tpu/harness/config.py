@@ -128,6 +128,27 @@ def config_flex(
     )
 
 
+def config_ffp(
+    q1: int, q2: int, q_fast: int, n_inst: int = 16_384, seed: int = 0
+) -> SimConfig:
+    """Fast Flexible Paxos: explicit classic + fast quorums over 5 acceptors.
+
+    Safe iff ``q1 + q2 > 5`` and ``q1 + 2*q_fast > 10`` (arXiv:2008.02671's
+    relaxed intersection conditions); an unsafe triple is a supported
+    bug-injection mode that must light up the safety checker.
+    """
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        seed=seed,
+        protocol="fastpaxos",
+        fault=FaultConfig(
+            p_idle=0.2, p_hold=0.2, p_drop=0.1, q1=q1, q2=q2, q_fast=q_fast
+        ),
+    )
+
+
 def config5_sweep(n_inst: int = 65_536, seed: int = 0) -> tuple[SimConfig, ...]:
     """Config 5: Paxos vs Fast-Paxos vs Raft-core under identical fault masks."""
     fault = FaultConfig(p_drop=0.1, p_idle=0.2, p_hold=0.2)

@@ -11,17 +11,22 @@ Fast Paxos (Lamport, "Fast Paxos", 2006) variant:
   Paxos' idempotent re-accept), and a value needs a **fast quorum**
   ``ceil(3n/4)`` (``kernels.quorum.fast_quorum``) to be chosen.
 - **Collision recovery**: a proposer that times out starts a classic round
-  (>= 1) with majority quorums.  Phase-1 value selection implements the
-  coordinated-recovery rule: value ``v`` *could have been chosen* at the
-  highest reported ballot ``k`` iff the acceptors that reported voting ``v``
-  at ``k`` plus the acceptors not yet heard from could contain a fast
-  quorum — ``count(v) + (n - heard) >= fast_quorum``.  If some value is
-  choosable the proposer must adopt it (with a fast quorum at ceil(3n/4)
-  and a majority phase-1 quorum, at most one value can be choosable);
-  otherwise nothing was or can be chosen at ``k`` and its own value is safe.
+  (>= 1).  Phase-1 value selection implements the coordinated-recovery
+  rule: value ``v`` *could have been chosen* at the highest reported ballot
+  ``k`` iff the acceptors that reported voting ``v`` at ``k`` plus the
+  acceptors not yet heard from could contain a fast quorum —
+  ``count(v) + (n - heard) >= fast_quorum``.  If some value is choosable
+  the proposer must adopt it; otherwise nothing was or can be chosen at
+  ``k`` and its own value is safe.
+- **Fast Flexible Paxos quorums** (arXiv:2008.02671): the classic phase-1 /
+  phase-2 quorums ``q1``/``q2`` and the fast quorum ``q_fast`` are
+  configurable (``FaultConfig``; 0 = the classic majority / ceil(3n/4)
+  defaults).  At most one value is choosable — so recovery is safe — iff
+  ``q1 + q2 > n`` AND ``q1 + 2*q_fast > 2n``; unsafe triples are supported
+  bug-injection modes the checker must catch (tests/test_fastpaxos.py).
 
-The learner applies the per-round-kind threshold (fast quorum for round 0,
-majority for classic rounds) via ``learner_observe(..., fast_quorum=...)``.
+The learner applies the per-round-kind threshold (``q_fast`` for round 0,
+``q2`` for classic rounds) via ``learner_observe(..., fast_quorum=...)``.
 """
 
 from __future__ import annotations
@@ -53,7 +58,14 @@ def apply_tick_fast(
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     quorum = majority(n_acc)
-    fquorum = fast_quorum(n_acc)
+    # Fast Flexible Paxos: explicit classic (q1 phase-1, q2 phase-2) and
+    # fast (q_fast) quorum sizes; 0 = the classic defaults (majority /
+    # ceil(3n/4)).  Safe iff q1 + q2 > n and q1 + 2*q_fast > 2n; unsafe
+    # triples are bug-injection modes the checker must catch
+    # (tests/test_fastpaxos.py).
+    q1 = cfg.q1 or quorum
+    q2 = cfg.q2 or quorum
+    fquorum = cfg.q_fast or fast_quorum(n_acc)
 
     acc = state.acceptor
     alive = plan.alive(state.tick)  # (A, I)
@@ -133,7 +145,7 @@ def apply_tick_fast(
     # ---- Learner / safety checker (fast-quorum-aware thresholds) ----
     with jax.named_scope("learner_check"):
         learner = learner_observe(
-            state.learner, ok_acc, msg_bal, msg_val, state.tick, quorum,
+            state.learner, ok_acc, msg_bal, msg_val, state.tick, q2,
             fast_quorum=fquorum,
         )
         inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
@@ -188,8 +200,8 @@ def apply_tick_fast(
 
     # Phase transitions.
     fast_done = (prop.phase == FAST) & (popcount(heard) >= fquorum)
-    p1_done = (prop.phase == P1) & quorum_reached(heard, quorum)
-    p2_done = (prop.phase == P2) & quorum_reached(heard, quorum)
+    p1_done = (prop.phase == P1) & quorum_reached(heard, q1)
+    p2_done = (prop.phase == P2) & quorum_reached(heard, q2)
 
     # Recovery value, by the round kind of the highest reported ballot k:
     # - k classic (round >= 1): classic Paxos — adopt k's value (unique:

@@ -101,3 +101,27 @@ def test_deterministic_replay():
     r2, s2 = run(cfg, total_ticks=200, return_state=True)
     assert r1 == r2
     assert bool(jnp.array_equal(s1.learner.chosen_val, s2.learner.chosen_val))
+
+
+def test_ffp_safe_quorums_clean():
+    """Fast Flexible Paxos (arXiv:2008.02671): q1=4, q2=2, q_fast=4 over 5
+    acceptors satisfies q1+q2>n and q1+2*q_fast>2n => safe under chaos."""
+    from paxos_tpu.harness.config import config_ffp
+
+    report = run(
+        config_ffp(4, 2, 4, n_inst=4096, seed=1),
+        until_all_chosen=True,
+        max_ticks=512,
+    )
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["chosen_frac"] == 1.0
+
+
+def test_ffp_unsafe_quorums_trip_checker():
+    """q1=2, q_fast=3: 2 + 2*3 <= 10, so a recovery quorum can miss a
+    fast-chosen value and choose another — the checker MUST catch it."""
+    from paxos_tpu.harness.config import config_ffp
+
+    report = run(config_ffp(2, 2, 3, n_inst=8192, seed=1), total_ticks=256)
+    assert report["violations"] > 0

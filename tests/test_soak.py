@@ -1,0 +1,14 @@
+"""Soak harness: rotating-seed accumulation and clean reporting."""
+
+from paxos_tpu.harness.config import config2_dueling_drop
+from paxos_tpu.harness.soak import soak
+
+
+def test_soak_accumulates_rotating_seeds():
+    cfg = config2_dueling_drop(n_inst=512, seed=7)
+    report = soak(cfg, target_rounds=3 * 512 * 64, ticks_per_seed=64, chunk=32)
+    assert report["seeds"] == 3  # ceil(target / (n_inst * ticks_per_seed))
+    assert report["rounds"] == 3 * 512 * 64
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["rounds_per_sec"] > 0
