@@ -1,0 +1,270 @@
+"""Bounded exhaustive model checking of single-decree Paxos.
+
+The fuzzer explores interleavings statistically at millions/sec; this module
+explores them EXHAUSTIVELY for small bounded instances (the Spin/TLA recipe
+— cf. "Model Checking Paxos in Spin", arXiv:1408.5962 in PAPERS.md): every
+reachable state of an asynchronous schedule space is enumerated and the
+agreement/validity invariants are asserted in each one.
+
+Model: the same protocol the batched kernels implement (and the same the
+Python golden model runs), as a pure transition system over immutable
+tuples:
+
+- **State** = (acceptors, proposers, network multiset, voters table).
+- **Actions** = deliver any in-flight message (consuming it), or time out a
+  live proposer onto its next ballot (bounded by ``max_round``).  Message
+  LOSS needs no separate action for safety: a lost message is one that is
+  never selected before the run ends, and every such prefix is explored.
+  Duplication is covered by the fuzzer (idempotence known-answer tests);
+  modeling it here would only blow up the bounded space.
+
+Because every action either consumes a message or spends a bounded timeout,
+the schedule space is a finite DAG; memoized DFS visits each reachable
+state once.  A violation raises with the full action trace — a
+counterexample schedule, Spin-style.
+
+This is the third leg of the verification tripod (SURVEY.md §5.2):
+randomized at scale (the TPU fuzzer), differential (golden model + native
+C++ oracle), exhaustive at small bounds (this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+# Message kinds
+PREPARE, PROMISE, ACCEPT, ACCEPTED = 0, 1, 2, 3
+# Proposer phases
+P1, P2, DONE = 0, 1, 2
+
+
+def make_ballot(rnd: int, pid: int, max_props: int = 8) -> int:
+    return rnd * max_props + pid + 1
+
+
+# A message: (kind, src, dst, bal, v1, v2).  src/dst are role-local indices
+# (proposer index for requests' src, acceptor index for replies' src).
+Msg = tuple[int, int, int, int, int, int]
+# An acceptor: (promised, acc_bal, acc_val).
+Acc = tuple[int, int, int]
+# A proposer: (phase, rnd, heard_bitmask, best_bal, best_val, prop_val,
+#              decided_val).
+Prop = tuple[int, int, int, int, int, int, int]
+# Full state: (accs, props, net, voters) with net a sorted tuple (multiset)
+# and voters a sorted tuple of ((bal, val), acceptor_bitmask).
+State = tuple[tuple[Acc, ...], tuple[Prop, ...], tuple[Msg, ...], tuple]
+
+
+@dataclasses.dataclass
+class CheckResult:
+    states: int  # distinct states visited
+    decided_states: int  # states where some proposer reached DONE
+    chosen_values: set  # every value ever chosen anywhere in the space
+    counterexample: Optional[list]  # action trace to a violation (None = ok)
+
+
+def _init_state(n_prop: int, n_acc: int) -> State:
+    accs = tuple((0, 0, 0) for _ in range(n_acc))
+    props = tuple(
+        (P1, 0, 0, 0, 0, 0, 0) for _ in range(n_prop)
+    )
+    net = tuple(
+        sorted(
+            (PREPARE, p, a, make_ballot(0, p), 0, 0)
+            for p in range(n_prop)
+            for a in range(n_acc)
+        )
+    )
+    return (accs, props, net, ())
+
+
+def _own_val(pid: int) -> int:
+    return 100 + pid
+
+
+def _chosen(voters: tuple, quorum: int) -> set:
+    return {bv[1] for bv, mask in voters if bin(mask).count("1") >= quorum}
+
+
+def _record_vote(voters: tuple, a: int, bal: int, val: int) -> tuple:
+    d = dict(voters)
+    d[(bal, val)] = d.get((bal, val), 0) | (1 << a)
+    return tuple(sorted(d.items()))
+
+
+def _deliver(
+    state: State, i: int, quorum: int, n_acc: int, unsafe_accept: bool = False
+) -> State:
+    """Deliver (and consume) in-flight message ``i``; pure.
+
+    ``unsafe_accept=True`` injects the classic bug (accept below the
+    promise) — the checker must then find a counterexample schedule.
+    """
+    accs, props, net, voters = state
+    kind, src, dst, bal, v1, v2 = net[i]
+    net = net[:i] + net[i + 1 :]
+    out: list[Msg] = []
+
+    if kind == PREPARE:
+        promised, abal, aval = accs[dst]
+        if bal > promised:
+            accs = accs[:dst] + ((bal, abal, aval),) + accs[dst + 1 :]
+            out.append((PROMISE, dst, src, bal, abal, aval))
+    elif kind == ACCEPT:
+        promised, abal, aval = accs[dst]
+        if unsafe_accept or bal >= promised:
+            accs = accs[:dst] + ((bal, bal, v1),) + accs[dst + 1 :]
+            voters = _record_vote(voters, dst, bal, v1)
+            out.append((ACCEPTED, dst, src, bal, v1, 0))
+    elif kind == PROMISE:
+        phase, rnd, heard, bb, bv, pv, dec = props[dst]
+        if phase == P1 and bal == make_ballot(rnd, dst):
+            heard |= 1 << src
+            if v1 > bb:
+                bb, bv = v1, v2
+            if bin(heard).count("1") >= quorum:
+                pv = bv if bb > 0 else _own_val(dst)
+                phase, heard = P2, 0
+                out.extend(
+                    (ACCEPT, dst, a, bal, pv, 0) for a in range(n_acc)
+                )
+            props = props[:dst] + ((phase, rnd, heard, bb, bv, pv, dec),) + props[dst + 1 :]
+    elif kind == ACCEPTED:
+        phase, rnd, heard, bb, bv, pv, dec = props[dst]
+        if phase == P2 and bal == make_ballot(rnd, dst):
+            heard |= 1 << src
+            if bin(heard).count("1") >= quorum:
+                phase, dec = DONE, pv
+            props = props[:dst] + ((phase, rnd, heard, bb, bv, pv, dec),) + props[dst + 1 :]
+
+    return (accs, props, tuple(sorted(net + tuple(out))), voters)
+
+
+def _timeout(state: State, p: int, n_acc: int) -> State:
+    """Proposer ``p`` abandons its ballot and retries one round higher."""
+    accs, props, net, voters = state
+    phase, rnd, heard, bb, bv, pv, dec = props[p]
+    rnd += 1
+    bal = make_ballot(rnd, p)
+    props = props[:p] + ((P1, rnd, 0, 0, 0, 0, dec),) + props[p + 1 :]
+    out = tuple((PREPARE, p, a, bal, 0, 0) for a in range(n_acc))
+    return (accs, props, tuple(sorted(net + out)), voters)
+
+
+def _gc(state: State, unsafe_accept: bool = False) -> State:
+    """Drop in-flight messages whose delivery is provably a no-op.
+
+    Sound state-space reduction: delivering such a message changes nothing
+    but the network multiset, so its removal commutes with every other
+    action and preserves the reachable set of (acceptor, proposer, voters)
+    configurations — while collapsing the dead-letter orderings that
+    otherwise dominate the bounded space.
+
+    - replies (PROMISE/ACCEPTED) to a proposer that is DONE, past phase 1
+      (for PROMISE), or on a different ballot (ballots only increase);
+    - PREPARE at or below the acceptor's promise, ACCEPT below it.
+    """
+    accs, props, net, voters = state
+    keep = []
+    for m in net:
+        kind, src, dst, bal, v1, v2 = m
+        if kind == PREPARE:
+            if bal <= accs[dst][0]:
+                continue
+        elif kind == ACCEPT:
+            # Under the injected accept-below-promise bug a stale ACCEPT is
+            # NOT a no-op — it is the bug — so it must stay deliverable.
+            if bal < accs[dst][0] and not unsafe_accept:
+                continue
+        else:
+            phase, rnd = props[dst][0], props[dst][1]
+            if phase == DONE or bal != make_ballot(rnd, dst):
+                continue
+            if kind == PROMISE and phase != P1:
+                continue
+            # ACCEPTED while still in P1 cannot exist for the CURRENT
+            # ballot (its phase 2 has not begun), so this only drops
+            # replies that can never be consumed.
+            if kind == ACCEPTED and phase != P2:
+                continue
+        keep.append(m)
+    return (accs, props, tuple(keep), voters)
+
+
+def check_exhaustive(
+    n_prop: int = 2,
+    n_acc: int = 3,
+    max_round: "int | tuple[int, ...]" = 1,
+    max_states: int = 5_000_000,
+    unsafe_accept: bool = False,
+) -> CheckResult:
+    """Exhaustively explore every schedule; assert agreement + validity.
+
+    ``max_round`` bounds retries — an int applies to every proposer, a tuple
+    gives per-proposer bounds (asymmetric bounds keep the space tractable:
+    the killer interleavings need only ONE proposer to preempt the other).
+    Raises ``AssertionError`` with the counterexample trace on a violation;
+    ``RuntimeError`` if the bounded space exceeds ``max_states`` (tighten
+    the bounds).
+    """
+    if isinstance(max_round, int):
+        max_round = (max_round,) * n_prop
+    quorum = n_acc // 2 + 1
+    own_vals = {_own_val(p) for p in range(n_prop)}
+    init = _init_state(n_prop, n_acc)
+    # DFS with an explicit stack carrying the action trace lazily: store
+    # (state, trace) only until visited; traces are tuples shared by prefix.
+    stack: list[tuple[State, tuple]] = [(init, ())]
+    visited: set[State] = set()
+    decided_states = 0
+    chosen_all: set = set()
+
+    while stack:
+        state, trace = stack.pop()
+        if state in visited:
+            continue
+        visited.add(state)
+        if len(visited) > max_states:
+            raise RuntimeError(
+                f"state space exceeds max_states={max_states}; tighten bounds"
+            )
+
+        accs, props, net, voters = state
+        chosen = _chosen(voters, quorum)
+        chosen_all |= chosen
+        decided = {pr[6] for pr in props if pr[0] == DONE}
+        if decided:
+            decided_states += 1
+
+        # ---- Invariants, checked in EVERY reachable state ----
+        ok = (
+            len(chosen) <= 1  # agreement
+            and chosen <= own_vals  # validity
+            and decided <= chosen  # a decided proposer's value was chosen
+        )
+        if not ok:
+            raise AssertionError(
+                f"invariant violated: chosen={chosen} decided={decided} "
+                f"after trace={list(trace)}"
+            )
+
+        # ---- Successors (GC'd: dead-letter orderings collapse) ----
+        for i in range(len(net)):
+            stack.append((
+                _gc(_deliver(state, i, quorum, n_acc, unsafe_accept), unsafe_accept),
+                trace + (("d", net[i]),),
+            ))
+        for p in range(n_prop):
+            if props[p][0] != DONE and props[p][1] < max_round[p]:
+                stack.append((
+                    _gc(_timeout(state, p, n_acc), unsafe_accept),
+                    trace + (("t", p),),
+                ))
+
+    return CheckResult(
+        states=len(visited),
+        decided_states=decided_states,
+        chosen_values=chosen_all,
+        counterexample=None,
+    )

@@ -33,9 +33,10 @@ CONFIGS = {
     "flex-safe": lambda **kw: config_mod.config_flex(4, 2, **kw),
     "flex-unsafe": lambda **kw: config_mod.config_flex(2, 2, **kw),
     # Fast Flexible Paxos (arXiv:2008.02671): classic q1/q2 + fast quorum.
-    # Safe: 4+2>5 and 4+2*4>10.  Unsafe: q1=2 with q_fast=3 (2+6 <= 10).
+    # Safe: 4+2>5 and 4+2*4>10.  Unsafe: classically fine (3+3>5) but the
+    # fast condition fails (3+2*3 <= 10) — isolates the q_fast path.
     "ffp-safe": lambda **kw: config_mod.config_ffp(4, 2, 4, **kw),
-    "ffp-unsafe": lambda **kw: config_mod.config_ffp(2, 2, 3, **kw),
+    "ffp-unsafe": lambda **kw: config_mod.config_ffp(3, 3, 3, **kw),
 }
 
 

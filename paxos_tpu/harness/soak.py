@@ -42,12 +42,16 @@ def soak(
     violations = 0
     evictions = 0
     seeds = 0
+    violating_seeds: list[int] = []
     t0 = time.perf_counter()
     while rounds < target_rounds:
         scfg = dataclasses.replace(cfg, seed=cfg.seed + seeds)
         report = run(scfg, total_ticks=ticks_per_seed, chunk=chunk, engine=engine)
         violations += report["violations"]
         evictions += report["evictions"]
+        if report["violations"]:
+            # Reproducibility: these seeds feed straight into `shrink`.
+            violating_seeds.append(scfg.seed)
         rounds += scfg.n_inst * ticks_per_seed
         seeds += 1
         say(f"seed {scfg.seed}: {rounds:.3e} rounds, {violations} violations")
@@ -56,6 +60,7 @@ def soak(
         "metric": "soak",
         "rounds": rounds,
         "violations": violations,
+        "violating_seeds": violating_seeds,
         "evictions": evictions,
         "seeds": seeds,
         "ticks_per_seed": ticks_per_seed,

@@ -118,10 +118,12 @@ def test_ffp_safe_quorums_clean():
     assert report["chosen_frac"] == 1.0
 
 
-def test_ffp_unsafe_quorums_trip_checker():
-    """q1=2, q_fast=3: 2 + 2*3 <= 10, so a recovery quorum can miss a
-    fast-chosen value and choose another — the checker MUST catch it."""
+def test_ffp_unsafe_fast_quorum_trips_checker():
+    """q1=3, q2=3, q_fast=3: CLASSICALLY safe (3+3 > 5) but fast-unsafe
+    (3 + 2*3 <= 10) — a phase-1 quorum can miss a fast-chosen value and
+    choose another.  Violations here can only come from the q_fast path,
+    so this test fails if cfg.q_fast is ever silently ignored."""
     from paxos_tpu.harness.config import config_ffp
 
-    report = run(config_ffp(2, 2, 3, n_inst=8192, seed=1), total_ticks=256)
+    report = run(config_ffp(3, 3, 3, n_inst=8192, seed=1), total_ticks=256)
     assert report["violations"] > 0
