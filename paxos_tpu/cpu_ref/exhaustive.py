@@ -170,7 +170,10 @@ def _gc(state: State, unsafe_accept: bool = False) -> State:
     for m in net:
         kind, src, dst, bal, v1, v2 = m
         if kind == PREPARE:
-            if bal <= accs[dst][0]:
+            # The prune relies on promised-ballot monotonicity, which the
+            # injected accept-below-promise bug breaks (a stale ACCEPT can
+            # LOWER the promise, reviving this PREPARE) — keep it then.
+            if bal <= accs[dst][0] and not unsafe_accept:
                 continue
         elif kind == ACCEPT:
             # Under the injected accept-below-promise bug a stale ACCEPT is
@@ -208,8 +211,14 @@ def check_exhaustive(
     ``RuntimeError`` if the bounded space exceeds ``max_states`` (tighten
     the bounds).
     """
+    if n_prop > 8:
+        raise ValueError("n_prop > 8 collides packed ballots (make_ballot)")
     if isinstance(max_round, int):
         max_round = (max_round,) * n_prop
+    if len(max_round) != n_prop:
+        raise ValueError(
+            f"max_round has {len(max_round)} bounds for n_prop={n_prop}"
+        )
     quorum = n_acc // 2 + 1
     own_vals = {_own_val(p) for p in range(n_prop)}
     init = _init_state(n_prop, n_acc)

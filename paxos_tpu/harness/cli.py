@@ -106,6 +106,22 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--seed", type=int, default=0)
     k.add_argument("--ticks", type=int, default=512, help="violation search budget")
     k.add_argument("--chunk", type=int, default=32)
+
+    c = sub.add_parser(
+        "check",
+        help="bounded exhaustive model check: every schedule of a small instance",
+    )
+    c.add_argument("--n-prop", type=int, default=2)
+    c.add_argument("--n-acc", type=int, default=3)
+    c.add_argument(
+        "--max-round", type=int, nargs="+", default=[1],
+        help="retry bound; one value for all proposers or one per proposer",
+    )
+    c.add_argument("--max-states", type=int, default=5_000_000)
+    c.add_argument(
+        "--unsafe-accept", action="store_true",
+        help="inject the accept-below-promise bug (must find a counterexample)",
+    )
     return p
 
 
@@ -281,6 +297,34 @@ def cmd_soak(args: argparse.Namespace) -> int:
     return 0 if report["violations"] == 0 else 2
 
 
+def cmd_check(args: argparse.Namespace) -> int:
+    """Exhaustively model-check a bounded instance; print the space summary."""
+    from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
+
+    mr = args.max_round[0] if len(args.max_round) == 1 else tuple(args.max_round)
+    try:
+        r = check_exhaustive(
+            n_prop=args.n_prop,
+            n_acc=args.n_acc,
+            max_round=mr,
+            max_states=args.max_states,
+            unsafe_accept=args.unsafe_accept,
+        )
+    except AssertionError as e:
+        print(json.dumps({"ok": False, "counterexample": str(e)}))
+        return 2
+    except (RuntimeError, ValueError) as e:
+        print(json.dumps({"ok": False, "error": str(e)}))
+        return 3
+    print(json.dumps({
+        "ok": True,
+        "states": r.states,
+        "decided_states": r.decided_states,
+        "chosen_values": sorted(r.chosen_values),
+    }))
+    return 0
+
+
 def cmd_shrink(args: argparse.Namespace) -> int:
     """Minimize a failing fault schedule and print the repro as JSON."""
     from paxos_tpu.harness.shrink import replay, shrink
@@ -324,6 +368,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_soak(args)
     if args.cmd == "shrink":
         return cmd_shrink(args)
+    if args.cmd == "check":
+        return cmd_check(args)
     return 1
 
 

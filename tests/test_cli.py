@@ -53,3 +53,20 @@ def test_liveness_stats():
     hist = chosen_tick_histogram(lrn, n_bins=8, bin_width=8)
     assert int(hist.sum()) == 256
     assert not bool(stuck_mask(lrn, 64, state.tick).any())
+
+
+def test_cli_check_subcommand(capsys):
+    import json
+
+    from paxos_tpu.harness.cli import main
+
+    assert main(["--platform", "cpu", "check", "--max-round", "0"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] and out["states"] > 3_000
+
+    assert (
+        main(["--platform", "cpu", "check", "--max-round", "0", "--unsafe-accept"])
+        == 2
+    )
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert not out["ok"] and "invariant violated" in out["counterexample"]
