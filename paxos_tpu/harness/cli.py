@@ -102,6 +102,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="delta-debug a violating config's fault plan to a minimal repro",
     )
     k.add_argument("--config", choices=sorted(CONFIGS), default="config4")
+    k.add_argument(
+        "--engine",
+        choices=["xla", "fused"],
+        default="fused",
+        help="stream the violation was observed under; defaults to fused to "
+        "match soak's default (seeds from `soak` replay only under the "
+        "same engine's stream)",
+    )
+    k.add_argument(
+        "--block", type=int, default=None,
+        help="fused block size of the observing run, when it differed from "
+        "the protocol default (e.g. a sharded run clamped it)",
+    )
     k.add_argument("--n-inst", type=int, default=None)
     k.add_argument("--seed", type=int, default=0)
     k.add_argument("--ticks", type=int, default=512, help="violation search budget")
@@ -132,11 +145,9 @@ def cmd_run(args: argparse.Namespace) -> int:
     from paxos_tpu.harness import trace as trace_mod
     from paxos_tpu.harness.metrics import MetricsLog
     from paxos_tpu.harness.run import (
-        base_key,
-        get_step_fn,
         init_plan,
         init_state,
-        run_chunk,
+        make_advance,
         summarize,
     )
     from paxos_tpu.parallel.mesh import make_mesh, shard_pytree
@@ -168,9 +179,9 @@ def cmd_run(args: argparse.Namespace) -> int:
                   "other backends); use --engine xla",
                   file=sys.stderr)
             return 1
-        import jax.numpy as jnp
-
         if args.shard:
+            import jax.numpy as jnp
+
             from paxos_tpu.kernels.fused_tick import fused_chunk_sharded, fused_fns
 
             apply_fn, mask_fn, blk = fused_fns(cfg.protocol)
@@ -182,19 +193,9 @@ def cmd_run(args: argparse.Namespace) -> int:
                 )
 
         else:
-            from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
-
-            fused = FUSED_CHUNKS[cfg.protocol]
-
-            def advance(s, n):
-                return fused(s, jnp.int32(cfg.seed), plan, cfg.fault, n)
-
+            advance = make_advance(cfg, plan, "fused")
     else:
-        step_fn = get_step_fn(cfg.protocol)
-        key = base_key(cfg)
-
-        def advance(s, n):
-            return run_chunk(s, key, plan, cfg.fault, n, step_fn)
+        advance = make_advance(cfg, plan, "xla")
 
     log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
              n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)
@@ -334,7 +335,8 @@ def cmd_shrink(args: argparse.Namespace) -> int:
         kw["n_inst"] = args.n_inst
     cfg = CONFIGS[args.config](**kw)
     result = shrink(
-        cfg, max_ticks=args.ticks, chunk=args.chunk,
+        cfg, max_ticks=args.ticks, chunk=args.chunk, engine=args.engine,
+        block=args.block,
         log=lambda s: print(f"# {s}", file=sys.stderr),
     )
     if result is None:

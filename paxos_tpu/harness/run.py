@@ -93,6 +93,51 @@ def run_chunk(
     return state
 
 
+def make_advance(
+    cfg: SimConfig,
+    plan: FaultPlan,
+    engine: str = "xla",
+    block: "int | None" = None,
+    interpret: "bool | None" = None,
+) -> Callable:
+    """Build ``advance(state, n_ticks)`` for an engine — THE engine dispatch.
+
+    Every unsharded execution path (:func:`run`, the shrinker's replay, the
+    CLI) goes through here so the (seed, stream) wiring cannot desynchronize
+    between the engine that observes a violation and the one that replays it.
+
+    ``"xla"`` scans the protocol step with ``jax.random`` masks; ``"fused"``
+    runs whole chunks in one Pallas kernel with counter-PRNG masks
+    (``kernels/fused_tick``).  ``block`` overrides the fused block size
+    (stream-relevant: streams are keyed per (seed, tick, block)).
+    ``interpret=None`` auto-enables the Pallas TPU interpreter off-TPU,
+    which replays the fused stream bit-identically (tests/test_fused.py).
+    """
+    if engine == "fused":
+        from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+
+        fused = FUSED_CHUNKS[cfg.protocol]
+        if interpret is None:
+            interpret = jax.devices()[0].platform != "tpu"
+
+        def advance(state, n):
+            return fused(
+                state, jnp.int32(cfg.seed), plan, cfg.fault, n,
+                block=block, interpret=interpret,
+            )
+
+        return advance
+    if engine == "xla":
+        step_fn = get_step_fn(cfg.protocol)
+        key = base_key(cfg)
+
+        def advance(state, n):
+            return run_chunk(state, key, plan, cfg.fault, n, step_fn)
+
+        return advance
+    raise ValueError(f"unknown engine: {engine!r}")
+
+
 def summarize(state: PaxosState) -> dict[str, Any]:
     """Reduce on-device state to a host-side scalar report.
 
@@ -148,31 +193,15 @@ def run(
     instance's learner chose a value (or ``max_ticks``), the batch analog of
     the reference master's "wait for the decision, then print it".
 
-    ``engine`` selects the execution path: ``"xla"`` scans the step function
-    (any protocol, any platform); ``"fused"`` runs the whole chunk inside
-    one Pallas kernel with state resident in VMEM (any protocol, TPU;
-    ~3-4x faster — see ``kernels/fused_tick``).
+    ``engine`` selects the execution path via :func:`make_advance`: ``"xla"``
+    scans the step function (any protocol, any platform); ``"fused"`` runs
+    the whole chunk inside one Pallas kernel with state resident in VMEM
+    (any protocol; ~3-4x faster on TPU, interpreted — slowly, bit-
+    identically — elsewhere; see ``kernels/fused_tick``).
     """
-    if engine == "fused":
-        from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
-
-        fused = FUSED_CHUNKS[cfg.protocol]
-
-        def advance(state, n):
-            return fused(state, jnp.int32(cfg.seed), plan, cfg.fault, n)
-
-    elif engine == "xla":
-        step_fn = get_step_fn(cfg.protocol)
-        key = base_key(cfg)
-
-        def advance(state, n):
-            return run_chunk(state, key, plan, cfg.fault, n, step_fn)
-
-    else:
-        raise ValueError(f"unknown engine: {engine!r}")
-
     state = init_state(cfg)
     plan = init_plan(cfg)
+    advance = make_advance(cfg, plan, engine)
 
     budget = max_ticks if until_all_chosen else total_ticks
     done = 0

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 
 from paxos_tpu.faults.injector import NEVER, FaultPlan
 from paxos_tpu.harness.config import SimConfig
-from paxos_tpu.harness.run import base_key, get_step_fn, init_plan, init_state, run_chunk
+from paxos_tpu.harness.run import init_plan, init_state, make_advance
 
 
 @dataclasses.dataclass
@@ -46,6 +46,8 @@ class ShrinkResult:
     atoms: list[str]  # surviving fault atoms, e.g. "equiv[acceptor=2]"
     removed: list[str]  # atoms removed while the violation persisted
     plan: FaultPlan  # minimized full-width plan (benign outside the lane)
+    engine: str = "xla"  # the stream the repro is valid under
+    block: Optional[int] = None  # fused block size (None = protocol default)
 
     def to_json(self) -> dict[str, Any]:
         return {
@@ -53,18 +55,37 @@ class ShrinkResult:
             "ticks": self.ticks,
             "atoms": self.atoms,
             "removed": self.removed,
+            "engine": self.engine,
+            "block": self.block,
         }
 
 
-def _violations_at(cfg: SimConfig, plan: FaultPlan, ticks: int, chunk: int):
-    """(I,) violations vector after ``ticks`` (fresh state, same key stream)."""
-    step = get_step_fn(cfg.protocol)
+def _violations_at(
+    cfg: SimConfig,
+    plan: FaultPlan,
+    ticks: int,
+    chunk: int,
+    engine: str = "xla",
+    block: Optional[int] = None,
+):
+    """(I,) violations vector after ``ticks`` (fresh state, same key stream).
+
+    ``engine`` must match the engine that OBSERVED the violation: the XLA
+    engine draws masks from the jax.random stream while the fused engine
+    draws from the counter PRNG keyed by (seed, tick, block), so the two
+    explore different schedules for the same seed.  A fused-soak seed only
+    reproduces under the fused stream at the SAME block size — pass
+    ``block`` when the observing run's block differed from the protocol
+    default (e.g. a sharded run whose per-shard block was clamped).
+    Off-TPU the fused stream is replayed under the Pallas TPU interpreter,
+    which is bit-identical to the compiled kernel (tests/test_fused.py).
+    """
     state = init_state(cfg)
-    key = base_key(cfg)
+    advance = make_advance(cfg, plan, engine, block=block)
     done = 0
     while done < ticks:
         n = min(chunk, ticks - done)
-        state = run_chunk(state, key, plan, cfg.fault, n, step)
+        state = advance(state, n)
         done += n
     return jax.device_get(state.learner.violations)
 
@@ -138,12 +159,21 @@ def shrink(
     max_ticks: int = 512,
     chunk: int = 32,
     log: Optional[Callable[[str], None]] = None,
+    engine: str = "xla",
+    block: Optional[int] = None,
 ) -> Optional[ShrinkResult]:
-    """Minimize ``cfg``'s sampled fault plan; None if no violation in budget."""
+    """Minimize ``cfg``'s sampled fault plan; None if no violation in budget.
+
+    Pass the ``engine`` under which the violation was observed (soak defaults
+    to fused) — the two engines draw different random streams, so replaying a
+    fused seed under the XLA stream explores a different schedule and may not
+    reproduce — and ``block`` if the observing fused run used a non-default
+    block size (see ``_violations_at``).
+    """
     say = log or (lambda s: None)
     plan = init_plan(cfg)
 
-    viol = _violations_at(cfg, plan, max_ticks, chunk)
+    viol = _violations_at(cfg, plan, max_ticks, chunk, engine, block)
     lanes = viol.nonzero()[0]
     if lanes.size == 0:
         return None
@@ -151,7 +181,7 @@ def shrink(
     say(f"violation in {lanes.size} lanes; shrinking lane {lane}")
 
     def fails(p: FaultPlan, ticks: int) -> bool:
-        return bool(_violations_at(cfg, p, ticks, chunk)[lane] > 0)
+        return bool(_violations_at(cfg, p, ticks, chunk, engine, block)[lane] > 0)
 
     plan = _lane_only(plan, lane)
     assert fails(plan, max_ticks), (
@@ -186,11 +216,14 @@ def shrink(
     say(f"minimal ticks: {ticks} (chunk granularity {chunk})")
 
     return ShrinkResult(
-        lane=lane, ticks=ticks, atoms=kept, removed=removed, plan=plan
+        lane=lane, ticks=ticks, atoms=kept, removed=removed, plan=plan,
+        engine=engine, block=block,
     )
 
 
 def replay(cfg: SimConfig, result: ShrinkResult, chunk: int = 32) -> bool:
     """True iff the minimized plan still trips the checker in its lane."""
-    viol = _violations_at(cfg, result.plan, result.ticks, chunk)
+    viol = _violations_at(
+        cfg, result.plan, result.ticks, chunk, result.engine, result.block
+    )
     return bool(viol[result.lane] > 0)

@@ -68,9 +68,16 @@ def counter_bits(seed: jnp.ndarray, stream: int, shape) -> jnp.ndarray:
 
 
 def bern(seed: jnp.ndarray, stream: int, shape, p: float):
-    """bool, True w.p. ``p``; None when ``p <= 0`` (branch pruned at trace)."""
+    """bool, True w.p. ``p``; None when ``p <= 0`` (branch pruned at trace).
+
+    ``p >= 1.0`` is special-cased to an all-True mask: the clamped threshold
+    would otherwise fire w.p. 1 - 2^-32, and config authors writing
+    ``drop=1.0`` mean *always*, not *almost always*.
+    """
     if p <= 0.0:
         return None
+    if p >= 1.0:
+        return jnp.ones(shape, jnp.bool_)
     t = min(int(round(p * float(1 << 32))), (1 << 32) - 1)
     # Map the unsigned comparison bits_u < t into int32 order by flipping
     # the sign bit of both sides.
@@ -85,7 +92,13 @@ def bern_not(seed: jnp.ndarray, stream: int, shape, p: float):
 
 
 def randint(seed: jnp.ndarray, stream: int, shape, n: int) -> jnp.ndarray:
-    """int32 in [0, n) — non-negative bits modulo the (small) range."""
+    """int32 in [0, n) — non-negative bits modulo the (small) range.
+
+    The modulo carries ~n/2^31 selection bias toward small values —
+    negligible for fault-schedule fuzzing (n here is a handful of acceptors
+    or tick offsets), and distinct from jax.random.randint's unbiased
+    rejection path; do not reuse this for anything statistical.
+    """
     return (counter_bits(seed, stream, shape) & jnp.int32(0x7FFFFFFF)) % jnp.int32(
         max(n, 1)
     )

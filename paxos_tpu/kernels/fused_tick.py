@@ -327,7 +327,13 @@ def fused_chunk_sharded(
     campaign's per-chunk calls hit the compile cache and donate the state.
     """
     n_inst = jax.tree.leaves(state)[0].shape[-1]
-    local = n_inst // int(mesh.devices.size)
+    n_dev = int(mesh.devices.size)
+    if n_inst % n_dev:
+        # Checked eagerly: an uneven split would silently miscompute
+        # blocks_per_shard (and thus the global PRNG block offsets) long
+        # before any shape error surfaced.
+        raise ValueError(f"n_inst={n_inst} not divisible by mesh size {n_dev}")
+    local = n_inst // n_dev
     block = min(block, local)
     if local % block:
         raise ValueError(f"local n_inst={local} not divisible by block={block}")

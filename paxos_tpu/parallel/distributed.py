@@ -21,6 +21,7 @@ of ``paxos_tpu.parallel.mesh``.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -44,7 +45,6 @@ def init_distributed(
     DCN-connected CPU/GPU fleets.
     """
     if coordinator_address is None and jax.process_count() == 1:
-        env_ok = False
         try:
             import jax._src.clusters as clusters
 
@@ -52,7 +52,20 @@ def init_distributed(
                 c.is_env_present() for c in clusters.ClusterEnv._cluster_types
             )
         except Exception:
-            env_ok = False
+            # The private probe moved/vanished: fall back to documented
+            # cluster env vars rather than silently running single-process
+            # on what is actually a pod (the failure mode would be N
+            # identical unsharded runs, not an error).
+            env_ok = any(
+                v in os.environ
+                for v in (
+                    "TPU_WORKER_HOSTNAMES",  # TPU pod (GCE metadata mirror)
+                    "MEGASCALE_COORDINATOR_ADDRESS",  # multislice
+                    "JAX_COORDINATOR_ADDRESS",
+                    "SLURM_JOB_ID",
+                    "OMPI_MCA_orte_hnp_uri",
+                )
+            )
         if not env_ok:
             return 0
     jax.distributed.initialize(

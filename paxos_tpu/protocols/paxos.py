@@ -61,7 +61,7 @@ class TickMasks:
     static :class:`FaultConfig`).
     """
 
-    sel_score: jnp.ndarray  # (2, P, A, I) uint32 — request-selection entropy
+    sel_score: jnp.ndarray  # (2, P, A, I) int32 — request-selection entropy
     busy: Optional[jnp.ndarray]  # (1, 1, A, I) bool — False = acceptor idles
     deliver: Optional[jnp.ndarray]  # (2, P, A, I) bool — reply not held
     dup_req: Optional[jnp.ndarray]  # (2, P, A, I) bool — request redelivered
@@ -83,7 +83,9 @@ def sample_masks(
     edge = (n_prop, n_acc, n_inst)
 
     return TickMasks(
-        sel_score=jax.random.bits(k_sel, slot, jnp.uint32),
+        # int32 everywhere (matching the counter-PRNG path and Mosaic's
+        # signed-only lowering); the uint32→int32 astype wraps bit-exactly.
+        sel_score=jax.random.bits(k_sel, slot, jnp.uint32).astype(jnp.int32),
         busy=net.keep_mask(k_idle, (1, 1, n_acc, n_inst), cfg.p_idle),
         deliver=net.keep_mask(k_hold, slot, cfg.p_hold),
         dup_req=net.stay_mask(k_dup_req, slot, cfg.p_dup),

@@ -29,3 +29,20 @@ def test_shrink_equivocation_repro():
 
 def test_shrink_clean_config_returns_none():
     assert shrink(config_flex(4, 2, n_inst=256, seed=0), max_ticks=96) is None
+
+
+def test_shrink_fused_engine_repro():
+    """A violation observed under the fused stream must shrink and replay
+    under the SAME stream (soak defaults to --engine fused; ADVICE round 1:
+    replaying a fused seed under the XLA stream explores a different
+    schedule).  Off-TPU this runs the Pallas TPU interpreter, bit-identical
+    to the compiled kernel."""
+    cfg = SimConfig(
+        n_inst=256, n_prop=2, n_acc=5, seed=3,
+        fault=FaultConfig(p_idle=0.2, p_hold=0.2, p_equiv=0.3),
+    )
+    result = shrink(cfg, max_ticks=96, chunk=32, engine="fused")
+    assert result is not None, "equivocation config must violate within budget"
+    assert result.engine == "fused"
+    assert result.atoms
+    assert replay(cfg, result)
