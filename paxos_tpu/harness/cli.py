@@ -175,8 +175,10 @@ def cmd_run(args: argparse.Namespace) -> int:
 
     if args.engine == "fused":
         if jax.devices()[0].platform != "tpu":
-            print("error: --engine fused needs a TPU (Mosaic does not target "
-                  "other backends); use --engine xla",
+            print("error: --engine fused compiles Mosaic kernels (TPU only); "
+                  "off-TPU only the Pallas interpreter can replay the fused "
+                  "stream (shrink uses it for repro) — far too slow for "
+                  "campaigns; use --engine xla",
                   file=sys.stderr)
             return 1
         if args.shard:
@@ -278,7 +280,8 @@ def cmd_soak(args: argparse.Namespace) -> int:
     from paxos_tpu.harness.soak import soak
 
     if args.engine == "fused" and jax.devices()[0].platform != "tpu":
-        print("error: --engine fused needs a TPU; use --engine xla",
+        print("error: --engine fused needs a TPU (the off-TPU interpreter is "
+              "far too slow for soak campaigns); use --engine xla",
               file=sys.stderr)
         return 1
     kw = {"seed": args.seed}

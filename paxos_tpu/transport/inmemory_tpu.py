@@ -52,7 +52,14 @@ def bern_threshold(p: float) -> jnp.ndarray:
 
 
 def _bernoulli_bits(key: jax.Array, shape, p: float) -> jnp.ndarray:
-    """bool mask, True with probability ``p`` (uint32-threshold sampling)."""
+    """bool mask, True with probability ``p`` (uint32-threshold sampling).
+
+    ``p >= 1.0`` is exact (all True), matching the counter-PRNG's ``bern``:
+    the clamped threshold would otherwise miss w.p. 2^-32, making drop=1.0
+    mean "almost always" under this engine but "always" under fused.
+    """
+    if p >= 1.0:
+        return jnp.ones(shape, jnp.bool_)
     return jax.random.bits(key, shape, jnp.uint32) < bern_threshold(p)
 
 

@@ -1,0 +1,189 @@
+"""Schedule-exact differential tests: host interpreter vs the JAX kernels.
+
+SURVEY.md §5.2.1 as written (round-1 verdict, "Missing #2"): the SAME
+pre-sampled ``TickMasks``/``FaultPlan`` feed both the batched JAX
+``apply_tick`` and the scalar per-lane interpreter
+(``cpu_ref/interp``), and the ENTIRE per-lane state must be equal after
+every tick — so a mask consumed by the wrong role, a biased selection, a
+payload routed to the wrong slot, or a checker-table divergence fails on
+the first tick it occurs, in every protocol, under every fault class.
+
+Both engines' mask streams are exercised: ``xla`` (jax.random fold-in, what
+``paxos_step``/``run_chunk`` draw) and ``counter`` (the counter-PRNG stream
+the fused Pallas engine draws, block 0) — together with the existing
+fused-vs-reference bit-exactness tests this closes the chain
+interpreter == apply_tick == fused kernel.
+
+Mutation-tested by hand (each perturbation was verified to fail here, then
+reverted): (1) the acceptor's accept rule ``>=`` -> ``>``; (2) the ACCEPT
+send-drop mask wired to ``keep_p1`` instead of ``keep_p2``; (3) the
+transport's selection score degenerated to the slot id (selection bias);
+(4) the learner's eviction admission ``b > min_bal`` -> ``>=`` (caught by
+``test_differential_table_pressure``, which forces a full table with
+same-ballot/different-value conflicts via the Fast Paxos fast round).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paxos_tpu.cpu_ref.interp import INTERP_TICKS, lane_of
+from paxos_tpu.faults.injector import FaultConfig
+from paxos_tpu.harness.config import SimConfig
+from paxos_tpu.harness.run import base_key, init_plan, init_state
+from paxos_tpu.kernels.counter_prng import mix
+
+
+def _protocol_fns(protocol):
+    """(mask_sampler_xla, mask_sampler_counter, apply_fn) for a protocol."""
+    if protocol == "multipaxos":
+        from paxos_tpu.protocols.multipaxos import (
+            apply_tick_mp,
+            mp_counter_masks,
+            sample_mp_masks,
+        )
+
+        return sample_mp_masks, mp_counter_masks, apply_tick_mp
+    from paxos_tpu.protocols.paxos import counter_masks, sample_masks
+
+    if protocol == "paxos":
+        from paxos_tpu.protocols.paxos import apply_tick
+    elif protocol == "fastpaxos":
+        from paxos_tpu.protocols.fastpaxos import apply_tick_fast as apply_tick
+    elif protocol == "raftcore":
+        from paxos_tpu.protocols.raftcore import apply_tick_raft as apply_tick
+    else:
+        raise ValueError(protocol)
+    return sample_masks, counter_masks, apply_tick
+
+
+def _diff(a, b, path=""):
+    """Paths at which two nested structures differ (for failure messages)."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        out = []
+        for k in a:
+            out += _diff(a[k], b[k], f"{path}.{k}")
+        return out
+    if isinstance(a, list) and isinstance(b, list) and len(a) == len(b):
+        out = []
+        for i, (x, y) in enumerate(zip(a, b)):
+            out += _diff(x, y, f"{path}[{i}]")
+        return out
+    return [] if a == b else [f"{path}: jax={a!r} interp={b!r}"]
+
+
+def run_differential(cfg: SimConfig, ticks: int, stream: str) -> None:
+    """Advance JAX kernel and interpreter in lockstep; compare every lane."""
+    sample_xla, sample_counter, apply_fn = _protocol_fns(cfg.protocol)
+    tick_fn = INTERP_TICKS[cfg.protocol]
+    apply_j = jax.jit(apply_fn, static_argnums=(3,))
+
+    state = init_state(cfg)
+    plan = init_plan(cfg)
+    key = base_key(cfg)
+    lanes = range(cfg.n_inst)
+
+    plan_h = jax.device_get(plan)
+    plan_l = [lane_of(plan_h, i) for i in lanes]
+    interp = [lane_of(jax.device_get(state), i) for i in lanes]
+
+    for t in range(ticks):
+        if stream == "xla":
+            # Exactly what the protocol's *_step does per scan iteration.
+            masks = sample_xla(
+                jax.random.fold_in(key, t), cfg.fault,
+                cfg.n_prop, cfg.n_acc, cfg.n_inst,
+            )
+        else:
+            # Exactly what the fused engine draws for block 0 (n_inst fits
+            # one block here, so this is the whole fused stream).
+            masks = sample_counter(
+                cfg.fault,
+                mix(jnp.int32(cfg.seed), jnp.int32(t), jnp.int32(0)),
+                state,
+            )
+        masks_h = jax.device_get(masks)
+        state = apply_j(state, masks, plan, cfg.fault)
+        state_h = jax.device_get(state)
+        for i in lanes:
+            tick_fn(interp[i], lane_of(masks_h, i), plan_l[i], cfg.fault)
+            got = lane_of(state_h, i)
+            if got != interp[i]:
+                diffs = "\n".join(_diff(got, interp[i])[:20])
+                raise AssertionError(
+                    f"{cfg.protocol}/{stream}: lane {i} diverged at tick {t}:\n"
+                    f"{diffs}"
+                )
+
+
+CHAOS = FaultConfig(
+    p_drop=0.15, p_dup=0.15, p_idle=0.2, p_hold=0.2,
+    p_crash=0.3, crash_max_start=24, crash_max_len=12,
+    p_equiv=0.2, p_part=0.5, part_max_start=16, part_max_len=12,
+    timeout=6, backoff_max=4,
+)
+
+CASES = [
+    # Every fault class at once, on every protocol (the masks all fire).
+    ("paxos", CHAOS, 64),
+    ("fastpaxos", CHAOS, 64),
+    ("raftcore", CHAOS, 64),
+    # Flexible / Fast-Flexible quorums (the q1/q2/q_fast code paths).
+    ("paxos", dataclasses.replace(CHAOS, q1=4, q2=2), 48),
+    ("fastpaxos", dataclasses.replace(CHAOS, q1=4, q2=2, q_fast=4), 48),
+    # Amnesia bug-injection branch (acceptor state loss on recovery).
+    ("paxos", dataclasses.replace(CHAOS, amnesia=True), 48),
+    # Clean network: the None-mask (fault disabled) branches.
+    ("paxos", FaultConfig(timeout=4), 32),
+]
+
+
+@pytest.mark.parametrize("stream", ["xla", "counter"])
+def test_differential_table_pressure(stream):
+    """K=1 learner table under Fast Paxos: the shared fast ballot with two
+    distinct proposer values forces same-ballot/different-value insert
+    conflicts on a full table, so the eviction/insert policy (the checker's
+    completeness bound, not just its happy path) actually exercises and any
+    divergence in it is caught."""
+    cfg = SimConfig(
+        n_inst=4, n_prop=2, n_acc=5, k_slots=1, seed=5, protocol="fastpaxos",
+        fault=dataclasses.replace(CHAOS, p_equiv=0.3, timeout=3),
+    )
+    run_differential(cfg, 64, stream)
+
+MP_FAULTS = FaultConfig(
+    p_drop=0.1, p_dup=0.1, p_idle=0.15, p_hold=0.15,
+    p_crash=0.2, p_crash_prop=0.5, crash_max_start=40, crash_max_len=16,
+    p_equiv=0.1, p_part=0.4, part_max_start=20, part_max_len=12,
+    timeout=8, backoff_max=4, lease_len=10,
+)
+
+
+@pytest.mark.parametrize("stream", ["xla", "counter"])
+@pytest.mark.parametrize("protocol,fault,ticks", CASES)
+def test_differential(protocol, fault, ticks, stream):
+    cfg = SimConfig(
+        n_inst=4, n_prop=2, n_acc=5, seed=7, protocol=protocol, fault=fault
+    )
+    run_differential(cfg, ticks, stream)
+
+
+@pytest.mark.parametrize("stream", ["xla", "counter"])
+def test_differential_multipaxos(stream):
+    cfg = SimConfig(
+        n_inst=4, n_prop=2, n_acc=5, log_len=4, k_slots=4, seed=3,
+        protocol="multipaxos", fault=MP_FAULTS,
+    )
+    run_differential(cfg, 96, stream)
+
+
+def test_differential_many_seeds():
+    """Breadth: the full-chaos paxos case across distinct seeds/plans."""
+    for seed in range(3):
+        cfg = SimConfig(
+            n_inst=4, n_prop=2, n_acc=5, seed=11 + seed,
+            protocol="paxos", fault=CHAOS,
+        )
+        run_differential(cfg, 48, "xla")
