@@ -1,6 +1,14 @@
 """Benchmark: quorum-rounds/sec/chip on the flagship fuzzing config.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default (driver contract): prints ONE JSON line
+{"metric", "value", "unit", "vs_baseline"} for the flagship case —
+config 2, fused engine on TPU.
+
+``--sweep``: one JSON line per (protocol x engine) case — the full measured
+table of BASELINE.md, reproducible in one command.  ``--record PATH``
+additionally writes the sweep to a JSON artifact (list of case dicts);
+``tests/test_perf_regression.py`` gates future rounds against that artifact
+(each case must stay >= 0.7x its recorded value on TPU).
 
 Metric definition (BASELINE.md): quorum-rounds/sec/chip — each scheduler
 tick advances every instance's consensus state machine by one protocol
@@ -10,60 +18,58 @@ round (deliver -> vote -> quorum-check), so throughput = instances x ticks
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
 
+NORTH_STAR = 10_000_000.0  # BASELINE.md north-star target
 
-def main() -> None:
-    import jax
 
-    # rbg is markedly faster than threefry on TPU for the per-tick mask
-    # sampling; streams stay deterministic per (seed, tick) within the impl.
-    jax.config.update("jax_default_prng_impl", "rbg")
+def _configs(platform: str):
+    """The sweep table: (name, SimConfig, engine) per case.
 
-    import jax.numpy as jnp
-
-    from paxos_tpu.harness.config import config2_dueling_drop
-    from paxos_tpu.harness.run import (
-        base_key,
-        get_step_fn,
-        init_plan,
-        init_state,
-        run_chunk,
+    TPU sizes match BASELINE.md's measured rows (1M instances).  The CPU
+    rig shrinks instances and skips the fused engine (the Pallas TPU
+    interpreter replays the stream bit-exactly but ~1000x slower — it is a
+    correctness tool, not a benchmark path).
+    """
+    from paxos_tpu.harness.config import (
+        config2_dueling_drop,
+        config3_multipaxos,
+        config5_sweep,
     )
 
-    platform = jax.devices()[0].platform
-    n_inst = 1 << 20 if platform != "cpu" else 1 << 14  # 1,048,576 on TPU
-    cfg = config2_dueling_drop(n_inst=n_inst, seed=0)
+    on_tpu = platform == "tpu"
+    n = 1 << 20 if on_tpu else 1 << 13
+    sweep = {c.protocol: c for c in config5_sweep(n_inst=n)}
+    cases = [
+        ("config2-paxos", config2_dueling_drop(n_inst=n)),
+        ("config5-fastpaxos", sweep["fastpaxos"]),
+        ("config5-raftcore", sweep["raftcore"]),
+        ("config3-multipaxos", config3_multipaxos(n_inst=n)),
+    ]
+    engines = ("fused", "xla") if on_tpu else ("xla",)
+    return [(name, cfg, eng) for name, cfg in cases for eng in engines]
 
+
+def bench_case(cfg, engine: str, chunk: int = 64, timed_chunks: int = 4) -> dict:
+    """Measure one (config, engine) case; returns the result dict."""
+    import jax
+
+    from paxos_tpu.harness.run import init_plan, init_state, make_advance
+
+    platform = jax.devices()[0].platform
     state = init_state(cfg)
     plan = init_plan(cfg)
+    advance = make_advance(cfg, plan, engine)
 
-    # Engine: the fused Pallas path (whole chunk resident in VMEM) on TPU;
-    # the scanned XLA path on CPU (Mosaic doesn't target host CPUs).
-    engine = "fused" if platform == "tpu" else "xla"
-    if engine == "fused":
-        from paxos_tpu.kernels.fused_tick import fused_paxos_chunk
-
-        def advance(s, n):
-            return fused_paxos_chunk(s, jnp.int32(cfg.seed), plan, cfg.fault, n)
-
-    else:
-        step = get_step_fn(cfg.protocol)
-        key = base_key(cfg)
-
-        def advance(s, n):
-            return run_chunk(s, key, plan, cfg.fault, n, step)
-
-    chunk = 64
     # Warmup: compile + one chunk.  NOTE: timing must end with a device->host
     # readback, not block_until_ready — on the axon tunnel backend
     # block_until_ready can return before execution finishes.
     state = advance(state, chunk)
     int(state.tick)
 
-    timed_chunks = 4
     t0 = time.perf_counter()
     for _ in range(timed_chunks):
         state = advance(state, chunk)
@@ -71,22 +77,60 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     ticks = timed_chunks * chunk
-    value = n_inst * ticks / dt
-    baseline = 10_000_000.0  # BASELINE.md north-star target
-    out = {
+    value = cfg.n_inst * ticks / dt
+    return {
         "metric": "quorum-rounds/sec/chip",
         "value": round(value, 1),
         "unit": "instance-rounds/sec",
-        "vs_baseline": round(value / baseline, 3),
-        "n_instances": n_inst,
+        "vs_baseline": round(value / NORTH_STAR, 3),
+        "n_instances": cfg.n_inst,
         "ticks": ticks,
         "seconds": round(dt, 4),
         "platform": platform,
         "engine": engine,
+        "protocol": cfg.protocol,
         "violations": violations,
         "config_fingerprint": cfg.fingerprint(),
     }
-    print(json.dumps(out))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sweep", action="store_true",
+                    help="bench all protocols x engines (one JSON line each)")
+    ap.add_argument("--record", metavar="PATH",
+                    help="with --sweep: also write the case list to PATH")
+    args = ap.parse_args(argv)
+    if args.record and not args.sweep:
+        ap.error("--record requires --sweep")
+
+    import jax
+
+    # rbg is markedly faster than threefry on TPU for the per-tick mask
+    # sampling; streams stay deterministic per (seed, tick) within the impl.
+    jax.config.update("jax_default_prng_impl", "rbg")
+    platform = jax.devices()[0].platform
+
+    if args.sweep:
+        results = []
+        for name, cfg, engine in _configs(platform):
+            out = bench_case(cfg, engine)
+            out["case"] = name
+            results.append(out)
+            print(json.dumps(out), flush=True)
+        if args.record:
+            with open(args.record, "w") as f:
+                json.dump(results, f, indent=1)
+        return
+
+    from paxos_tpu.harness.config import config2_dueling_drop
+
+    n_inst = 1 << 20 if platform != "cpu" else 1 << 14  # 1,048,576 on TPU
+    cfg = config2_dueling_drop(n_inst=n_inst, seed=0)
+    # Engine: the fused Pallas path (whole chunk resident in VMEM) on TPU;
+    # the scanned XLA path on CPU (Mosaic doesn't target host CPUs).
+    engine = "fused" if platform == "tpu" else "xla"
+    print(json.dumps(bench_case(cfg, engine)))
 
 
 if __name__ == "__main__":

@@ -6,16 +6,23 @@ Multi-chip tests without a cluster — the TPU analog of Cloud Haskell's
 so an env var alone is not enough: re-point jax at CPU explicitly before
 any backend is used.  XLA_FLAGS must be set before the CPU client is
 created (lazily), which this module-level code guarantees.
+
+``PAXOS_TPU_REAL=1`` opts OUT of the CPU rig and keeps the real TPU
+backend — intended for the TPU-gated perf-regression suite only
+(``PAXOS_TPU_REAL=1 pytest tests/test_perf_regression.py``); the
+multi-device sharding tests assume the 8-device CPU mesh and are not
+expected to pass against a single real chip.
 """
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if os.environ.get("PAXOS_TPU_REAL") != "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")

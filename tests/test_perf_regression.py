@@ -1,0 +1,72 @@
+"""Perf-regression gate (SURVEY.md §5.2.5; round-1 verdict "Missing #1").
+
+Each (config x engine) case of the sweep bench must stay within a band
+(>= 0.7x) of its recorded TPU value in ``BENCH_SWEEP.json`` (produced by
+``python bench.py --sweep --record BENCH_SWEEP.json`` on a v5e-1).  A
+silent 10x regression — e.g. a layout revert undoing the instance-minor
+win (BASELINE.md row "before instance-minor layout refactor": 35x slower)
+— fails here long before it eats the 32x cushion over the north star.
+
+The CPU rig skips: interpreter-mode timings say nothing about the chip.
+Run with ``PAXOS_TPU_REAL=1 python -m pytest tests/test_perf_regression.py``
+on a machine with a real TPU (the conftest otherwise forces the CPU mesh).
+"""
+
+import json
+import pathlib
+import sys
+
+import jax
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+ARTIFACT = ROOT / "BENCH_SWEEP.json"
+BAND = 0.7  # min acceptable fraction of the recorded throughput
+
+pytestmark = pytest.mark.skipif(
+    jax.devices()[0].platform != "tpu",
+    reason="perf gate needs a real TPU (set PAXOS_TPU_REAL=1 to disable the CPU rig)",
+)
+
+
+def _recorded():
+    if not ARTIFACT.exists():
+        return []
+    return [c for c in json.loads(ARTIFACT.read_text()) if c["platform"] == "tpu"]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _bench_prng():
+    # Match the conditions the artifact was recorded under (bench.py main).
+    jax.config.update("jax_default_prng_impl", "rbg")
+    yield
+
+
+@pytest.mark.parametrize(
+    "case", _recorded(), ids=lambda c: f"{c['case']}-{c['engine']}"
+)
+def test_perf_band(case):
+    from bench import _configs, bench_case
+
+    table = {(name, eng): cfg for name, cfg, eng in _configs("tpu")}
+    cfg = table[(case["case"], case["engine"])]
+    # The recorded number must refer to this exact config, else the band
+    # compares apples to oranges (a config change requires re-recording).
+    assert cfg.fingerprint() == case["config_fingerprint"], (
+        f"{case['case']}: config changed since BENCH_SWEEP.json was recorded; "
+        "re-run `python bench.py --sweep --record BENCH_SWEEP.json`"
+    )
+    out = bench_case(cfg, case["engine"])
+    assert out["violations"] == 0
+    assert out["value"] >= BAND * case["value"], (
+        f"{case['case']} ({case['engine']}): {out['value']:.3e} < "
+        f"{BAND} x recorded {case['value']:.3e} — perf regression"
+    )
+
+
+def test_artifact_present():
+    """The gate must not pass vacuously because the artifact vanished."""
+    assert ARTIFACT.exists(), "BENCH_SWEEP.json missing — perf gate is vacuous"
+    assert len(_recorded()) >= 8, "expected >= 8 TPU cases (4 protocols x 2 engines)"
