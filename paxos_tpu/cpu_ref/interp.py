@@ -221,6 +221,70 @@ def _send(
     buf["present"][kind][p][a] = True
 
 
+def _link_fn(plan: dict, tick: int, cfg):
+    """(p, a) -> partition-respecting reachability (constant True w/o faults)."""
+    if cfg.p_part > 0.0:
+        return lambda p, a: _link_ok(plan, p, a, tick)
+    return lambda p, a: True
+
+
+def _deliver_replies(st: dict, m: dict, link, P: int, A: int) -> tuple:
+    """Reply delivery decided on the pre-tick buffer; delivered slots clear
+    (minus duplicates) before the acceptors write new replies.
+
+    Returns ``(pre_rep, delivered)`` — the pre-tick snapshot and the
+    (2, P, A) delivery decision the proposer half-tick folds over.
+    """
+    pre_rep = copy.deepcopy(st["replies"])
+    delivered = [
+        [
+            [
+                pre_rep["present"][k][p][a]
+                and _mask3(m["deliver"], k, p, a)
+                and link(p, a)
+                for a in range(A)
+            ]
+            for p in range(P)
+        ]
+        for k in range(2)
+    ]
+    _consume(st["replies"], delivered, m["dup_rep"], P, A)
+    return pre_rep, delivered
+
+
+def _select_requests(
+    st: dict, m: dict, plan: dict, tick: int, P: int, A: int, link
+) -> tuple:
+    """Per-acceptor transport pick + gating, then consume selected slots.
+
+    Returns ``(pre_req, picks)``: the pre-tick request snapshot and the
+    ``(a, kind, proposer)`` triples that survive the busy/alive/link gates —
+    the at-most-one request each live acceptor processes this tick.
+    Consuming before the acceptor bodies run is equivalent to the kernels'
+    post-loop consume: the bodies read only ``pre_req`` and write only reply
+    buffers.
+    """
+    pre_req = copy.deepcopy(st["requests"])
+    sel = [[[False] * A for _ in range(P)] for _ in range(2)]
+    picks = []
+    for a in range(A):
+        pick = _select_one(
+            [[pre_req["present"][k][p][a] for p in range(P)] for k in range(2)],
+            [[m["sel_score"][k][p][a] for p in range(P)] for k in range(2)],
+            P,
+        )
+        if pick is None:
+            continue
+        k, p = pick
+        busy_ok = m["busy"] is None or bool(m["busy"][0][0][a])
+        if not (busy_ok and _alive(plan, a, tick) and link(p, a)):
+            continue
+        sel[k][p][a] = True
+        picks.append((a, k, p))
+    _consume(st["requests"], sel, m["dup_req"], P, A)
+    return pre_req, picks
+
+
 # ---------------------------------------------------------------------------
 # Single-decree Paxos (protocols/paxos.apply_tick)
 # ---------------------------------------------------------------------------
@@ -247,47 +311,15 @@ def paxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 acc["promised"][a] = acc["acc_bal"][a] = acc["acc_val"][a] = 0
     acc_pre = copy.deepcopy(acc)
 
-    has_link = cfg.p_part > 0.0
-
-    def link(p: int, a: int) -> bool:
-        return _link_ok(plan, p, a, tick) if has_link else True
-
-    # Reply delivery decided on the pre-tick buffer; delivered slots clear
-    # (minus duplicates) before the acceptors write new replies.
-    pre_rep = copy.deepcopy(st["replies"])
-    delivered = [
-        [
-            [
-                pre_rep["present"][k][p][a]
-                and _mask3(m["deliver"], k, p, a)
-                and link(p, a)
-                for a in range(A)
-            ]
-            for p in range(P)
-        ]
-        for k in range(2)
-    ]
-    _consume(st["replies"], delivered, m["dup_rep"], P, A)
+    link = _link_fn(plan, tick, cfg)
+    pre_rep, delivered = _deliver_replies(st, m, link, P, A)
 
     # ---- Acceptor half-tick: select and process at most one request ----
-    pre_req = copy.deepcopy(st["requests"])
-    sel = [[[False] * A for _ in range(P)] for _ in range(2)]
+    pre_req, picks = _select_requests(st, m, plan, tick, P, A, link)
     ok_acc = [False] * A
     ev_bal = [0] * A
     ev_val = [0] * A
-    for a in range(A):
-        pick = _select_one(
-            [[pre_req["present"][k][p][a] for p in range(P)] for k in range(2)],
-            [[m["sel_score"][k][p][a] for p in range(P)] for k in range(2)],
-            P,
-        )
-        if pick is None:
-            continue
-        k, p = pick
-        busy_ok = m["busy"] is None or bool(m["busy"][0][0][a])
-        if not (busy_ok and _alive(plan, a, tick) and link(p, a)):
-            continue
-        sel[k][p][a] = True
+    for a, k, p in picks:
         eq = bool(plan["equivocate"][a])
         bal = pre_req["bal"][k][p][a]
         val = pre_req["v1"][k][p][a]
@@ -311,7 +343,6 @@ def paxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 acc["acc_bal"][a], acc["acc_val"][a] = bal, val
                 ok_acc[a], ev_bal[a], ev_val[a] = True, bal, val
                 _send(st["replies"], 1, p, a, m["keep_accd"], bal, val, 0)
-    _consume(st["requests"], sel, m["dup_req"], P, A)
 
     # ---- Learner / safety checker ----
     _learner_fold(lrn, list(zip(ok_acc, ev_bal, ev_val)), tick, q2)
@@ -413,44 +444,14 @@ def fastpaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 acc["promised"][a] = acc["acc_bal"][a] = acc["acc_val"][a] = 0
     acc_pre = copy.deepcopy(acc)
 
-    has_link = cfg.p_part > 0.0
+    link = _link_fn(plan, tick, cfg)
+    pre_rep, delivered = _deliver_replies(st, m, link, P, A)
 
-    def link(p: int, a: int) -> bool:
-        return _link_ok(plan, p, a, tick) if has_link else True
-
-    pre_rep = copy.deepcopy(st["replies"])
-    delivered = [
-        [
-            [
-                pre_rep["present"][k][p][a]
-                and _mask3(m["deliver"], k, p, a)
-                and link(p, a)
-                for a in range(A)
-            ]
-            for p in range(P)
-        ]
-        for k in range(2)
-    ]
-    _consume(st["replies"], delivered, m["dup_rep"], P, A)
-
-    pre_req = copy.deepcopy(st["requests"])
-    sel = [[[False] * A for _ in range(P)] for _ in range(2)]
+    pre_req, picks = _select_requests(st, m, plan, tick, P, A, link)
     ok_acc = [False] * A
     ev_bal = [0] * A
     ev_val = [0] * A
-    for a in range(A):
-        pick = _select_one(
-            [[pre_req["present"][k][p][a] for p in range(P)] for k in range(2)],
-            [[m["sel_score"][k][p][a] for p in range(P)] for k in range(2)],
-            P,
-        )
-        if pick is None:
-            continue
-        k, p = pick
-        busy_ok = m["busy"] is None or bool(m["busy"][0][0][a])
-        if not (busy_ok and _alive(plan, a, tick) and link(p, a)):
-            continue
-        sel[k][p][a] = True
+    for a, k, p in picks:
         eq = bool(plan["equivocate"][a])
         bal = pre_req["bal"][k][p][a]
         val = pre_req["v1"][k][p][a]
@@ -475,7 +476,6 @@ def fastpaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 acc["acc_bal"][a], acc["acc_val"][a] = bal, val
                 ok_acc[a], ev_bal[a], ev_val[a] = True, bal, val
                 _send(st["replies"], 1, p, a, m["keep_accd"], bal, val, 0)
-    _consume(st["requests"], sel, m["dup_req"], P, A)
 
     _learner_fold(
         lrn, list(zip(ok_acc, ev_bal, ev_val)), tick, q2, fquorum=fquorum
@@ -613,44 +613,14 @@ def raftcore_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 voter["voted"][a] = voter["ent_term"][a] = voter["ent_val"][a] = 0
     voter_pre = copy.deepcopy(voter)
 
-    has_link = cfg.p_part > 0.0
+    link = _link_fn(plan, tick, cfg)
+    pre_rep, delivered = _deliver_replies(st, m, link, P, A)
 
-    def link(p: int, a: int) -> bool:
-        return _link_ok(plan, p, a, tick) if has_link else True
-
-    pre_rep = copy.deepcopy(st["replies"])
-    delivered = [
-        [
-            [
-                pre_rep["present"][k][p][a]
-                and _mask3(m["deliver"], k, p, a)
-                and link(p, a)
-                for a in range(A)
-            ]
-            for p in range(P)
-        ]
-        for k in range(2)
-    ]
-    _consume(st["replies"], delivered, m["dup_rep"], P, A)
-
-    pre_req = copy.deepcopy(st["requests"])
-    sel = [[[False] * A for _ in range(P)] for _ in range(2)]
+    pre_req, picks = _select_requests(st, m, plan, tick, P, A, link)
     ok_ap = [False] * A
     ev_bal = [0] * A
     ev_val = [0] * A
-    for a in range(A):
-        pick = _select_one(
-            [[pre_req["present"][k][p][a] for p in range(P)] for k in range(2)],
-            [[m["sel_score"][k][p][a] for p in range(P)] for k in range(2)],
-            P,
-        )
-        if pick is None:
-            continue
-        k, p = pick
-        busy_ok = m["busy"] is None or bool(m["busy"][0][0][a])
-        if not (busy_ok and _alive(plan, a, tick) and link(p, a)):
-            continue
-        sel[k][p][a] = True
+    for a, k, p in picks:
         eq = bool(plan["equivocate"][a])
         term = pre_req["bal"][k][p][a]
         v1 = pre_req["v1"][k][p][a]
@@ -677,7 +647,6 @@ def raftcore_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 voter["ent_term"][a], voter["ent_val"][a] = term, v1
                 ok_ap[a], ev_bal[a], ev_val[a] = True, term, v1
                 _send(st["replies"], 1, p, a, m["keep_accd"], term, v1, 0)
-    _consume(st["requests"], sel, m["dup_req"], P, A)
 
     _learner_fold(lrn, list(zip(ok_ap, ev_bal, ev_val)), tick, quorum)
     for a in range(A):
@@ -871,10 +840,7 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 for s in range(L):
                     acc["log_bal"][a][s] = acc["log_val"][a][s] = 0
 
-    has_link = cfg.p_part > 0.0
-
-    def link(p: int, a: int) -> bool:
-        return _link_ok(plan, p, a, tick) if has_link else True
+    link = _link_fn(plan, tick, cfg)
 
     # Reply delivery (promises and accepteds are separate buffers here).
     pre_prom = copy.deepcopy(st["promises"])
@@ -905,22 +871,9 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 st["accepted"]["present"][p][a] = False
 
     # ---- Acceptor half-tick ----
-    pre_req = copy.deepcopy(st["requests"])
-    sel = [[[False] * A for _ in range(P)] for _ in range(2)]
+    pre_req, picks = _select_requests(st, m, plan, tick, P, A, link)
     events = [(False, 0, 0, 0)] * A
-    for a in range(A):
-        pick = _select_one(
-            [[pre_req["present"][k][p][a] for p in range(P)] for k in range(2)],
-            [[m["sel_score"][k][p][a] for p in range(P)] for k in range(2)],
-            P,
-        )
-        if pick is None:
-            continue
-        k, p = pick
-        busy_ok = m["busy"] is None or bool(m["busy"][0][0][a])
-        if not (busy_ok and _alive(plan, a, tick) and link(p, a)):
-            continue
-        sel[k][p][a] = True
+    for a, k, p in picks:
         eq = bool(plan["equivocate"][a])
         bal = pre_req["bal"][k][p][a]
         val = pre_req["v1"][k][p][a]
@@ -953,7 +906,6 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                     st["accepted"]["bal"][p][a] = bal
                     st["accepted"]["slot"][p][a] = slot
                     st["accepted"]["val"][p][a] = val
-    _consume(st["requests"], sel, m["dup_req"], P, A)
 
     # ---- Learner / checker (chosen count feeds the leases, post-update) ----
     _mp_learner_fold(lrn, events, tick, quorum)
@@ -1073,16 +1025,14 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
         # Emits.
         if start_elec and p_up:
             for a in range(A):
-                if _mask2(m["keep_prep"], p, a):
-                    _send_req_mp(st["requests"], 0, p, a, bal, 0, 0)
+                _send(st["requests"], 0, p, a, m["keep_prep"], bal, 0, 0)
         ci = min(prop["commit_idx"][p], L - 1)
         if new_phase == LEAD and p_up and prop["commit_idx"][p] < L:
             rb = prop["recov_bal"][p][ci]
             rv = prop["recov_val"][p][ci]
             pval = rv if rb > 0 else (p + 1) * 1000 + ci
             for a in range(A):
-                if _mask2(m["keep_acc"], p, a):
-                    _send_req_mp(st["requests"], 1, p, a, bal, pval, ci)
+                _send(st["requests"], 1, p, a, m["keep_acc"], bal, pval, ci)
 
         prop["phase"][p] = new_phase
         prop["heard"][p] = heard
@@ -1090,13 +1040,6 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
         prop["candidate_timer"][p] = candidate_timer
 
     st["tick"] = tick + 1
-
-
-def _send_req_mp(buf: dict, kind: int, p: int, a: int, bal: int, v1: int, v2: int):
-    buf["bal"][kind][p][a] = bal
-    buf["v1"][kind][p][a] = v1
-    buf["v2"][kind][p][a] = v2
-    buf["present"][kind][p][a] = True
 
 
 INTERP_TICKS = {
