@@ -39,3 +39,41 @@ def chosen_tick_histogram(
 def stuck_mask(learner: LearnerState, budget_ticks: int, now) -> jnp.ndarray:
     """(I,) bool: still undecided although ``budget_ticks`` have elapsed."""
     return ~learner.chosen & (jnp.asarray(now) >= budget_ticks)
+
+
+def liveness_report(
+    learner: LearnerState, now: int, n_points: int = 8, n_bins: int = 16
+) -> dict:
+    """The liveness block of a run report (SURVEY.md §6.5).
+
+    Host-side dict of plain Python values: ``decided_by_curve`` —
+    ``n_points`` (tick, fraction) pairs evenly spaced to ``now``;
+    ``chosen_tick_hist`` — ``n_bins`` decision-latency counts (undecided
+    lanes in the last bin, ``hist_bin_width`` ticks per bin); ``stuck_lanes``
+    — lanes (slot-lanes for Multi-Paxos) still undecided at ``now``.  A
+    livelock regression (dueling proposers without backoff) shows up as a
+    flattening curve + growing ``stuck_lanes``, not as a silent slowdown.
+
+    Shape-polymorphic over single-decree ``(I,)`` and Multi-Paxos ``(L, I)``
+    learners: curve/histogram count slot-lanes in the latter.
+    """
+    import jax
+
+    now = max(int(now), 1)
+    ticks = [max(1, (now * (i + 1)) // n_points) for i in range(n_points)]
+    # Width chosen so every decided tick (<= now-1) lands in bins
+    # 0..n_bins-2: the last bin holds ONLY undecided lanes, so
+    # hist[-1] is exactly the livelock count, never late deciders.
+    bin_width = max(1, -(-now // (n_bins - 1)))
+    curve = [decided_by(learner, k) for k in ticks]
+    hist = chosen_tick_histogram(learner, n_bins, bin_width)
+    stuck = stuck_mask(learner, now, now).sum()
+    curve, hist, stuck = jax.device_get((curve, hist, stuck))
+    return {
+        "decided_by_curve": [
+            (k, round(float(f), 6)) for k, f in zip(ticks, curve)
+        ],
+        "chosen_tick_hist": [int(c) for c in hist],
+        "hist_bin_width": bin_width,
+        "stuck_lanes": int(stuck),
+    }

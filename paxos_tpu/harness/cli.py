@@ -70,6 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--resume", default=None, help="checkpoint dir to resume from")
     r.add_argument("--trace", default=None, help="jax.profiler trace logdir")
     r.add_argument(
+        "--liveness",
+        action="store_true",
+        help="append decided-by curve / latency histogram / stuck-lane "
+        "count to the final report (check/liveness)",
+    )
+    r.add_argument(
         "--events",
         action="store_true",
         help="per-chunk protocol event dump to stderr (debug; slows the loop)",
@@ -221,7 +227,7 @@ def cmd_run(args: argparse.Namespace) -> int:
             if args.until_all_chosen and bool(state.learner.chosen.all()):
                 break
 
-    report = summarize(state)
+    report = summarize(state, liveness=args.liveness)
     report["config_fingerprint"] = cfg.fingerprint()
     if args.checkpoint_dir:
         ckpt.save(args.checkpoint_dir, state, plan, cfg)

@@ -138,11 +138,13 @@ def make_advance(
     raise ValueError(f"unknown engine: {engine!r}")
 
 
-def summarize(state: PaxosState) -> dict[str, Any]:
+def summarize(state: PaxosState, liveness: bool = False) -> dict[str, Any]:
     """Reduce on-device state to a host-side scalar report.
 
     Reductions run on-device (sharded states psum automatically under jit);
-    only scalars come back to the host.
+    only scalars come back to the host.  ``liveness`` appends the
+    decided-by curve / latency histogram / stuck-lane count block
+    (:func:`paxos_tpu.check.liveness.liveness_report`).
     """
     lrn, prop = state.learner, state.proposer
     chosen = lrn.chosen  # (I,) single-decree, (L, I) multipaxos
@@ -175,7 +177,15 @@ def summarize(state: PaxosState) -> dict[str, Any]:
             & (prop.decided_val != lrn.chosen_val[None])
         ).any(axis=0).sum()
 
-    return {k: (v.item() if hasattr(v, "item") else v) for k, v in jax.device_get(out).items()}
+    out = {
+        k: (v.item() if hasattr(v, "item") else v)
+        for k, v in jax.device_get(out).items()
+    }
+    if liveness:
+        from paxos_tpu.check.liveness import liveness_report
+
+        out.update(liveness_report(lrn, out["ticks"]))
+    return out
 
 
 def run(
@@ -186,6 +196,7 @@ def run(
     max_ticks: int = 4096,
     return_state: bool = False,
     engine: str = "xla",
+    liveness: bool = False,
 ):
     """Host loop: init, scan chunks, return the final report.
 
@@ -212,7 +223,7 @@ def run(
         if until_all_chosen:
             if state.learner.chosen.all().item():
                 break
-    report = summarize(state)
+    report = summarize(state, liveness=liveness)
     report["config_fingerprint"] = cfg.fingerprint()
     report["engine"] = engine
     if return_state:
