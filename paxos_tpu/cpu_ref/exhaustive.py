@@ -64,6 +64,33 @@ class CheckResult:
     counterexample: Optional[list]  # action trace to a violation (None = ok)
 
 
+def explore(init, successors, check_state, max_states: int) -> int:
+    """Memoized DFS over a finite action DAG — the shared search driver.
+
+    ``successors(state)`` yields ``(action, next_state)`` pairs;
+    ``check_state(state, trace)`` asserts the invariants (raising
+    ``AssertionError`` with the Spin-style action trace) and accumulates
+    stats via closure.  Traces are tuples shared by prefix, so storing one
+    per stack entry is O(depth), not O(depth^2).  Returns the number of
+    distinct states visited; raises ``RuntimeError`` past ``max_states``.
+    """
+    stack = [(init, ())]
+    visited = set()
+    while stack:
+        state, trace = stack.pop()
+        if state in visited:
+            continue
+        visited.add(state)
+        if len(visited) > max_states:
+            raise RuntimeError(
+                f"state space exceeds max_states={max_states}; tighten bounds"
+            )
+        check_state(state, trace)
+        for action, nxt in successors(state):
+            stack.append((nxt, trace + (action,)))
+    return len(visited)
+
+
 def _init_state(n_prop: int, n_acc: int) -> State:
     accs = tuple((0, 0, 0) for _ in range(n_acc))
     props = tuple(
@@ -221,31 +248,15 @@ def check_exhaustive(
         )
     quorum = n_acc // 2 + 1
     own_vals = {_own_val(p) for p in range(n_prop)}
-    init = _init_state(n_prop, n_acc)
-    # DFS with an explicit stack carrying the action trace lazily: store
-    # (state, trace) only until visited; traces are tuples shared by prefix.
-    stack: list[tuple[State, tuple]] = [(init, ())]
-    visited: set[State] = set()
-    decided_states = 0
-    chosen_all: set = set()
+    stats = {"decided_states": 0, "chosen_all": set()}
 
-    while stack:
-        state, trace = stack.pop()
-        if state in visited:
-            continue
-        visited.add(state)
-        if len(visited) > max_states:
-            raise RuntimeError(
-                f"state space exceeds max_states={max_states}; tighten bounds"
-            )
-
+    def check_state(state: State, trace: tuple) -> None:
         accs, props, net, voters = state
         chosen = _chosen(voters, quorum)
-        chosen_all |= chosen
+        stats["chosen_all"] |= chosen
         decided = {pr[6] for pr in props if pr[0] == DONE}
         if decided:
-            decided_states += 1
-
+            stats["decided_states"] += 1
         # ---- Invariants, checked in EVERY reachable state ----
         ok = (
             len(chosen) <= 1  # agreement
@@ -258,22 +269,21 @@ def check_exhaustive(
                 f"after trace={list(trace)}"
             )
 
-        # ---- Successors (GC'd: dead-letter orderings collapse) ----
+    def successors(state: State):
+        # GC'd: dead-letter orderings collapse.
+        accs, props, net, voters = state
         for i in range(len(net)):
-            stack.append((
-                _gc(_deliver(state, i, quorum, n_acc, unsafe_accept), unsafe_accept),
-                trace + (("d", net[i]),),
-            ))
+            yield ("d", net[i]), _gc(
+                _deliver(state, i, quorum, n_acc, unsafe_accept), unsafe_accept
+            )
         for p in range(n_prop):
             if props[p][0] != DONE and props[p][1] < max_round[p]:
-                stack.append((
-                    _gc(_timeout(state, p, n_acc), unsafe_accept),
-                    trace + (("t", p),),
-                ))
+                yield ("t", p), _gc(_timeout(state, p, n_acc), unsafe_accept)
 
+    states = explore(_init_state(n_prop, n_acc), successors, check_state, max_states)
     return CheckResult(
-        states=len(visited),
-        decided_states=decided_states,
-        chosen_values=chosen_all,
+        states=states,
+        decided_states=stats["decided_states"],
+        chosen_values=stats["chosen_all"],
         counterexample=None,
     )

@@ -1,0 +1,314 @@
+"""Bounded exhaustive model checking of Fast Paxos (round-1 verdict #3).
+
+`cpu_ref/exhaustive.py` enumerates every schedule of single-decree Paxos;
+this sibling does the same for **Fast Paxos** — the repo's subtlest logic
+(`protocols/fastpaxos.py`): the shared fast round, the
+vote-at-most-once-per-ballot rule, and coordinated recovery's *choosable*
+rule.  Until now these were verified only by randomized fuzzing plus
+hand-picked cases; here every reachable state of a small bounded instance
+is visited and agreement/validity asserted in each.
+
+Model (mirroring the kernel's semantics, not its vectorized form):
+
+- Round 0 is the **fast round** with the shared ballot ``make_ballot(0, 0)``
+  (`core/fp_state.py` `fast_ballot`): every proposer's
+  ``Accept(fast_bal, own_val)`` broadcast is in flight initially.
+- An acceptor votes at most once per ballot: it accepts ``(b, v)`` iff
+  ``b >= promised`` and (``b > acc_bal`` or the identical pair — idempotent
+  re-accept).
+- A timed-out proposer starts a **classic round** ``>= 1``: phase-1
+  PREPAREs, promises carrying the pre-update ``(acc_bal, acc_val)``, and on
+  a q1 quorum the coordinated-recovery pick: value ``v`` is *choosable* at
+  the highest reported ballot ``k`` (when ``k`` is the fast round) iff its
+  reporters plus the unheard acceptors could still contain a fast quorum —
+  ``count(v) + (n - heard) >= q_fast``.  A choosable value MUST be adopted;
+  if none is, the proposer's own value is safe.  At a classic ``k`` the
+  (unique) reported value is adopted.
+- A value is **chosen** when a ``(bal, v)`` row has a fast quorum of votes
+  (round-0 ballot) or a classic q2 quorum (rounds >= 1) — the same
+  per-round-kind threshold `check/safety.learner_observe` applies.
+
+``adopt_any=True`` injects the classic wrong-recovery bug: skip the
+choosable filter and adopt any reported value (lowest value id).  The
+checker must then find a counterexample — e.g. with 5 acceptors, recovery
+hearing {v1 x 1, v2 x 2} must adopt the still-choosable v2; adopting v1
+lets v1 be chosen classically while the two unheard acceptors complete
+v2's fast quorum.  That this trace is found (and none exists under the
+correct rule) is exactly what tests/test_exhaustive.py asserts.
+
+Same soundness notes as the paxos checker: message loss = never-delivered
+(every prefix explored), duplication left to the fuzzer, GC'd no-op
+deliveries collapse dead-letter orderings.
+"""
+
+from __future__ import annotations
+
+from paxos_tpu.cpu_ref.exhaustive import CheckResult, explore, make_ballot
+
+# Message kinds (same encoding as the paxos checker).
+PREPARE, PROMISE, ACCEPT, ACCEPTED = 0, 1, 2, 3
+# Proposer phases (core/fp_state.py).
+P1, P2, DONE, FAST = 0, 1, 2, 3
+
+FAST_BAL = make_ballot(0, 0)  # shared fast ballot (fp_state.fast_ballot)
+
+
+def _round(bal: int, max_props: int = 8) -> int:
+    return (bal - 1) // max_props
+
+
+def _fast_quorum(n_acc: int) -> int:
+    return -((-3 * n_acc) // 4)  # ceil(3n/4)
+
+
+def _own_val(pid: int) -> int:
+    return 100 + pid
+
+
+# An acceptor: (promised, acc_bal, acc_val).
+# A proposer: (phase, rnd, heard_mask, best_bal, rep_masks, prop_val,
+#              decided_val) — rep_masks is a tuple of per-value-id acceptor
+#              bitmasks at best_bal (protocols/fastpaxos.py's rep_mask fold).
+# State: (accs, props, net, voters); net a sorted tuple (multiset); voters a
+# sorted tuple of ((bal, val), acceptor_bitmask) — the learner's vote table.
+
+
+def _init_state(n_prop: int, n_acc: int):
+    accs = tuple((0, 0, 0) for _ in range(n_acc))
+    props = tuple(
+        (FAST, 0, 0, 0, (0,) * n_prop, _own_val(p), 0) for p in range(n_prop)
+    )
+    net = tuple(
+        sorted(
+            (ACCEPT, p, a, FAST_BAL, _own_val(p), 0)
+            for p in range(n_prop)
+            for a in range(n_acc)
+        )
+    )
+    return (accs, props, net, ())
+
+
+def _record_vote(voters: tuple, a: int, bal: int, val: int) -> tuple:
+    d = dict(voters)
+    d[(bal, val)] = d.get((bal, val), 0) | (1 << a)
+    return tuple(sorted(d.items()))
+
+
+def _chosen(voters: tuple, q2: int, fquorum: int) -> set:
+    return {
+        bv[1]
+        for bv, mask in voters
+        if bin(mask).count("1") >= (fquorum if _round(bv[0]) == 0 else q2)
+    }
+
+
+def _recovery_pick(
+    pid: int,
+    n_prop: int,
+    n_acc: int,
+    heard: int,
+    best_bal: int,
+    rep_masks: tuple,
+    fquorum: int,
+    adopt_any: bool,
+) -> int:
+    """The coordinated-recovery value pick at q1 completion (kernel's rule)."""
+    if best_bal == 0:
+        return _own_val(pid)
+    if adopt_any:  # BUG INJECTION: ignore choosability entirely
+        return next(
+            (_own_val(v) for v in range(n_prop) if rep_masks[v]), _own_val(pid)
+        )
+    if _round(best_bal) == 0:  # recovering a fast round
+        unheard = n_acc - bin(heard).count("1")
+        choosable = [
+            rep_masks[v] != 0
+            and bin(rep_masks[v]).count("1") + unheard >= fquorum
+            for v in range(n_prop)
+        ]
+        return next(
+            (_own_val(v) for v in range(n_prop) if choosable[v]),
+            _own_val(pid),
+        )
+    # Classic round: its unique owner proposed exactly one value.
+    return next(
+        (_own_val(v) for v in range(n_prop) if rep_masks[v]), _own_val(pid)
+    )
+
+
+def _deliver(
+    state,
+    i: int,
+    n_prop: int,
+    n_acc: int,
+    q1: int,
+    q2: int,
+    fquorum: int,
+    adopt_any: bool,
+):
+    """Deliver (and consume) in-flight message ``i``; pure."""
+    accs, props, net, voters = state
+    kind, src, dst, bal, v1, v2 = net[i]
+    net = net[:i] + net[i + 1 :]
+    out = []
+
+    if kind == PREPARE:
+        promised, abal, aval = accs[dst]
+        if bal > promised:
+            accs = accs[:dst] + ((bal, abal, aval),) + accs[dst + 1 :]
+            out.append((PROMISE, dst, src, bal, abal, aval))
+    elif kind == ACCEPT:
+        promised, abal, aval = accs[dst]
+        # Vote at most once per ballot (the fast-round rule).
+        revote = bal > abal or (bal == abal and v1 == aval)
+        if bal >= promised and revote:
+            accs = accs[:dst] + ((max(promised, bal), bal, v1),) + accs[dst + 1 :]
+            voters = _record_vote(voters, dst, bal, v1)
+            out.append((ACCEPTED, dst, src, bal, v1, 0))
+    elif kind == PROMISE:
+        phase, rnd, heard, bb, masks, pv, dec = props[dst]
+        if phase == P1 and bal == make_ballot(rnd, dst):
+            heard |= 1 << src
+            if v1 > 0 and 0 <= v2 - 100 < n_prop:
+                vid = v2 - 100
+                if v1 > bb:
+                    bb, masks = v1, (0,) * n_prop
+                if v1 == bb:
+                    masks = masks[:vid] + (masks[vid] | (1 << src),) + masks[vid + 1 :]
+            if bin(heard).count("1") >= q1:
+                pv = _recovery_pick(
+                    dst, n_prop, n_acc, heard, bb, masks, fquorum, adopt_any
+                )
+                phase, heard = P2, 0
+                out.extend((ACCEPT, dst, a, bal, pv, 0) for a in range(n_acc))
+            props = props[:dst] + ((phase, rnd, heard, bb, masks, pv, dec),) + props[dst + 1 :]
+    elif kind == ACCEPTED:
+        phase, rnd, heard, bb, masks, pv, dec = props[dst]
+        fast_ok = phase == FAST and bal == FAST_BAL
+        p2_ok = phase == P2 and bal == make_ballot(rnd, dst)
+        if fast_ok or p2_ok:
+            heard |= 1 << src
+            need = fquorum if fast_ok else q2
+            if bin(heard).count("1") >= need:
+                phase, dec = DONE, pv
+            props = props[:dst] + ((phase, rnd, heard, bb, masks, pv, dec),) + props[dst + 1 :]
+
+    return (accs, props, tuple(sorted(net + tuple(out))), voters)
+
+
+def _timeout(state, p: int, n_prop: int, n_acc: int):
+    """Proposer ``p`` abandons its round and starts the next classic one."""
+    accs, props, net, voters = state
+    phase, rnd, heard, bb, masks, pv, dec = props[p]
+    rnd += 1
+    bal = make_ballot(rnd, p)
+    props = props[:p] + ((P1, rnd, 0, 0, (0,) * n_prop, pv, dec),) + props[p + 1 :]
+    out = tuple((PREPARE, p, a, bal, 0, 0) for a in range(n_acc))
+    return (accs, props, tuple(sorted(net + out)), voters)
+
+
+def _gc(state, n_prop: int):
+    """Drop in-flight messages whose delivery is provably a no-op.
+
+    Unlike the paxos checker, no prune here depends on a rule the injected
+    bug (``adopt_any`` — a PROPOSER pick) could break: acceptor monotonicity
+    holds in both modes, so the same reductions are sound for both.
+    """
+    accs, props, net, voters = state
+    keep = []
+    for m in net:
+        kind, src, dst, bal, v1, v2 = m
+        if kind == PREPARE:
+            if bal <= accs[dst][0]:
+                continue
+        elif kind == ACCEPT:
+            promised, abal, aval = accs[dst]
+            revote = bal > abal or (bal == abal and v1 == aval)
+            if bal < promised or not revote:
+                continue
+        else:
+            phase, rnd = props[dst][0], props[dst][1]
+            if phase == DONE:
+                continue
+            if kind == PROMISE and (phase != P1 or bal != make_ballot(rnd, dst)):
+                continue
+            if kind == ACCEPTED:
+                fast_ok = phase == FAST and bal == FAST_BAL
+                p2_ok = phase == P2 and bal == make_ballot(rnd, dst)
+                if not (fast_ok or p2_ok):
+                    continue
+        keep.append(m)
+    return (accs, props, tuple(keep), voters)
+
+
+def check_fp_exhaustive(
+    n_prop: int = 2,
+    n_acc: int = 5,
+    max_round: "int | tuple[int, ...]" = (1, 0),
+    max_states: int = 5_000_000,
+    adopt_any: bool = False,
+    q1: int = 0,
+    q2: int = 0,
+    q_fast: int = 0,
+) -> CheckResult:
+    """Exhaustively explore every Fast-Paxos schedule at small bounds.
+
+    Defaults: 2 proposers x 5 acceptors (5 is the smallest count where the
+    choosable rule is load-bearing: with 3, nothing reported by a majority
+    recovery can ever still reach the fast quorum of 3), proposer 0 allowed
+    one classic recovery round, proposer 1 fast-only.  ``q1``/``q2``/
+    ``q_fast`` = 0 use the classic majority / ceil(3n/4) defaults (nonzero
+    values model Fast Flexible Paxos quorums).  Raises ``AssertionError``
+    with the counterexample trace on an agreement/validity violation.
+    """
+    if n_prop > 8:
+        raise ValueError("n_prop > 8 collides packed ballots (make_ballot)")
+    if isinstance(max_round, int):
+        max_round = (max_round,) * n_prop
+    if len(max_round) != n_prop:
+        raise ValueError(
+            f"max_round has {len(max_round)} bounds for n_prop={n_prop}"
+        )
+    quorum = n_acc // 2 + 1
+    q1 = q1 or quorum
+    q2 = q2 or quorum
+    fquorum = q_fast or _fast_quorum(n_acc)
+    own_vals = {_own_val(p) for p in range(n_prop)}
+    stats = {"decided_states": 0, "chosen_all": set()}
+
+    def check_state(state, trace) -> None:
+        accs, props, net, voters = state
+        chosen = _chosen(voters, q2, fquorum)
+        stats["chosen_all"] |= chosen
+        decided = {pr[6] for pr in props if pr[0] == DONE}
+        if decided:
+            stats["decided_states"] += 1
+        ok = (
+            len(chosen) <= 1  # agreement
+            and chosen <= own_vals  # validity
+            and decided <= chosen  # a decided proposer's value was chosen
+        )
+        if not ok:
+            raise AssertionError(
+                f"invariant violated: chosen={chosen} decided={decided} "
+                f"after trace={list(trace)}"
+            )
+
+    def successors(state):
+        accs, props, net, voters = state
+        for i in range(len(net)):
+            yield ("d", net[i]), _gc(
+                _deliver(state, i, n_prop, n_acc, q1, q2, fquorum, adopt_any),
+                n_prop,
+            )
+        for p in range(n_prop):
+            if props[p][0] != DONE and props[p][1] < max_round[p]:
+                yield ("t", p), _gc(_timeout(state, p, n_prop, n_acc), n_prop)
+
+    states = explore(_init_state(n_prop, n_acc), successors, check_state, max_states)
+    return CheckResult(
+        states=states,
+        decided_states=stats["decided_states"],
+        chosen_values=stats["chosen_all"],
+        counterexample=None,
+    )

@@ -141,6 +141,27 @@ def build_parser() -> argparse.ArgumentParser:
         "--unsafe-accept", action="store_true",
         help="inject the accept-below-promise bug (must find a counterexample)",
     )
+    c.add_argument(
+        "--protocol", choices=["paxos", "fastpaxos"], default="paxos",
+        help="which protocol's bounded model to enumerate",
+    )
+    c.add_argument(
+        "--adopt-any", action="store_true",
+        help="fastpaxos only: inject the wrong-recovery bug (adopt any "
+        "reported value instead of the choosable rule)",
+    )
+    c.add_argument(
+        "--q1", type=int, default=0,
+        help="fastpaxos only: FFP phase-1 quorum (0 = majority)",
+    )
+    c.add_argument(
+        "--q2", type=int, default=0,
+        help="fastpaxos only: FFP phase-2 quorum (0 = majority)",
+    )
+    c.add_argument(
+        "--q-fast", type=int, default=0,
+        help="fastpaxos only: FFP fast quorum (0 = ceil(3n/4))",
+    )
     return p
 
 
@@ -309,17 +330,44 @@ def cmd_soak(args: argparse.Namespace) -> int:
 
 def cmd_check(args: argparse.Namespace) -> int:
     """Exhaustively model-check a bounded instance; print the space summary."""
-    from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
-
     mr = args.max_round[0] if len(args.max_round) == 1 else tuple(args.max_round)
+    # Reject flags that the selected protocol's model would silently ignore —
+    # a user probing an unsafe FFP quorum without --protocol fastpaxos must
+    # get an error, not a misleading "ok" from the classic checker.
+    if args.protocol == "fastpaxos" and args.unsafe_accept:
+        print("error: --unsafe-accept applies to --protocol paxos only",
+              file=sys.stderr)
+        return 1
+    if args.protocol != "fastpaxos" and (
+        args.adopt_any or args.q1 or args.q2 or args.q_fast
+    ):
+        print("error: --adopt-any/--q1/--q2/--q-fast require "
+              "--protocol fastpaxos", file=sys.stderr)
+        return 1
     try:
-        r = check_exhaustive(
-            n_prop=args.n_prop,
-            n_acc=args.n_acc,
-            max_round=mr,
-            max_states=args.max_states,
-            unsafe_accept=args.unsafe_accept,
-        )
+        if args.protocol == "fastpaxos":
+            from paxos_tpu.cpu_ref.fp_exhaustive import check_fp_exhaustive
+
+            r = check_fp_exhaustive(
+                n_prop=args.n_prop,
+                n_acc=args.n_acc,
+                max_round=mr,
+                max_states=args.max_states,
+                adopt_any=args.adopt_any,
+                q1=args.q1,
+                q2=args.q2,
+                q_fast=args.q_fast,
+            )
+        else:
+            from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
+
+            r = check_exhaustive(
+                n_prop=args.n_prop,
+                n_acc=args.n_acc,
+                max_round=mr,
+                max_states=args.max_states,
+                unsafe_accept=args.unsafe_accept,
+            )
     except AssertionError as e:
         print(json.dumps({"ok": False, "counterexample": str(e)}))
         return 2

@@ -113,3 +113,23 @@ def test_cli_check_subcommand(capsys):
     )
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert not out["ok"] and "invariant violated" in out["counterexample"]
+
+
+def test_cli_check_fastpaxos(capsys):
+    from paxos_tpu.harness.cli import main
+
+    # Clean bounded space (tiny: both proposers fast-only).
+    assert main([
+        "--platform", "cpu", "check", "--protocol", "fastpaxos",
+        "--n-acc", "4", "--max-round", "0",
+    ]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] and out["states"] > 100
+
+    # Injected wrong-recovery rule must produce a counterexample.
+    assert main([
+        "--platform", "cpu", "check", "--protocol", "fastpaxos",
+        "--n-acc", "4", "--max-round", "1", "0", "--adopt-any",
+    ]) == 2
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert not out["ok"] and "invariant violated" in out["counterexample"]
