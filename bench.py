@@ -36,6 +36,7 @@ def _configs(platform: str):
     """
     from paxos_tpu.harness.config import (
         config2_dueling_drop,
+        config3_long,
         config3_multipaxos,
         config5_sweep,
     )
@@ -48,6 +49,9 @@ def _configs(platform: str):
         ("config5-fastpaxos", sweep["fastpaxos"]),
         ("config5-raftcore", sweep["raftcore"]),
         ("config3-multipaxos", config3_multipaxos(n_inst=n)),
+        # Long-log mode: 16-slot window sliding over a 256-slot log with
+        # decided-prefix compaction at every chunk boundary (cost included).
+        ("config3long-multipaxos", config3_long(n_inst=n)),
     ]
     engines = ("fused", "xla") if on_tpu else ("xla",)
     return [(name, cfg, eng) for name, cfg in cases for eng in engines]
@@ -57,12 +61,20 @@ def bench_case(cfg, engine: str, chunk: int = 64, timed_chunks: int = 4) -> dict
     """Measure one (config, engine) case; returns the result dict."""
     import jax
 
-    from paxos_tpu.harness.run import init_plan, init_state, make_advance
+    from paxos_tpu.harness.run import (
+        init_plan,
+        init_state,
+        make_advance,
+        make_longlog,
+    )
 
     platform = jax.devices()[0].platform
     state = init_state(cfg)
     plan = init_plan(cfg)
     advance = make_advance(cfg, plan, engine)
+    ll = make_longlog(cfg)
+    if ll:  # long-log: compaction rides in the timed loop
+        advance = ll.wrap_advance(advance)
 
     # Warmup: compile + one chunk.  NOTE: timing must end with a device->host
     # readback, not block_until_ready — on the axon tunnel backend

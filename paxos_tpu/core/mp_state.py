@@ -165,6 +165,10 @@ class MultiPaxosState:
     promises: PromiseBuf  # a->p
     accepted: AcceptedBuf  # a->p
     tick: jnp.ndarray  # () int32
+    # (I,) int32: global log index of window slot 0 — the count of
+    # decided-prefix slots compacted out so far (0 in plain mode).  Message
+    # slots stay window-relative; values/termination use base + slot.
+    base: jnp.ndarray
 
     @classmethod
     def init(
@@ -191,6 +195,7 @@ class MultiPaxosState:
             promises=PromiseBuf.empty(n_inst, n_prop, n_acc, log_len),
             accepted=AcceptedBuf.empty(n_inst, n_prop, n_acc),
             tick=jnp.zeros((), jnp.int32),
+            base=jnp.zeros((n_inst,), jnp.int32),
         )
 
     @property

@@ -978,6 +978,8 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
             prop["last_chosen_count"][p], chosen_count
         )
         log_full = chosen_count >= L
+        if cfg.log_total:
+            log_full = log_full or st["base"] + chosen_count >= cfg.log_total
         lease_out = lease_timer > cfg.lease_len
 
         start_elec = (
@@ -1027,10 +1029,14 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
             for a in range(A):
                 _send(st["requests"], 0, p, a, m["keep_prep"], bal, 0, 0)
         ci = min(prop["commit_idx"][p], L - 1)
-        if new_phase == LEAD and p_up and prop["commit_idx"][p] < L:
+        drive = new_phase == LEAD and p_up and prop["commit_idx"][p] < L
+        if cfg.log_total:
+            drive = drive and st["base"] + prop["commit_idx"][p] < cfg.log_total
+        if drive:
             rb = prop["recov_bal"][p][ci]
             rv = prop["recov_val"][p][ci]
-            pval = rv if rb > 0 else (p + 1) * 1000 + ci
+            # Command payloads are keyed by GLOBAL slot (base + ci).
+            pval = rv if rb > 0 else (p + 1) * 1000 + st["base"] + ci
             for a in range(A):
                 _send(st["requests"], 1, p, a, m["keep_acc"], bal, pval, ci)
 
@@ -1040,6 +1046,64 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
         prop["candidate_timer"][p] = candidate_timer
 
     st["tick"] = tick + 1
+
+
+def multipaxos_compact_lane(st: dict) -> tuple:
+    """Scalar mirror of ``protocols.multipaxos.compact_mp`` for ONE lane.
+
+    Shifts the contiguous chosen prefix out of every slot-indexed list,
+    re-bases in-flight ACCEPT slots (dropping those below the new window),
+    and advances ``base``.  Returns ``(shift, evicted_vals)`` so the
+    differential harness can compare against the kernel's outputs.
+    """
+    lrn, prop, acc = st["learner"], st["proposer"], st["acceptor"]
+    L = len(lrn["chosen"])
+    A = len(acc["promised"])
+    P = len(prop["bal"])
+    shift = 0
+    while shift < L and lrn["chosen"][shift]:
+        shift += 1
+    evicted = list(lrn["chosen_val"][:shift]) + [0] * (L - shift)
+
+    def sh(lst, fill=0):
+        return lst[shift:] + [fill] * shift
+
+    for a in range(A):
+        acc["log_bal"][a] = sh(acc["log_bal"][a])
+        acc["log_val"][a] = sh(acc["log_val"][a])
+    for p in range(P):
+        prop["recov_bal"][p] = sh(prop["recov_bal"][p])
+        prop["recov_val"][p] = sh(prop["recov_val"][p])
+        prop["commit_idx"][p] = max(prop["commit_idx"][p] - shift, 0)
+        prop["last_chosen_count"][p] = max(
+            prop["last_chosen_count"][p] - shift, 0
+        )
+    for key in ("lt_bal", "lt_val", "lt_mask"):
+        # Fresh row lists (a shared fill list would alias mutations).
+        lrn[key] = lrn[key][shift:] + [
+            [0] * len(lrn[key][0]) for _ in range(shift)
+        ]
+    lrn["chosen"] = sh(lrn["chosen"], fill=False)
+    lrn["chosen_val"] = sh(lrn["chosen_val"])
+    lrn["chosen_tick"] = sh(lrn["chosen_tick"], fill=-1)
+    req = st["requests"]
+    for p in range(P):
+        for a in range(A):
+            s = req["v2"][1][p][a] - shift  # kind 1 = ACCEPT carries the slot
+            req["v2"][1][p][a] = s
+            if s < 0:
+                req["present"][1][p][a] = False
+            ab = st["accepted"]
+            s2 = ab["slot"][p][a] - shift
+            ab["slot"][p][a] = s2
+            if s2 < 0:
+                ab["present"][p][a] = False
+            # In-flight promises drop on any nonzero shift (compact_mp
+            # clears them instead of shifting their payloads).
+            if shift:
+                st["promises"]["present"][p][a] = False
+    st["base"] += shift
+    return shift, evicted
 
 
 INTERP_TICKS = {

@@ -75,6 +75,12 @@ class FaultConfig:
     # Multi-Paxos leader lease (ticks without chosen-count progress before
     # followers suspect the leader / a leader demotes itself)
     lease_len: int = 24
+    # Multi-Paxos long-log mode (SURVEY.md §6.7): total GLOBAL log length to
+    # replicate through the sliding window of ``SimConfig.log_len`` slots
+    # (decided prefixes compact out at chunk boundaries —
+    # ``protocols.multipaxos.compact_mp``).  0 = plain bounded-log mode
+    # (window IS the whole log; bit-identical to the pre-long-log build).
+    log_total: int = 0
 
 
 @struct.dataclass

@@ -26,6 +26,7 @@ CONFIGS = {
     "config1": config_mod.config1_no_faults,
     "config2": config_mod.config2_dueling_drop,
     "config3": config_mod.config3_multipaxos,
+    "config3long": config_mod.config3_long,
     "config4": config_mod.config4_byzantine,
     "partition": config_mod.config_partition,
     # Flexible Paxos: safe (4+2 > 5) and deliberately unsafe (2+2 <= 5)
@@ -175,6 +176,7 @@ def cmd_run(args: argparse.Namespace) -> int:
         init_plan,
         init_state,
         make_advance,
+        make_longlog,
         summarize,
     )
     from paxos_tpu.parallel.mesh import make_mesh, shard_pytree
@@ -229,6 +231,8 @@ def cmd_run(args: argparse.Namespace) -> int:
     log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
              n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)
 
+    ll = make_longlog(cfg)
+
     done, since_ckpt = 0, 0
     with trace_mod.profile(args.trace):
         while done < args.ticks:
@@ -236,6 +240,8 @@ def cmd_run(args: argparse.Namespace) -> int:
             state = advance(state, n)
             done += n
             since_ckpt += n
+            if ll:  # decided prefixes leave the window between chunks
+                state = ll.compact(state)
             rep = summarize(state)
             log.emit("chunk", **rep)
             if args.events:
@@ -245,11 +251,14 @@ def cmd_run(args: argparse.Namespace) -> int:
                 log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
                 since_ckpt = 0
             # Exact check (a float32 mean can round to != 1.0 at huge scales).
-            if args.until_all_chosen and bool(state.learner.chosen.all()):
-                break
+            if args.until_all_chosen:
+                if (ll.done(state) if ll else bool(state.learner.chosen.all())):
+                    break
 
     report = summarize(state, liveness=args.liveness)
     report["config_fingerprint"] = cfg.fingerprint()
+    if ll:
+        report.update(ll.report_fields(state))
     if args.checkpoint_dir:
         ckpt.save(args.checkpoint_dir, state, plan, cfg)
         log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))

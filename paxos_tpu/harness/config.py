@@ -77,6 +77,42 @@ def config3_multipaxos(n_inst: int = 1_000_000, seed: int = 0) -> SimConfig:
     )
 
 
+def config3_long(
+    n_inst: int = 262_144,
+    seed: int = 0,
+    log_total: int = 256,
+    window: int = 16,
+) -> SimConfig:
+    """Config 3-long: Multi-Paxos over a LONG log through a sliding window.
+
+    SURVEY.md §6.7's claim made concrete: ``log_total`` slots are replicated
+    per instance while HBM holds only the ``window``-slot working set —
+    decided prefixes compact out at chunk boundaries
+    (``protocols.multipaxos.compact_mp``).  Same fault family as config 3;
+    crash windows spread over the (much longer) expected run.
+    """
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        log_len=window,
+        k_slots=4,
+        seed=seed,
+        protocol="multipaxos",
+        fault=FaultConfig(
+            p_drop=0.05,
+            p_idle=0.1,
+            p_hold=0.1,
+            p_crash=0.1,
+            p_crash_prop=0.4,
+            crash_max_start=2000,
+            crash_max_len=60,
+            lease_len=24,
+            log_total=log_total,
+        ),
+    )
+
+
 def config4_byzantine(n_inst: int = 4096, seed: int = 0) -> SimConfig:
     """Config 4: acceptor equivocation (double-promise) to validate the checker."""
     return SimConfig(

@@ -138,6 +138,53 @@ def make_advance(
     raise ValueError(f"unknown engine: {engine!r}")
 
 
+class LongLog:
+    """Chunk-boundary orchestration for long-log Multi-Paxos (SURVEY §6.7).
+
+    The ONE owner of the compact/terminate/report protocol shared by
+    :func:`run`, the CLI loop, and the bench: decided prefixes compact out
+    of the window after every chunk, a run is done when every instance's
+    ``base`` reached ``log_total``, and reports carry the replicated-log
+    fields.  ``make_longlog`` returns None for non-long-log configs so
+    callers can write ``if ll:`` guards.
+    """
+
+    def __init__(self, cfg: SimConfig):
+        from paxos_tpu.protocols.multipaxos import compact_mp
+
+        self._compact_mp = compact_mp
+        self.log_total = cfg.fault.log_total
+
+    def compact(self, state):
+        state, _, _ = self._compact_mp(state)
+        return state
+
+    def wrap_advance(self, advance: Callable) -> Callable:
+        def advance_and_compact(state, n):
+            return self.compact(advance(state, n))
+
+        return advance_and_compact
+
+    def done(self, state) -> bool:
+        return bool((state.base >= self.log_total).all())
+
+    def report_fields(self, state) -> dict[str, Any]:
+        import numpy as np
+
+        base = np.asarray(jax.device_get(state.base))
+        return {
+            "log_total": self.log_total,
+            "slots_replicated": int(base.sum()),  # compacted = decided
+            "replicated_frac": float((base >= self.log_total).mean()),
+        }
+
+
+def make_longlog(cfg: SimConfig) -> "LongLog | None":
+    if cfg.protocol == "multipaxos" and cfg.fault.log_total > 0:
+        return LongLog(cfg)
+    return None
+
+
 def summarize(state: PaxosState, liveness: bool = False) -> dict[str, Any]:
     """Reduce on-device state to a host-side scalar report.
 
@@ -213,6 +260,10 @@ def run(
     state = init_state(cfg)
     plan = init_plan(cfg)
     advance = make_advance(cfg, plan, engine)
+    # Long-log Multi-Paxos (SURVEY.md §6.7): decided prefixes compact out of
+    # the window at every chunk boundary, so HBM stays O(window) while the
+    # replicated log grows to cfg.fault.log_total.
+    ll = make_longlog(cfg)
 
     budget = max_ticks if until_all_chosen else total_ticks
     done = 0
@@ -220,12 +271,18 @@ def run(
         n = min(chunk, budget - done)
         state = advance(state, n)
         done += n
-        if until_all_chosen:
+        if ll:
+            state = ll.compact(state)
+            if until_all_chosen and ll.done(state):
+                break
+        elif until_all_chosen:
             if state.learner.chosen.all().item():
                 break
     report = summarize(state, liveness=liveness)
     report["config_fingerprint"] = cfg.fingerprint()
     report["engine"] = engine
+    if ll:
+        report.update(ll.report_fields(state))
     if return_state:
         return report, state
     return report

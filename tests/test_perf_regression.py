@@ -39,9 +39,12 @@ def _recorded():
 
 @pytest.fixture(scope="module", autouse=True)
 def _bench_prng():
-    # Match the conditions the artifact was recorded under (bench.py main).
+    # Match the conditions the artifact was recorded under (bench.py main);
+    # restore afterwards so later modules keep the default stream impl.
+    prev = jax.config.jax_default_prng_impl
     jax.config.update("jax_default_prng_impl", "rbg")
     yield
+    jax.config.update("jax_default_prng_impl", prev)
 
 
 @pytest.mark.parametrize(
