@@ -243,6 +243,266 @@ struct Sim {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Multi-Paxos oracle (round-2: second protocol so triangulation isn't
+// single-protocol).  Mirrors the SEMANTICS of
+// paxos_tpu/protocols/multipaxos.py — whole-log phase 1 (promises carry the
+// full accepted log), slot-by-slot phase 2, leader preemption via timeout
+// events — under this file's own event-driven scheduler.  Tick-based leases
+// don't exist here: preemption timeouts subsume them (the lease only decides
+// WHEN a follower challenges; safety must hold for ANY challenge schedule,
+// which is exactly what random timeout events explore).
+// ---------------------------------------------------------------------------
+
+namespace mp {
+
+constexpr int kMaxLog = 32;
+
+inline int32_t own_slot_value(int pid, int slot) {
+  return (pid + 1) * 1000 + slot;  // multipaxos.own_slot_value
+}
+
+enum Kind : uint8_t { PREPARE, PROMISE, ACCEPT, ACCEPTED };
+
+struct Msg {
+  Kind kind;
+  int8_t src;
+  int8_t dst;
+  int32_t bal;
+  int32_t slot;
+  int32_t val;
+  int32_t log_bal[kMaxLog];  // PROMISE payload: full accepted log snapshot
+  int32_t log_val[kMaxLog];
+};
+
+struct Acceptor {
+  int32_t promised = 0;
+  int32_t log_bal[kMaxLog] = {};
+  int32_t log_val[kMaxLog] = {};
+};
+
+struct Proposer {
+  enum Phase { FOLLOW, CAND, LEAD, DONE };
+  int pid;
+  int rnd = 0;
+  int32_t bal = 0;
+  Phase phase = FOLLOW;
+  uint32_t heard = 0;
+  int commit_idx = 0;
+  int32_t recov_bal[kMaxLog] = {};
+  int32_t recov_val[kMaxLog] = {};
+  int32_t decided[kMaxLog] = {};
+
+  explicit Proposer(int p) : pid(p) {}
+};
+
+struct Sim {
+  int n_prop, n_acc, log_len, quorum;
+  double p_drop, p_dup, timeout_weight;
+  Rng rng;
+  std::vector<Acceptor> acceptors;
+  std::vector<Proposer> proposers;
+  std::vector<Msg> network;
+  // Accept-event history per (slot, ballot, value) -> voter bitmask.
+  std::vector<int32_t> ev_slot, ev_bal, ev_val;
+  std::vector<uint32_t> ev_mask;
+
+  Sim(uint64_t seed, int np, int na, int ll, double pd, double pdup, double tw)
+      : n_prop(np), n_acc(na), log_len(ll), quorum(na / 2 + 1), p_drop(pd),
+        p_dup(pdup), timeout_weight(tw), rng(seed ^ 0xa5a5a5a5ull) {
+    acceptors.resize(n_acc);
+    for (int p = 0; p < n_prop; ++p) proposers.emplace_back(p);
+  }
+
+  void offer(const Msg& m) {
+    if (rng.uniform() >= p_drop) network.push_back(m);
+  }
+
+  void record_accept(int acc, int32_t slot, int32_t bal, int32_t val) {
+    for (size_t i = 0; i < ev_bal.size(); ++i) {
+      if (ev_slot[i] == slot && ev_bal[i] == bal && ev_val[i] == val) {
+        ev_mask[i] |= 1u << acc;
+        return;
+      }
+    }
+    ev_slot.push_back(slot);
+    ev_bal.push_back(bal);
+    ev_val.push_back(val);
+    ev_mask.push_back(1u << acc);
+  }
+
+  void drive_slot(Proposer& p) {  // broadcast ACCEPT for the current slot
+    if (p.commit_idx >= log_len) {
+      p.phase = Proposer::DONE;
+      return;
+    }
+    int s = p.commit_idx;
+    int32_t v = p.recov_bal[s] > 0 ? p.recov_val[s] : own_slot_value(p.pid, s);
+    for (int a = 0; a < n_acc; ++a) {
+      Msg m{};
+      m.kind = ACCEPT;
+      m.src = static_cast<int8_t>(p.pid);
+      m.dst = static_cast<int8_t>(a);
+      m.bal = p.bal;
+      m.slot = s;
+      m.val = v;
+      offer(m);
+    }
+  }
+
+  void dispatch(const Msg& m) {
+    switch (m.kind) {
+      case PREPARE: {
+        Acceptor& a = acceptors[m.dst];
+        if (m.bal > a.promised) {
+          a.promised = m.bal;
+          Msg r{};
+          r.kind = PROMISE;
+          r.src = m.dst;
+          r.dst = m.src;
+          r.bal = m.bal;
+          std::memcpy(r.log_bal, a.log_bal, sizeof(a.log_bal));
+          std::memcpy(r.log_val, a.log_val, sizeof(a.log_val));
+          offer(r);
+        }
+        break;
+      }
+      case ACCEPT: {
+        Acceptor& a = acceptors[m.dst];
+        if (m.bal >= a.promised) {
+          a.promised = a.promised > m.bal ? a.promised : m.bal;
+          a.log_bal[m.slot] = m.bal;
+          a.log_val[m.slot] = m.val;
+          record_accept(m.dst, m.slot, m.bal, m.val);
+          Msg r{};
+          r.kind = ACCEPTED;
+          r.src = m.dst;
+          r.dst = m.src;
+          r.bal = m.bal;
+          r.slot = m.slot;
+          r.val = m.val;
+          offer(r);
+        }
+        break;
+      }
+      case PROMISE: {
+        Proposer& p = proposers[m.dst];
+        if (p.phase != Proposer::CAND || m.bal != p.bal) break;
+        p.heard |= 1u << m.src;
+        // Whole-log recovery: per-slot max-ballot fold over promises.
+        for (int s = 0; s < log_len; ++s) {
+          if (m.log_bal[s] > p.recov_bal[s]) {
+            p.recov_bal[s] = m.log_bal[s];
+            p.recov_val[s] = m.log_val[s];
+          }
+        }
+        if (__builtin_popcount(p.heard) >= quorum) {
+          p.phase = Proposer::LEAD;
+          p.heard = 0;
+          p.commit_idx = 0;
+          drive_slot(p);
+        }
+        break;
+      }
+      case ACCEPTED: {
+        Proposer& p = proposers[m.dst];
+        if (p.phase != Proposer::LEAD || m.bal != p.bal ||
+            m.slot != p.commit_idx)
+          break;
+        p.heard |= 1u << m.src;
+        if (__builtin_popcount(p.heard) >= quorum) {
+          p.decided[p.commit_idx] = m.val;
+          p.heard = 0;
+          ++p.commit_idx;
+          drive_slot(p);
+        }
+        break;
+      }
+    }
+  }
+
+  bool any_done() const {
+    for (const auto& p : proposers)
+      if (p.phase == Proposer::DONE) return true;
+    return false;
+  }
+
+  Result run(int max_steps) {
+    int steps = 0;
+    while (steps < max_steps && !any_done()) {
+      ++steps;
+      if (!network.empty() && rng.uniform() >= timeout_weight) {
+        int i = rng.below(static_cast<int>(network.size()));
+        Msg m = network[i];
+        if (rng.uniform() >= p_dup) {
+          network[i] = network.back();
+          network.pop_back();
+        }
+        dispatch(m);
+      } else {
+        // Preemption/lease surrogate: any non-DONE proposer may challenge
+        // with the next ballot (a LEAD proposer re-elects itself too —
+        // harmless, and it models a stale leader recovering leadership).
+        int live = 0;
+        for (const auto& p : proposers) live += p.phase != Proposer::DONE;
+        if (live == 0) break;
+        int pick = rng.below(live);
+        for (auto& p : proposers) {
+          if (p.phase == Proposer::DONE) continue;
+          if (pick-- == 0) {
+            ++p.rnd;
+            p.bal = make_ballot(p.rnd, p.pid);
+            p.phase = Proposer::CAND;
+            p.heard = 0;
+            for (int s = 0; s < log_len; ++s)
+              p.recov_bal[s] = p.recov_val[s] = 0;
+            for (int a = 0; a < n_acc; ++a) {
+              Msg m{};
+              m.kind = PREPARE;
+              m.src = static_cast<int8_t>(p.pid);
+              m.dst = static_cast<int8_t>(a);
+              m.bal = p.bal;
+              offer(m);
+            }
+            break;
+          }
+        }
+      }
+    }
+
+    // Omniscient per-slot oracle over the accept history.
+    int32_t chosen_val[kMaxLog];
+    int chosen_cnt[kMaxLog] = {};
+    bool validity = true;
+    int slots_chosen = 0;
+    for (size_t i = 0; i < ev_bal.size(); ++i) {
+      if (__builtin_popcount(ev_mask[i]) >= quorum) {
+        int s = ev_slot[i];
+        if (chosen_cnt[s] == 0 || chosen_val[s] != ev_val[i]) ++chosen_cnt[s];
+        chosen_val[s] = ev_val[i];
+        // Validity: some proposer proposes this value FOR THIS SLOT.
+        int32_t v = ev_val[i];
+        validity &= v % 1000 == s && v / 1000 >= 1 && v / 1000 <= n_prop;
+      }
+    }
+    bool agreement = true;
+    for (int s = 0; s < log_len; ++s) {
+      agreement &= chosen_cnt[s] <= 1;
+      slots_chosen += chosen_cnt[s] >= 1;
+    }
+    // A DONE proposer's decided log must match the chosen values exactly.
+    for (const auto& p : proposers) {
+      if (p.phase != Proposer::DONE) continue;
+      for (int s = 0; s < log_len; ++s)
+        agreement &= chosen_cnt[s] == 1 && p.decided[s] == chosen_val[s];
+    }
+    return Result{any_done() ? 1 : 0, agreement ? 1 : 0, validity ? 1 : 0,
+                  slots_chosen, steps};
+  }
+};
+
+}  // namespace mp
+
 }  // namespace
 
 extern "C" {
@@ -267,6 +527,24 @@ void run_batch(uint64_t seed0, int32_t n_runs, int32_t n_prop, int32_t n_acc,
   for (int32_t r = 0; r < n_runs; ++r) {
     Sim sim(seed0 + static_cast<uint64_t>(r), n_prop, n_acc, p_drop, p_dup,
             timeout_weight);
+    Result res = sim.run(max_steps);
+    std::memcpy(out + 5 * r, &res, sizeof(res));
+  }
+}
+
+// Multi-Paxos batch: same 5-int32-per-run layout as run_batch, with
+// n_chosen reporting the count of slots chosen (not distinct values).
+void mp_run_batch(uint64_t seed0, int32_t n_runs, int32_t n_prop,
+                  int32_t n_acc, int32_t log_len, double p_drop, double p_dup,
+                  double timeout_weight, int32_t max_steps, int32_t* out) {
+  if (!valid_topology(n_prop, n_acc) || log_len < 1 ||
+      log_len > mp::kMaxLog) {
+    for (int32_t i = 0; i < 5 * n_runs; ++i) out[i] = -1;
+    return;
+  }
+  for (int32_t r = 0; r < n_runs; ++r) {
+    mp::Sim sim(seed0 + static_cast<uint64_t>(r), n_prop, n_acc, log_len,
+                p_drop, p_dup, timeout_weight);
     Result res = sim.run(max_steps);
     std::memcpy(out + 5 * r, &res, sizeof(res));
   }

@@ -60,6 +60,12 @@ def _load() -> ctypes.CDLL:
         lib.run_batch.restype = None
         lib.bench_steps.argtypes = lib.run_batch.argtypes[:-1]
         lib.bench_steps.restype = ctypes.c_int64
+        lib.mp_run_batch.argtypes = [
+            ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.mp_run_batch.restype = None
         _LIB = lib
     return _LIB
 
@@ -106,6 +112,44 @@ def run_native_batch(
     lib.run_batch(
         seed0, n_runs, n_prop, n_acc, p_drop, p_dup, timeout_weight, max_steps,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return OracleBatch(
+        decided=out[:, 0].astype(bool),
+        agreement_ok=out[:, 1].astype(bool),
+        validity_ok=out[:, 2].astype(bool),
+        n_chosen=out[:, 3],
+        steps=out[:, 4],
+    )
+
+
+def run_native_mp_batch(
+    seed0: int,
+    n_runs: int,
+    n_prop: int = 2,
+    n_acc: int = 3,
+    log_len: int = 4,
+    p_drop: float = 0.0,
+    p_dup: float = 0.0,
+    timeout_weight: float = 0.05,
+    max_steps: int = 60_000,
+) -> OracleBatch:
+    """Fuzz ``n_runs`` independent Multi-Paxos instances in native code.
+
+    Second oracle protocol (round-1 verdict #9): whole-log phase 1,
+    slot-by-slot phase 2, leader preemption by random challenge — the same
+    semantics as ``protocols/multipaxos.py`` under an event-driven
+    scheduler.  ``n_chosen`` reports chosen SLOTS; ``agreement_ok`` covers
+    per-slot agreement AND every finished proposer's decided log matching
+    the chosen values.
+    """
+    _check_topology(n_prop, n_acc)
+    if not 1 <= log_len <= 32:
+        raise ValueError(f"log_len={log_len} outside oracle capacity [1, 32]")
+    lib = _load()
+    out = np.empty((n_runs, 5), dtype=np.int32)
+    lib.mp_run_batch(
+        seed0, n_runs, n_prop, n_acc, log_len, p_drop, p_dup, timeout_weight,
+        max_steps, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return OracleBatch(
         decided=out[:, 0].astype(bool),

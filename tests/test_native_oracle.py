@@ -11,7 +11,11 @@ import shutil
 import pytest
 
 from paxos_tpu.cpu_ref.golden import run_golden
-from paxos_tpu.cpu_ref.native import bench_native_steps, run_native_batch
+from paxos_tpu.cpu_ref.native import (
+    bench_native_steps,
+    run_native_batch,
+    run_native_mp_batch,
+)
 
 needs_gxx = pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
 
@@ -60,3 +64,32 @@ def test_native_bench_counts_steps():
     total = bench_native_steps(seed0=0, n_runs=50, n_prop=1, n_acc=3)
     # A clean 1-proposer instance needs ~a dozen events; 50 runs well under cap.
     assert 50 * 5 < total < 50 * 20_000
+
+
+# ---- Multi-Paxos oracle (round-1 verdict #9: second protocol) ----
+
+
+@needs_gxx
+def test_native_mp_clean_network():
+    """No faults: some proposer replicates the whole log on most seeds, and
+    every chosen slot is agreement/validity-clean on all of them."""
+    batch = run_native_mp_batch(
+        seed0=0, n_runs=1000, n_prop=2, n_acc=3, log_len=4
+    )
+    assert batch.agreement_ok.all()
+    assert batch.validity_ok.all()
+    assert batch.decided.mean() > 0.9
+    assert (batch.n_chosen[batch.decided] == 4).all()
+
+
+@needs_gxx
+def test_native_mp_chaos():
+    """Drops/dups/preemption storms: per-slot safety on every seed, and a
+    finished leader's decided log always equals the chosen values."""
+    batch = run_native_mp_batch(
+        seed0=7_000, n_runs=1000, n_prop=3, n_acc=5, log_len=6,
+        p_drop=0.2, p_dup=0.2, timeout_weight=0.1,
+    )
+    assert batch.agreement_ok.all()
+    assert batch.validity_ok.all()
+    assert batch.decided.mean() > 0.5  # chaos hurts liveness, never safety
