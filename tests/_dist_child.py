@@ -1,0 +1,91 @@
+"""Child process for the two-process distributed smoke test.
+
+Usage: python tests/_dist_child.py <process_id> <coordinator_port>
+
+Joins a 2-process CPU "cluster" via ``init_distributed`` (explicit
+coordinator — the multi-controller rendezvous path that round 1 left
+uncovered), builds the global instances mesh spanning both processes'
+devices, runs a tiny sharded campaign entirely under ``jit`` (outputs are
+replicated scalars, so both controllers must report identical metrics),
+and prints one JSON line.
+"""
+
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+
+    from paxos_tpu.parallel.distributed import (
+        init_distributed,
+        make_instances_mesh,
+    )
+
+    idx = init_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+    assert idx == pid, (idx, pid)
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 4  # 2 local x 2 processes, globally visible
+
+    from paxos_tpu.harness.config import config2_dueling_drop
+    from paxos_tpu.harness.run import base_key, init_plan, init_state, run_chunk
+    from paxos_tpu.parallel.mesh import INSTANCES_AXIS
+
+    cfg = config2_dueling_drop(n_inst=64, seed=3)
+    mesh = make_instances_mesh()
+
+    def constrain(tree):
+        def leaf(x):
+            if getattr(x, "ndim", 0) >= 1 and x.shape[-1] == cfg.n_inst:
+                spec = P(*([None] * (x.ndim - 1)), INSTANCES_AXIS)
+            else:
+                spec = P()
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec)
+            )
+
+        return jax.tree.map(leaf, tree)
+
+    from paxos_tpu.harness.run import get_step_fn
+
+    step = get_step_fn(cfg.protocol)
+
+    @jax.jit
+    def campaign():
+        # State is built INSIDE jit and sharding-constrained, so each
+        # controller materializes only its addressable shards — the
+        # multi-controller idiom (no host-side global array assembly).
+        state = constrain(init_state(cfg))
+        plan = constrain(init_plan(cfg))
+        state = run_chunk(state, base_key(cfg), plan, cfg.fault, 32, step)
+        return {
+            "chosen": state.learner.chosen.sum(),
+            "violations": state.learner.violations.sum(),
+            "evictions": state.learner.evictions.sum(),
+            "tick": state.tick,
+        }
+
+    out = {k: int(v) for k, v in jax.device_get(campaign()).items()}
+    out["process"] = pid
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

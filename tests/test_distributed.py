@@ -38,3 +38,52 @@ def test_instances_mesh_spans_all_devices_and_runs():
 
 def test_process_local_batch():
     assert process_local_batch(1 << 20) == (1 << 20) // jax.process_count()
+
+
+def test_two_process_rendezvous_smoke():
+    """Round-1 verdict #8: the actual jax.distributed.initialize rendezvous.
+
+    Two fresh CPU processes join via an explicit coordinator, build the
+    global 4-device mesh, run the same tiny sharded campaign under jit,
+    and must print IDENTICAL metrics (multi-controller SPMD: every
+    controller sees the same replicated scalars)."""
+    import json
+    import pathlib
+    import socket
+    import subprocess
+    import sys
+
+    child = pathlib.Path(__file__).parent / "_dist_child.py"
+    with socket.socket() as s:  # grab a free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(child), str(pid), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # A hung rendezvous (e.g. the free-port TOCTOU race) must not leak
+        # children blocking in distributed-init past the test.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    assert outs[0]["process"] == 0 and outs[1]["process"] == 1
+    for o in outs:
+        del o["process"]
+    assert outs[0] == outs[1], outs  # identical metrics on both controllers
+    assert outs[0]["violations"] == 0
+    assert outs[0]["tick"] == 32
+    assert outs[0]["chosen"] > 0
