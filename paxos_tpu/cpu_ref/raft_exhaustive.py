@@ -1,0 +1,221 @@
+"""Bounded exhaustive model checking of the Raft-core vote kernel.
+
+Third member of the model-checker family (`exhaustive.py` classic Paxos,
+`fp_exhaustive.py` Fast Paxos): every schedule of a small bounded
+instance of `protocols/raftcore.py`'s semantics — election restriction,
+one-vote-per-term, entry adoption from vote replies (grants AND denials),
+heartbeat append/ack commit — with agreement/validity asserted in every
+reachable state.
+
+The kernel's docstring argues safety rests on two mechanisms:
+
+1. **election restriction** — a voter grants only candidates whose last
+   entry is at least as up to date (``cand_last >= ent_term``);
+2. **adoption** — a candidate adopts the highest-term entry carried by
+   ANY vote reply before proposing.
+
+This checker makes that argument mechanical: ``no_restriction`` and
+``no_adoption`` disable each leg independently.  Either leg ALONE keeps
+the bounded space clean (restriction blocks stale candidates outright —
+real Raft's design; adoption recovers the committed value Paxos-style
+even when stale candidates win), while disabling BOTH yields a
+counterexample trace (a stale candidate wins with an empty log and
+commits a second value over the first) — asserted by
+tests/test_exhaustive.py.
+
+Same soundness notes as the siblings: loss = never-delivered (every
+prefix explored), duplication left to the fuzzer, GC'd no-op deliveries
+collapse dead-letter orderings.
+"""
+
+from __future__ import annotations
+
+from paxos_tpu.cpu_ref.exhaustive import (
+    CheckResult,
+    _chosen,
+    _own_val,
+    _record_vote as _record,
+    explore,
+    make_ballot,
+)
+
+# Message kinds.
+REQVOTE, VOTE, APPEND, ACK = 0, 1, 2, 3
+# Candidate phases (core/raft_state.py: CAND, LEAD, DONE).
+CAND, LEAD, DONE = 0, 1, 2
+
+# A voter: (voted, ent_term, ent_val).
+# A candidate: (phase, rnd, heard_mask, ent_term, ent_val, prop_val, decided).
+# Messages (kind, src, dst, term, x, y):
+#   REQVOTE: x = cand_last (sender's entry term), y unused
+#   VOTE:    x = granted (0/1), y = (pre-update ent_term, ent_val) packed
+#            as a tuple — kept as two fields via a 7-tuple instead.
+# To stay hashable and uniform, messages are 7-tuples
+# (kind, src, dst, term, x, y, z).
+
+
+def _init_state(n_prop: int, n_acc: int):
+    voters = tuple((0, 0, 0) for _ in range(n_acc))
+    cands = tuple((CAND, 0, 0, 0, 0, 0, 0) for p in range(n_prop))
+    net = tuple(
+        sorted(
+            (REQVOTE, p, a, make_ballot(0, p), 0, 0, 0)
+            for p in range(n_prop)
+            for a in range(n_acc)
+        )
+    )
+    return (voters, cands, net, ())
+
+
+def _deliver(
+    state,
+    i: int,
+    n_acc: int,
+    quorum: int,
+    no_restriction: bool,
+    no_adoption: bool,
+):
+    voters, cands, net, events = state
+    kind, src, dst, term, x, y, z = net[i]
+    net = net[:i] + net[i + 1 :]
+    out = []
+
+    if kind == REQVOTE:
+        voted, et, ev = voters[dst]
+        grant = term > voted and (no_restriction or x >= et)
+        if grant:
+            voters = voters[:dst] + ((term, et, ev),) + voters[dst + 1 :]
+        # Reply to every solicitor — grant or denial — with the pre-update
+        # entry (the kernel's gossip channel candidates adopt from).
+        out.append((VOTE, dst, src, term, 1 if grant else 0, et, ev))
+    elif kind == VOTE:
+        phase, rnd, heard, et, ev, pv, dec = cands[dst]
+        if phase == CAND and term == make_ballot(rnd, dst):
+            if x:
+                heard |= 1 << src
+            if not no_adoption and y > et:
+                et, ev = y, z
+            if bin(heard).count("1") >= quorum:
+                pv = ev if et > 0 else _own_val(dst)
+                phase, heard = LEAD, 0
+                et, ev = term, pv  # records its proposal at its own term
+                out.extend(
+                    (APPEND, dst, a, term, pv, 0, 0) for a in range(n_acc)
+                )
+            cands = cands[:dst] + ((phase, rnd, heard, et, ev, pv, dec),) + cands[dst + 1 :]
+    elif kind == APPEND:
+        voted, et, ev = voters[dst]
+        if term >= voted:
+            voters = voters[:dst] + ((max(voted, term), term, x),) + voters[dst + 1 :]
+            events = _record(events, dst, term, x)
+            out.append((ACK, dst, src, term, 0, 0, 0))
+    elif kind == ACK:
+        phase, rnd, heard, et, ev, pv, dec = cands[dst]
+        if phase == LEAD and term == make_ballot(rnd, dst):
+            heard |= 1 << src
+            if bin(heard).count("1") >= quorum:
+                phase, dec = DONE, pv
+            cands = cands[:dst] + ((phase, rnd, heard, et, ev, pv, dec),) + cands[dst + 1 :]
+
+    return (voters, cands, tuple(sorted(net + tuple(out))), events)
+
+
+def _timeout(state, p: int, n_acc: int):
+    """Candidate ``p`` abandons its term and runs at the next one.
+
+    The adopted entry PERSISTS across retries (matching the kernel: the
+    expired branch resets ballot/heard only) — it is the candidate's log.
+    """
+    voters, cands, net, events = state
+    phase, rnd, heard, et, ev, pv, dec = cands[p]
+    rnd += 1
+    bal = make_ballot(rnd, p)
+    cands = cands[:p] + ((CAND, rnd, 0, et, ev, pv, dec),) + cands[p + 1 :]
+    out = tuple((REQVOTE, p, a, bal, et, 0, 0) for a in range(n_acc))
+    return (voters, cands, tuple(sorted(net + out)), events)
+
+
+def _gc(state):
+    """Drop provably-no-op messages.  Conservative: a REQVOTE below the
+    voter's term is kept only while its denial reply could still matter."""
+    voters, cands, net, events = state
+    keep = []
+    for m in net:
+        kind, src, dst, term, x, y, z = m
+        if kind == REQVOTE:
+            # No grant possible AND the reply would be ignored => no-op.
+            phase, rnd = cands[src][0], cands[src][1]
+            reply_dead = phase != CAND or term != make_ballot(rnd, src)
+            if term <= voters[dst][0] and reply_dead:
+                continue
+        elif kind == VOTE:
+            phase, rnd = cands[dst][0], cands[dst][1]
+            if phase != CAND or term != make_ballot(rnd, dst):
+                continue
+        elif kind == APPEND:
+            if term < voters[dst][0]:
+                continue
+        else:  # ACK
+            phase, rnd = cands[dst][0], cands[dst][1]
+            if phase != LEAD or term != make_ballot(rnd, dst):
+                continue
+        keep.append(m)
+    return (voters, cands, tuple(keep), events)
+
+
+def check_raft_exhaustive(
+    n_prop: int = 2,
+    n_acc: int = 3,
+    max_round: "int | tuple[int, ...]" = (1, 0),
+    max_states: int = 5_000_000,
+    no_restriction: bool = False,
+    no_adoption: bool = False,
+) -> CheckResult:
+    """Exhaustively explore every Raft-core schedule at small bounds."""
+    if n_prop > 8:
+        raise ValueError("n_prop > 8 collides packed ballots (make_ballot)")
+    if isinstance(max_round, int):
+        max_round = (max_round,) * n_prop
+    if len(max_round) != n_prop:
+        raise ValueError(
+            f"max_round has {len(max_round)} bounds for n_prop={n_prop}"
+        )
+    quorum = n_acc // 2 + 1
+    own_vals = {_own_val(p) for p in range(n_prop)}
+    stats = {"decided_states": 0, "chosen_all": set()}
+
+    def check_state(state, trace) -> None:
+        voters, cands, net, events = state
+        chosen = _chosen(events, quorum)
+        stats["chosen_all"] |= chosen
+        decided = {c[6] for c in cands if c[0] == DONE}
+        if decided:
+            stats["decided_states"] += 1
+        ok = (
+            len(chosen) <= 1  # agreement (distinct committed values)
+            and chosen <= own_vals  # validity
+            and decided <= chosen  # a finished leader's value was committed
+        )
+        if not ok:
+            raise AssertionError(
+                f"invariant violated: chosen={chosen} decided={decided} "
+                f"after trace={list(trace)}"
+            )
+
+    def successors(state):
+        voters, cands, net, events = state
+        for i in range(len(net)):
+            yield ("d", net[i]), _gc(
+                _deliver(state, i, n_acc, quorum, no_restriction, no_adoption)
+            )
+        for p in range(n_prop):
+            if cands[p][0] != DONE and cands[p][1] < max_round[p]:
+                yield ("t", p), _gc(_timeout(state, p, n_acc))
+
+    states = explore(_init_state(n_prop, n_acc), successors, check_state, max_states)
+    return CheckResult(
+        states=states,
+        decided_states=stats["decided_states"],
+        chosen_values=stats["chosen_all"],
+        counterexample=None,
+    )

@@ -143,7 +143,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="inject the accept-below-promise bug (must find a counterexample)",
     )
     c.add_argument(
-        "--protocol", choices=["paxos", "fastpaxos"], default="paxos",
+        "--protocol", choices=["paxos", "fastpaxos", "raftcore"],
+        default="paxos",
         help="which protocol's bounded model to enumerate",
     )
     c.add_argument(
@@ -162,6 +163,16 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument(
         "--q-fast", type=int, default=0,
         help="fastpaxos only: FFP fast quorum (0 = ceil(3n/4))",
+    )
+    c.add_argument(
+        "--no-restriction", action="store_true",
+        help="raftcore only: disable the election restriction (one of the "
+        "two safety legs; clean alone, violates with --no-adoption)",
+    )
+    c.add_argument(
+        "--no-adoption", action="store_true",
+        help="raftcore only: candidates ignore vote-reply entries (the "
+        "other safety leg; clean alone, violates with --no-restriction)",
     )
     return p
 
@@ -343,7 +354,7 @@ def cmd_check(args: argparse.Namespace) -> int:
     # Reject flags that the selected protocol's model would silently ignore —
     # a user probing an unsafe FFP quorum without --protocol fastpaxos must
     # get an error, not a misleading "ok" from the classic checker.
-    if args.protocol == "fastpaxos" and args.unsafe_accept:
+    if args.protocol != "paxos" and args.unsafe_accept:
         print("error: --unsafe-accept applies to --protocol paxos only",
               file=sys.stderr)
         return 1
@@ -353,8 +364,23 @@ def cmd_check(args: argparse.Namespace) -> int:
         print("error: --adopt-any/--q1/--q2/--q-fast require "
               "--protocol fastpaxos", file=sys.stderr)
         return 1
+    if args.protocol != "raftcore" and (args.no_restriction or args.no_adoption):
+        print("error: --no-restriction/--no-adoption require "
+              "--protocol raftcore", file=sys.stderr)
+        return 1
     try:
-        if args.protocol == "fastpaxos":
+        if args.protocol == "raftcore":
+            from paxos_tpu.cpu_ref.raft_exhaustive import check_raft_exhaustive
+
+            r = check_raft_exhaustive(
+                n_prop=args.n_prop,
+                n_acc=args.n_acc,
+                max_round=mr,
+                max_states=args.max_states,
+                no_restriction=args.no_restriction,
+                no_adoption=args.no_adoption,
+            )
+        elif args.protocol == "fastpaxos":
             from paxos_tpu.cpu_ref.fp_exhaustive import check_fp_exhaustive
 
             r = check_fp_exhaustive(

@@ -4,6 +4,7 @@ import pytest
 
 from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
 from paxos_tpu.cpu_ref.fp_exhaustive import check_fp_exhaustive
+from paxos_tpu.cpu_ref.raft_exhaustive import check_raft_exhaustive
 
 
 def test_exhaustive_no_retries_clean():
@@ -88,3 +89,37 @@ def test_fp_exhaustive_safe_ffp_quorum_clean():
     r = check_fp_exhaustive(n_prop=2, n_acc=4, q1=3, q2=2, q_fast=3)
     assert r.counterexample is None
     assert r.states > 50_000
+
+
+# ---- Raft-core (cpu_ref/raft_exhaustive.py) ----
+
+
+def test_raft_exhaustive_clean():
+    """Every schedule of 2 candidates x 3 voters with one retry: election
+    restriction + one-vote-per-term + adoption + append/ack commit are
+    agreement-clean across the bounded space."""
+    r = check_raft_exhaustive(n_prop=2, n_acc=3, max_round=(1, 0))
+    assert r.counterexample is None
+    assert r.states > 80_000
+    assert r.decided_states > 10_000
+    assert r.chosen_values == {100, 101}
+
+
+def test_raft_exhaustive_each_safety_leg_suffices():
+    """The kernel's safety argument rests on TWO mechanisms — the election
+    restriction (real Raft's) and entry adoption from vote replies (the
+    Paxos-phase-1 analog).  Exhaustively: EITHER alone keeps the space
+    clean..."""
+    r = check_raft_exhaustive(max_round=(1, 0), no_restriction=True)
+    assert r.counterexample is None and r.states > 100_000
+    r = check_raft_exhaustive(max_round=(1, 0), no_adoption=True)
+    assert r.counterexample is None and r.states > 50_000
+
+
+def test_raft_exhaustive_finds_double_bug():
+    """... while removing BOTH yields a counterexample (a stale candidate
+    wins with an empty log and commits a second value over the first)."""
+    with pytest.raises(AssertionError, match="invariant violated"):
+        check_raft_exhaustive(
+            max_round=(1, 0), no_restriction=True, no_adoption=True
+        )
