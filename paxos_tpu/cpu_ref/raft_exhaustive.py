@@ -46,12 +46,11 @@ CAND, LEAD, DONE = 0, 1, 2
 
 # A voter: (voted, ent_term, ent_val).
 # A candidate: (phase, rnd, heard_mask, ent_term, ent_val, prop_val, decided).
-# Messages (kind, src, dst, term, x, y):
-#   REQVOTE: x = cand_last (sender's entry term), y unused
-#   VOTE:    x = granted (0/1), y = (pre-update ent_term, ent_val) packed
-#            as a tuple — kept as two fields via a 7-tuple instead.
-# To stay hashable and uniform, messages are 7-tuples
-# (kind, src, dst, term, x, y, z).
+# Messages are uniform hashable 7-tuples (kind, src, dst, term, x, y, z):
+#   REQVOTE: x = cand_last (sender's entry term);          y, z unused
+#   VOTE:    x = granted (0/1), y = pre-update ent_term, z = ent_val
+#   APPEND:  x = value;                                    y, z unused
+#   ACK:     x, y, z unused
 
 
 def _init_state(n_prop: int, n_acc: int):

@@ -439,7 +439,7 @@ def cmd_shrink(args: argparse.Namespace) -> int:
         "violation": True,
         "config_fingerprint": cfg.fingerprint(),
         "seed": args.seed,
-        "replays": replay(cfg, result, chunk=args.chunk),
+        "replays": replay(cfg, result),
         **result.to_json(),
     }
     print(json.dumps(out))

@@ -36,7 +36,12 @@ import jax.numpy as jnp
 
 from paxos_tpu.faults.injector import NEVER, FaultPlan
 from paxos_tpu.harness.config import SimConfig
-from paxos_tpu.harness.run import init_plan, init_state, make_advance
+from paxos_tpu.harness.run import (
+    init_plan,
+    init_state,
+    make_advance,
+    make_longlog,
+)
 
 
 @dataclasses.dataclass
@@ -48,6 +53,9 @@ class ShrinkResult:
     plan: FaultPlan  # minimized full-width plan (benign outside the lane)
     engine: str = "xla"  # the stream the repro is valid under
     block: Optional[int] = None  # fused block size (None = protocol default)
+    # Chunk the repro was minimized at: schedule-relevant for long-log
+    # configs (compaction cadence) and the granularity of ``ticks``.
+    chunk: int = 32
 
     def to_json(self) -> dict[str, Any]:
         return {
@@ -57,6 +65,7 @@ class ShrinkResult:
             "removed": self.removed,
             "engine": self.engine,
             "block": self.block,
+            "chunk": self.chunk,
         }
 
 
@@ -79,9 +88,17 @@ def _violations_at(
     default (e.g. a sharded run whose per-shard block was clamped).
     Off-TPU the fused stream is replayed under the Pallas TPU interpreter,
     which is bit-identical to the compiled kernel (tests/test_fused.py).
+
+    Long-log configs additionally compact at chunk boundaries — the
+    compaction CADENCE is schedule-relevant (it decides when in-flight
+    messages for compacted slots drop), so ``chunk`` must also match the
+    observing run's chunk for an exact replay.
     """
     state = init_state(cfg)
     advance = make_advance(cfg, plan, engine, block=block)
+    ll = make_longlog(cfg)
+    if ll:
+        advance = ll.wrap_advance(advance)
     done = 0
     while done < ticks:
         n = min(chunk, ticks - done)
@@ -217,13 +234,19 @@ def shrink(
 
     return ShrinkResult(
         lane=lane, ticks=ticks, atoms=kept, removed=removed, plan=plan,
-        engine=engine, block=block,
+        engine=engine, block=block, chunk=chunk,
     )
 
 
-def replay(cfg: SimConfig, result: ShrinkResult, chunk: int = 32) -> bool:
-    """True iff the minimized plan still trips the checker in its lane."""
+def replay(cfg: SimConfig, result: ShrinkResult) -> bool:
+    """True iff the minimized plan still trips the checker in its lane.
+
+    Replays at the result's own recorded chunk — for long-log configs the
+    compaction cadence is part of the schedule, so a different chunk could
+    silently fail to reproduce.
+    """
     viol = _violations_at(
-        cfg, result.plan, result.ticks, chunk, result.engine, result.block
+        cfg, result.plan, result.ticks, result.chunk, result.engine,
+        result.block,
     )
     return bool(viol[result.lane] > 0)

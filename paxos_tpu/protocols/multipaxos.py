@@ -22,6 +22,7 @@ per acceptor per tick, commutative reply folds at proposers), extended with:
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -416,7 +417,7 @@ def _shift_slots(x: jnp.ndarray, shift: jnp.ndarray, axis: int, fill=0):
     return out
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def compact_mp(state: MultiPaxosState):
     """Compact each instance's contiguous chosen prefix out of the window.
 
