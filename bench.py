@@ -57,8 +57,17 @@ def _configs(platform: str):
     return [(name, cfg, eng) for name, cfg in cases for eng in engines]
 
 
-def bench_case(cfg, engine: str, chunk: int = 64, timed_chunks: int = 4) -> dict:
-    """Measure one (config, engine) case; returns the result dict."""
+def bench_case(
+    cfg, engine: str, chunk: int = 64, timed_chunks: int = 4, repeats: int = 3
+) -> dict:
+    """Measure one (config, engine) case; returns the result dict.
+
+    ``repeats`` timed groups of ``timed_chunks`` chunks each are measured
+    after one warmup group; ``value`` is the BEST group's throughput (the
+    standard min-time discipline — noise on a shared tunnel only ever
+    slows a run down) and ``throughput_runs`` records every group so a
+    reader can judge the spread.
+    """
     import jax
 
     from paxos_tpu.harness.run import (
@@ -82,14 +91,17 @@ def bench_case(cfg, engine: str, chunk: int = 64, timed_chunks: int = 4) -> dict
     state = advance(state, chunk)
     int(state.tick)
 
-    t0 = time.perf_counter()
-    for _ in range(timed_chunks):
-        state = advance(state, chunk)
-    violations = int(state.learner.violations.sum())  # forces completion
-    dt = time.perf_counter() - t0
-
     ticks = timed_chunks * chunk
-    value = cfg.n_inst * ticks / dt
+    runs = []
+    violations = 0
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        for _ in range(timed_chunks):
+            state = advance(state, chunk)
+        violations = int(state.learner.violations.sum())  # forces completion
+        runs.append(cfg.n_inst * ticks / (time.perf_counter() - t0))
+
+    value = max(runs)
     return {
         "metric": "quorum-rounds/sec/chip",
         "value": round(value, 1),
@@ -97,7 +109,8 @@ def bench_case(cfg, engine: str, chunk: int = 64, timed_chunks: int = 4) -> dict
         "vs_baseline": round(value / NORTH_STAR, 3),
         "n_instances": cfg.n_inst,
         "ticks": ticks,
-        "seconds": round(dt, 4),
+        "seconds": round(cfg.n_inst * ticks / value, 4),
+        "throughput_runs": [round(r, 1) for r in runs],
         "platform": platform,
         "engine": engine,
         "protocol": cfg.protocol,
