@@ -48,4 +48,16 @@ def __getattr__(name):
         from paxos_tpu.harness.config import SimConfig
 
         return SimConfig
+    if name == "check_exhaustive":
+        from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
+
+        return check_exhaustive
+    if name == "check_fp_exhaustive":
+        from paxos_tpu.cpu_ref.fp_exhaustive import check_fp_exhaustive
+
+        return check_fp_exhaustive
+    if name == "check_raft_exhaustive":
+        from paxos_tpu.cpu_ref.raft_exhaustive import check_raft_exhaustive
+
+        return check_raft_exhaustive
     raise AttributeError(f"module 'paxos_tpu' has no attribute {name!r}")

@@ -125,7 +125,13 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--n-inst", type=int, default=None)
     k.add_argument("--seed", type=int, default=0)
     k.add_argument("--ticks", type=int, default=512, help="violation search budget")
-    k.add_argument("--chunk", type=int, default=32)
+    k.add_argument(
+        "--chunk", type=int, default=64,
+        help="chunk of the observing run (default matches run/soak's 64; "
+        "schedule-relevant for long-log configs — compaction fires at "
+        "chunk boundaries, so a mismatched chunk explores a different "
+        "schedule and can miss the violation)",
+    )
 
     c = sub.add_parser(
         "check",

@@ -55,7 +55,7 @@ class ShrinkResult:
     block: Optional[int] = None  # fused block size (None = protocol default)
     # Chunk the repro was minimized at: schedule-relevant for long-log
     # configs (compaction cadence) and the granularity of ``ticks``.
-    chunk: int = 32
+    chunk: int = 64
 
     def to_json(self) -> dict[str, Any]:
         return {
@@ -174,7 +174,7 @@ def _atom_removals(plan: FaultPlan, lane: int) -> list[tuple[str, Callable]]:
 def shrink(
     cfg: SimConfig,
     max_ticks: int = 512,
-    chunk: int = 32,
+    chunk: int = 64,  # matches run/soak defaults: cadence-exact for long logs
     log: Optional[Callable[[str], None]] = None,
     engine: str = "xla",
     block: Optional[int] = None,

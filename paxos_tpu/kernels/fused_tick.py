@@ -157,7 +157,12 @@ def fused_chunk(
     n_inst = jax.tree.leaves(state)[0].shape[-1]
     block = min(block, n_inst)
     if n_inst % block:
-        raise ValueError(f"n_inst={n_inst} not divisible by block={block}")
+        raise ValueError(
+            f"n_inst={n_inst} not divisible by block={block}: the fused "
+            f"engine needs a block-aligned instance count — use a power-of-"
+            f"two n_inst (e.g. 1<<20) or pass an explicit block that "
+            f"divides it (block is stream-relevant: replays must reuse it)"
+        )
     grid = n_inst // block
 
     treedef, s_leaves, tick, tick_pos = _split_tick(state)

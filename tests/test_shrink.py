@@ -31,6 +31,24 @@ def test_shrink_clean_config_returns_none():
     assert shrink(config_flex(4, 2, n_inst=256, seed=0), max_ticks=96) is None
 
 
+def test_shrink_longlog_cadence_exact_repro():
+    """Long-log configs compact at chunk boundaries, so the compaction
+    CADENCE is part of the schedule: the shrinker must wrap its replay
+    advance with the same per-chunk compaction (run.make_longlog) and
+    record the chunk, and the repro must replay at that recorded chunk."""
+    from paxos_tpu.harness.config import config3_long
+
+    cfg = config3_long(n_inst=64, log_total=16, window=4, seed=2)
+    cfg = dataclasses.replace(
+        cfg, fault=dataclasses.replace(cfg.fault, p_equiv=0.5)
+    )
+    result = shrink(cfg, max_ticks=128, chunk=64)
+    assert result is not None, "equivocating long-log config must violate"
+    assert result.chunk == 64  # recorded for cadence-exact replay
+    assert result.atoms
+    assert replay(cfg, result)
+
+
 def test_shrink_fused_engine_repro():
     """A violation observed under the fused stream must shrink and replay
     under the SAME stream (soak defaults to --engine fused; ADVICE round 1:
