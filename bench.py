@@ -80,10 +80,8 @@ def bench_case(
     platform = jax.devices()[0].platform
     state = init_state(cfg)
     plan = init_plan(cfg)
-    advance = make_advance(cfg, plan, engine)
-    ll = make_longlog(cfg)
-    if ll:  # long-log: compaction rides in the timed loop
-        advance = ll.wrap_advance(advance)
+    # Long-log: compaction rides in the timed loop (traced into each chunk).
+    advance = make_advance(cfg, plan, engine, compact=bool(make_longlog(cfg)))
 
     # Warmup: compile + one chunk.  NOTE: timing must end with a device->host
     # readback, not block_until_ready — on the axon tunnel backend

@@ -219,6 +219,7 @@ def cmd_run(args: argparse.Namespace) -> int:
         plan = shard_pytree(plan, mesh, cfg.n_inst)
         log.emit("mesh", devices=len(mesh.devices))
 
+    ll = make_longlog(cfg)
     if args.engine == "fused":
         if jax.devices()[0].platform != "tpu":
             print("error: --engine fused compiles Mosaic kernels (TPU only); "
@@ -234,21 +235,27 @@ def cmd_run(args: argparse.Namespace) -> int:
 
             apply_fn, mask_fn, blk = fused_fns(cfg.protocol)
 
-            def advance(s, n):
+            def advance_sharded(s, n):
                 return fused_chunk_sharded(
                     s, jnp.int32(cfg.seed), plan, cfg.fault, n,
                     apply_fn, mask_fn, mesh, block=blk,
                 )
 
+            if ll:  # sharded long-log: compact between (sharded) chunks
+                from paxos_tpu.protocols.multipaxos import compact_mp
+
+                def advance(s, n):
+                    return compact_mp(advance_sharded(s, n))[0]
+
+            else:
+                advance = advance_sharded
         else:
-            advance = make_advance(cfg, plan, "fused")
+            advance = make_advance(cfg, plan, "fused", compact=bool(ll))
     else:
-        advance = make_advance(cfg, plan, "xla")
+        advance = make_advance(cfg, plan, "xla", compact=bool(ll))
 
     log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
              n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)
-
-    ll = make_longlog(cfg)
 
     done, since_ckpt = 0, 0
     with trace_mod.profile(args.trace):
@@ -257,8 +264,6 @@ def cmd_run(args: argparse.Namespace) -> int:
             state = advance(state, n)
             done += n
             since_ckpt += n
-            if ll:  # decided prefixes leave the window between chunks
-                state = ll.compact(state)
             rep = summarize(state)
             log.emit("chunk", **rep)
             if args.events:

@@ -93,12 +93,47 @@ def run_chunk(
     return state
 
 
+# Long-log variants: the chunk and the decided-prefix compaction trace into
+# ONE module-level jitted computation — plan/key stay traced arguments, so
+# every shrink probe, soak seed, and recheck hits the same compile cache
+# (a per-call jit closure here caused a full retrace per probe).
+
+
+@functools.partial(
+    jax.jit, static_argnames=("fault", "n_ticks", "step_fn"), donate_argnums=(0,)
+)
+def run_chunk_compact(state, key, plan, fault, n_ticks, step_fn):
+    from paxos_tpu.protocols.multipaxos import compact_mp_body
+
+    def body(s, _):
+        return step_fn(s, key, plan, fault), None
+
+    state, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return compact_mp_body(state)[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fault", "n_ticks", "protocol", "block", "interpret"),
+    donate_argnums=(0,),
+)
+def fused_chunk_compact(state, seed, plan, fault, n_ticks, protocol, block, interpret):
+    from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+    from paxos_tpu.protocols.multipaxos import compact_mp_body
+
+    state = FUSED_CHUNKS[protocol](
+        state, seed, plan, fault, n_ticks, block=block, interpret=interpret
+    )
+    return compact_mp_body(state)[0]
+
+
 def make_advance(
     cfg: SimConfig,
     plan: FaultPlan,
     engine: str = "xla",
     block: "int | None" = None,
     interpret: "bool | None" = None,
+    compact: bool = False,
 ) -> Callable:
     """Build ``advance(state, n_ticks)`` for an engine — THE engine dispatch.
 
@@ -112,13 +147,28 @@ def make_advance(
     (stream-relevant: streams are keyed per (seed, tick, block)).
     ``interpret=None`` auto-enables the Pallas TPU interpreter off-TPU,
     which replays the fused stream bit-identically (tests/test_fused.py).
+
+    ``compact=True`` (long-log Multi-Paxos) appends decided-prefix
+    compaction to every chunk, traced into the same module-level jitted
+    computation — the compaction cadence is the chunk cadence.
     """
     if engine == "fused":
-        from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+        from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS, fused_fns
 
-        fused = FUSED_CHUNKS[cfg.protocol]
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
+
+        if compact:
+            blk = fused_fns(cfg.protocol)[2] if block is None else block
+
+            def advance(state, n):
+                return fused_chunk_compact(
+                    state, jnp.int32(cfg.seed), plan, cfg.fault, n,
+                    cfg.protocol, blk, interpret,
+                )
+
+            return advance
+        fused = FUSED_CHUNKS[cfg.protocol]
 
         def advance(state, n):
             return fused(
@@ -130,9 +180,10 @@ def make_advance(
     if engine == "xla":
         step_fn = get_step_fn(cfg.protocol)
         key = base_key(cfg)
+        chunk_fn = run_chunk_compact if compact else run_chunk
 
         def advance(state, n):
-            return run_chunk(state, key, plan, cfg.fault, n, step_fn)
+            return chunk_fn(state, key, plan, cfg.fault, n, step_fn)
 
         return advance
     raise ValueError(f"unknown engine: {engine!r}")
@@ -141,29 +192,18 @@ def make_advance(
 class LongLog:
     """Chunk-boundary orchestration for long-log Multi-Paxos (SURVEY §6.7).
 
-    The ONE owner of the compact/terminate/report protocol shared by
-    :func:`run`, the CLI loop, and the bench: decided prefixes compact out
-    of the window after every chunk, a run is done when every instance's
-    ``base`` reached ``log_total``, and reports carry the replicated-log
-    fields.  ``make_longlog`` returns None for non-long-log configs so
-    callers can write ``if ll:`` guards.
+    The ONE owner of the terminate/report protocol shared by :func:`run`,
+    the CLI loop, the bench, and the shrinker: decided prefixes compact
+    out of the window after every chunk (``make_advance(compact=True)`` —
+    traced into the chunk's own jitted computation so the module-level
+    compile caches cover every probe and seed), a run is done when every
+    instance's ``base`` reached ``log_total``, and reports carry the
+    replicated-log fields.  ``make_longlog`` returns None for non-long-log
+    configs so callers can write ``if ll:`` guards.
     """
 
     def __init__(self, cfg: SimConfig):
-        from paxos_tpu.protocols.multipaxos import compact_mp
-
-        self._compact_mp = compact_mp
         self.log_total = cfg.fault.log_total
-
-    def compact(self, state):
-        state, _, _ = self._compact_mp(state)
-        return state
-
-    def wrap_advance(self, advance: Callable) -> Callable:
-        def advance_and_compact(state, n):
-            return self.compact(advance(state, n))
-
-        return advance_and_compact
 
     def done(self, state) -> bool:
         return bool((state.base >= self.log_total).all())
@@ -238,7 +278,7 @@ def summarize(state: PaxosState, liveness: bool = False) -> dict[str, Any]:
 def run(
     cfg: SimConfig,
     total_ticks: int = 64,
-    chunk: int = 32,
+    chunk: int = 64,  # matches CLI run/soak/shrink: cadence-exact for long logs
     until_all_chosen: bool = False,
     max_ticks: int = 4096,
     return_state: bool = False,
@@ -259,11 +299,11 @@ def run(
     """
     state = init_state(cfg)
     plan = init_plan(cfg)
-    advance = make_advance(cfg, plan, engine)
     # Long-log Multi-Paxos (SURVEY.md §6.7): decided prefixes compact out of
-    # the window at every chunk boundary, so HBM stays O(window) while the
-    # replicated log grows to cfg.fault.log_total.
+    # the window at every chunk boundary (traced into the chunk's dispatch),
+    # so HBM stays O(window) while the log grows to cfg.fault.log_total.
     ll = make_longlog(cfg)
+    advance = make_advance(cfg, plan, engine, compact=bool(ll))
 
     budget = max_ticks if until_all_chosen else total_ticks
     done = 0
@@ -271,12 +311,11 @@ def run(
         n = min(chunk, budget - done)
         state = advance(state, n)
         done += n
-        if ll:
-            state = ll.compact(state)
-            if until_all_chosen and ll.done(state):
-                break
-        elif until_all_chosen:
-            if state.learner.chosen.all().item():
+        if until_all_chosen:
+            if ll:
+                if ll.done(state):
+                    break
+            elif state.learner.chosen.all().item():
                 break
     report = summarize(state, liveness=liveness)
     report["config_fingerprint"] = cfg.fingerprint()

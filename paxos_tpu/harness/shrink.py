@@ -95,10 +95,9 @@ def _violations_at(
     observing run's chunk for an exact replay.
     """
     state = init_state(cfg)
-    advance = make_advance(cfg, plan, engine, block=block)
-    ll = make_longlog(cfg)
-    if ll:
-        advance = ll.wrap_advance(advance)
+    advance = make_advance(
+        cfg, plan, engine, block=block, compact=bool(make_longlog(cfg))
+    )
     done = 0
     while done < ticks:
         n = min(chunk, ticks - done)

@@ -417,8 +417,7 @@ def _shift_slots(x: jnp.ndarray, shift: jnp.ndarray, axis: int, fill=0):
     return out
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def compact_mp(state: MultiPaxosState):
+def compact_mp_body(state: MultiPaxosState):
     """Compact each instance's contiguous chosen prefix out of the window.
 
     Returns ``(state', shift, evicted_vals)``: ``shift`` (I,) is the prefix
@@ -433,7 +432,9 @@ def compact_mp(state: MultiPaxosState):
     compacted slots, which are dropped (their slot re-bases below 0).
     Dropping is indistinguishable from message loss, which the schedule
     space already contains; the finalized prefix is write-off-limits by
-    construction.  Run between chunks (host loop), never inside one.
+    construction.  Run between chunks, never inside one — either via the
+    jitted :func:`compact_mp` or traced into the same dispatch as the
+    chunk by ``harness.run.LongLog.wrap_advance``.
     """
     lrn, prop, acc = state.learner, state.proposer, state.acceptor
     L = state.log_len
@@ -496,3 +497,7 @@ def compact_mp(state: MultiPaxosState):
         shift,
         evicted,
     )
+
+
+compact_mp = functools.partial(jax.jit, donate_argnums=(0,))(compact_mp_body)
+compact_mp.__doc__ = compact_mp_body.__doc__
