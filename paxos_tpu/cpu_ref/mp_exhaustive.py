@@ -1,0 +1,258 @@
+"""Bounded exhaustive model checking of Multi-Paxos log replication.
+
+Completes the model-checker matrix (`exhaustive.py` classic Paxos,
+`fp_exhaustive.py` Fast Paxos, `raft_exhaustive.py` Raft-core): every
+schedule of a small bounded instance of `protocols/multipaxos.py`'s
+semantics — whole-log phase 1 (promises carry the acceptor's full
+accepted log), slot-by-slot phase 2 from slot 0 with per-slot max-ballot
+recovery, one promise covering every slot — with per-slot
+agreement/validity asserted in every reachable state.
+
+The lease machinery is deliberately absent: leases only decide WHEN a
+follower challenges the leader, and safety must hold for ANY challenge
+schedule, which is exactly what the nondeterministic timeout action
+explores (the same abstraction the C++ oracle `native/paxos_oracle.cc`
+mp::Sim uses — this checker is its exhaustive counterpart).
+
+``no_recovery=True`` injects the classic Multi-Paxos bug: a new leader
+skips the promise-payload fold and drives its OWN values from slot 0.
+The checker must then find a counterexample — a second leader at a
+higher ballot overwrites an already-chosen slot — while the correct
+recovery rule keeps the whole bounded space clean (re-confirming a
+chosen slot re-chooses the same value).
+
+Same soundness notes as the siblings: loss = never-delivered (every
+prefix explored), duplication left to the fuzzer, GC'd no-op deliveries
+collapse dead-letter orderings.
+"""
+
+from __future__ import annotations
+
+from paxos_tpu.cpu_ref.exhaustive import CheckResult, explore, make_ballot
+
+# Message kinds.
+PREPARE, PROMISE, ACCEPT, ACCEPTED = 0, 1, 2, 3
+# Proposer phases (core/mp_state.py: FOLLOW, CANDIDATE, LEAD + terminal).
+FOLLOW, CAND, LEAD, DONE = 0, 1, 2, 3
+
+
+def own_slot_value(pid: int, slot: int) -> int:
+    return (pid + 1) * 1000 + slot  # multipaxos.own_slot_value
+
+
+# An acceptor: (promised, log) with log an L-tuple of (bal, val).
+# A proposer: (phase, rnd, heard_mask, recov, commit_idx, decided) with
+#   recov an L-tuple of (bal, val) and decided an L-tuple of values.
+# Messages are 7-tuples (kind, src, dst, bal, slot, val, payload):
+#   PREPARE:  slot/val/payload unused
+#   PROMISE:  payload = the acceptor's full pre-promise log (L-tuple)
+#   ACCEPT:   (slot, val) the driven slot; payload unused
+#   ACCEPTED: (slot, val) echoed; payload unused
+# Votes: sorted tuple of ((slot, bal, val), acceptor_bitmask).
+
+
+def _init_state(n_prop: int, n_acc: int, log_len: int):
+    accs = tuple((0, ((0, 0),) * log_len) for _ in range(n_acc))
+    props = tuple(
+        (FOLLOW, 0, 0, ((0, 0),) * log_len, 0, (0,) * log_len)
+        for _ in range(n_prop)
+    )
+    return (accs, props, (), ())
+
+
+def _record(votes: tuple, a: int, slot: int, bal: int, val: int) -> tuple:
+    d = dict(votes)
+    d[(slot, bal, val)] = d.get((slot, bal, val), 0) | (1 << a)
+    return tuple(sorted(d.items()))
+
+
+def _chosen_per_slot(votes: tuple, quorum: int, log_len: int) -> list:
+    out = [set() for _ in range(log_len)]
+    for (slot, bal, val), mask in votes:
+        if bin(mask).count("1") >= quorum:
+            out[slot].add(val)
+    return out
+
+
+def _drive(p: int, prop, log_len: int, n_acc: int, no_recovery: bool):
+    """The leader's ACCEPT broadcast for its current slot (or DONE)."""
+    phase, rnd, heard, recov, ci, dec = prop
+    if ci >= log_len:
+        return (DONE, rnd, 0, recov, ci, dec), ()
+    rb, rv = recov[ci]
+    val = own_slot_value(p, ci) if (no_recovery or rb == 0) else rv
+    bal = make_ballot(rnd, p)
+    out = tuple(
+        (ACCEPT, p, a, bal, ci, val, ()) for a in range(n_acc)
+    )
+    return (LEAD, rnd, 0, recov, ci, dec), out
+
+
+def _deliver(
+    state,
+    i: int,
+    n_acc: int,
+    log_len: int,
+    quorum: int,
+    no_recovery: bool,
+):
+    accs, props, net, votes = state
+    kind, src, dst, bal, slot, val, payload = net[i]
+    net = net[:i] + net[i + 1 :]
+    out = []
+
+    if kind == PREPARE:
+        promised, log = accs[dst]
+        if bal > promised:
+            accs = accs[:dst] + ((bal, log),) + accs[dst + 1 :]
+            out.append((PROMISE, dst, src, bal, 0, 0, log))
+    elif kind == ACCEPT:
+        promised, log = accs[dst]
+        if bal >= promised:
+            log = log[:slot] + ((bal, val),) + log[slot + 1 :]
+            accs = accs[:dst] + ((max(promised, bal), log),) + accs[dst + 1 :]
+            votes = _record(votes, dst, slot, bal, val)
+            out.append((ACCEPTED, dst, src, bal, slot, val, ()))
+    elif kind == PROMISE:
+        prop = props[dst]
+        phase, rnd, heard, recov, ci, dec = prop
+        if phase == CAND and bal == make_ballot(rnd, dst):
+            heard |= 1 << src
+            if not no_recovery:
+                # Whole-log recovery: per-slot max-ballot fold.
+                recov = tuple(
+                    max(recov[s], payload[s]) for s in range(log_len)
+                )
+            if bin(heard).count("1") >= quorum:
+                newp, emits = _drive(
+                    dst, (LEAD, rnd, 0, recov, 0, dec), log_len, n_acc,
+                    no_recovery,
+                )
+                props = props[:dst] + (newp,) + props[dst + 1 :]
+                out.extend(emits)
+            else:
+                props = props[:dst] + ((phase, rnd, heard, recov, ci, dec),) + props[dst + 1 :]
+    elif kind == ACCEPTED:
+        prop = props[dst]
+        phase, rnd, heard, recov, ci, dec = prop
+        if phase == LEAD and bal == make_ballot(rnd, dst) and slot == ci:
+            heard |= 1 << src
+            if bin(heard).count("1") >= quorum:
+                dec = dec[:ci] + (val,) + dec[ci + 1 :]
+                newp, emits = _drive(
+                    dst, (LEAD, rnd, 0, recov, ci + 1, dec), log_len, n_acc,
+                    no_recovery,
+                )
+                props = props[:dst] + (newp,) + props[dst + 1 :]
+                out.extend(emits)
+            else:
+                props = props[:dst] + ((phase, rnd, heard, recov, ci, dec),) + props[dst + 1 :]
+
+    return (accs, props, tuple(sorted(net + tuple(out))), votes)
+
+
+def _timeout(state, p: int, n_acc: int, log_len: int):
+    """Proposer ``p`` challenges for leadership at its next ballot (the
+    lease-expiry surrogate: any challenge schedule must be safe)."""
+    accs, props, net, votes = state
+    phase, rnd, heard, recov, ci, dec = props[p]
+    rnd += 1
+    bal = make_ballot(rnd, p)
+    props = props[:p] + ((CAND, rnd, 0, ((0, 0),) * log_len, 0, dec),) + props[p + 1 :]
+    out = tuple((PREPARE, p, a, bal, 0, 0, ()) for a in range(n_acc))
+    return (accs, props, tuple(sorted(net + out)), votes)
+
+
+def _gc(state, log_len: int):
+    accs, props, net, votes = state
+    keep = []
+    for m in net:
+        kind, src, dst, bal, slot, val, payload = m
+        if kind == PREPARE:
+            if bal <= accs[dst][0]:
+                continue
+        elif kind == ACCEPT:
+            if bal < accs[dst][0]:
+                continue
+        else:
+            phase, rnd = props[dst][0], props[dst][1]
+            if phase == DONE or bal != make_ballot(rnd, dst):
+                continue
+            if kind == PROMISE and phase != CAND:
+                continue
+            if kind == ACCEPTED and (
+                phase != LEAD or slot != props[dst][4]
+            ):
+                continue
+        keep.append(m)
+    return (accs, props, tuple(keep), votes)
+
+
+def check_mp_exhaustive(
+    n_prop: int = 2,
+    n_acc: int = 3,
+    log_len: int = 2,
+    max_round: "int | tuple[int, ...]" = 1,
+    max_states: int = 5_000_000,
+    no_recovery: bool = False,
+) -> CheckResult:
+    """Exhaustively explore every Multi-Paxos schedule at small bounds.
+
+    ``decided_states`` counts states where some proposer replicated the
+    FULL log; ``chosen_values`` is the union over slots.
+    """
+    if n_prop > 8:
+        raise ValueError("n_prop > 8 collides packed ballots (make_ballot)")
+    if isinstance(max_round, int):
+        max_round = (max_round,) * n_prop
+    if len(max_round) != n_prop:
+        raise ValueError(
+            f"max_round has {len(max_round)} bounds for n_prop={n_prop}"
+        )
+    quorum = n_acc // 2 + 1
+    stats = {"decided_states": 0, "chosen_all": set()}
+
+    def check_state(state, trace) -> None:
+        accs, props, net, votes = state
+        per_slot = _chosen_per_slot(votes, quorum, log_len)
+        for s, vals in enumerate(per_slot):
+            stats["chosen_all"] |= vals
+            ok = len(vals) <= 1 and all(
+                v % 1000 == s and 1 <= v // 1000 <= n_prop for v in vals
+            )
+            if not ok:
+                raise AssertionError(
+                    f"invariant violated: slot {s} chosen={vals} "
+                    f"after trace={list(trace)}"
+                )
+        for prop in props:
+            if prop[0] != DONE:
+                continue
+            stats["decided_states"] += 1
+            for s in range(log_len):
+                if not (per_slot[s] == {prop[5][s]}):
+                    raise AssertionError(
+                        f"invariant violated: DONE log {prop[5]} vs "
+                        f"chosen {per_slot} after trace={list(trace)}"
+                    )
+
+    def successors(state):
+        accs, props, net, votes = state
+        for i in range(len(net)):
+            yield ("d", net[i]), _gc(
+                _deliver(state, i, n_acc, log_len, quorum, no_recovery),
+                log_len,
+            )
+        for p in range(n_prop):
+            if props[p][0] != DONE and props[p][1] < max_round[p]:
+                yield ("t", p), _gc(_timeout(state, p, n_acc, log_len), log_len)
+
+    states = explore(
+        _init_state(n_prop, n_acc, log_len), successors, check_state, max_states
+    )
+    return CheckResult(
+        states=states,
+        decided_states=stats["decided_states"],
+        chosen_values=stats["chosen_all"],
+        counterexample=None,
+    )

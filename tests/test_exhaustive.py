@@ -4,6 +4,7 @@ import pytest
 
 from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
 from paxos_tpu.cpu_ref.fp_exhaustive import check_fp_exhaustive
+from paxos_tpu.cpu_ref.mp_exhaustive import check_mp_exhaustive
 from paxos_tpu.cpu_ref.raft_exhaustive import check_raft_exhaustive
 
 
@@ -89,6 +90,39 @@ def test_fp_exhaustive_safe_ffp_quorum_clean():
     r = check_fp_exhaustive(n_prop=2, n_acc=4, q1=3, q2=2, q_fast=3)
     assert r.counterexample is None
     assert r.states > 50_000
+
+
+# ---- Multi-Paxos (cpu_ref/mp_exhaustive.py) ----
+
+
+def test_mp_exhaustive_clean():
+    """Every schedule of 2 proposers x 3 acceptors x 2-slot logs with one
+    election each: whole-log phase 1, per-slot max-ballot recovery, and
+    slot-by-slot phase 2 are per-slot-agreement-clean, and every finished
+    leader's log equals the chosen values (~30k states; ~1.7M at
+    asymmetric 2 retries — CLI `check --protocol multipaxos`)."""
+    r = check_mp_exhaustive(n_prop=2, n_acc=3, log_len=2, max_round=1)
+    assert r.counterexample is None
+    assert r.states > 25_000
+    assert r.decided_states > 5_000
+    # Either proposer can own either slot across schedules.
+    assert r.chosen_values == {1000, 1001, 2000, 2001}
+
+
+def test_mp_exhaustive_three_slots_clean():
+    r = check_mp_exhaustive(n_prop=2, n_acc=3, log_len=3, max_round=1)
+    assert r.counterexample is None
+    assert r.states > 300_000
+
+
+def test_mp_exhaustive_finds_no_recovery_bug():
+    """A leader that skips the promise-payload fold (drives its own values
+    from slot 0) must produce a counterexample: the second leader
+    overwrites an already-chosen slot with its own value."""
+    with pytest.raises(AssertionError, match="invariant violated"):
+        check_mp_exhaustive(
+            n_prop=2, n_acc=3, log_len=2, max_round=1, no_recovery=True
+        )
 
 
 # ---- Raft-core (cpu_ref/raft_exhaustive.py) ----
