@@ -47,6 +47,7 @@ constexpr int kMaxProposers = 8;  // matches paxos_tpu.core.ballot.MAX_PROPOSERS
 constexpr int kValueBase = 100;   // proposer p proposes kValueBase + p
 
 inline int make_ballot(int rnd, int pid) { return rnd * kMaxProposers + pid + 1; }
+inline int ballot_round(int bal) { return (bal - 1) / kMaxProposers; }
 
 enum Kind : uint8_t { PREPARE, PROMISE, ACCEPT, ACCEPTED };
 
@@ -222,14 +223,23 @@ struct Sim {
       }
     }
 
-    // Omniscient oracle over the full accept history.
+    // Omniscient oracle over the full accept history.  n_chosen counts
+    // DISTINCT chosen values (a value chosen at several ballots, or an
+    // A,B,A event order, still counts each value once).
     int n_chosen = 0;
     int32_t chosen_val = -1;
     bool validity = true;
     for (size_t i = 0; i < ev_bal.size(); ++i) {
       if (__builtin_popcount(ev_mask[i]) >= quorum) {
-        if (n_chosen == 0 || ev_val[i] != chosen_val) ++n_chosen;
-        chosen_val = ev_val[i];
+        bool seen = false;
+        for (size_t j = 0; j < i && !seen; ++j) {
+          seen = __builtin_popcount(ev_mask[j]) >= quorum &&
+                 ev_val[j] == ev_val[i];
+        }
+        if (!seen) {
+          ++n_chosen;
+          chosen_val = ev_val[i];
+        }
         validity &= ev_val[i] >= kValueBase && ev_val[i] < kValueBase + n_prop;
       }
     }
@@ -470,7 +480,9 @@ struct Sim {
       }
     }
 
-    // Omniscient per-slot oracle over the accept history.
+    // Omniscient per-slot oracle over the accept history.  chosen_cnt[s]
+    // counts DISTINCT chosen values for the slot (an A,B,A quorum-event
+    // order counts two, not three).
     int32_t chosen_val[kMaxLog];
     int chosen_cnt[kMaxLog] = {};
     bool validity = true;
@@ -478,8 +490,15 @@ struct Sim {
     for (size_t i = 0; i < ev_bal.size(); ++i) {
       if (__builtin_popcount(ev_mask[i]) >= quorum) {
         int s = ev_slot[i];
-        if (chosen_cnt[s] == 0 || chosen_val[s] != ev_val[i]) ++chosen_cnt[s];
-        chosen_val[s] = ev_val[i];
+        bool seen = false;
+        for (size_t j = 0; j < i && !seen; ++j) {
+          seen = __builtin_popcount(ev_mask[j]) >= quorum &&
+                 ev_slot[j] == s && ev_val[j] == ev_val[i];
+        }
+        if (!seen) {
+          ++chosen_cnt[s];
+          chosen_val[s] = ev_val[i];
+        }
         // Validity: some proposer proposes this value FOR THIS SLOT.
         int32_t v = ev_val[i];
         validity &= v % 1000 == s && v / 1000 >= 1 && v / 1000 <= n_prop;
@@ -502,6 +521,268 @@ struct Sim {
 };
 
 }  // namespace mp
+
+// ---------------------------------------------------------------------------
+// Fast Paxos oracle (round-3: third protocol — the subtlest recovery logic).
+// Mirrors the SEMANTICS of paxos_tpu/protocols/fastpaxos.py: a shared
+// round-0 fast ballot every proposer's Accept(own_val) rides immediately
+// (no phase 1), vote-at-most-once-per-ballot acceptors, fast-quorum
+// (default ceil(3n/4)) choice at round 0, and coordinated recovery in
+// classic rounds >= 1 — a value v is CHOOSABLE at the highest reported
+// ballot k iff the acceptors that reported voting v at k plus those not
+// heard from could still contain a fast quorum; if some value is choosable
+// the recovering proposer must adopt it (lowest value id on ties, matching
+// the kernel's first_true pick), else its own value is safe.  Fast
+// Flexible Paxos (arXiv:2008.02671) quorum overrides q1/q2/q_fast are
+// supported; 0 = classic defaults.  Unsafe triples are the bug-injection
+// leg: the oracle itself must then FIND agreement violations.
+// ---------------------------------------------------------------------------
+
+namespace fp {
+
+enum Kind : uint8_t { PREPARE, PROMISE, ACCEPT, ACCEPTED };
+
+struct Msg {
+  Kind kind;
+  int8_t src;
+  int8_t dst;
+  int32_t bal;
+  int32_t val;
+  int32_t prev_bal;  // PROMISE payload: acceptor's accepted pair
+  int32_t prev_val;
+};
+
+struct Acceptor {
+  int32_t promised = 0;
+  int32_t acc_bal = 0;
+  int32_t acc_val = 0;
+};
+
+struct Proposer {
+  enum Phase { P1, P2, DONE, FAST };  // matches core/fp_state.py
+  int pid;
+  int32_t own_val;
+  int32_t bal;
+  Phase phase = FAST;
+  uint32_t heard = 0;
+  int32_t best_bal = 0;
+  uint32_t rep_mask[kMaxProposers] = {};  // per-value-id voter bitmasks
+  int32_t prop_val = 0;
+  int32_t decided_val = -1;
+
+  explicit Proposer(int p)
+      : pid(p), own_val(kValueBase + p), bal(make_ballot(0, 0)) {}
+};
+
+struct Sim {
+  int n_prop, n_acc, q1, q2, qf;
+  double p_drop, p_dup, timeout_weight;
+  Rng rng;
+  std::vector<Acceptor> acceptors;
+  std::vector<Proposer> proposers;
+  std::vector<Msg> network;
+  std::vector<int32_t> ev_bal, ev_val;
+  std::vector<uint32_t> ev_mask;
+
+  Sim(uint64_t seed, int np, int na, int q1_, int q2_, int qf_, double pd,
+      double pdup, double tw)
+      : n_prop(np), n_acc(na), q1(q1_ ? q1_ : na / 2 + 1),
+        q2(q2_ ? q2_ : na / 2 + 1), qf(qf_ ? qf_ : (3 * na + 3) / 4),
+        p_drop(pd), p_dup(pdup), timeout_weight(tw),
+        rng(seed ^ 0x5bd1e995ull) {
+    acceptors.resize(n_acc);
+    for (int p = 0; p < n_prop; ++p) proposers.emplace_back(p);
+    // The fast round is in flight at step 0 (core/fp_state.py init).
+    for (auto& p : proposers) {
+      for (int a = 0; a < n_acc; ++a) {
+        offer(Msg{ACCEPT, static_cast<int8_t>(p.pid), static_cast<int8_t>(a),
+                  p.bal, p.own_val, 0, 0});
+      }
+    }
+  }
+
+  void offer(const Msg& m) {
+    if (rng.uniform() >= p_drop) network.push_back(m);
+  }
+
+  void record_accept(int acc, int32_t bal, int32_t val) {
+    for (size_t i = 0; i < ev_bal.size(); ++i) {
+      if (ev_bal[i] == bal && ev_val[i] == val) {
+        ev_mask[i] |= 1u << acc;
+        return;
+      }
+    }
+    ev_bal.push_back(bal);
+    ev_val.push_back(val);
+    ev_mask.push_back(1u << acc);
+  }
+
+  void dispatch(const Msg& m) {
+    switch (m.kind) {
+      case PREPARE: {
+        Acceptor& a = acceptors[m.dst];
+        if (m.bal > a.promised) {
+          a.promised = m.bal;
+          offer(Msg{PROMISE, m.dst, m.src, m.bal, 0, a.acc_bal, a.acc_val});
+        }
+        break;
+      }
+      case ACCEPT: {
+        Acceptor& a = acceptors[m.dst];
+        // Vote at most once per ballot: never switch values within a round
+        // (re-accepting the identical pair stays idempotent for dups).
+        bool revote = m.bal > a.acc_bal ||
+                      (m.bal == a.acc_bal && m.val == a.acc_val);
+        if (m.bal >= a.promised && revote) {
+          a.promised = a.promised > m.bal ? a.promised : m.bal;
+          a.acc_bal = m.bal;
+          a.acc_val = m.val;
+          record_accept(m.dst, m.bal, m.val);
+          offer(Msg{ACCEPTED, m.dst, m.src, m.bal, m.val, 0, 0});
+        }
+        break;
+      }
+      case PROMISE: {
+        Proposer& p = proposers[m.dst];
+        if (p.phase != Proposer::P1 || m.bal != p.bal) break;
+        p.heard |= 1u << m.src;
+        // Per-value voter masks at the highest reported accepted ballot.
+        bool valid = m.prev_bal > 0 && m.prev_val >= kValueBase &&
+                     m.prev_val < kValueBase + n_prop;
+        if (valid) {
+          if (m.prev_bal > p.best_bal) {
+            p.best_bal = m.prev_bal;
+            for (int v = 0; v < kMaxProposers; ++v) p.rep_mask[v] = 0;
+          }
+          if (m.prev_bal == p.best_bal)
+            p.rep_mask[m.prev_val - kValueBase] |= 1u << m.src;
+        }
+        if (__builtin_popcount(p.heard) >= q1) {
+          int unheard = n_acc - __builtin_popcount(p.heard);
+          int32_t v = p.own_val;
+          if (p.best_bal > 0) {
+            if (ballot_round(p.best_bal) == 0) {
+              // k fast: adopt the (lowest-vid) choosable value if any.
+              for (int vid = 0; vid < n_prop; ++vid) {
+                if (p.rep_mask[vid] != 0 &&
+                    __builtin_popcount(p.rep_mask[vid]) + unheard >= qf) {
+                  v = kValueBase + vid;
+                  break;
+                }
+              }
+            } else {
+              // k classic: adopt k's (unique) value.
+              for (int vid = 0; vid < n_prop; ++vid) {
+                if (p.rep_mask[vid] != 0) {
+                  v = kValueBase + vid;
+                  break;
+                }
+              }
+            }
+          }
+          p.phase = Proposer::P2;
+          p.heard = 0;
+          p.prop_val = v;
+          for (int a = 0; a < n_acc; ++a) {
+            offer(Msg{ACCEPT, static_cast<int8_t>(p.pid),
+                      static_cast<int8_t>(a), p.bal, v, 0, 0});
+          }
+        }
+        break;
+      }
+      case ACCEPTED: {
+        Proposer& p = proposers[m.dst];
+        bool in_vote = p.phase == Proposer::P2 || p.phase == Proposer::FAST;
+        if (!in_vote || m.bal != p.bal) break;
+        p.heard |= 1u << m.src;
+        int need = p.phase == Proposer::FAST ? qf : q2;
+        if (__builtin_popcount(p.heard) >= need) {
+          p.decided_val =
+              p.phase == Proposer::FAST ? p.own_val : p.prop_val;
+          p.phase = Proposer::DONE;
+        }
+        break;
+      }
+    }
+  }
+
+  bool all_done() const {
+    for (const auto& p : proposers)
+      if (p.phase != Proposer::DONE) return false;
+    return true;
+  }
+
+  Result run(int max_steps) {
+    int steps = 0;
+    while (steps < max_steps && !all_done()) {
+      ++steps;
+      if (!network.empty() && rng.uniform() >= timeout_weight) {
+        int i = rng.below(static_cast<int>(network.size()));
+        Msg m = network[i];
+        if (rng.uniform() >= p_dup) {
+          network[i] = network.back();
+          network.pop_back();
+        }
+        dispatch(m);
+      } else {
+        // Collision/loss recovery: a non-DONE proposer abandons its round
+        // and starts a classic round at the next ballot.
+        int live = 0;
+        for (const auto& p : proposers) live += p.phase != Proposer::DONE;
+        if (live == 0) break;
+        int pick = rng.below(live);
+        for (auto& p : proposers) {
+          if (p.phase == Proposer::DONE) continue;
+          if (pick-- == 0) {
+            p.bal = make_ballot(ballot_round(p.bal) + 1, p.pid);
+            p.phase = Proposer::P1;
+            p.heard = 0;
+            p.best_bal = 0;
+            for (int v = 0; v < kMaxProposers; ++v) p.rep_mask[v] = 0;
+            for (int a = 0; a < n_acc; ++a) {
+              offer(Msg{PREPARE, static_cast<int8_t>(p.pid),
+                        static_cast<int8_t>(a), p.bal, 0, 0, 0});
+            }
+            break;
+          }
+        }
+      }
+    }
+
+    // Omniscient oracle: the choice threshold is per-round-kind (q_fast
+    // for the fast round 0, q2 for classic rounds); n_chosen counts
+    // DISTINCT chosen values.
+    int n_chosen = 0;
+    int32_t chosen_val = -1;
+    bool validity = true;
+    auto chosen = [&](size_t i) {
+      int need = ballot_round(ev_bal[i]) == 0 ? qf : q2;
+      return __builtin_popcount(ev_mask[i]) >= need;
+    };
+    for (size_t i = 0; i < ev_bal.size(); ++i) {
+      if (chosen(i)) {
+        bool seen = false;
+        for (size_t j = 0; j < i && !seen; ++j) {
+          seen = chosen(j) && ev_val[j] == ev_val[i];
+        }
+        if (!seen) {
+          ++n_chosen;
+          chosen_val = ev_val[i];
+        }
+        validity &= ev_val[i] >= kValueBase && ev_val[i] < kValueBase + n_prop;
+      }
+    }
+    bool agreement = n_chosen <= 1;
+    for (const auto& p : proposers) {
+      if (p.decided_val >= 0)
+        agreement &= n_chosen == 1 && p.decided_val == chosen_val;
+    }
+    return Result{all_done() ? 1 : 0, agreement ? 1 : 0, validity ? 1 : 0,
+                  n_chosen, steps};
+  }
+};
+
+}  // namespace fp
 
 }  // namespace
 
@@ -545,6 +826,27 @@ void mp_run_batch(uint64_t seed0, int32_t n_runs, int32_t n_prop,
   for (int32_t r = 0; r < n_runs; ++r) {
     mp::Sim sim(seed0 + static_cast<uint64_t>(r), n_prop, n_acc, log_len,
                 p_drop, p_dup, timeout_weight);
+    Result res = sim.run(max_steps);
+    std::memcpy(out + 5 * r, &res, sizeof(res));
+  }
+}
+
+// Fast Paxos batch: same 5-int32-per-run layout; q1/q2/q_fast of 0 select
+// the classic defaults (majority / majority / ceil(3n/4)).  The caller is
+// responsible for knowing whether the triple is FFP-safe — unsafe triples
+// are the falsifiability leg (the oracle must then find violations).
+void fp_run_batch(uint64_t seed0, int32_t n_runs, int32_t n_prop,
+                  int32_t n_acc, int32_t q1, int32_t q2, int32_t q_fast,
+                  double p_drop, double p_dup, double timeout_weight,
+                  int32_t max_steps, int32_t* out) {
+  if (!valid_topology(n_prop, n_acc) || q1 < 0 || q1 > n_acc || q2 < 0 ||
+      q2 > n_acc || q_fast < 0 || q_fast > n_acc) {
+    for (int32_t i = 0; i < 5 * n_runs; ++i) out[i] = -1;
+    return;
+  }
+  for (int32_t r = 0; r < n_runs; ++r) {
+    fp::Sim sim(seed0 + static_cast<uint64_t>(r), n_prop, n_acc, q1, q2,
+                q_fast, p_drop, p_dup, timeout_weight);
     Result res = sim.run(max_steps);
     std::memcpy(out + 5 * r, &res, sizeof(res));
   }

@@ -52,6 +52,10 @@ def __getattr__(name):
         from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
 
         return check_exhaustive
+    if name == "check_mp_exhaustive":
+        from paxos_tpu.cpu_ref.mp_exhaustive import check_mp_exhaustive
+
+        return check_mp_exhaustive
     if name == "check_fp_exhaustive":
         from paxos_tpu.cpu_ref.fp_exhaustive import check_fp_exhaustive
 

@@ -12,6 +12,14 @@ on-device from `LearnerState.chosen_tick`:
   fair scheduler these indicate livelock (e.g. dueling proposers without
   backoff), the classic Paxos liveness failure (FLP-adjacent), which the
   fuzzer is meant to surface, not hide.
+
+Long-log Multi-Paxos (SURVEY.md §6.7): the learner holds only the residual
+window — compacted slots (decided by definition) have left it, and window
+rows whose global index ``base + slot >= log_total`` can never be decided.
+Every function here accepts an optional ``valid`` mask so those
+never-decidable tail rows are excluded from denominators, histograms, and
+stuck counts instead of being misreported as livelocked
+(``window_valid_mask`` builds the mask; ``liveness_report`` wires it).
 """
 
 from __future__ import annotations
@@ -21,28 +29,54 @@ import jax.numpy as jnp
 from paxos_tpu.core.state import LearnerState
 
 
-def decided_by(learner: LearnerState, k) -> jnp.ndarray:
-    """Fraction of instances chosen at tick <= k (scalar float32)."""
+def window_valid_mask(chosen_shape, base, log_total: int):
+    """(L, I) bool: window rows whose global slot index is a real log slot.
+
+    ``base`` is the per-instance count of compacted (decided) slots; row
+    ``l`` of instance ``i`` holds global slot ``base[i] + l``, which exists
+    only while it is ``< log_total``.
+    """
+    sl = jnp.arange(chosen_shape[0], dtype=jnp.int32)[:, None]
+    return (base[None, :] + sl) < log_total
+
+
+def decided_by(learner: LearnerState, k, valid=None) -> jnp.ndarray:
+    """Fraction of (valid) instances chosen at tick <= k (scalar float32)."""
     ok = learner.chosen & (learner.chosen_tick <= k)
-    return ok.mean(dtype=jnp.float32)
+    if valid is None:
+        return ok.mean(dtype=jnp.float32)
+    return (ok & valid).sum(dtype=jnp.float32) / jnp.maximum(
+        valid.sum(dtype=jnp.float32), 1.0
+    )
 
 
 def chosen_tick_histogram(
-    learner: LearnerState, n_bins: int, bin_width: int
+    learner: LearnerState, n_bins: int, bin_width: int, valid=None
 ) -> jnp.ndarray:
-    """(n_bins,) int32 histogram of decision ticks; undecided in the last bin."""
+    """(n_bins,) int32 histogram of decision ticks; undecided in the last bin.
+
+    With ``valid``, never-decidable rows are dropped entirely (they belong
+    to no bin — neither decided nor livelocked).
+    """
     t = jnp.where(learner.chosen, learner.chosen_tick, jnp.iinfo(jnp.int32).max)
     binned = jnp.clip(t // bin_width, 0, n_bins - 1)
-    return jnp.zeros((n_bins,), jnp.int32).at[binned].add(1)
+    w = 1 if valid is None else valid.astype(jnp.int32)
+    return jnp.zeros((n_bins,), jnp.int32).at[binned].add(w)
 
 
-def stuck_mask(learner: LearnerState, budget_ticks: int, now) -> jnp.ndarray:
-    """(I,) bool: still undecided although ``budget_ticks`` have elapsed."""
-    return ~learner.chosen & (jnp.asarray(now) >= budget_ticks)
+def stuck_mask(learner: LearnerState, budget_ticks: int, now, valid=None):
+    """bool mask: still undecided although ``budget_ticks`` have elapsed."""
+    stuck = ~learner.chosen & (jnp.asarray(now) >= budget_ticks)
+    return stuck if valid is None else stuck & valid
 
 
 def liveness_report(
-    learner: LearnerState, now: int, n_points: int = 8, n_bins: int = 16
+    learner: LearnerState,
+    now: int,
+    n_points: int = 8,
+    n_bins: int = 16,
+    base=None,
+    log_total: int = 0,
 ) -> dict:
     """The liveness block of a run report (SURVEY.md §6.5).
 
@@ -56,6 +90,12 @@ def liveness_report(
 
     Shape-polymorphic over single-decree ``(I,)`` and Multi-Paxos ``(L, I)``
     learners: curve/histogram count slot-lanes in the latter.
+
+    Long-log runs (``log_total > 0`` with per-instance ``base``): all
+    statistics are WINDOW-RELATIVE — compacted slots (decided, but gone
+    from the learner) are reported separately as ``slots_compacted``, and
+    window rows past the end of the log are masked out rather than counted
+    as stuck (the masking leg of `check/liveness` — see module docstring).
     """
     import jax
 
@@ -65,11 +105,14 @@ def liveness_report(
     # 0..n_bins-2: the last bin holds ONLY undecided lanes, so
     # hist[-1] is exactly the livelock count, never late deciders.
     bin_width = max(1, -(-now // (n_bins - 1)))
-    curve = [decided_by(learner, k) for k in ticks]
-    hist = chosen_tick_histogram(learner, n_bins, bin_width)
-    stuck = stuck_mask(learner, now, now).sum()
+    valid = None
+    if log_total > 0 and base is not None:
+        valid = window_valid_mask(learner.chosen.shape, base, log_total)
+    curve = [decided_by(learner, k, valid) for k in ticks]
+    hist = chosen_tick_histogram(learner, n_bins, bin_width, valid)
+    stuck = stuck_mask(learner, now, now, valid).sum()
     curve, hist, stuck = jax.device_get((curve, hist, stuck))
-    return {
+    out = {
         "decided_by_curve": [
             (k, round(float(f), 6)) for k, f in zip(ticks, curve)
         ],
@@ -77,3 +120,7 @@ def liveness_report(
         "hist_bin_width": bin_width,
         "stuck_lanes": int(stuck),
     }
+    if valid is not None:
+        out["liveness_window_relative"] = True
+        out["slots_compacted"] = int(jax.device_get(base.sum()))
+    return out

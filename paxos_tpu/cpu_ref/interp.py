@@ -1074,6 +1074,10 @@ def multipaxos_compact_lane(st: dict) -> tuple:
     for p in range(P):
         prop["recov_bal"][p] = sh(prop["recov_bal"][p])
         prop["recov_val"][p] = sh(prop["recov_val"][p])
+        # Mirror of compact_mp: a leader whose driven slot was compacted
+        # under it re-collects votes for the (different) slot it clamps to.
+        if prop["phase"][p] == LEAD and shift > prop["commit_idx"][p]:
+            prop["heard"][p] = 0
         prop["commit_idx"][p] = max(prop["commit_idx"][p] - shift, 0)
         prop["last_chosen_count"][p] = max(
             prop["last_chosen_count"][p] - shift, 0

@@ -225,10 +225,11 @@ def check_mp_exhaustive(
                     f"invariant violated: slot {s} chosen={vals} "
                     f"after trace={list(trace)}"
                 )
+        if any(prop[0] == DONE for prop in props):
+            stats["decided_states"] += 1  # per STATE, as documented
         for prop in props:
             if prop[0] != DONE:
                 continue
-            stats["decided_states"] += 1
             for s in range(log_len):
                 if not (per_slot[s] == {prop[5][s]}):
                     raise AssertionError(

@@ -149,9 +149,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="inject the accept-below-promise bug (must find a counterexample)",
     )
     c.add_argument(
-        "--protocol", choices=["paxos", "fastpaxos", "raftcore"],
+        "--protocol", choices=["paxos", "multipaxos", "fastpaxos", "raftcore"],
         default="paxos",
         help="which protocol's bounded model to enumerate",
+    )
+    c.add_argument(
+        "--log-len", type=int, default=2,
+        help="multipaxos only: bounded log length per instance",
+    )
+    c.add_argument(
+        "--no-recovery", action="store_true",
+        help="multipaxos only: inject the skipped-promise-fold bug (a new "
+        "leader drives its own values from slot 0; must find a "
+        "counterexample)",
     )
     c.add_argument(
         "--adopt-any", action="store_true",
@@ -264,7 +274,7 @@ def cmd_run(args: argparse.Namespace) -> int:
             state = advance(state, n)
             done += n
             since_ckpt += n
-            rep = summarize(state)
+            rep = summarize(state, log_total=cfg.fault.log_total)
             log.emit("chunk", **rep)
             if args.events:
                 trace_mod.event_dump(state)
@@ -277,7 +287,9 @@ def cmd_run(args: argparse.Namespace) -> int:
                 if (ll.done(state) if ll else bool(state.learner.chosen.all())):
                     break
 
-    report = summarize(state, liveness=args.liveness)
+    report = summarize(
+        state, liveness=args.liveness, log_total=cfg.fault.log_total
+    )
     report["config_fingerprint"] = cfg.fingerprint()
     if ll:
         report.update(ll.report_fields(state))
@@ -379,8 +391,23 @@ def cmd_check(args: argparse.Namespace) -> int:
         print("error: --no-restriction/--no-adoption require "
               "--protocol raftcore", file=sys.stderr)
         return 1
+    if args.protocol != "multipaxos" and (args.no_recovery or args.log_len != 2):
+        print("error: --no-recovery/--log-len require --protocol multipaxos",
+              file=sys.stderr)
+        return 1
     try:
-        if args.protocol == "raftcore":
+        if args.protocol == "multipaxos":
+            from paxos_tpu.cpu_ref.mp_exhaustive import check_mp_exhaustive
+
+            r = check_mp_exhaustive(
+                n_prop=args.n_prop,
+                n_acc=args.n_acc,
+                log_len=args.log_len,
+                max_round=mr,
+                max_states=args.max_states,
+                no_recovery=args.no_recovery,
+            )
+        elif args.protocol == "raftcore":
             from paxos_tpu.cpu_ref.raft_exhaustive import check_raft_exhaustive
 
             r = check_raft_exhaustive(

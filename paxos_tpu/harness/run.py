@@ -225,13 +225,18 @@ def make_longlog(cfg: SimConfig) -> "LongLog | None":
     return None
 
 
-def summarize(state: PaxosState, liveness: bool = False) -> dict[str, Any]:
+def summarize(
+    state: PaxosState, liveness: bool = False, log_total: int = 0
+) -> dict[str, Any]:
     """Reduce on-device state to a host-side scalar report.
 
     Reductions run on-device (sharded states psum automatically under jit);
     only scalars come back to the host.  ``liveness`` appends the
     decided-by curve / latency histogram / stuck-lane count block
-    (:func:`paxos_tpu.check.liveness.liveness_report`).
+    (:func:`paxos_tpu.check.liveness.liveness_report`).  ``log_total > 0``
+    (long-log Multi-Paxos) makes that block window-relative: compacted
+    slots report as ``slots_compacted`` and never-decidable tail rows are
+    masked out of the stuck count instead of misreported as livelocked.
     """
     lrn, prop = state.learner, state.proposer
     chosen = lrn.chosen  # (I,) single-decree, (L, I) multipaxos
@@ -252,7 +257,22 @@ def summarize(state: PaxosState, liveness: bool = False) -> dict[str, Any]:
     }
 
     if chosen.ndim == 2:  # Multi-Paxos: chosen_frac is slot-level
-        out["decided_frac"] = chosen.all(axis=0).mean(dtype=jnp.float32)  # full logs
+        if log_total > 0:
+            # Long-log: the window is a moving residual, so "fraction of
+            # instances with a full window" reads ~0 on a HEALTHY run
+            # (compacted rows left, tail rows can never decide).  Report
+            # global replication progress instead: decided slot-lanes
+            # (compacted prefix + in-window chosen rows that are real log
+            # slots) over the whole log.
+            from paxos_tpu.check.liveness import window_valid_mask
+
+            valid = window_valid_mask(chosen.shape, state.base, log_total)
+            out["decided_frac"] = (
+                state.base.sum(dtype=jnp.float32)
+                + (chosen & valid).sum(dtype=jnp.float32)
+            ) / (chosen.shape[-1] * log_total)
+        else:
+            out["decided_frac"] = chosen.all(axis=0).mean(dtype=jnp.float32)
         out["proposer_disagree"] = jnp.zeros((), jnp.int32)  # n/a: leaders adopt
     else:
         out["decided_frac"] = (prop.phase == DONE).any(axis=0).mean(dtype=jnp.float32)
@@ -271,7 +291,10 @@ def summarize(state: PaxosState, liveness: bool = False) -> dict[str, Any]:
     if liveness:
         from paxos_tpu.check.liveness import liveness_report
 
-        out.update(liveness_report(lrn, out["ticks"]))
+        out.update(liveness_report(
+            lrn, out["ticks"],
+            base=getattr(state, "base", None), log_total=log_total,
+        ))
     return out
 
 
@@ -317,7 +340,7 @@ def run(
                     break
             elif state.learner.chosen.all().item():
                 break
-    report = summarize(state, liveness=liveness)
+    report = summarize(state, liveness=liveness, log_total=cfg.fault.log_total)
     report["config_fingerprint"] = cfg.fingerprint()
     report["engine"] = engine
     if ll:

@@ -38,12 +38,17 @@ def profile(logdir: str | None) -> Iterator[None]:
         yield
 
 
-def event_dump(state, stream=sys.stderr) -> None:
+def event_dump(state, stream=None) -> None:
     """Print one JSON line of per-chunk protocol events (host-side readback).
 
     Works for any protocol state (single-decree or Multi-Paxos learner
-    shapes); intended for debugging runs, not the hot path.
+    shapes); intended for debugging runs, not the hot path.  ``stream``
+    defaults to the CURRENT ``sys.stderr`` at call time — a def-time
+    default would bake in whatever stream was installed at first import
+    (e.g. a long-closed pytest capture object).
     """
+    if stream is None:
+        stream = sys.stderr
     lrn = state.learner
     chosen = lrn.chosen
     bal = state.proposer.bal

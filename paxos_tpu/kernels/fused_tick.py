@@ -56,6 +56,14 @@ from paxos_tpu.kernels.counter_prng import mix
 
 DEFAULT_BLOCK = 1024
 
+# Largest instance count one pallas_call compiles at (measured: 4M compiles
+# and runs on v5e-1 at any block; 8M fails the TPU compile at EVERY block
+# size, so the limit is per-call lanes, not VMEM per block).  Bigger batches
+# auto-split into sequential per-segment kernels with globally-offset
+# counter-PRNG block ids — bit-identical to the (uncompilable) single call
+# at the same block size (tests/test_fused.py::test_fused_segmented_*).
+MAX_LANES_PER_CALL = 1 << 22
+
 
 def _split_tick(state: Any):
     """Flatten the state with the scalar ``tick`` leaf separated out.
@@ -230,6 +238,97 @@ def fused_chunk(
     return jax.tree.unflatten(treedef, new_leaves)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg", "n_ticks", "apply_fn", "mask_fn", "block", "segments",
+        "interpret",
+    ),
+    donate_argnums=(0,),
+)
+def _segmented_impl(
+    state, seed, plan, *, cfg, n_ticks, apply_fn, mask_fn, block, segments,
+    interpret,
+):
+    n_inst = jax.tree.leaves(state)[0].shape[-1]
+    seg = n_inst // segments
+    bps = seg // block  # blocks per segment
+
+    def slice_seg(tree, s):
+        return jax.tree.map(
+            lambda x: jax.lax.slice_in_dim(
+                x, s * seg, (s + 1) * seg, axis=x.ndim - 1
+            )
+            if getattr(x, "ndim", 0) >= 1 and x.shape[-1] == n_inst
+            else x,
+            tree,
+        )
+
+    outs = [
+        fused_chunk(
+            slice_seg(state, s), seed, slice_seg(plan, s), cfg, n_ticks,
+            apply_fn, mask_fn, block=block, interpret=interpret,
+            block_offset=s * bps,
+        )
+        for s in range(segments)
+    ]
+
+    def recombine(*leaves):
+        if getattr(leaves[0], "ndim", 0) == 0 or leaves[0].shape[-1] != seg:
+            return leaves[0]  # tick (and any unsliced leaf): identical per seg
+        return jnp.concatenate(leaves, axis=-1)
+
+    return jax.tree.map(recombine, *outs)
+
+
+def fused_chunk_auto(
+    state: Any,
+    seed: jnp.ndarray,
+    plan: FaultPlan,
+    cfg: FaultConfig,
+    n_ticks: int,
+    apply_fn: Callable,
+    mask_fn: Callable,
+    block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+    max_lanes: int = MAX_LANES_PER_CALL,
+) -> Any:
+    """:func:`fused_chunk` with the scale ceiling removed (VERDICT r2 #7).
+
+    Up to ``max_lanes`` instances this IS ``fused_chunk``.  Beyond it, the
+    batch splits into the fewest equal segments that fit, each advanced by
+    its own kernel with ``block_offset = segment * blocks_per_segment`` —
+    exactly the global block ids the single kernel would use — so the
+    schedule stream is invariant to the segmentation and a campaign's
+    replay/shrink/checkpoint contract (same seed + same block -> same
+    schedule) survives the degradation.  Cost: one extra HBM copy of the
+    state per chunk (slice + concat), amortized over ``n_ticks`` ticks.
+    """
+    n_inst = jax.tree.leaves(state)[0].shape[-1]
+    if n_inst <= max_lanes:
+        return fused_chunk(
+            state, seed, plan, cfg, n_ticks, apply_fn, mask_fn,
+            block=block, interpret=interpret,
+        )
+    segments = -(-n_inst // max_lanes)
+    if n_inst % segments:
+        raise ValueError(
+            f"n_inst={n_inst} not divisible into {segments} segments of "
+            f"<= {max_lanes} lanes; use a power-of-two instance count"
+        )
+    seg = n_inst // segments
+    block = min(block, seg)
+    if seg % block:
+        raise ValueError(
+            f"segment size {seg} not divisible by block={block}"
+        )
+    return _segmented_impl(
+        state, jnp.asarray(seed, jnp.int32), plan,
+        cfg=cfg, n_ticks=n_ticks, apply_fn=apply_fn, mask_fn=mask_fn,
+        block=block, segments=segments, interpret=interpret,
+    )
+
+
 def reference_chunk(
     state: Any,
     seed: jnp.ndarray,
@@ -381,14 +480,17 @@ def fused_fns(protocol: str):
 def _make_chunk(protocol: str) -> Callable:
     def chunk(state, seed, plan, cfg, n_ticks, block=None, interpret=False):
         apply_fn, mask_fn, default_block = fused_fns(protocol)
-        return fused_chunk(
+        return fused_chunk_auto(
             state, seed, plan, cfg, n_ticks, apply_fn, mask_fn,
             block=default_block if block is None else block,
             interpret=interpret,
         )
 
     chunk.__name__ = f"fused_{protocol}_chunk"
-    chunk.__doc__ = f"{protocol} on the fused engine (binding: fused_fns)."
+    chunk.__doc__ = (
+        f"{protocol} on the fused engine (binding: fused_fns); batches over "
+        f"MAX_LANES_PER_CALL auto-segment (fused_chunk_auto)."
+    )
     return chunk
 
 

@@ -472,6 +472,19 @@ def compact_mp_body(state: MultiPaxosState):
                 last_chosen_count=dec(prop.last_chosen_count),
                 recov_bal=_shift_slots(prop.recov_bal, shift, 1),
                 recov_val=_shift_slots(prop.recov_val, shift, 1),
+                # A leader whose in-progress slot was compacted under it
+                # (shift > commit_idx) clamps to window slot 0 — a DIFFERENT
+                # global slot — so ACCEPTED votes folded for the old slot
+                # must not count toward the new one's quorum: clear heard
+                # and re-collect (leaders re-broadcast ACCEPT every tick).
+                # Candidate heard is slot-agnostic (promises cover the whole
+                # log) and keeps.  Liveness-only either way, but the honest
+                # accounting costs nothing.
+                heard=jnp.where(
+                    (prop.phase == LEAD) & (shift[None] > prop.commit_idx),
+                    0,
+                    prop.heard,
+                ),
             ),
             learner=lrn.replace(
                 lt_bal=_shift_slots(lrn.lt_bal, shift, 0),

@@ -1,0 +1,34 @@
+"""Pytree comparison helpers shared by tests and the driver contract.
+
+Bit-exact state equality is the framework's central testing move (fused vs
+reference stream, sharded vs unsharded, segmented vs single-kernel, resumed
+vs uninterrupted), so the compare-and-collect idiom lives here once instead
+of being re-rolled per test file.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def tree_mismatches(a: Any, b: Any) -> list:
+    """Key paths at which two pytrees are not elementwise equal.
+
+    Both trees are fetched to host first.  ``tree_map_with_path`` raises on
+    any tree-structure mismatch, so a future state-field change can never
+    silently truncate the comparison.
+    """
+    ah, bh = jax.device_get(a), jax.device_get(b)
+    mism: list = []
+    jax.tree_util.tree_map_with_path(
+        lambda p, x, y: mism.append(p) if not (x == y).all() else None, ah, bh
+    )
+    return mism
+
+
+def assert_trees_equal(a: Any, b: Any, msg: str = "pytrees differ") -> None:
+    """Assert bit-exact equality, naming the mismatching key paths."""
+    mism = tree_mismatches(a, b)
+    assert not mism, f"{msg}: {mism}"

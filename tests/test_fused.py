@@ -22,14 +22,7 @@ from paxos_tpu.harness.run import init_plan, init_state
 from paxos_tpu.kernels.fused_tick import fused_paxos_chunk, reference_chunk
 
 
-def _trees_equal(a, b):
-    la, _ = jax.tree.flatten(a)
-    lb, _ = jax.tree.flatten(b)
-    return [
-        i
-        for i, (x, y) in enumerate(zip(la, lb))
-        if not np.array_equal(np.asarray(x), np.asarray(y))
-    ]
+from paxos_tpu.utils.trees import tree_mismatches as _trees_equal
 
 
 def test_pallas_lowering_bitexact_vs_reference():
@@ -143,3 +136,53 @@ def test_fused_stream_chunk_split_invariant():
     two = reference_chunk(init_state(cfg), jnp.int32(9), plan, cfg.fault, 24)
     two = reference_chunk(two, jnp.int32(9), plan, cfg.fault, 24)
     assert _trees_equal(one, two) == []
+
+
+def test_fused_segmented_matches_single_call():
+    """fused_chunk_auto above its lane ceiling == the single kernel at the
+    same block, bit for bit: per-segment global block offsets reproduce the
+    exact stream, so the 8M+ degradation path (VERDICT r2 #7) preserves
+    the replay/shrink/checkpoint contract."""
+    from paxos_tpu.kernels.fused_tick import fused_chunk, fused_chunk_auto
+    from paxos_tpu.protocols.paxos import apply_tick, counter_masks
+
+    cfg = config2_dueling_drop(n_inst=64, seed=4)
+    plan = init_plan(cfg)
+
+    single = fused_chunk(
+        init_state(cfg), jnp.int32(4), plan, cfg.fault, 24,
+        apply_tick, counter_masks, block=8, interpret=True,
+    )
+    # max_lanes=16 forces 4 segments of 2 blocks each.
+    segmented = fused_chunk_auto(
+        init_state(cfg), jnp.int32(4), plan, cfg.fault, 24,
+        apply_tick, counter_masks, block=8, interpret=True, max_lanes=16,
+    )
+    assert _trees_equal(single, segmented) == []
+
+
+def test_fused_segmented_multipaxos_longlog_compact():
+    """The segmented path composes with decided-prefix compaction the same
+    way the single-kernel path does (the 8M config3long story)."""
+    import dataclasses
+
+    from paxos_tpu.harness.config import config3_long
+    from paxos_tpu.kernels.fused_tick import fused_chunk_auto, fused_fns
+    from paxos_tpu.protocols.multipaxos import compact_mp
+
+    cfg = config3_long(n_inst=32, log_total=8, window=4, seed=6)
+    apply_fn, mask_fn, _ = fused_fns("multipaxos")
+    plan = init_plan(cfg)
+
+    def drive(max_lanes):
+        st = init_state(cfg)
+        for _ in range(3):
+            st = fused_chunk_auto(
+                st, jnp.int32(cfg.seed), plan, cfg.fault, 8,
+                apply_fn, mask_fn, block=8, interpret=True,
+                max_lanes=max_lanes,
+            )
+            st = compact_mp(st)[0]
+        return st
+
+    assert _trees_equal(drive(1 << 22), drive(16)) == []

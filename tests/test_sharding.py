@@ -2,15 +2,26 @@
 
 The simulator is deterministic by construction (counter-based PRNG keyed on
 (seed, tick)), so sharding the instances axis across a mesh must produce
-bit-identical results to the single-device run.
+bit-identical results to the single-device run.  Long-log Multi-Paxos
+(window + decided-prefix compaction, SURVEY.md §6.7) is covered here too:
+compaction composed over a sharded chunk — both engines — must equal the
+unsharded composition lane for lane.
 """
 
 import jax
 import jax.numpy as jnp
 
-from paxos_tpu.harness.config import config2_dueling_drop
-from paxos_tpu.harness.run import base_key, get_step_fn, init_plan, init_state, run_chunk
+from paxos_tpu.harness.config import config2_dueling_drop, config3_long
+from paxos_tpu.harness.run import (
+    base_key,
+    get_step_fn,
+    init_plan,
+    init_state,
+    make_advance,
+    run_chunk,
+)
 from paxos_tpu.parallel.mesh import make_mesh, shard_pytree
+from paxos_tpu.utils.trees import assert_trees_equal as _assert_trees_equal
 
 
 def test_eight_device_mesh_matches_single_device():
@@ -31,6 +42,59 @@ def test_eight_device_mesh_matches_single_device():
     assert len(s8.acceptor.promised.sharding.device_set) == 8
     for l1, l8 in zip(jax.tree.leaves(s1), jax.tree.leaves(s8)):
         assert jnp.array_equal(l1, jax.device_get(l8)), "sharded run diverged"
+
+
+def test_sharded_xla_longlog_compact_matches_unsharded():
+    """Sharded XLA chunk + decided-prefix compaction == unsharded, lane for
+    lane — the engine×sharding×config cell the CLI composes at
+    cli.py (run --shard --config config3long --engine xla)."""
+    cfg = config3_long(n_inst=64, log_total=12, window=4, seed=3)
+
+    s1 = init_state(cfg)
+    adv1 = make_advance(cfg, init_plan(cfg), "xla", compact=True)
+    for _ in range(6):
+        s1 = adv1(s1, 8)
+
+    mesh = make_mesh()
+    s8 = shard_pytree(init_state(cfg), mesh, cfg.n_inst)
+    adv8 = make_advance(cfg, shard_pytree(init_plan(cfg), mesh, cfg.n_inst),
+                        "xla", compact=True)
+    for _ in range(6):
+        s8 = adv8(s8, 8)
+
+    assert len(s8.acceptor.log_bal.sharding.device_set) == 8
+    assert (jax.device_get(s8.base) > 0).any(), "vacuous: nothing compacted"
+    _assert_trees_equal(s1, s8, "sharded xla long-log diverged")
+
+
+def test_sharded_fused_longlog_compact_matches_unsharded():
+    """compact_mp over fused_chunk_sharded (the CLI's sharded fused long-log
+    composition) == the unsharded fused+compact path at the same block."""
+    from paxos_tpu.kernels.fused_tick import fused_chunk_sharded, fused_fns
+    from paxos_tpu.protocols.multipaxos import compact_mp
+
+    cfg = config3_long(n_inst=64, log_total=12, window=4, seed=7)
+    block = 8  # == local shard size, so global block ids match unsharded
+
+    s1 = init_state(cfg)
+    adv1 = make_advance(cfg, init_plan(cfg), "fused", block=block, compact=True)
+    for _ in range(6):
+        s1 = adv1(s1, 8)
+
+    mesh = make_mesh()
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    plan8 = shard_pytree(init_plan(cfg), mesh, cfg.n_inst)
+    s8 = shard_pytree(init_state(cfg), mesh, cfg.n_inst)
+    for _ in range(6):
+        s8 = fused_chunk_sharded(
+            s8, jnp.int32(cfg.seed), plan8, cfg.fault, 8,
+            apply_fn, mask_fn, mesh, block=block, interpret=True,
+        )
+        s8 = compact_mp(s8)[0]
+
+    assert len(s8.acceptor.log_bal.sharding.device_set) == 8
+    assert (jax.device_get(s8.base) > 0).any(), "vacuous: nothing compacted"
+    _assert_trees_equal(s1, s8, "sharded fused long-log diverged")
 
 
 def test_metrics_reduce_across_shards():
