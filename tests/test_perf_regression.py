@@ -53,15 +53,27 @@ def _bench_prng():
 def test_perf_band(case):
     from bench import _configs, bench_case
 
-    table = {(name, eng): cfg for name, cfg, eng in _configs("tpu")}
-    cfg = table[(case["case"], case["engine"])]
+    table = {
+        (name, eng): (cfg, chunk)
+        for name, cfg, eng, chunk in _configs("tpu")
+    }
+    cfg, chunk = table[(case["case"], case["engine"])]
     # The recorded number must refer to this exact config, else the band
     # compares apples to oranges (a config change requires re-recording).
     assert cfg.fingerprint() == case["config_fingerprint"], (
         f"{case['case']}: config changed since BENCH_SWEEP.json was recorded; "
         "re-run `python bench.py --sweep --record BENCH_SWEEP.json`"
     )
-    out = bench_case(cfg, case["engine"])
+    # Chunk must match the recording EXACTLY — chunk moves the measured
+    # value by ~17% between 64 and 1024 (dispatch amortization), so a
+    # mismatched chunk quietly eats the 0.7 band cushion.  The artifact
+    # records chunk directly; ticks == timed_chunks * chunk is the
+    # equivalent exact check for the default timed_chunks=4.
+    assert case.get("chunk", case["ticks"] // 4) == chunk, (
+        f"{case['case']}: bench chunk {chunk} != recorded "
+        f"{case.get('chunk', case['ticks'] // 4)}; re-record BENCH_SWEEP.json"
+    )
+    out = bench_case(cfg, case["engine"], chunk=chunk)
     assert out["violations"] == 0
     assert out["value"] >= BAND * case["value"], (
         f"{case['case']} ({case['engine']}): {out['value']:.3e} < "
