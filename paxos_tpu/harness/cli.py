@@ -22,12 +22,27 @@ from typing import Optional
 from paxos_tpu.harness import config as config_mod
 from paxos_tpu.harness.config import SimConfig
 
+def _sweep_member(protocol):
+    """One protocol's member of the config-5 sweep as a standalone config,
+    so run/soak/shrink can target the fastpaxos/raftcore kernels directly
+    (the `sweep` subcommand runs all three under identical masks)."""
+
+    def make(**kw):
+        return next(
+            c for c in config_mod.config5_sweep(**kw) if c.protocol == protocol
+        )
+
+    return make
+
+
 CONFIGS = {
     "config1": config_mod.config1_no_faults,
     "config2": config_mod.config2_dueling_drop,
     "config3": config_mod.config3_multipaxos,
     "config3long": config_mod.config3_long,
     "config4": config_mod.config4_byzantine,
+    "config5-fastpaxos": _sweep_member("fastpaxos"),
+    "config5-raftcore": _sweep_member("raftcore"),
     "partition": config_mod.config_partition,
     # Flexible Paxos: safe (4+2 > 5) and deliberately unsafe (2+2 <= 5)
     # quorum pairs; the unsafe one exists to prove the checker catches it.

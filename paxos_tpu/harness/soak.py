@@ -47,6 +47,14 @@ def soak(
     lanes; the signal to watch across soaks is the TREND of
     ``stuck_frac`` for a fixed config, not its absolute value.
 
+    Long-log caveat: ``stuck`` means "not decided by the campaign budget",
+    and a long-log campaign deliberately truncates mid-log — worse, the
+    final chunk's compaction removes every decided row from the window, so
+    the residual rows are undecided by construction and ``stuck_frac``
+    reads ~1.0 on a perfectly healthy config3long soak (measured).  For
+    long-log configs the livelock signal is the ``decided_frac`` trend
+    (global replication progress per fixed budget), not ``stuck_frac``.
+
     **Eviction recheck (completeness):** a campaign whose learner table hit
     its K-slot bound (``evictions > 0``) has lanes whose agreement
     accounting is incomplete — "0 violations" would silently exclude them.
