@@ -784,6 +784,228 @@ struct Sim {
 
 }  // namespace fp
 
+// ---------------------------------------------------------------------------
+// Raft-core oracle (round-3: fourth protocol — the native matrix is square).
+// Mirrors the SEMANTICS of paxos_tpu/protocols/raftcore.py: leader election
+// with the election restriction (grant iff the candidate's entry term is at
+// least the voter's), one vote per term (strictly increasing grants; the
+// vote fence also rises on accepted appends), entry adoption from vote
+// replies (grants AND denials carry the voter's entry; the candidate keeps
+// the highest-term one across retries), and single-entry commit on a
+// majority of acks at the leader's term.  ``no_restriction`` /
+// ``no_adoption`` disable one safety leg each — the exhaustive checker
+// proved either alone suffices and both off violates; this oracle is the
+// event-driven falsifiability counterpart of that result.
+// ---------------------------------------------------------------------------
+
+namespace raft {
+
+enum Kind : uint8_t { REQVOTE, VOTE, APPEND, ACK };
+
+struct Msg {
+  Kind kind;
+  int8_t src;
+  int8_t dst;
+  int32_t term;
+  int32_t granted;   // VOTE: 1 = granted
+  int32_t ent_term;  // REQVOTE: candidate's entry term; VOTE: voter's entry
+  int32_t ent_val;   // VOTE payload / APPEND value
+};
+
+struct Voter {
+  int32_t voted = 0;  // highest term granted or appended (the vote fence)
+  int32_t ent_term = 0;
+  int32_t ent_val = 0;
+};
+
+struct Cand {
+  enum Phase { CAND, LEAD, DONE };
+  int pid;
+  int32_t own_val;
+  int32_t bal;
+  Phase phase = CAND;
+  uint32_t heard = 0;
+  int32_t ent_term = 0;  // adopted entry (kept across retries)
+  int32_t ent_val = 0;
+  int32_t prop_val = 0;
+  int32_t decided_val = -1;
+
+  explicit Cand(int p)
+      : pid(p), own_val(kValueBase + p), bal(make_ballot(0, p)) {}
+};
+
+struct Sim {
+  int n_prop, n_acc, quorum;
+  bool no_restriction, no_adoption;
+  double p_drop, p_dup, timeout_weight;
+  Rng rng;
+  std::vector<Voter> voters;
+  std::vector<Cand> cands;
+  std::vector<Msg> network;
+  std::vector<int32_t> ev_term, ev_val;  // append-accept history
+  std::vector<uint32_t> ev_mask;
+
+  Sim(uint64_t seed, int np, int na, bool norestr, bool noadopt, double pd,
+      double pdup, double tw)
+      : n_prop(np), n_acc(na), quorum(na / 2 + 1), no_restriction(norestr),
+        no_adoption(noadopt), p_drop(pd), p_dup(pdup), timeout_weight(tw),
+        rng(seed ^ 0xc3a5c85c97cb3127ull) {
+    voters.resize(n_acc);
+    for (int p = 0; p < n_prop; ++p) cands.emplace_back(p);
+    for (auto& c : cands) request_votes(c);
+  }
+
+  void offer(const Msg& m) {
+    if (rng.uniform() >= p_drop) network.push_back(m);
+  }
+
+  void request_votes(Cand& c) {
+    for (int a = 0; a < n_acc; ++a) {
+      offer(Msg{REQVOTE, static_cast<int8_t>(c.pid), static_cast<int8_t>(a),
+                c.bal, 0, c.ent_term, 0});
+    }
+  }
+
+  void record_accept(int voter, int32_t term, int32_t val) {
+    for (size_t i = 0; i < ev_term.size(); ++i) {
+      if (ev_term[i] == term && ev_val[i] == val) {
+        ev_mask[i] |= 1u << voter;
+        return;
+      }
+    }
+    ev_term.push_back(term);
+    ev_val.push_back(val);
+    ev_mask.push_back(1u << voter);
+  }
+
+  void dispatch(const Msg& m) {
+    switch (m.kind) {
+      case REQVOTE: {
+        Voter& v = voters[m.dst];
+        bool restrict_ok = no_restriction || m.ent_term >= v.ent_term;
+        bool grant = m.term > v.voted && restrict_ok;
+        if (grant) v.voted = m.term;
+        // Replies go out for grants AND denials, carrying the voter's
+        // (pre-update — unchanged by REQVOTE) entry.
+        offer(Msg{VOTE, m.dst, m.src, m.term, grant ? 1 : 0, v.ent_term,
+                  v.ent_val});
+        break;
+      }
+      case VOTE: {
+        Cand& c = cands[m.dst];
+        if (c.phase != Cand::CAND || m.term != c.bal) break;
+        if (!no_adoption && m.ent_term > c.ent_term) {
+          c.ent_term = m.ent_term;
+          c.ent_val = m.ent_val;
+        }
+        if (m.granted) c.heard |= 1u << m.src;
+        if (__builtin_popcount(c.heard) >= quorum) {
+          int32_t val = c.ent_term > 0 ? c.ent_val : c.own_val;
+          c.phase = Cand::LEAD;
+          c.heard = 0;
+          c.prop_val = val;
+          c.ent_term = c.bal;  // the leader's proposal is its own entry now
+          c.ent_val = val;
+          for (int a = 0; a < n_acc; ++a) {
+            offer(Msg{APPEND, static_cast<int8_t>(c.pid),
+                      static_cast<int8_t>(a), c.bal, 0, 0, val});
+          }
+        }
+        break;
+      }
+      case APPEND: {
+        Voter& v = voters[m.dst];
+        if (m.term >= v.voted) {
+          v.voted = m.term;  // >= v.voted by the guard
+          v.ent_term = m.term;
+          v.ent_val = m.ent_val;
+          record_accept(m.dst, m.term, m.ent_val);
+          offer(Msg{ACK, m.dst, m.src, m.term, 0, 0, 0});
+        }
+        break;
+      }
+      case ACK: {
+        Cand& c = cands[m.dst];
+        if (c.phase != Cand::LEAD || m.term != c.bal) break;
+        c.heard |= 1u << m.src;
+        if (__builtin_popcount(c.heard) >= quorum) {
+          c.phase = Cand::DONE;
+          c.decided_val = c.prop_val;
+        }
+        break;
+      }
+    }
+  }
+
+  bool all_done() const {
+    for (const auto& c : cands)
+      if (c.phase != Cand::DONE) return false;
+    return true;
+  }
+
+  Result run(int max_steps) {
+    int steps = 0;
+    while (steps < max_steps && !all_done()) {
+      ++steps;
+      if (!network.empty() && rng.uniform() >= timeout_weight) {
+        int i = rng.below(static_cast<int>(network.size()));
+        Msg m = network[i];
+        if (rng.uniform() >= p_dup) {
+          network[i] = network.back();
+          network.pop_back();
+        }
+        dispatch(m);
+      } else {
+        // Election timeout: a non-DONE candidate (a stale leader included)
+        // runs at the next term, keeping its adopted entry.
+        int live = 0;
+        for (const auto& c : cands) live += c.phase != Cand::DONE;
+        if (live == 0) break;
+        int pick = rng.below(live);
+        for (auto& c : cands) {
+          if (c.phase == Cand::DONE) continue;
+          if (pick-- == 0) {
+            c.bal = make_ballot(ballot_round(c.bal) + 1, c.pid);
+            c.phase = Cand::CAND;
+            c.heard = 0;
+            request_votes(c);
+            break;
+          }
+        }
+      }
+    }
+
+    // Omniscient oracle: distinct committed values over the append-accept
+    // history at majority quorums.
+    int n_chosen = 0;
+    int32_t chosen_val = -1;
+    bool validity = true;
+    for (size_t i = 0; i < ev_term.size(); ++i) {
+      if (__builtin_popcount(ev_mask[i]) >= quorum) {
+        bool seen = false;
+        for (size_t j = 0; j < i && !seen; ++j) {
+          seen = __builtin_popcount(ev_mask[j]) >= quorum &&
+                 ev_val[j] == ev_val[i];
+        }
+        if (!seen) {
+          ++n_chosen;
+          chosen_val = ev_val[i];
+        }
+        validity &= ev_val[i] >= kValueBase && ev_val[i] < kValueBase + n_prop;
+      }
+    }
+    bool agreement = n_chosen <= 1;
+    for (const auto& c : cands) {
+      if (c.decided_val >= 0)
+        agreement &= n_chosen == 1 && c.decided_val == chosen_val;
+    }
+    return Result{all_done() ? 1 : 0, agreement ? 1 : 0, validity ? 1 : 0,
+                  n_chosen, steps};
+  }
+};
+
+}  // namespace raft
+
 }  // namespace
 
 extern "C" {
@@ -847,6 +1069,27 @@ void fp_run_batch(uint64_t seed0, int32_t n_runs, int32_t n_prop,
   for (int32_t r = 0; r < n_runs; ++r) {
     fp::Sim sim(seed0 + static_cast<uint64_t>(r), n_prop, n_acc, q1, q2,
                 q_fast, p_drop, p_dup, timeout_weight);
+    Result res = sim.run(max_steps);
+    std::memcpy(out + 5 * r, &res, sizeof(res));
+  }
+}
+
+// Raft-core batch: same 5-int32-per-run layout.  no_restriction /
+// no_adoption disable one safety leg each (both off must let the oracle
+// find agreement violations — the event-driven counterpart of the
+// exhaustive checker's two-leg decomposition).
+void raft_run_batch(uint64_t seed0, int32_t n_runs, int32_t n_prop,
+                    int32_t n_acc, int32_t no_restriction,
+                    int32_t no_adoption, double p_drop, double p_dup,
+                    double timeout_weight, int32_t max_steps, int32_t* out) {
+  if (!valid_topology(n_prop, n_acc)) {
+    for (int32_t i = 0; i < 5 * n_runs; ++i) out[i] = -1;
+    return;
+  }
+  for (int32_t r = 0; r < n_runs; ++r) {
+    raft::Sim sim(seed0 + static_cast<uint64_t>(r), n_prop, n_acc,
+                  no_restriction != 0, no_adoption != 0, p_drop, p_dup,
+                  timeout_weight);
     Result res = sim.run(max_steps);
     std::memcpy(out + 5 * r, &res, sizeof(res));
   }

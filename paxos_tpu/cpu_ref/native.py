@@ -73,6 +73,13 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
         ]
         lib.fp_run_batch.restype = None
+        lib.raft_run_batch.argtypes = [
+            ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.raft_run_batch.restype = None
         _LIB = lib
     return _LIB
 
@@ -200,6 +207,46 @@ def run_native_fp_batch(
     lib.fp_run_batch(
         seed0, n_runs, n_prop, n_acc, q1, q2, q_fast, p_drop, p_dup,
         timeout_weight, max_steps,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return OracleBatch(
+        decided=out[:, 0].astype(bool),
+        agreement_ok=out[:, 1].astype(bool),
+        validity_ok=out[:, 2].astype(bool),
+        n_chosen=out[:, 3],
+        steps=out[:, 4],
+    )
+
+
+def run_native_raft_batch(
+    seed0: int,
+    n_runs: int,
+    n_prop: int = 2,
+    n_acc: int = 3,
+    no_restriction: bool = False,
+    no_adoption: bool = False,
+    p_drop: float = 0.0,
+    p_dup: float = 0.0,
+    timeout_weight: float = 0.05,
+    max_steps: int = 40_000,
+) -> OracleBatch:
+    """Fuzz ``n_runs`` independent Raft-core instances in native code.
+
+    Fourth oracle protocol — the native matrix is square: election
+    restriction, one-vote-per-term fencing, entry adoption from vote
+    replies, and majority-ack commit, the same semantics as
+    ``protocols/raftcore.py`` under an event-driven scheduler.
+    ``no_restriction``/``no_adoption`` each disable one safety leg; the
+    exhaustive checker proved either alone suffices and both off violates,
+    and this oracle must reproduce that result under its event-driven
+    scheduler (tests/test_native_oracle.py).
+    """
+    _check_topology(n_prop, n_acc)
+    lib = _load()
+    out = np.empty((n_runs, 5), dtype=np.int32)
+    lib.raft_run_batch(
+        seed0, n_runs, n_prop, n_acc, int(no_restriction), int(no_adoption),
+        p_drop, p_dup, timeout_weight, max_steps,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return OracleBatch(

@@ -16,6 +16,7 @@ from paxos_tpu.cpu_ref.native import (
     run_native_batch,
     run_native_fp_batch,
     run_native_mp_batch,
+    run_native_raft_batch,
 )
 
 needs_gxx = pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
@@ -155,3 +156,66 @@ def test_native_fp_unsafe_quorum_caught():
     )
     assert safe.agreement_ok.all()
     assert safe.validity_ok.all()
+
+
+# ---- Raft-core oracle (round 3: the native matrix is square) ----
+
+
+@needs_gxx
+def test_native_raft_clean_network():
+    """No faults: elections + appends commit exactly one value per seed."""
+    batch = run_native_raft_batch(seed0=0, n_runs=2000, n_prop=2, n_acc=3)
+    assert batch.decided.all()
+    assert batch.agreement_ok.all()
+    assert batch.validity_ok.all()
+    assert (batch.n_chosen == 1).all()
+
+
+@needs_gxx
+def test_native_raft_chaos():
+    """Drops + dups + elections: safety on every seed.  timeout_weight
+    stays moderate (0.05): Raft's vote-once-per-term rule means a
+    preemption rate faster than one full election livelocks on split
+    votes — authentic Raft behavior (its paper's randomized-timeout
+    motivation; the JAX kernel's backoff jitter plays that role).  The
+    storm case below fuzzes the aggressive rate for SAFETY only."""
+    batch = run_native_raft_batch(
+        seed0=13_000, n_runs=2000, n_prop=3, n_acc=5,
+        p_drop=0.2, p_dup=0.2, timeout_weight=0.05,
+    )
+    assert batch.agreement_ok.all()
+    assert batch.validity_ok.all()
+    assert batch.decided.mean() > 0.9
+
+
+@needs_gxx
+def test_native_raft_election_storm_safety():
+    """Preemption faster than an election completes: split-vote livelock
+    (few seeds decide — expected for vote-once-per-term) must still never
+    break agreement across ~75M scheduler events."""
+    batch = run_native_raft_batch(
+        seed0=21_000, n_runs=2000, n_prop=3, n_acc=5,
+        p_drop=0.2, p_dup=0.2, timeout_weight=0.1,
+    )
+    assert batch.agreement_ok.all()
+    assert batch.validity_ok.all()
+
+
+@needs_gxx
+def test_native_raft_two_leg_safety():
+    """Event-driven counterpart of the exhaustive two-leg decomposition:
+    the election restriction alone is safe, adoption alone is safe,
+    removing BOTH lets a stale empty-logged candidate win and commit a
+    second value — the oracle must find it."""
+    kw = dict(
+        seed0=700, n_runs=4000, n_prop=2, n_acc=3,
+        p_drop=0.1, timeout_weight=0.1,
+    )
+    only_restriction = run_native_raft_batch(no_adoption=True, **kw)
+    assert only_restriction.agreement_ok.all()
+    only_adoption = run_native_raft_batch(no_restriction=True, **kw)
+    assert only_adoption.agreement_ok.all()
+    neither = run_native_raft_batch(
+        no_restriction=True, no_adoption=True, **kw
+    )
+    assert not neither.agreement_ok.all(), "both legs off must violate"
