@@ -43,8 +43,13 @@ def config1_no_faults(n_inst: int = 1024, seed: int = 0) -> SimConfig:
     return SimConfig(n_inst=n_inst, n_prop=1, n_acc=3, seed=seed)
 
 
-def config2_dueling_drop(n_inst: int = 100_000, seed: int = 0) -> SimConfig:
-    """Config 2: 5 acceptors, 2 dueling proposers, 10% message drop."""
+def config2_dueling_drop(n_inst: int = 131_072, seed: int = 0) -> SimConfig:
+    """Config 2: 5 acceptors, 2 dueling proposers, 10% message drop.
+
+    Default batch is the power-of-two at the spec's "100k" scale (2^17):
+    TPU lane tiling needs 128-divisible blocks, and the literal 100,000
+    (2^5 x 5^5) admits none — the fused engine would reject it.
+    """
     return SimConfig(
         n_inst=n_inst,
         n_prop=2,
@@ -54,8 +59,13 @@ def config2_dueling_drop(n_inst: int = 100_000, seed: int = 0) -> SimConfig:
     )
 
 
-def config3_multipaxos(n_inst: int = 1_000_000, seed: int = 0) -> SimConfig:
-    """Config 3: Multi-Paxos log replication, leader lease + leader crash."""
+def config3_multipaxos(n_inst: int = 1_048_576, seed: int = 0) -> SimConfig:
+    """Config 3: Multi-Paxos log replication, leader lease + leader crash.
+
+    Default batch is the power-of-two at the spec's "1M" scale (2^20):
+    the literal 1,000,000 (2^6 x 5^6) admits no 128-divisible block, so
+    the fused engine would reject it (see ``fused_tick.fit_block``).
+    """
     return SimConfig(
         n_inst=n_inst,
         n_prop=2,

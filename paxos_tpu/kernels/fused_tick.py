@@ -65,6 +65,53 @@ DEFAULT_BLOCK = 1024
 MAX_LANES_PER_CALL = 1 << 22
 
 
+def fit_block(
+    block: int, n: int, floor: "int | None" = None, interpret: bool = False
+) -> int:
+    """A block that DIVIDES ``n``: the request if already valid, else the
+    largest power of two <= the request that divides ``n``.
+
+    An explicitly valid request (divides ``n`` and tiles: a multiple of
+    the lane floor, or the full array — Mosaic exempts full-dimension
+    blocks from alignment) is returned UNCHANGED: block is
+    stream-relevant (streams key on the block id), so a replay passing
+    the observing run's block must get exactly that block back.  Invalid
+    requests degrade deterministically in (block, n), so replays of
+    degraded runs reproduce too.
+
+    ``floor`` defaults from ``interpret``: Mosaic requires the block's
+    trailing dim divisible by 128 on a real TPU; the Pallas TPU
+    interpreter accepts 8.  A count like the literal 1,000,000 (2^6 x
+    5^6, largest power-of-two divisor 64) cannot host ANY aligned block:
+    small such counts (<= DEFAULT_BLOCK) degrade to one full-array block,
+    large ones get an error steering to a 128-divisible count (e.g.
+    1<<20) or the XLA engine, which has no alignment constraint.
+    """
+    if floor is None:
+        floor = 8 if interpret else 128
+    if n % block == 0 and (block % floor == 0 or block == n):
+        return block
+    p2 = n & -n  # largest power-of-two divisor of n
+    if p2 < floor:
+        if n <= DEFAULT_BLOCK:
+            return n  # one full-array block: tiles trivially, fits VMEM
+        raise ValueError(
+            f"n_inst={n} has largest power-of-two divisor {p2} (< {floor}, "
+            f"the TPU lane-tiling minimum): the fused engine needs an "
+            f"aligned instance count — use one divisible by {floor} (e.g. "
+            f"1<<20 for '1M') or --engine xla (no alignment constraint)"
+        )
+    b = min(block, p2)
+    b = 1 << (b.bit_length() - 1)  # round down to a power of two (divides n)
+    if b < floor:
+        raise ValueError(
+            f"block={block} is below the lane-tiling minimum {floor}: pass "
+            f"a block >= {floor} that divides n_inst={n}, or omit it for "
+            f"the protocol default"
+        )
+    return b
+
+
 def _split_tick(state: Any):
     """Flatten the state with the scalar ``tick`` leaf separated out.
 
@@ -158,19 +205,16 @@ def fused_chunk(
 
     ``seed`` is an int32 scalar (the campaign seed); per-(tick, block)
     streams are derived on-core.  ``block`` instances are processed per grid
-    step and must divide ``n_inst``; 1-D state leaves pin it to the XLA
-    1024-element tiling at large sizes, so the default is rarely worth
-    changing.
+    step; a request that doesn't divide ``n_inst`` (or misses the tiling
+    floor) degrades deterministically via :func:`fit_block`.  1-D state
+    leaves pin it to the XLA 1024-element tiling at large sizes, so the
+    default is rarely worth changing.
     """
     n_inst = jax.tree.leaves(state)[0].shape[-1]
-    block = min(block, n_inst)
-    if n_inst % block:
-        raise ValueError(
-            f"n_inst={n_inst} not divisible by block={block}: the fused "
-            f"engine needs a block-aligned instance count — use a power-of-"
-            f"two n_inst (e.g. 1<<20) or pass an explicit block that "
-            f"divides it (block is stream-relevant: replays must reuse it)"
-        )
+    # Non-dividing blocks degrade to the largest power-of-two divisor
+    # (deterministic, so the stream keying per (seed, tick, block id)
+    # stays reproducible across replays at the same n_inst).
+    block = fit_block(min(block, n_inst), n_inst, interpret=interpret)
     grid = n_inst // block
 
     treedef, s_leaves, tick, tick_pos = _split_tick(state)
@@ -317,11 +361,7 @@ def fused_chunk_auto(
             f"<= {max_lanes} lanes; use a power-of-two instance count"
         )
     seg = n_inst // segments
-    block = min(block, seg)
-    if seg % block:
-        raise ValueError(
-            f"segment size {seg} not divisible by block={block}"
-        )
+    block = fit_block(min(block, seg), seg, interpret=interpret)
     return _segmented_impl(
         state, jnp.asarray(seed, jnp.int32), plan,
         cfg=cfg, n_ticks=n_ticks, apply_fn=apply_fn, mask_fn=mask_fn,
@@ -438,9 +478,7 @@ def fused_chunk_sharded(
         # before any shape error surfaced.
         raise ValueError(f"n_inst={n_inst} not divisible by mesh size {n_dev}")
     local = n_inst // n_dev
-    block = min(block, local)
-    if local % block:
-        raise ValueError(f"local n_inst={local} not divisible by block={block}")
+    block = fit_block(min(block, local), local, interpret=interpret)
     return _sharded_impl(
         state, jnp.asarray(seed, jnp.int32), plan,
         cfg=cfg, n_ticks=n_ticks, apply_fn=apply_fn, mask_fn=mask_fn,

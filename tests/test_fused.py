@@ -186,3 +186,49 @@ def test_fused_segmented_multipaxos_longlog_compact():
         return st
 
     assert _trees_equal(drive(1 << 22), drive(16)) == []
+
+
+def test_fused_nonpow2_instance_count_degrades_block():
+    """Non-power-of-two instance counts degrade to the largest
+    power-of-two-divisor block (deterministic -> replays reproduce)
+    instead of refusing to run, down to the platform's lane-tiling floor
+    (8 under the Pallas TPU interpreter, 128 on a compiled TPU — where
+    the literal 1,000,000 has no admissible block at all and the error
+    must steer to an aligned count or the XLA engine)."""
+    import pytest
+
+    from paxos_tpu.kernels.fused_tick import fit_block
+
+    assert fit_block(1024, 1_000_000, floor=8) == 64
+    assert fit_block(1024, 100_000, floor=8) == 32
+    assert fit_block(16, 96, floor=8) == 16
+    assert fit_block(1024, 1_048_576) == 1024  # 128-floor: 1<<20 is fine
+    assert fit_block(1024, 3 * 256) == 256
+    # A non-dividing explicit block must never truncate lanes: it rounds
+    # down to a power of two that divides n (48 -> 32 for n=1024).
+    assert fit_block(48, 1024, floor=8) == 32
+    # An explicitly VALID block is returned unchanged, even non-power-of-
+    # two (block is stream-relevant: replays pass the observing block).
+    assert fit_block(393_216, 786_432) == 393_216
+    # Small unalignable counts degrade to ONE full-array block (Mosaic
+    # exempts full-dimension blocks from the 8/128 alignment rule).
+    assert fit_block(1024, 20, floor=8) == 20
+    assert fit_block(1024, 1000) == 1000
+    with pytest.raises(ValueError, match="--engine xla"):
+        fit_block(1024, 1_000_000)  # compiled floor: 64 < 128, too big
+    with pytest.raises(ValueError, match="block=64 is below"):
+        fit_block(64, 1 << 20)  # the BLOCK is at fault, not n_inst
+
+    # End-to-end: a non-dividing request (48 on 64 lanes) degrades to the
+    # dividing power of two below it (32) — bit-identical to asking for 32.
+    cfg = config2_dueling_drop(n_inst=64, seed=3)
+    plan = init_plan(cfg)
+    degraded = fused_paxos_chunk(
+        init_state(cfg), jnp.int32(3), plan, cfg.fault, 16,
+        block=48, interpret=True,
+    )
+    explicit = fused_paxos_chunk(
+        init_state(cfg), jnp.int32(3), plan, cfg.fault, 16,
+        block=32, interpret=True,
+    )
+    assert _trees_equal(degraded, explicit) == []
