@@ -49,6 +49,46 @@ constexpr int kValueBase = 100;   // proposer p proposes kValueBase + p
 inline int make_ballot(int rnd, int pid) { return rnd * kMaxProposers + pid + 1; }
 inline int ballot_round(int bal) { return (bal - 1) / kMaxProposers; }
 
+// Shared omniscient-oracle bookkeeping: a voter bitmask per
+// (key, ballot, value) accept/commit event, where key is the log slot for
+// Multi-Paxos and 0 for the single-decree protocols.  Only the
+// bookkeeping is shared — each sim's protocol logic stays independent.
+struct History {
+  std::vector<int32_t> key, bal, val;
+  std::vector<uint32_t> mask;
+
+  void record(int acc, int32_t k, int32_t b, int32_t v) {
+    for (size_t i = 0; i < bal.size(); ++i) {
+      if (key[i] == k && bal[i] == b && val[i] == v) {
+        mask[i] |= 1u << acc;
+        return;
+      }
+    }
+    key.push_back(k);
+    bal.push_back(b);
+    val.push_back(v);
+    mask.push_back(1u << acc);
+  }
+
+  // Distinct (key, value) pairs among events passing the per-event
+  // ``chosen(i)`` predicate, in first-chosen order (an A,B,A quorum-event
+  // order yields two entries, not three).
+  template <typename F>
+  void distinct_chosen(F&& chosen, std::vector<int32_t>* out_key,
+                       std::vector<int32_t>* out_val) const {
+    for (size_t i = 0; i < bal.size(); ++i) {
+      if (!chosen(i)) continue;
+      bool seen = false;
+      for (size_t j = 0; j < out_key->size() && !seen; ++j)
+        seen = (*out_key)[j] == key[i] && (*out_val)[j] == val[i];
+      if (!seen) {
+        out_key->push_back(key[i]);
+        out_val->push_back(val[i]);
+      }
+    }
+  }
+};
+
 enum Kind : uint8_t { PREPARE, PROMISE, ACCEPT, ACCEPTED };
 
 struct Msg {
@@ -98,10 +138,7 @@ struct Sim {
   std::vector<Acceptor> acceptors;
   std::vector<Proposer> proposers;
   std::vector<Msg> network;
-  // Accept-event history: acceptor bitmask per (ballot, value), linear table
-  // (ballot counts stay tiny at single-instance scale).
-  std::vector<int32_t> ev_bal, ev_val;
-  std::vector<uint32_t> ev_mask;
+  History hist;  // accept events keyed (0, ballot, value)
 
   Sim(uint64_t seed, int np, int na, double pd, double pdup, double tw)
       : n_prop(np), n_acc(na), quorum(na / 2 + 1), p_drop(pd), p_dup(pdup),
@@ -122,18 +159,6 @@ struct Sim {
     }
   }
 
-  void record_accept(int acc, int32_t bal, int32_t val) {
-    for (size_t i = 0; i < ev_bal.size(); ++i) {
-      if (ev_bal[i] == bal && ev_val[i] == val) {
-        ev_mask[i] |= 1u << acc;
-        return;
-      }
-    }
-    ev_bal.push_back(bal);
-    ev_val.push_back(val);
-    ev_mask.push_back(1u << acc);
-  }
-
   void dispatch(const Msg& m) {
     switch (m.kind) {
       case PREPARE: {
@@ -150,7 +175,7 @@ struct Sim {
           a.promised = a.promised > m.bal ? a.promised : m.bal;
           a.acc_bal = m.bal;
           a.acc_val = m.val;
-          record_accept(m.dst, m.bal, m.val);
+          hist.record(m.dst, 0, m.bal, m.val);
           offer(Msg{ACCEPTED, m.dst, m.src, m.bal, m.val, 0, 0});
         }
         break;
@@ -223,26 +248,16 @@ struct Sim {
       }
     }
 
-    // Omniscient oracle over the full accept history.  n_chosen counts
-    // DISTINCT chosen values (a value chosen at several ballots, or an
-    // A,B,A event order, still counts each value once).
-    int n_chosen = 0;
-    int32_t chosen_val = -1;
+    // Omniscient oracle: distinct chosen values over the accept history.
+    std::vector<int32_t> ck, cv;
+    hist.distinct_chosen(
+        [&](size_t i) { return __builtin_popcount(hist.mask[i]) >= quorum; },
+        &ck, &cv);
+    int n_chosen = static_cast<int>(cv.size());
+    int32_t chosen_val = cv.empty() ? -1 : cv.back();
     bool validity = true;
-    for (size_t i = 0; i < ev_bal.size(); ++i) {
-      if (__builtin_popcount(ev_mask[i]) >= quorum) {
-        bool seen = false;
-        for (size_t j = 0; j < i && !seen; ++j) {
-          seen = __builtin_popcount(ev_mask[j]) >= quorum &&
-                 ev_val[j] == ev_val[i];
-        }
-        if (!seen) {
-          ++n_chosen;
-          chosen_val = ev_val[i];
-        }
-        validity &= ev_val[i] >= kValueBase && ev_val[i] < kValueBase + n_prop;
-      }
-    }
+    for (int32_t v : cv)
+      validity &= v >= kValueBase && v < kValueBase + n_prop;
     bool agreement = n_chosen <= 1;
     for (const auto& p : proposers) {
       if (p.decided_val >= 0)
@@ -313,9 +328,7 @@ struct Sim {
   std::vector<Acceptor> acceptors;
   std::vector<Proposer> proposers;
   std::vector<Msg> network;
-  // Accept-event history per (slot, ballot, value) -> voter bitmask.
-  std::vector<int32_t> ev_slot, ev_bal, ev_val;
-  std::vector<uint32_t> ev_mask;
+  History hist;  // accept events keyed (slot, ballot, value)
 
   Sim(uint64_t seed, int np, int na, int ll, double pd, double pdup, double tw)
       : n_prop(np), n_acc(na), log_len(ll), quorum(na / 2 + 1), p_drop(pd),
@@ -326,19 +339,6 @@ struct Sim {
 
   void offer(const Msg& m) {
     if (rng.uniform() >= p_drop) network.push_back(m);
-  }
-
-  void record_accept(int acc, int32_t slot, int32_t bal, int32_t val) {
-    for (size_t i = 0; i < ev_bal.size(); ++i) {
-      if (ev_slot[i] == slot && ev_bal[i] == bal && ev_val[i] == val) {
-        ev_mask[i] |= 1u << acc;
-        return;
-      }
-    }
-    ev_slot.push_back(slot);
-    ev_bal.push_back(bal);
-    ev_val.push_back(val);
-    ev_mask.push_back(1u << acc);
   }
 
   void drive_slot(Proposer& p) {  // broadcast ACCEPT for the current slot
@@ -383,7 +383,7 @@ struct Sim {
           a.promised = a.promised > m.bal ? a.promised : m.bal;
           a.log_bal[m.slot] = m.bal;
           a.log_val[m.slot] = m.val;
-          record_accept(m.dst, m.slot, m.bal, m.val);
+          hist.record(m.dst, m.slot, m.bal, m.val);
           Msg r{};
           r.kind = ACCEPTED;
           r.src = m.dst;
@@ -480,29 +480,22 @@ struct Sim {
       }
     }
 
-    // Omniscient per-slot oracle over the accept history.  chosen_cnt[s]
-    // counts DISTINCT chosen values for the slot (an A,B,A quorum-event
-    // order counts two, not three).
+    // Omniscient per-slot oracle: distinct chosen values per slot.
+    std::vector<int32_t> ck, cv;
+    hist.distinct_chosen(
+        [&](size_t i) { return __builtin_popcount(hist.mask[i]) >= quorum; },
+        &ck, &cv);
     int32_t chosen_val[kMaxLog];
     int chosen_cnt[kMaxLog] = {};
     bool validity = true;
     int slots_chosen = 0;
-    for (size_t i = 0; i < ev_bal.size(); ++i) {
-      if (__builtin_popcount(ev_mask[i]) >= quorum) {
-        int s = ev_slot[i];
-        bool seen = false;
-        for (size_t j = 0; j < i && !seen; ++j) {
-          seen = __builtin_popcount(ev_mask[j]) >= quorum &&
-                 ev_slot[j] == s && ev_val[j] == ev_val[i];
-        }
-        if (!seen) {
-          ++chosen_cnt[s];
-          chosen_val[s] = ev_val[i];
-        }
-        // Validity: some proposer proposes this value FOR THIS SLOT.
-        int32_t v = ev_val[i];
-        validity &= v % 1000 == s && v / 1000 >= 1 && v / 1000 <= n_prop;
-      }
+    for (size_t i = 0; i < ck.size(); ++i) {
+      int s = ck[i];
+      ++chosen_cnt[s];
+      chosen_val[s] = cv[i];
+      // Validity: some proposer proposes this value FOR THIS SLOT.
+      validity &= cv[i] % 1000 == s && cv[i] / 1000 >= 1 &&
+                  cv[i] / 1000 <= n_prop;
     }
     bool agreement = true;
     for (int s = 0; s < log_len; ++s) {
@@ -581,8 +574,7 @@ struct Sim {
   std::vector<Acceptor> acceptors;
   std::vector<Proposer> proposers;
   std::vector<Msg> network;
-  std::vector<int32_t> ev_bal, ev_val;
-  std::vector<uint32_t> ev_mask;
+  History hist;  // accept events keyed (0, ballot, value)
 
   Sim(uint64_t seed, int np, int na, int q1_, int q2_, int qf_, double pd,
       double pdup, double tw)
@@ -605,18 +597,6 @@ struct Sim {
     if (rng.uniform() >= p_drop) network.push_back(m);
   }
 
-  void record_accept(int acc, int32_t bal, int32_t val) {
-    for (size_t i = 0; i < ev_bal.size(); ++i) {
-      if (ev_bal[i] == bal && ev_val[i] == val) {
-        ev_mask[i] |= 1u << acc;
-        return;
-      }
-    }
-    ev_bal.push_back(bal);
-    ev_val.push_back(val);
-    ev_mask.push_back(1u << acc);
-  }
-
   void dispatch(const Msg& m) {
     switch (m.kind) {
       case PREPARE: {
@@ -637,7 +617,7 @@ struct Sim {
           a.promised = a.promised > m.bal ? a.promised : m.bal;
           a.acc_bal = m.bal;
           a.acc_val = m.val;
-          record_accept(m.dst, m.bal, m.val);
+          hist.record(m.dst, 0, m.bal, m.val);
           offer(Msg{ACCEPTED, m.dst, m.src, m.bal, m.val, 0, 0});
         }
         break;
@@ -750,28 +730,19 @@ struct Sim {
     }
 
     // Omniscient oracle: the choice threshold is per-round-kind (q_fast
-    // for the fast round 0, q2 for classic rounds); n_chosen counts
-    // DISTINCT chosen values.
-    int n_chosen = 0;
-    int32_t chosen_val = -1;
+    // for the fast round 0, q2 for classic rounds); distinct chosen values.
+    std::vector<int32_t> ck, cv;
+    hist.distinct_chosen(
+        [&](size_t i) {
+          int need = ballot_round(hist.bal[i]) == 0 ? qf : q2;
+          return __builtin_popcount(hist.mask[i]) >= need;
+        },
+        &ck, &cv);
+    int n_chosen = static_cast<int>(cv.size());
+    int32_t chosen_val = cv.empty() ? -1 : cv.back();
     bool validity = true;
-    auto chosen = [&](size_t i) {
-      int need = ballot_round(ev_bal[i]) == 0 ? qf : q2;
-      return __builtin_popcount(ev_mask[i]) >= need;
-    };
-    for (size_t i = 0; i < ev_bal.size(); ++i) {
-      if (chosen(i)) {
-        bool seen = false;
-        for (size_t j = 0; j < i && !seen; ++j) {
-          seen = chosen(j) && ev_val[j] == ev_val[i];
-        }
-        if (!seen) {
-          ++n_chosen;
-          chosen_val = ev_val[i];
-        }
-        validity &= ev_val[i] >= kValueBase && ev_val[i] < kValueBase + n_prop;
-      }
-    }
+    for (int32_t v : cv)
+      validity &= v >= kValueBase && v < kValueBase + n_prop;
     bool agreement = n_chosen <= 1;
     for (const auto& p : proposers) {
       if (p.decided_val >= 0)
@@ -842,8 +813,7 @@ struct Sim {
   std::vector<Voter> voters;
   std::vector<Cand> cands;
   std::vector<Msg> network;
-  std::vector<int32_t> ev_term, ev_val;  // append-accept history
-  std::vector<uint32_t> ev_mask;
+  History hist;  // append-accept events keyed (0, term, value)
 
   Sim(uint64_t seed, int np, int na, bool norestr, bool noadopt, double pd,
       double pdup, double tw)
@@ -864,18 +834,6 @@ struct Sim {
       offer(Msg{REQVOTE, static_cast<int8_t>(c.pid), static_cast<int8_t>(a),
                 c.bal, 0, c.ent_term, 0});
     }
-  }
-
-  void record_accept(int voter, int32_t term, int32_t val) {
-    for (size_t i = 0; i < ev_term.size(); ++i) {
-      if (ev_term[i] == term && ev_val[i] == val) {
-        ev_mask[i] |= 1u << voter;
-        return;
-      }
-    }
-    ev_term.push_back(term);
-    ev_val.push_back(val);
-    ev_mask.push_back(1u << voter);
   }
 
   void dispatch(const Msg& m) {
@@ -919,7 +877,7 @@ struct Sim {
           v.voted = m.term;  // >= v.voted by the guard
           v.ent_term = m.term;
           v.ent_val = m.ent_val;
-          record_accept(m.dst, m.term, m.ent_val);
+          hist.record(m.dst, 0, m.term, m.ent_val);
           offer(Msg{ACK, m.dst, m.src, m.term, 0, 0, 0});
         }
         break;
@@ -977,23 +935,15 @@ struct Sim {
 
     // Omniscient oracle: distinct committed values over the append-accept
     // history at majority quorums.
-    int n_chosen = 0;
-    int32_t chosen_val = -1;
+    std::vector<int32_t> ck, cv;
+    hist.distinct_chosen(
+        [&](size_t i) { return __builtin_popcount(hist.mask[i]) >= quorum; },
+        &ck, &cv);
+    int n_chosen = static_cast<int>(cv.size());
+    int32_t chosen_val = cv.empty() ? -1 : cv.back();
     bool validity = true;
-    for (size_t i = 0; i < ev_term.size(); ++i) {
-      if (__builtin_popcount(ev_mask[i]) >= quorum) {
-        bool seen = false;
-        for (size_t j = 0; j < i && !seen; ++j) {
-          seen = __builtin_popcount(ev_mask[j]) >= quorum &&
-                 ev_val[j] == ev_val[i];
-        }
-        if (!seen) {
-          ++n_chosen;
-          chosen_val = ev_val[i];
-        }
-        validity &= ev_val[i] >= kValueBase && ev_val[i] < kValueBase + n_prop;
-      }
-    }
+    for (int32_t v : cv)
+      validity &= v >= kValueBase && v < kValueBase + n_prop;
     bool agreement = n_chosen <= 1;
     for (const auto& c : cands) {
       if (c.decided_val >= 0)

@@ -245,39 +245,20 @@ def cmd_run(args: argparse.Namespace) -> int:
         log.emit("mesh", devices=len(mesh.devices))
 
     ll = make_longlog(cfg)
-    if args.engine == "fused":
-        if jax.devices()[0].platform != "tpu":
-            print("error: --engine fused compiles Mosaic kernels (TPU only); "
-                  "off-TPU only the Pallas interpreter can replay the fused "
-                  "stream (shrink uses it for repro) — far too slow for "
-                  "campaigns; use --engine xla",
-                  file=sys.stderr)
-            return 1
-        if args.shard:
-            import jax.numpy as jnp
-
-            from paxos_tpu.kernels.fused_tick import fused_chunk_sharded, fused_fns
-
-            apply_fn, mask_fn, blk = fused_fns(cfg.protocol)
-
-            def advance_sharded(s, n):
-                return fused_chunk_sharded(
-                    s, jnp.int32(cfg.seed), plan, cfg.fault, n,
-                    apply_fn, mask_fn, mesh, block=blk,
-                )
-
-            if ll:  # sharded long-log: compact between (sharded) chunks
-                from paxos_tpu.protocols.multipaxos import compact_mp
-
-                def advance(s, n):
-                    return compact_mp(advance_sharded(s, n))[0]
-
-            else:
-                advance = advance_sharded
-        else:
-            advance = make_advance(cfg, plan, "fused", compact=bool(ll))
-    else:
-        advance = make_advance(cfg, plan, "xla", compact=bool(ll))
+    if args.engine == "fused" and jax.devices()[0].platform != "tpu":
+        print("error: --engine fused compiles Mosaic kernels (TPU only); "
+              "off-TPU only the Pallas interpreter can replay the fused "
+              "stream (shrink uses it for repro) — far too slow for "
+              "campaigns; use --engine xla",
+              file=sys.stderr)
+        return 1
+    # ONE dispatch for every engine x sharding x long-log combination
+    # (make_advance; the XLA engine ignores the mesh — sharded inputs
+    # alone drive pjit).
+    advance = make_advance(
+        cfg, plan, args.engine, compact=bool(ll),
+        mesh=mesh if (args.shard and args.engine == "fused") else None,
+    )
 
     log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
              n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)

@@ -134,12 +134,14 @@ def make_advance(
     block: "int | None" = None,
     interpret: "bool | None" = None,
     compact: bool = False,
+    mesh=None,
 ) -> Callable:
     """Build ``advance(state, n_ticks)`` for an engine — THE engine dispatch.
 
-    Every unsharded execution path (:func:`run`, the shrinker's replay, the
-    CLI) goes through here so the (seed, stream) wiring cannot desynchronize
-    between the engine that observes a violation and the one that replays it.
+    Every execution path (:func:`run`, the shrinker's replay, the CLI —
+    sharded or not) goes through here so the (seed, stream) wiring cannot
+    desynchronize between the engine that observes a violation and the one
+    that replays it.
 
     ``"xla"`` scans the protocol step with ``jax.random`` masks; ``"fused"``
     runs whole chunks in one Pallas kernel with counter-PRNG masks
@@ -151,12 +153,39 @@ def make_advance(
     ``compact=True`` (long-log Multi-Paxos) appends decided-prefix
     compaction to every chunk, traced into the same module-level jitted
     computation — the compaction cadence is the chunk cadence.
+
+    ``mesh`` (a ``jax.sharding.Mesh`` over already-sharded state/plan)
+    selects the multi-chip fused path: one kernel per shard under
+    ``shard_map`` with globally-offset streams
+    (``fused_chunk_sharded``), compaction composed between chunks.  The
+    XLA engine needs no mesh plumbing — sharded inputs alone drive pjit.
     """
     if engine == "fused":
         from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS, fused_fns
 
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
+
+        if mesh is not None:
+            from paxos_tpu.kernels.fused_tick import fused_chunk_sharded
+
+            apply_fn, mask_fn, dblk = fused_fns(cfg.protocol)
+            blk = dblk if block is None else block
+
+            def advance_sharded(state, n):
+                return fused_chunk_sharded(
+                    state, jnp.int32(cfg.seed), plan, cfg.fault, n,
+                    apply_fn, mask_fn, mesh, block=blk, interpret=interpret,
+                )
+
+            if compact:
+                from paxos_tpu.protocols.multipaxos import compact_mp
+
+                def advance(state, n):
+                    return compact_mp(advance_sharded(state, n))[0]
+
+                return advance
+            return advance_sharded
 
         if compact:
             blk = fused_fns(cfg.protocol)[2] if block is None else block

@@ -80,15 +80,17 @@ def fit_block(
     degraded runs reproduce too.
 
     ``floor`` defaults from ``interpret``: Mosaic requires the block's
-    trailing dim divisible by 128 on a real TPU; the Pallas TPU
-    interpreter accepts 8.  A count like the literal 1,000,000 (2^6 x
-    5^6, largest power-of-two divisor 64) cannot host ANY aligned block:
+    trailing dim divisible by 128 on a real TPU, while the Pallas TPU
+    interpreter emulates with no minimum (floor 1, so every dividing
+    block passes verbatim and the error branches below are compiled-mode
+    only).  On hardware, a count like the literal 1,000,000 (2^6 x 5^6,
+    largest power-of-two divisor 64) cannot host ANY aligned block:
     small such counts (<= DEFAULT_BLOCK) degrade to one full-array block,
     large ones get an error steering to a 128-divisible count (e.g.
     1<<20) or the XLA engine, which has no alignment constraint.
     """
     if floor is None:
-        floor = 8 if interpret else 128
+        floor = 1 if interpret else 128
     if n % block == 0 and (block % floor == 0 or block == n):
         return block
     p2 = n & -n  # largest power-of-two divisor of n

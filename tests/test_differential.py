@@ -74,8 +74,14 @@ def _diff(a, b, path=""):
     return [] if a == b else [f"{path}: jax={a!r} interp={b!r}"]
 
 
-def run_differential(cfg: SimConfig, ticks: int, stream: str) -> None:
-    """Advance JAX kernel and interpreter in lockstep; compare every lane."""
+def run_differential(cfg: SimConfig, ticks: int, stream: str, sampler=None):
+    """Advance JAX kernel and interpreter in lockstep; compare every lane.
+
+    ``sampler(t, state) -> masks`` overrides the mask source (used by the
+    multi-block case to feed per-block counter streams); otherwise
+    ``stream`` selects the xla or the block-0 counter stream.  Returns the
+    final JAX state (for callers cross-checking it against an engine).
+    """
     sample_xla, sample_counter, apply_fn = _protocol_fns(cfg.protocol)
     tick_fn = INTERP_TICKS[cfg.protocol]
     apply_j = jax.jit(apply_fn, static_argnums=(3,))
@@ -90,7 +96,9 @@ def run_differential(cfg: SimConfig, ticks: int, stream: str) -> None:
     interp = [lane_of(jax.device_get(state), i) for i in lanes]
 
     for t in range(ticks):
-        if stream == "xla":
+        if sampler is not None:
+            masks = sampler(t, state)
+        elif stream == "xla":
             # Exactly what the protocol's *_step does per scan iteration.
             masks = sample_xla(
                 jax.random.fold_in(key, t), cfg.fault,
@@ -116,6 +124,7 @@ def run_differential(cfg: SimConfig, ticks: int, stream: str) -> None:
                     f"{cfg.protocol}/{stream}: lane {i} diverged at tick {t}:\n"
                     f"{diffs}"
                 )
+    return state
 
 
 CHAOS = FaultConfig(
@@ -231,17 +240,9 @@ def test_differential_counter_multiblock(protocol):
         n_inst=2 * block, n_prop=2, n_acc=5, seed=9, protocol=protocol,
         fault=fault, **kw,
     )
-    _, sample_counter, apply_fn = _protocol_fns(protocol)
-    tick_fn = INTERP_TICKS[protocol]
-    apply_j = jax.jit(apply_fn, static_argnums=(3,))
+    _, sample_counter, _ = _protocol_fns(protocol)
 
-    state = init_state(cfg)
-    plan = init_plan(cfg)
-    lanes = range(cfg.n_inst)
-    plan_l = [lane_of(jax.device_get(plan), i) for i in lanes]
-    interp = [lane_of(jax.device_get(state), i) for i in lanes]
-
-    for t in range(ticks):
+    def per_block_sampler(t, state):
         parts = [
             sample_counter(
                 cfg.fault,
@@ -250,19 +251,9 @@ def test_differential_counter_multiblock(protocol):
             )
             for b in range(2)
         ]
-        masks = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=-1), *parts)
-        masks_h = jax.device_get(masks)
-        state = apply_j(state, masks, plan, cfg.fault)
-        state_h = jax.device_get(state)
-        for i in lanes:
-            tick_fn(interp[i], lane_of(masks_h, i), plan_l[i], cfg.fault)
-            got = lane_of(state_h, i)
-            if got != interp[i]:
-                diffs = "\n".join(_diff(got, interp[i])[:20])
-                raise AssertionError(
-                    f"{protocol}/multiblock: lane {i} diverged at tick {t}:\n"
-                    f"{diffs}"
-                )
+        return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=-1), *parts)
+
+    state = run_differential(cfg, ticks, "multiblock", sampler=per_block_sampler)
 
     # The 2-block fused kernel must reproduce the lockstep state exactly:
     # its on-core blk_id arithmetic IS the _py_mix block argument above.

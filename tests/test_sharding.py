@@ -68,11 +68,9 @@ def test_sharded_xla_longlog_compact_matches_unsharded():
 
 
 def test_sharded_fused_longlog_compact_matches_unsharded():
-    """compact_mp over fused_chunk_sharded (the CLI's sharded fused long-log
-    composition) == the unsharded fused+compact path at the same block."""
-    from paxos_tpu.kernels.fused_tick import fused_chunk_sharded, fused_fns
-    from paxos_tpu.protocols.multipaxos import compact_mp
-
+    """The sharded fused long-log path (the CLI's composition, now owned by
+    ``make_advance(mesh=...)``) == the unsharded fused+compact path at the
+    same block — covering the mesh branch of the ONE engine dispatch."""
     cfg = config3_long(n_inst=64, log_total=12, window=4, seed=7)
     block = 8  # == local shard size, so global block ids match unsharded
 
@@ -82,15 +80,13 @@ def test_sharded_fused_longlog_compact_matches_unsharded():
         s1 = adv1(s1, 8)
 
     mesh = make_mesh()
-    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
     plan8 = shard_pytree(init_plan(cfg), mesh, cfg.n_inst)
     s8 = shard_pytree(init_state(cfg), mesh, cfg.n_inst)
+    adv8 = make_advance(
+        cfg, plan8, "fused", block=block, compact=True, mesh=mesh
+    )
     for _ in range(6):
-        s8 = fused_chunk_sharded(
-            s8, jnp.int32(cfg.seed), plan8, cfg.fault, 8,
-            apply_fn, mask_fn, mesh, block=block, interpret=True,
-        )
-        s8 = compact_mp(s8)[0]
+        s8 = adv8(s8, 8)
 
     assert len(s8.acceptor.log_bal.sharding.device_set) == 8
     assert (jax.device_get(s8.base) > 0).any(), "vacuous: nothing compacted"
