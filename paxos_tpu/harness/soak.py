@@ -21,6 +21,37 @@ from paxos_tpu.harness.config import SimConfig
 from paxos_tpu.harness.run import run
 
 
+def _run_with_retries(
+    run_fn: Callable[[], dict],
+    say: Callable[[str], None],
+    transient_retries: int,
+    backoff_s: float = 5.0,
+) -> tuple[dict, int]:
+    """Call ``run_fn``, retrying transient runtime failures.
+
+    Long soaks on a tunneled TPU backend die to occasional transient
+    infra errors (remote-compile HTTP 500s, dropped response bodies) that
+    have nothing to do with the campaign.  Campaigns are deterministic in
+    (config, seed), so re-running one is an exact replay — retrying never
+    changes what is measured.  Returns (report, retries_used); re-raises
+    once the budget is exhausted.
+    """
+    import jax
+
+    for attempt in range(transient_retries + 1):
+        try:
+            return run_fn(), attempt
+        except jax.errors.JaxRuntimeError as e:
+            if attempt >= transient_retries:
+                raise
+            first_line = (str(e).splitlines() or [""])[0][:120]
+            say(f"transient backend error (attempt {attempt + 1}/"
+                f"{transient_retries + 1}): {first_line}; "
+                f"retrying in {backoff_s:.0f}s")
+            time.sleep(backoff_s)
+    raise AssertionError("unreachable")
+
+
 def soak(
     cfg: SimConfig,
     target_rounds: float = 1e9,
@@ -29,6 +60,8 @@ def soak(
     engine: str = "xla",
     log: Optional[Callable[[str], None]] = None,
     recheck_doublings: int = 4,
+    transient_retries: int = 2,
+    retry_backoff_s: float = 5.0,
 ) -> dict[str, Any]:
     """Run campaigns over rotating seeds until ``target_rounds`` accumulate.
 
@@ -54,6 +87,13 @@ def soak(
     reads ~1.0 on a perfectly healthy config3long soak (measured).  For
     long-log configs the livelock signal is the ``decided_frac`` trend
     (global replication progress per fixed budget), not ``stuck_frac``.
+
+    **Transient-failure resilience:** each campaign retries up to
+    ``transient_retries`` times on backend runtime errors (tunnel
+    remote-compile 500s and the like) — campaigns are deterministic in
+    (config, seed), so a retry is an exact replay, never new coverage.
+    The report counts retries in ``transient_retries_used``; an error
+    that persists past the budget still raises.
 
     **Eviction recheck (completeness):** a campaign whose learner table hit
     its K-slot bound (``evictions > 0``) has lanes whose agreement
@@ -83,13 +123,18 @@ def soak(
     stuck_max = 0
     lanes_total = 0
     decided_fracs: list[float] = []
+    retries_used = 0
     t0 = time.perf_counter()
     while rounds < target_rounds:
         scfg = dataclasses.replace(cfg, seed=cfg.seed + seeds)
-        report = run(
-            scfg, total_ticks=ticks_per_seed, chunk=chunk, engine=engine,
-            liveness=True,
+        report, used = _run_with_retries(
+            lambda: run(
+                scfg, total_ticks=ticks_per_seed, chunk=chunk, engine=engine,
+                liveness=True,
+            ),
+            say, transient_retries, retry_backoff_s,
         )
+        retries_used += used
         evictions_first_pass += report["evictions"]
         if report["evictions"]:
             k = scfg.k_slots
@@ -99,11 +144,15 @@ def soak(
                 k *= 2
                 say(f"seed {scfg.seed}: {report['evictions']} evictions, "
                     f"rechecking at k_slots={k}")
-                report = run(
-                    dataclasses.replace(scfg, k_slots=k),
-                    total_ticks=ticks_per_seed, chunk=chunk, engine=engine,
-                    liveness=True,
+                rcfg = dataclasses.replace(scfg, k_slots=k)
+                report, used = _run_with_retries(
+                    lambda: run(
+                        rcfg, total_ticks=ticks_per_seed, chunk=chunk,
+                        engine=engine, liveness=True,
+                    ),
+                    say, transient_retries, retry_backoff_s,
                 )
+                retries_used += used
                 recheck_rounds += scfg.n_inst * ticks_per_seed
             rechecked_seeds.append({
                 "seed": scfg.seed,
@@ -136,6 +185,7 @@ def soak(
         # NOT new schedule coverage, so "rounds" (the safety-claim
         # denominator) excludes them while the throughput figure counts them.
         "recheck_rounds": recheck_rounds,
+        "transient_retries_used": retries_used,
         "stuck_lanes": stuck_total,
         "stuck_lanes_max": stuck_max,
         "stuck_frac": round(stuck_total / max(lanes_total, 1), 6),

@@ -2,6 +2,8 @@
 
 import dataclasses
 
+import pytest
+
 from paxos_tpu.faults.injector import FaultConfig
 from paxos_tpu.harness.config import SimConfig, config2_dueling_drop
 from paxos_tpu.harness.soak import soak
@@ -42,6 +44,45 @@ def test_soak_reports_liveness():
     assert clean["stuck_lanes"] == 0
     assert clean["stuck_frac"] == 0.0
     assert clean["decided_frac_mean"] == 1.0
+
+
+def test_soak_retries_transient_backend_errors(monkeypatch):
+    """A transient backend failure (tunnel remote-compile 500s) mid-soak
+    must retry the campaign — an exact replay, campaigns being
+    deterministic in (config, seed) — instead of killing a long run.
+    A persistent failure still raises once the retry budget is spent."""
+    import jax
+
+    from paxos_tpu.harness import soak as soak_mod
+
+    real_run = soak_mod.run
+    fails = {"left": 1}
+
+    def flaky_run(*a, **kw):
+        if fails["left"] > 0:
+            fails["left"] -= 1
+            raise jax.errors.JaxRuntimeError(
+                "INTERNAL: remote_compile: HTTP 500 (synthetic)"
+            )
+        return real_run(*a, **kw)
+
+    monkeypatch.setattr(soak_mod, "run", flaky_run)
+    cfg = config2_dueling_drop(n_inst=256, seed=11)
+    report = soak_mod.soak(
+        cfg, target_rounds=2 * 256 * 32, ticks_per_seed=32, chunk=16,
+        retry_backoff_s=0.0,
+    )
+    assert report["transient_retries_used"] == 1
+    assert report["seeds"] == 2
+    assert report["violations"] == 0
+
+    # Persistent failure: budget exhausted -> the error surfaces.
+    fails["left"] = 10**9
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        soak_mod.soak(
+            cfg, target_rounds=256 * 32, ticks_per_seed=32, chunk=16,
+            transient_retries=1, retry_backoff_s=0.0,
+        )
 
 
 def test_soak_rechecks_evicting_seeds():
