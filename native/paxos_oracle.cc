@@ -935,6 +935,25 @@ struct Sim {
 
     // Omniscient oracle: distinct committed values over the append-accept
     // history at majority quorums.
+    //
+    // "Majority-accepted at a term" is NOT a stable commit point in general
+    // Raft (Figure 8: a majority-replicated entry from an old term can be
+    // overwritten before a new-term entry commits on top of it).  It IS
+    // stable in this single-entry model, and only because of the
+    // restriction/adoption interplay the exhaustive checker mechanizes
+    // (cpu_ref/raft_exhaustive.py, BASELINE.md raftcore decomposition row):
+    // a voter that accepted (t, v) refuses RequestVote to any candidate
+    // whose last-accepted term is lower (election restriction), so a
+    // candidate that wins a majority with a single entry majority-accepted
+    // at term t must have intersected that majority and therefore ADOPTS
+    // (t, v) as its own entry — there is no "commit on top" step that could
+    // race, because there is exactly one slot.  Do not copy this chosen
+    // predicate into a multi-entry context unchanged; there the commit
+    // point is the leader's commitIndex advance over its OWN term.  Under
+    // the bug-injection legs (restriction/adoption disabled) a flagged
+    // "violation" may thus be a majority-accepted-then-superseded entry
+    // rather than two actually-committed values — which is exactly the
+    // hazard those legs exist to demonstrate.
     std::vector<int32_t> ck, cv;
     hist.distinct_chosen(
         [&](size_t i) { return __builtin_popcount(hist.mask[i]) >= quorum; },

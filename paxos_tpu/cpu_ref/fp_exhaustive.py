@@ -43,7 +43,13 @@ deliveries collapse dead-letter orderings.
 
 from __future__ import annotations
 
-from paxos_tpu.cpu_ref.exhaustive import CheckResult, explore, make_ballot
+from paxos_tpu.cpu_ref.exhaustive import (
+    CheckResult,
+    explore,
+    make_ballot,
+    make_fair_completion,
+    make_liveness_checker,
+)
 
 # Message kinds (same encoding as the paxos checker).
 PREPARE, PROMISE, ACCEPT, ACCEPTED = 0, 1, 2, 3
@@ -196,10 +202,27 @@ def _deliver(
     return (accs, props, tuple(sorted(net + tuple(out))), voters)
 
 
-def _timeout(state, p: int, n_prop: int, n_acc: int):
-    """Proposer ``p`` abandons its round and starts the next classic one."""
+def _timeout(state, p: int, n_prop: int, n_acc: int, bump: bool = True):
+    """Proposer ``p`` abandons its round and starts the next classic one.
+
+    ``bump=False`` is the injected liveness bug, Fast Paxos' OWN livelock
+    shape: on timeout the proposer RETRIES THE FAST ROUND (re-broadcasts
+    its value at the shared fast ballot) instead of escalating to a classic
+    recovery round.  After a collision the vote-at-most-once-per-ballot
+    rule makes every re-broadcast a no-op or an idempotent re-vote, so the
+    collided tally never changes and nobody ever reaches the fast quorum —
+    the mechanized-liveness leg must find the lasso (retry -> idempotent
+    replies -> drained net -> identical state)."""
     accs, props, net, voters = state
     phase, rnd, heard, bb, masks, pv, dec = props[p]
+    if not bump:
+        props = props[:p] + (
+            (FAST, 0, 0, 0, (0,) * n_prop, _own_val(p), dec),
+        ) + props[p + 1 :]
+        out = tuple(
+            (ACCEPT, p, a, FAST_BAL, _own_val(p), 0) for a in range(n_acc)
+        )
+        return (accs, props, tuple(sorted(net + out)), voters)
     rnd += 1
     bal = make_ballot(rnd, p)
     props = props[:p] + ((P1, rnd, 0, 0, (0,) * n_prop, pv, dec),) + props[p + 1 :]
@@ -207,12 +230,15 @@ def _timeout(state, p: int, n_prop: int, n_acc: int):
     return (accs, props, tuple(sorted(net + out)), voters)
 
 
-def _gc(state, n_prop: int):
+def _gc(state, n_prop: int, dedup: bool = False):
     """Drop in-flight messages whose delivery is provably a no-op.
 
     Unlike the paxos checker, no prune here depends on a rule the injected
     bug (``adopt_any`` — a PROPOSER pick) could break: acceptor monotonicity
     holds in both modes, so the same reductions are sound for both.
+    ``dedup`` collapses the multiset to a set in the ``livelock_bug`` leg
+    (see exhaustive._gc: frozen ballots make re-emitted retries identical,
+    and without the collapse the multiset grows without bound).
     """
     accs, props, net, voters = state
     keep = []
@@ -238,6 +264,8 @@ def _gc(state, n_prop: int):
                 if not (fast_ok or p2_ok):
                     continue
         keep.append(m)
+    if dedup:
+        keep = sorted(set(keep))
     return (accs, props, tuple(keep), voters)
 
 
@@ -250,6 +278,8 @@ def check_fp_exhaustive(
     q1: int = 0,
     q2: int = 0,
     q_fast: int = 0,
+    liveness_bound: "int | None" = None,
+    livelock_bug: bool = False,
 ) -> CheckResult:
     """Exhaustively explore every Fast-Paxos schedule at small bounds.
 
@@ -294,21 +324,47 @@ def check_fp_exhaustive(
                 f"after trace={list(trace)}"
             )
 
+    live_check, live_stats = (None, None)
+    if liveness_bound is not None:
+        fair_next, is_decided = make_fair_completion(
+            lambda s: (("d", s[2][0]), _gc(
+                _deliver(s, 0, n_prop, n_acc, q1, q2, fquorum, adopt_any),
+                n_prop, dedup=livelock_bug,
+            )),
+            lambda s, p: _gc(
+                _timeout(s, p, n_prop, n_acc, bump=not livelock_bug),
+                n_prop, dedup=livelock_bug,
+            ),
+            done_phase=DONE,
+        )
+        live_check, live_stats = make_liveness_checker(
+            fair_next, is_decided, liveness_bound
+        )
+
+    def check_both(state, trace) -> None:
+        check_state(state, trace)
+        if live_check is not None:
+            live_check(state, trace)
+
     def successors(state):
         accs, props, net, voters = state
         for i in range(len(net)):
             yield ("d", net[i]), _gc(
                 _deliver(state, i, n_prop, n_acc, q1, q2, fquorum, adopt_any),
-                n_prop,
+                n_prop, dedup=livelock_bug,
             )
         for p in range(n_prop):
             if props[p][0] != DONE and props[p][1] < max_round[p]:
-                yield ("t", p), _gc(_timeout(state, p, n_prop, n_acc), n_prop)
+                yield ("t", p), _gc(
+                    _timeout(state, p, n_prop, n_acc, bump=not livelock_bug),
+                    n_prop, dedup=livelock_bug,
+                )
 
-    states = explore(_init_state(n_prop, n_acc), successors, check_state, max_states)
+    states = explore(_init_state(n_prop, n_acc), successors, check_both, max_states)
     return CheckResult(
         states=states,
         decided_states=stats["decided_states"],
         chosen_values=stats["chosen_all"],
         counterexample=None,
+        max_completion=None if live_stats is None else live_stats["max_completion"],
     )

@@ -28,7 +28,13 @@ collapse dead-letter orderings.
 
 from __future__ import annotations
 
-from paxos_tpu.cpu_ref.exhaustive import CheckResult, explore, make_ballot
+from paxos_tpu.cpu_ref.exhaustive import (
+    CheckResult,
+    explore,
+    make_ballot,
+    make_fair_completion,
+    make_liveness_checker,
+)
 
 # Message kinds.
 PREPARE, PROMISE, ACCEPT, ACCEPTED = 0, 1, 2, 3
@@ -151,19 +157,30 @@ def _deliver(
     return (accs, props, tuple(sorted(net + tuple(out))), votes)
 
 
-def _timeout(state, p: int, n_acc: int, log_len: int):
+def _timeout(state, p: int, n_acc: int, log_len: int, bump: bool = True):
     """Proposer ``p`` challenges for leadership at its next ballot (the
-    lease-expiry surrogate: any challenge schedule must be safe)."""
+    lease-expiry surrogate: any challenge schedule must be safe).
+
+    ``bump=False`` is the injected liveness bug (a leadership challenge
+    that does NOT raise the ballot): once any acceptor has promised above
+    the frozen ballot, the challenge PREPAREs GC away and the challenger
+    re-collects nothing — the mechanized-liveness leg must find the
+    lasso."""
     accs, props, net, votes = state
     phase, rnd, heard, recov, ci, dec = props[p]
-    rnd += 1
+    if bump:
+        rnd += 1
     bal = make_ballot(rnd, p)
     props = props[:p] + ((CAND, rnd, 0, ((0, 0),) * log_len, 0, dec),) + props[p + 1 :]
     out = tuple((PREPARE, p, a, bal, 0, 0, ()) for a in range(n_acc))
     return (accs, props, tuple(sorted(net + out)), votes)
 
 
-def _gc(state, log_len: int):
+def _gc(state, log_len: int, dedup: bool = False):
+    """Drop provably-no-op messages; ``dedup`` collapses the multiset to a
+    set in the ``livelock_bug`` leg (see exhaustive._gc: frozen ballots
+    make re-emitted challenges identical, and without the collapse the
+    multiset grows without bound)."""
     accs, props, net, votes = state
     keep = []
     for m in net:
@@ -185,6 +202,8 @@ def _gc(state, log_len: int):
             ):
                 continue
         keep.append(m)
+    if dedup:
+        keep = sorted(set(keep))
     return (accs, props, tuple(keep), votes)
 
 
@@ -195,11 +214,23 @@ def check_mp_exhaustive(
     max_round: "int | tuple[int, ...]" = 1,
     max_states: int = 5_000_000,
     no_recovery: bool = False,
+    liveness_bound: "int | None" = None,
+    livelock_bug: bool = False,
 ) -> CheckResult:
     """Exhaustively explore every Multi-Paxos schedule at small bounds.
 
     ``decided_states`` counts states where some proposer replicated the
     FULL log; ``chosen_values`` is the union over slots.
+
+    ``liveness_bound`` arms the mechanized liveness leg
+    (exhaustive.make_liveness_checker): from every reachable state the
+    fair completion — drain, then the highest-ballot live proposer
+    challenges for leadership at the NEXT ballot — fully replicates some
+    leader's log within the bound.  Multi-Paxos exercises the timeout arm
+    from the very first state: the initial network is EMPTY (leadership
+    challenges create all traffic), so completion is election-driven, not
+    just drain-driven.  ``livelock_bug`` freezes the challenge ballot and
+    the leg must produce a lasso counterexample.
     """
     if n_prop > 8:
         raise ValueError("n_prop > 8 collides packed ballots (make_ballot)")
@@ -237,23 +268,49 @@ def check_mp_exhaustive(
                         f"chosen {per_slot} after trace={list(trace)}"
                     )
 
+    live_check, live_stats = (None, None)
+    if liveness_bound is not None:
+        fair_next, is_decided = make_fair_completion(
+            lambda s: (("d", s[2][0]), _gc(
+                _deliver(s, 0, n_acc, log_len, quorum, no_recovery),
+                log_len, dedup=livelock_bug,
+            )),
+            lambda s, p: _gc(
+                _timeout(s, p, n_acc, log_len, bump=not livelock_bug),
+                log_len, dedup=livelock_bug,
+            ),
+            done_phase=DONE,
+        )
+        live_check, live_stats = make_liveness_checker(
+            fair_next, is_decided, liveness_bound
+        )
+
+    def check_both(state, trace) -> None:
+        check_state(state, trace)
+        if live_check is not None:
+            live_check(state, trace)
+
     def successors(state):
         accs, props, net, votes = state
         for i in range(len(net)):
             yield ("d", net[i]), _gc(
                 _deliver(state, i, n_acc, log_len, quorum, no_recovery),
-                log_len,
+                log_len, dedup=livelock_bug,
             )
         for p in range(n_prop):
             if props[p][0] != DONE and props[p][1] < max_round[p]:
-                yield ("t", p), _gc(_timeout(state, p, n_acc, log_len), log_len)
+                yield ("t", p), _gc(
+                    _timeout(state, p, n_acc, log_len, bump=not livelock_bug),
+                    log_len, dedup=livelock_bug,
+                )
 
     states = explore(
-        _init_state(n_prop, n_acc, log_len), successors, check_state, max_states
+        _init_state(n_prop, n_acc, log_len), successors, check_both, max_states
     )
     return CheckResult(
         states=states,
         decided_states=stats["decided_states"],
         chosen_values=stats["chosen_all"],
         counterexample=None,
+        max_completion=None if live_stats is None else live_stats["max_completion"],
     )

@@ -37,6 +37,8 @@ from paxos_tpu.cpu_ref.exhaustive import (
     _record_vote as _record,
     explore,
     make_ballot,
+    make_fair_completion,
+    make_liveness_checker,
 )
 
 # Message kinds.
@@ -119,24 +121,33 @@ def _deliver(
     return (voters, cands, tuple(sorted(net + tuple(out))), events)
 
 
-def _timeout(state, p: int, n_acc: int):
+def _timeout(state, p: int, n_acc: int, bump: bool = True):
     """Candidate ``p`` abandons its term and runs at the next one.
 
     The adopted entry PERSISTS across retries (matching the kernel: the
     expired branch resets ballot/heard only) — it is the candidate's log.
-    """
+
+    ``bump=False`` is the injected liveness bug (re-election WITHOUT a term
+    increase): every voter already spent its one vote for this term, so the
+    re-run collects only denials, forever — the mechanized-liveness leg
+    must find the lasso.  This is exactly the hazard Raft's randomized
+    election timeouts + term bump exist to prevent."""
     voters, cands, net, events = state
     phase, rnd, heard, et, ev, pv, dec = cands[p]
-    rnd += 1
+    if bump:
+        rnd += 1
     bal = make_ballot(rnd, p)
     cands = cands[:p] + ((CAND, rnd, 0, et, ev, pv, dec),) + cands[p + 1 :]
     out = tuple((REQVOTE, p, a, bal, et, 0, 0) for a in range(n_acc))
     return (voters, cands, tuple(sorted(net + out)), events)
 
 
-def _gc(state):
+def _gc(state, dedup: bool = False):
     """Drop provably-no-op messages.  Conservative: a REQVOTE below the
-    voter's term is kept only while its denial reply could still matter."""
+    voter's term is kept only while its denial reply could still matter.
+    ``dedup`` collapses the multiset to a set in the ``livelock_bug`` leg
+    (see exhaustive._gc: frozen terms make re-emitted REQVOTEs identical,
+    and without the collapse the multiset grows without bound)."""
     voters, cands, net, events = state
     keep = []
     for m in net:
@@ -159,6 +170,8 @@ def _gc(state):
             if phase != LEAD or term != make_ballot(rnd, dst):
                 continue
         keep.append(m)
+    if dedup:
+        keep = sorted(set(keep))
     return (voters, cands, tuple(keep), events)
 
 
@@ -169,8 +182,20 @@ def check_raft_exhaustive(
     max_states: int = 5_000_000,
     no_restriction: bool = False,
     no_adoption: bool = False,
+    liveness_bound: "int | None" = None,
+    livelock_bug: bool = False,
 ) -> CheckResult:
-    """Exhaustively explore every Raft-core schedule at small bounds."""
+    """Exhaustively explore every Raft-core schedule at small bounds.
+
+    ``liveness_bound`` arms the mechanized liveness leg
+    (exhaustive.make_liveness_checker): from every reachable state, the
+    fair completion (drain, then the highest-term live candidate re-runs
+    at the NEXT term) elects a leader and commits within the bound.
+    ``livelock_bug`` removes the term bump from re-election — the classic
+    split-vote livelock Raft's design calls out — and the leg must then
+    produce a lasso counterexample (every voter's one vote for the term is
+    spent, so re-runs collect only denials).
+    """
     if n_prop > 8:
         raise ValueError("n_prop > 8 collides packed ballots (make_ballot)")
     if isinstance(max_round, int):
@@ -201,20 +226,47 @@ def check_raft_exhaustive(
                 f"after trace={list(trace)}"
             )
 
+    live_check, live_stats = (None, None)
+    if liveness_bound is not None:
+        fair_next, is_decided = make_fair_completion(
+            lambda s: (("d", s[2][0]), _gc(
+                _deliver(s, 0, n_acc, quorum, no_restriction, no_adoption),
+                dedup=livelock_bug,
+            )),
+            lambda s, p: _gc(
+                _timeout(s, p, n_acc, bump=not livelock_bug),
+                dedup=livelock_bug,
+            ),
+            done_phase=DONE,
+        )
+        live_check, live_stats = make_liveness_checker(
+            fair_next, is_decided, liveness_bound
+        )
+
+    def check_both(state, trace) -> None:
+        check_state(state, trace)
+        if live_check is not None:
+            live_check(state, trace)
+
     def successors(state):
         voters, cands, net, events = state
         for i in range(len(net)):
             yield ("d", net[i]), _gc(
-                _deliver(state, i, n_acc, quorum, no_restriction, no_adoption)
+                _deliver(state, i, n_acc, quorum, no_restriction, no_adoption),
+                dedup=livelock_bug,
             )
         for p in range(n_prop):
             if cands[p][0] != DONE and cands[p][1] < max_round[p]:
-                yield ("t", p), _gc(_timeout(state, p, n_acc))
+                yield ("t", p), _gc(
+                    _timeout(state, p, n_acc, bump=not livelock_bug),
+                    dedup=livelock_bug,
+                )
 
-    states = explore(_init_state(n_prop, n_acc), successors, check_state, max_states)
+    states = explore(_init_state(n_prop, n_acc), successors, check_both, max_states)
     return CheckResult(
         states=states,
         decided_states=stats["decided_states"],
         chosen_values=stats["chosen_all"],
         counterexample=None,
+        max_completion=None if live_stats is None else live_stats["max_completion"],
     )

@@ -205,6 +205,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="raftcore only: candidates ignore vote-reply entries (the "
         "other safety leg; clean alone, violates with --no-restriction)",
     )
+    c.add_argument(
+        "--liveness-bound", type=int, default=None, metavar="N",
+        help="arm the mechanized liveness leg: from EVERY reachable state, "
+        "the deterministic fair completion schedule must decide within N "
+        "actions (reports the max actually needed); any protocol",
+    )
+    c.add_argument(
+        "--livelock-bug", action="store_true",
+        help="inject the protocol's livelock bug (paxos/multipaxos: retry "
+        "without ballot increase; raftcore: re-election without term bump; "
+        "fastpaxos: retry the fast round instead of classic recovery) — "
+        "--liveness-bound must then find a lasso counterexample",
+    )
     return p
 
 
@@ -391,6 +404,10 @@ def cmd_check(args: argparse.Namespace) -> int:
         print("error: --no-recovery/--log-len require --protocol multipaxos",
               file=sys.stderr)
         return 1
+    if args.livelock_bug and args.liveness_bound is None:
+        print("error: --livelock-bug needs --liveness-bound (the liveness "
+              "leg is what detects it)", file=sys.stderr)
+        return 1
     try:
         if args.protocol == "multipaxos":
             from paxos_tpu.cpu_ref.mp_exhaustive import check_mp_exhaustive
@@ -402,6 +419,8 @@ def cmd_check(args: argparse.Namespace) -> int:
                 max_round=mr,
                 max_states=args.max_states,
                 no_recovery=args.no_recovery,
+                liveness_bound=args.liveness_bound,
+                livelock_bug=args.livelock_bug,
             )
         elif args.protocol == "raftcore":
             from paxos_tpu.cpu_ref.raft_exhaustive import check_raft_exhaustive
@@ -413,6 +432,8 @@ def cmd_check(args: argparse.Namespace) -> int:
                 max_states=args.max_states,
                 no_restriction=args.no_restriction,
                 no_adoption=args.no_adoption,
+                liveness_bound=args.liveness_bound,
+                livelock_bug=args.livelock_bug,
             )
         elif args.protocol == "fastpaxos":
             from paxos_tpu.cpu_ref.fp_exhaustive import check_fp_exhaustive
@@ -426,6 +447,8 @@ def cmd_check(args: argparse.Namespace) -> int:
                 q1=args.q1,
                 q2=args.q2,
                 q_fast=args.q_fast,
+                liveness_bound=args.liveness_bound,
+                livelock_bug=args.livelock_bug,
             )
         else:
             from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
@@ -436,6 +459,8 @@ def cmd_check(args: argparse.Namespace) -> int:
                 max_round=mr,
                 max_states=args.max_states,
                 unsafe_accept=args.unsafe_accept,
+                liveness_bound=args.liveness_bound,
+                livelock_bug=args.livelock_bug,
             )
     except AssertionError as e:
         print(json.dumps({"ok": False, "counterexample": str(e)}))
@@ -443,12 +468,15 @@ def cmd_check(args: argparse.Namespace) -> int:
     except (RuntimeError, ValueError) as e:
         print(json.dumps({"ok": False, "error": str(e)}))
         return 3
-    print(json.dumps({
+    out = {
         "ok": True,
         "states": r.states,
         "decided_states": r.decided_states,
         "chosen_values": sorted(r.chosen_values),
-    }))
+    }
+    if r.max_completion is not None:
+        out["max_completion"] = r.max_completion
+    print(json.dumps(out))
     return 0
 
 

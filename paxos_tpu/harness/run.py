@@ -170,12 +170,12 @@ def make_advance(
             from paxos_tpu.kernels.fused_tick import fused_chunk_sharded
 
             apply_fn, mask_fn, dblk = fused_fns(cfg.protocol)
-            blk = dblk if block is None else block
 
             def advance_sharded(state, n):
                 return fused_chunk_sharded(
                     state, jnp.int32(cfg.seed), plan, cfg.fault, n,
-                    apply_fn, mask_fn, mesh, block=blk, interpret=interpret,
+                    apply_fn, mask_fn, mesh, block=block,
+                    interpret=interpret, default=dblk,
                 )
 
             if compact:
@@ -188,12 +188,12 @@ def make_advance(
             return advance_sharded
 
         if compact:
-            blk = fused_fns(cfg.protocol)[2] if block is None else block
-
+            # block=None flows through: FUSED_CHUNKS resolves the protocol
+            # default (fused_fns) silently; explicit blocks warn on degrade.
             def advance(state, n):
                 return fused_chunk_compact(
                     state, jnp.int32(cfg.seed), plan, cfg.fault, n,
-                    cfg.protocol, blk, interpret,
+                    cfg.protocol, block, interpret,
                 )
 
             return advance

@@ -66,7 +66,11 @@ MAX_LANES_PER_CALL = 1 << 22
 
 
 def fit_block(
-    block: int, n: int, floor: "int | None" = None, interpret: bool = False
+    block: int,
+    n: int,
+    floor: "int | None" = None,
+    interpret: bool = False,
+    warn: bool = True,
 ) -> int:
     """A block that DIVIDES ``n``: the request if already valid, else the
     largest power of two <= the request that divides ``n``.
@@ -96,6 +100,8 @@ def fit_block(
     p2 = n & -n  # largest power-of-two divisor of n
     if p2 < floor:
         if n <= DEFAULT_BLOCK:
+            if warn:
+                _warn_degraded(block, n, n)
             return n  # one full-array block: tiles trivially, fits VMEM
         raise ValueError(
             f"n_inst={n} has largest power-of-two divisor {p2} (< {floor}, "
@@ -111,7 +117,29 @@ def fit_block(
             f"a block >= {floor} that divides n_inst={n}, or omit it for "
             f"the protocol default"
         )
+    if warn:
+        _warn_degraded(block, b, n)
     return b
+
+
+def _warn_degraded(requested: int, got: int, n: int) -> None:
+    """Loud signal when an EXPLICIT block request degrades (ADVICE r3:
+    block is stream-relevant, so a typo'd block must not silently run a
+    different PRNG schedule).  A warning — not an error — because
+    degradation is deterministic in (block, n) and replays of degraded runs
+    reproduce.  Default-block resolution (``block=None`` at the public
+    entry points) degrades silently: the user typed nothing, so there is
+    no typo to flag (callers pass ``warn=False``)."""
+    if got != requested:
+        import warnings
+
+        warnings.warn(
+            f"fused block={requested} does not tile n_inst={n}; degraded "
+            f"deterministically to block={got} (a DIFFERENT schedule stream "
+            f"than block={requested} at an n_inst it divides — pass "
+            f"block={got} explicitly to silence)",
+            stacklevel=3,
+        )
 
 
 def _split_tick(state: Any):
@@ -188,7 +216,10 @@ def _kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "n_ticks", "apply_fn", "mask_fn", "block", "interpret"),
+    static_argnames=(
+        "cfg", "n_ticks", "apply_fn", "mask_fn", "block", "interpret",
+        "default",
+    ),
     donate_argnums=(0,),
 )
 def fused_chunk(
@@ -199,24 +230,33 @@ def fused_chunk(
     n_ticks: int,
     apply_fn: Callable,
     mask_fn: Callable,
-    block: int = DEFAULT_BLOCK,
+    block: "int | None" = None,
     interpret: bool = False,
     block_offset: "jnp.ndarray | int" = 0,
+    default: int = DEFAULT_BLOCK,
 ) -> Any:
     """Advance ``n_ticks`` ticks fully in VMEM; returns the new state.
 
     ``seed`` is an int32 scalar (the campaign seed); per-(tick, block)
     streams are derived on-core.  ``block`` instances are processed per grid
-    step; a request that doesn't divide ``n_inst`` (or misses the tiling
-    floor) degrades deterministically via :func:`fit_block`.  1-D state
-    leaves pin it to the XLA 1024-element tiling at large sizes, so the
-    default is rarely worth changing.
+    step; ``None`` resolves to ``default`` (the protocol's library block —
+    silent degradation); an EXPLICIT request that doesn't divide ``n_inst``
+    (or misses the tiling floor) degrades deterministically via
+    :func:`fit_block` WITH a warning, since block is stream-relevant.  1-D
+    state leaves pin it to the XLA 1024-element tiling at large sizes, so
+    the default is rarely worth changing.
     """
     n_inst = jax.tree.leaves(state)[0].shape[-1]
     # Non-dividing blocks degrade to the largest power-of-two divisor
     # (deterministic, so the stream keying per (seed, tick, block id)
-    # stays reproducible across replays at the same n_inst).
-    block = fit_block(min(block, n_inst), n_inst, interpret=interpret)
+    # stays reproducible across replays at the same n_inst).  No pre-clamp:
+    # fit_block handles block > n_inst itself, so oversized explicit
+    # requests warn instead of silently snapping to the full array.
+    explicit = block is not None
+    block = fit_block(
+        block if explicit else default, n_inst, interpret=interpret,
+        warn=explicit,
+    )
     grid = n_inst // block
 
     treedef, s_leaves, tick, tick_pos = _split_tick(state)
@@ -284,6 +324,16 @@ def fused_chunk(
     return jax.tree.unflatten(treedef, new_leaves)
 
 
+# Donation contract (ADVICE r3, re-verified on hardware): the fused engine
+# CONSUMES its input state on BOTH sides of the MAX_LANES_PER_CALL
+# threshold — fused_chunk's donate_argnums deletes the caller's buffers on
+# TPU (measured: holding the input after a direct <=4M-lane call raises
+# "Array has been deleted"), so _segmented_impl donating too is symmetric,
+# not an asymmetry.  Donation is load-bearing at scale: 8M-lane state is
+# ~6.5 GB (BASELINE.md), and without in-place reuse input+output copies
+# double that against a 16 GB v5e.  Callers needing the pre-chunk state
+# (before/after comparisons — see tests) must copy it first; every harness
+# path reassigns `state = advance(state, n)` and never re-reads the input.
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -335,26 +385,35 @@ def fused_chunk_auto(
     n_ticks: int,
     apply_fn: Callable,
     mask_fn: Callable,
-    block: int = DEFAULT_BLOCK,
+    block: "int | None" = None,
     interpret: bool = False,
     max_lanes: int = MAX_LANES_PER_CALL,
+    default: int = DEFAULT_BLOCK,
 ) -> Any:
     """:func:`fused_chunk` with the scale ceiling removed (VERDICT r2 #7).
 
     Up to ``max_lanes`` instances this IS ``fused_chunk``.  Beyond it, the
     batch splits into the fewest equal segments that fit, each advanced by
     its own kernel with ``block_offset = segment * blocks_per_segment`` —
-    exactly the global block ids the single kernel would use — so the
-    schedule stream is invariant to the segmentation and a campaign's
-    replay/shrink/checkpoint contract (same seed + same block -> same
-    schedule) survives the degradation.  Cost: one extra HBM copy of the
-    state per chunk (slice + concat), amortized over ``n_ticks`` ticks.
+    the global block ids the single kernel would use at the POST-FIT block
+    — so the schedule stream is invariant to the segmentation and a
+    campaign's replay/shrink/checkpoint contract (same seed + same block ->
+    same schedule) survives the degradation.  The stream contract is keyed
+    to the post-fit block (ADVICE r3): the block is fitted against the
+    SEGMENT size, so a composite request that divides ``n_inst`` but not
+    the segment (e.g. block=3072 at n_inst=12M, segment 4M) degrades —
+    loudly, via :func:`fit_block`'s warning — to a block that divides the
+    segment, and the resulting stream matches the single kernel at that
+    degraded block, not at the request.  Power-of-two blocks (every
+    default) always divide the segment and pass through unchanged.  Cost:
+    one extra HBM copy of the state per chunk (slice + concat), amortized
+    over ``n_ticks`` ticks.
     """
     n_inst = jax.tree.leaves(state)[0].shape[-1]
     if n_inst <= max_lanes:
         return fused_chunk(
             state, seed, plan, cfg, n_ticks, apply_fn, mask_fn,
-            block=block, interpret=interpret,
+            block=block, interpret=interpret, default=default,
         )
     segments = -(-n_inst // max_lanes)
     if n_inst % segments:
@@ -363,7 +422,11 @@ def fused_chunk_auto(
             f"<= {max_lanes} lanes; use a power-of-two instance count"
         )
     seg = n_inst // segments
-    block = fit_block(min(block, seg), seg, interpret=interpret)
+    explicit = block is not None
+    block = fit_block(
+        block if explicit else default, seg, interpret=interpret,
+        warn=explicit,
+    )
     return _segmented_impl(
         state, jnp.asarray(seed, jnp.int32), plan,
         cfg=cfg, n_ticks=n_ticks, apply_fn=apply_fn, mask_fn=mask_fn,
@@ -457,8 +520,9 @@ def fused_chunk_sharded(
     apply_fn: Callable,
     mask_fn: Callable,
     mesh,
-    block: int = DEFAULT_BLOCK,
+    block: "int | None" = None,
     interpret: bool = False,
+    default: int = DEFAULT_BLOCK,
 ) -> Any:
     """Multi-chip fused engine: one fused kernel per shard under shard_map.
 
@@ -480,7 +544,11 @@ def fused_chunk_sharded(
         # before any shape error surfaced.
         raise ValueError(f"n_inst={n_inst} not divisible by mesh size {n_dev}")
     local = n_inst // n_dev
-    block = fit_block(min(block, local), local, interpret=interpret)
+    explicit = block is not None
+    block = fit_block(
+        block if explicit else default, local, interpret=interpret,
+        warn=explicit,
+    )
     return _sharded_impl(
         state, jnp.asarray(seed, jnp.int32), plan,
         cfg=cfg, n_ticks=n_ticks, apply_fn=apply_fn, mask_fn=mask_fn,
@@ -522,8 +590,7 @@ def _make_chunk(protocol: str) -> Callable:
         apply_fn, mask_fn, default_block = fused_fns(protocol)
         return fused_chunk_auto(
             state, seed, plan, cfg, n_ticks, apply_fn, mask_fn,
-            block=default_block if block is None else block,
-            interpret=interpret,
+            block=block, interpret=interpret, default=default_block,
         )
 
     chunk.__name__ = f"fused_{protocol}_chunk"

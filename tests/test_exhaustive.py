@@ -157,3 +157,86 @@ def test_raft_exhaustive_finds_double_bug():
         check_raft_exhaustive(
             max_round=(1, 0), no_restriction=True, no_adoption=True
         )
+
+
+# ---- Mechanized liveness (VERDICT r3 #2) ----
+#
+# The fair-completion leg (exhaustive.make_liveness_checker): from EVERY
+# reachable state, the deterministic fair schedule — drain the network in
+# sorted order, then let the highest-ballot live proposer retry — must
+# decide within the bound.  Each protocol is checked clean AND shown to
+# produce a lasso counterexample under its injected livelock bug, so the
+# leg is falsifiable, not vacuous.
+
+from paxos_tpu.cpu_ref.exhaustive import LivenessViolation  # noqa: E402
+
+
+def test_liveness_paxos_clean():
+    r = check_exhaustive(max_round=1, liveness_bound=60)
+    assert r.states == 602_641  # liveness leg must not perturb the space
+    assert 0 < r.max_completion <= 60
+
+
+def test_liveness_paxos_livelock_bug_found():
+    """Retry without ballot increase: the retry's PREPAREs sit at/below
+    every promise already extracted, so the proposer re-collects nothing
+    — a pure lasso (same state revisited)."""
+    with pytest.raises(LivenessViolation, match="LASSO"):
+        check_exhaustive(
+            n_prop=1, n_acc=2, max_round=1, liveness_bound=60,
+            livelock_bug=True,
+        )
+    with pytest.raises(LivenessViolation, match="LASSO"):
+        check_exhaustive(max_round=1, liveness_bound=60, livelock_bug=True)
+
+
+def test_liveness_fastpaxos_clean_and_collision_recovery():
+    """Fast Paxos is where the timeout arm of the fair completion earns
+    its keep: a collided fast round leaves an EMPTY network with nobody
+    decided, so completion must drive the classic recovery round.  (The
+    n_acc=5 canonical space — all 4,013,181 states complete in <= 25 fair
+    actions, ~8 min — is run via the CLI and recorded in BASELINE.md, per
+    the same convention as test_fp_exhaustive_clean.)"""
+    r = check_fp_exhaustive(n_acc=3, max_round=(1, 0), liveness_bound=60)
+    assert r.max_completion > 0
+    r4 = check_fp_exhaustive(n_acc=4, max_round=(1, 0), liveness_bound=80)
+    assert r4.max_completion > 0
+
+
+def test_liveness_fastpaxos_fast_retry_bug_found():
+    """The injected fp livelock: on timeout, retry the FAST round instead
+    of escalating to classic recovery.  Vote-at-most-once makes every
+    re-broadcast a no-op against the collided tally — lasso."""
+    with pytest.raises(LivenessViolation, match="LASSO"):
+        check_fp_exhaustive(
+            n_acc=3, max_round=(1, 0), liveness_bound=60, livelock_bug=True
+        )
+
+
+def test_liveness_multipaxos_clean():
+    """MP exercises the timeout arm from the FIRST state: the initial
+    network is empty (all traffic comes from leadership challenges)."""
+    r = check_mp_exhaustive(max_round=(1, 1), liveness_bound=80)
+    assert r.max_completion > 0
+
+
+def test_liveness_multipaxos_frozen_challenge_bug_found():
+    with pytest.raises(LivenessViolation, match="LASSO"):
+        check_mp_exhaustive(
+            max_round=1, liveness_bound=80, livelock_bug=True
+        )
+
+
+def test_liveness_raft_clean():
+    r = check_raft_exhaustive(max_round=(1, 0), liveness_bound=80)
+    assert r.max_completion > 0
+
+
+def test_liveness_raft_same_term_reelection_bug_found():
+    """Re-election WITHOUT a term bump: every voter's one vote for the
+    term is spent, so re-runs collect only denials — the split-vote
+    livelock Raft's randomized timeouts + term bump exist to prevent."""
+    with pytest.raises(LivenessViolation, match="LASSO"):
+        check_raft_exhaustive(
+            max_round=1, liveness_bound=80, livelock_bug=True
+        )

@@ -232,3 +232,55 @@ def test_fused_nonpow2_instance_count_degrades_block():
         block=32, interpret=True,
     )
     assert _trees_equal(degraded, explicit) == []
+
+
+def test_fused_block_degradation_warning_policy():
+    """ADVICE r3 + r4 review: an EXPLICIT block request that degrades must
+    warn (block is stream-relevant — a typo'd block silently running a
+    different PRNG schedule is the failure mode); the library default
+    (block=None) must degrade SILENTLY (the user typed nothing); and an
+    oversized explicit request must not be pre-clamped past the warning."""
+    import warnings
+
+    from paxos_tpu.kernels.fused_tick import fit_block
+
+    def degraded_warns(fn):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = fn()
+            return out, [x for x in w if "fused block" in str(x.message)]
+
+    # Explicit non-dividing request: warns, names both blocks.
+    got, w = degraded_warns(lambda: fit_block(48, 1024, floor=8))
+    assert got == 32 and len(w) == 1
+    assert "block=48" in str(w[0].message) and "block=32" in str(w[0].message)
+    # warn=False (the block=None resolution path): same result, silent.
+    got, w = degraded_warns(lambda: fit_block(48, 1024, floor=8, warn=False))
+    assert got == 32 and w == []
+    # Valid request: unchanged AND silent in both modes.
+    got, w = degraded_warns(lambda: fit_block(32, 1024, floor=8))
+    assert got == 32 and w == []
+    # Oversized requests reach fit_block un-clamped and warn (the old
+    # min(block, n) pre-clamp made them silently "valid"): with an
+    # admissible power-of-two divisor (8 >= floor 8) it degrades to that;
+    # with none (floor 128 > p2 8) a small count degrades to one
+    # full-array block.
+    got, w = degraded_warns(lambda: fit_block(2048, 1000, floor=8))
+    assert got == 8 and len(w) == 1
+    got, w = degraded_warns(lambda: fit_block(2048, 1000))
+    assert got == 1000 and len(w) == 1
+
+    # End-to-end: the default path (block=None -> protocol default 1024,
+    # degrading to 512 at n_inst=1536) is silent; the same degradation
+    # from an explicit block=1024 warns.
+    cfg = config2_dueling_drop(n_inst=1536, seed=5)
+    plan = init_plan(cfg)
+    _, w = degraded_warns(lambda: fused_paxos_chunk(
+        init_state(cfg), jnp.int32(5), plan, cfg.fault, 2, interpret=True,
+    ))
+    assert w == []
+    _, w = degraded_warns(lambda: fused_paxos_chunk(
+        init_state(cfg), jnp.int32(5), plan, cfg.fault, 2, block=1024,
+        interpret=True,
+    ))
+    assert len(w) == 1
