@@ -560,13 +560,36 @@ def fused_chunk_sharded(
 # ---- Per-protocol bindings -------------------------------------------------
 
 
-def fused_fns(protocol: str):
+@functools.lru_cache(maxsize=None)
+def fused_fns(protocol: str, ablate: frozenset = frozenset()):
     """(apply_fn, mask_fn, default_block) for a protocol — the ONE place a
     protocol is bound to the fused engine (both the per-protocol wrappers in
-    ``FUSED_CHUNKS`` and the sharded CLI path read from here)."""
+    ``FUSED_CHUNKS`` and the sharded CLI path read from here).
+
+    ``ablate`` (dev-only; scripts/ablate_fused.py) compiles the kernel with
+    a component removed — flags are interpreted by the protocol's apply/mask
+    functions ("learner", "sends", "select", "consume", "proposer" in
+    apply; "prng" in masks).  Supported for paxos and multipaxos (the two
+    roofline targets); other protocols accept only the empty set.  The
+    lru_cache makes the returned partials identity-stable, so each variant
+    compiles once per process (apply_fn/mask_fn are static jit arguments).
+    """
+    if ablate and protocol not in ("paxos", "multipaxos"):
+        raise ValueError(f"ablation flags unsupported for {protocol!r}")
+    unknown = set(ablate) - {
+        "learner", "sends", "select", "consume", "proposer", "prng"
+    }
+    if unknown:
+        raise ValueError(f"unknown ablate flags: {sorted(unknown)}")
     if protocol == "paxos":
         from paxos_tpu.protocols.paxos import apply_tick, counter_masks
 
+        if ablate:
+            return (
+                functools.partial(apply_tick, ablate=ablate),
+                functools.partial(counter_masks, ablate=ablate),
+                DEFAULT_BLOCK,
+            )
         return apply_tick, counter_masks, DEFAULT_BLOCK
     if protocol == "fastpaxos":
         from paxos_tpu.protocols.fastpaxos import apply_tick_fast
@@ -581,6 +604,12 @@ def fused_fns(protocol: str):
     if protocol == "multipaxos":
         from paxos_tpu.protocols.multipaxos import apply_tick_mp, mp_counter_masks
 
+        if ablate:
+            return (
+                functools.partial(apply_tick_mp, ablate=ablate),
+                functools.partial(mp_counter_masks, ablate=ablate),
+                256,
+            )
         return apply_tick_mp, mp_counter_masks, 256
     raise ValueError(f"unknown protocol: {protocol!r}")
 

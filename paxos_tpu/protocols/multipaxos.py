@@ -88,14 +88,30 @@ def sample_mp_masks(
     )
 
 
-def mp_counter_masks(cfg: FaultConfig, tick_seed: jax.Array, state) -> MPTickMasks:
-    """Draw a tick's masks from the counter PRNG (the fused engine's source)."""
+def mp_counter_masks(
+    cfg: FaultConfig, tick_seed: jax.Array, state,
+    ablate: frozenset = frozenset(),
+) -> MPTickMasks:
+    """Draw a tick's masks from the counter PRNG (the fused engine's source).
+
+    ``ablate={"prng"}``: constants instead of PRNG draws (timing-only; see
+    ``protocols.paxos.counter_masks``)."""
     from paxos_tpu.kernels import counter_prng as cp
 
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     slot = (2, n_prop, n_acc, n_inst)
     edge = (n_prop, n_acc, n_inst)
+    if "prng" in ablate:
+        return MPTickMasks(
+            sel_score=jnp.broadcast_to(
+                jax.lax.broadcasted_iota(jnp.int32, slot, 3), slot
+            ),
+            busy=None, dup_req=None, prom_deliver=None, accd_deliver=None,
+            keep_prom=None, keep_accd=None, keep_prep=None, keep_acc=None,
+            jitter=jnp.zeros((n_prop, n_inst), jnp.int32),
+            backoff=jnp.zeros((n_prop, n_inst), jnp.int32),
+        )
     return MPTickMasks(
         sel_score=cp.counter_bits(tick_seed, 0, slot),
         busy=cp.bern_not(tick_seed, 1, (1, 1, n_acc, n_inst), cfg.p_idle),
@@ -114,9 +130,16 @@ def mp_counter_masks(cfg: FaultConfig, tick_seed: jax.Array, state) -> MPTickMas
 
 
 def apply_tick_mp(
-    state: MultiPaxosState, masks: MPTickMasks, plan: FaultPlan, cfg: FaultConfig
+    state: MultiPaxosState, masks: MPTickMasks, plan: FaultPlan, cfg: FaultConfig,
+    ablate: frozenset = frozenset(),
 ) -> MultiPaxosState:
-    """The pure Multi-Paxos transition for one tick over pre-sampled masks."""
+    """The pure Multi-Paxos transition for one tick over pre-sampled masks.
+
+    ``ablate`` (dev-only; via ``fused_fns("multipaxos", ablate=...)``)
+    disables a component at trace time for the fused-tick ablation tool —
+    same flag set and caveats as ``protocols.paxos.apply_tick``
+    ("learner", "sends", "select", "consume", "proposer"; ablated variants
+    are timing-only, not the protocol)."""
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     n_slots = state.log_len
@@ -148,11 +171,32 @@ def apply_tick_mp(
     if link is not None:  # partitioned links stall replies in flight
         prom_del = prom_del & link
         accd_del = accd_del & link
-    promises = state.promises.replace(present=state.promises.present & ~prom_del)
-    accepted = state.accepted.replace(present=state.accepted.present & ~accd_del)
+    if "consume" in ablate:
+        promises, accepted = state.promises, state.accepted
+    else:
+        promises = state.promises.replace(
+            present=state.promises.present & ~prom_del
+        )
+        accepted = state.accepted.replace(
+            present=state.accepted.present & ~accd_del
+        )
 
     # ---- Acceptor half-tick ----
-    sel = net.select_from_scores(state.requests.present, masks.sel_score, masks.busy)
+    if "select" in ablate:
+        # All-false via an iota compare rather than a constant: a folded
+        # constant mask cascades constants through the whole kernel and
+        # trips Mosaic's vector-layout pass (Check failed: limits <= dim).
+        sel = (
+            jax.lax.broadcasted_iota(
+                jnp.int32, state.requests.present.shape,
+                state.requests.present.ndim - 1,
+            )
+            < 0
+        )
+    else:
+        sel = net.select_from_scores(
+            state.requests.present, masks.sel_score, masks.busy
+        )
     sel = sel & alive[None, None]
     if link is not None:  # partitioned links stall requests in flight
         sel = sel & link[None]
@@ -180,37 +224,56 @@ def apply_tick_mp(
     log_val = jnp.where(wr, msg_val[:, None], acc.log_val)
 
     # Promise replies carry the acceptor's full log (equivocators hide theirs).
-    prom_send = sel[PREPARE] & ok_prep[None]  # (P, A, I)
-    if masks.keep_prom is not None:
-        prom_send = prom_send & masks.keep_prom
-    payload_pb = jnp.where(equiv[:, None], 0, acc.log_bal)  # (A, L, I)
-    payload_pv = jnp.where(equiv[:, None], 0, acc.log_val)
-    promises = promises.replace(
-        present=promises.present | prom_send,
-        bal=jnp.where(prom_send, msg_bal[None], promises.bal),
-        pb=jnp.where(prom_send[:, :, None], payload_pb[None], promises.pb),
-        pv=jnp.where(prom_send[:, :, None], payload_pv[None], promises.pv),
-    )
+    if "sends" not in ablate:
+        prom_send = sel[PREPARE] & ok_prep[None]  # (P, A, I)
+        if masks.keep_prom is not None:
+            prom_send = prom_send & masks.keep_prom
+        payload_pb = jnp.where(equiv[:, None], 0, acc.log_bal)  # (A, L, I)
+        payload_pv = jnp.where(equiv[:, None], 0, acc.log_val)
+        promises = promises.replace(
+            present=promises.present | prom_send,
+            bal=jnp.where(prom_send, msg_bal[None], promises.bal),
+            pb=jnp.where(prom_send[:, :, None], payload_pb[None], promises.pb),
+            pv=jnp.where(prom_send[:, :, None], payload_pv[None], promises.pv),
+        )
 
-    accd_send = sel[ACCEPT] & ok_acc[None]  # (P, A, I)
-    if masks.keep_accd is not None:
-        accd_send = accd_send & masks.keep_accd
-    accepted = accepted.replace(
-        present=accepted.present | accd_send,
-        bal=jnp.where(accd_send, msg_bal[None], accepted.bal),
-        slot=jnp.where(accd_send, msg_slot[None], accepted.slot),
-        val=jnp.where(accd_send, msg_val[None], accepted.val),
-    )
+        accd_send = sel[ACCEPT] & ok_acc[None]  # (P, A, I)
+        if masks.keep_accd is not None:
+            accd_send = accd_send & masks.keep_accd
+        accepted = accepted.replace(
+            present=accepted.present | accd_send,
+            bal=jnp.where(accd_send, msg_bal[None], accepted.bal),
+            slot=jnp.where(accd_send, msg_slot[None], accepted.slot),
+            val=jnp.where(accd_send, msg_val[None], accepted.val),
+        )
 
-    requests = net.consume(state.requests, sel, stay=masks.dup_req)
+    if "consume" in ablate:
+        requests = state.requests
+    else:
+        requests = net.consume(state.requests, sel, stay=masks.dup_req)
     acc = acc.replace(promised=promised, log_bal=log_bal, log_val=log_val)
 
     # ---- Learner / checker ----
-    with jax.named_scope("learner_check"):
-        learner = mp_learner_observe(
-            state.learner, ok_acc, msg_bal, msg_slot, msg_val, state.tick, quorum
+    if "learner" in ablate:
+        learner = state.learner
+        chosen_count = jnp.zeros((n_inst,), jnp.int32)
+    else:
+        with jax.named_scope("learner_check"):
+            learner = mp_learner_observe(
+                state.learner, ok_acc, msg_bal, msg_slot, msg_val, state.tick,
+                quorum,
+            )
+            chosen_count = learner.chosen.sum(axis=0, dtype=jnp.int32)  # (I,)
+
+    if "proposer" in ablate:
+        return state.replace(
+            acceptor=acc,
+            learner=learner,
+            requests=requests,
+            promises=promises,
+            accepted=accepted,
+            tick=state.tick + 1,
         )
-        chosen_count = learner.chosen.sum(axis=0, dtype=jnp.int32)  # (I,)
 
     # ---- Proposer half-tick ----
     bits = (jnp.asarray(1, jnp.int32) << jnp.arange(n_acc, dtype=jnp.int32))[
@@ -313,14 +376,15 @@ def apply_tick_mp(
     prep_mask = jnp.broadcast_to(
         (start_elec & p_alive)[:, None], (n_prop, n_acc, n_inst)
     )
-    requests = net.send(
-        requests, PREPARE,
-        send_mask=prep_mask,
-        bal=bal_next[:, None],
-        v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=masks.keep_prep,
-    )
+    if "sends" not in ablate:
+        requests = net.send(
+            requests, PREPARE,
+            send_mask=prep_mask,
+            bal=bal_next[:, None],
+            v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
+            v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
+            keep=masks.keep_prep,
+        )
     # Leaders re-broadcast the current slot's Accept every tick (idempotent,
     # self-healing under loss).
     is_lead = (phase == LEAD) & p_alive & (commit_idx < n_slots)
@@ -335,14 +399,15 @@ def apply_tick_mp(
     # Command payloads are keyed by GLOBAL slot (base + window index), so a
     # slot's value is stable across window shifts (base is 0 in plain mode).
     pval = jnp.where(rb > 0, rv, own_slot_value(pid, state.base[None] + ci))
-    requests = net.send(
-        requests, ACCEPT,
-        send_mask=jnp.broadcast_to(is_lead[:, None], (n_prop, n_acc, n_inst)),
-        bal=bal_next[:, None],
-        v1=pval[:, None],
-        v2=ci[:, None],
-        keep=masks.keep_acc,
-    )
+    if "sends" not in ablate:
+        requests = net.send(
+            requests, ACCEPT,
+            send_mask=jnp.broadcast_to(is_lead[:, None], (n_prop, n_acc, n_inst)),
+            bal=bal_next[:, None],
+            v1=pval[:, None],
+            v2=ci[:, None],
+            keep=masks.keep_acc,
+        )
 
     prop = prop.replace(
         bal=bal_next,

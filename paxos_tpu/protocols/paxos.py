@@ -101,13 +101,20 @@ def sample_masks(
 
 
 def counter_masks(
-    cfg: FaultConfig, tick_seed: jax.Array, state: PaxosState
+    cfg: FaultConfig, tick_seed: jax.Array, state: PaxosState,
+    ablate: frozenset = frozenset(),
 ) -> TickMasks:
     """Draw a tick's masks from the counter PRNG (the fused engine's source).
 
     Same mask shapes and probabilities as :func:`sample_masks`, different
     (but equally deterministic) stream; pure jnp, so it traces identically
     inside Pallas kernels and in plain XLA (``kernels/counter_prng``).
+
+    ``ablate={"prng"}`` (dev-only, ``fused_fns(..., ablate=...)``): replace
+    every PRNG draw with constants — a fixed selection-score pattern and
+    fault-free None masks — to measure the counter-PRNG's share of the
+    fused tick.  NOT a valid protocol schedule (selection entropy is the
+    adversarial scheduler); timing-only.
     """
     from paxos_tpu.kernels import counter_prng as cp
 
@@ -116,6 +123,15 @@ def counter_masks(
     _, n_prop, n_acc, n_inst = state.requests.present.shape
     slot = (2, n_prop, n_acc, n_inst)
     edge = (n_prop, n_acc, n_inst)
+    if "prng" in ablate:
+        return TickMasks(
+            sel_score=jnp.broadcast_to(
+                jax.lax.broadcasted_iota(jnp.int32, slot, 3), slot
+            ),
+            busy=None, deliver=None, dup_req=None, dup_rep=None,
+            keep_prom=None, keep_accd=None, keep_p1=None, keep_p2=None,
+            backoff=jnp.zeros((n_prop, n_inst), jnp.int32),
+        )
     return TickMasks(
         sel_score=cp.counter_bits(tick_seed, 0, slot),
         busy=cp.bern_not(tick_seed, 1, (1, 1, n_acc, n_inst), cfg.p_idle),
@@ -131,9 +147,26 @@ def counter_masks(
 
 
 def apply_tick(
-    state: PaxosState, masks: TickMasks, plan: FaultPlan, cfg: FaultConfig
+    state: PaxosState, masks: TickMasks, plan: FaultPlan, cfg: FaultConfig,
+    ablate: frozenset = frozenset(),
 ) -> PaxosState:
-    """The pure protocol transition for one tick over pre-sampled masks."""
+    """The pure protocol transition for one tick over pre-sampled masks.
+
+    ``ablate`` (dev-only; reach it via ``fused_fns(protocol, ablate=...)``)
+    disables a component AT TRACE TIME so the fused kernel compiles without
+    it — the ablation tool for locating the hot spots of the fused tick
+    (VERDICT r3 #7; scripts/ablate_fused.py), replacing the old
+    monkeypatching approach with flags the compiler sees:
+
+    - ``"learner"``: skip the omniscient checker + acceptor invariants;
+    - ``"sends"``:   skip every ``net.send`` (replies AND request emits);
+    - ``"select"``:  acceptors select nothing (no request processing);
+    - ``"consume"``: delivered/selected buffers are never cleared;
+    - ``"proposer"``: skip the proposer half-tick entirely.
+
+    Ablated variants are NOT the protocol (safety/liveness meaningless);
+    they exist to be timed against the full kernel.
+    """
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     quorum = majority(n_acc)
@@ -167,10 +200,27 @@ def apply_tick(
         delivered = delivered & masks.deliver
     if link is not None:  # partitioned links stall replies in flight
         delivered = delivered & link[None]
-    replies = net.consume(state.replies, delivered, stay=masks.dup_rep)
+    if "consume" in ablate:
+        replies = state.replies
+    else:
+        replies = net.consume(state.replies, delivered, stay=masks.dup_rep)
 
     # ---- Acceptor half-tick: select one request per (instance, acceptor) ----
-    sel = net.select_from_scores(state.requests.present, masks.sel_score, masks.busy)
+    if "select" in ablate:
+        # All-false via an iota compare rather than a constant: a folded
+        # constant mask cascades constants through the whole kernel and
+        # trips Mosaic's vector-layout pass (Check failed: limits <= dim).
+        sel = (
+            jax.lax.broadcasted_iota(
+                jnp.int32, state.requests.present.shape,
+                state.requests.present.ndim - 1,
+            )
+            < 0
+        )
+    else:
+        sel = net.select_from_scores(
+            state.requests.present, masks.sel_score, masks.busy
+        )
     sel = sel & alive[None, None]  # crashed acceptors process nothing
     if link is not None:  # partitioned links stall requests in flight
         sel = sel & link[None]
@@ -200,31 +250,47 @@ def apply_tick(
     # Replies routed back to the selected sender's slot.
     prom_payload_bal = jnp.where(equiv, 0, acc.acc_bal)  # pre-update pair
     prom_payload_val = jnp.where(equiv, 0, acc.acc_val)
-    replies = net.send(
-        replies, PROMISE,
-        send_mask=sel[PREPARE] & ok_prep[None],
-        bal=msg_bal[None],
-        v1=prom_payload_bal[None],
-        v2=prom_payload_val[None],
-        keep=masks.keep_prom,
-    )
-    replies = net.send(
-        replies, ACCEPTED,
-        send_mask=sel[ACCEPT] & ok_acc[None],
-        bal=msg_bal[None],
-        v1=msg_val[None],
-        v2=jnp.zeros_like(msg_val)[None],
-        keep=masks.keep_accd,
-    )
-    requests = net.consume(state.requests, sel, stay=masks.dup_req)
+    if "sends" not in ablate:
+        replies = net.send(
+            replies, PROMISE,
+            send_mask=sel[PREPARE] & ok_prep[None],
+            bal=msg_bal[None],
+            v1=prom_payload_bal[None],
+            v2=prom_payload_val[None],
+            keep=masks.keep_prom,
+        )
+        replies = net.send(
+            replies, ACCEPTED,
+            send_mask=sel[ACCEPT] & ok_acc[None],
+            bal=msg_bal[None],
+            v1=msg_val[None],
+            v2=jnp.zeros_like(msg_val)[None],
+            keep=masks.keep_accd,
+        )
+    if "consume" in ablate:
+        requests = state.requests
+    else:
+        requests = net.consume(state.requests, sel, stay=masks.dup_req)
     acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
 
     # ---- Learner / safety checker (omniscient: sees accept events directly) ----
-    learner = learner_observe(
-        state.learner, ok_acc, msg_bal, msg_val, state.tick, q2
-    )
-    inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
-    learner = learner.replace(violations=learner.violations + inv_viol)
+    if "learner" in ablate:
+        learner = state.learner
+    else:
+        learner = learner_observe(
+            state.learner, ok_acc, msg_bal, msg_val, state.tick, q2
+        )
+        inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
+        learner = learner.replace(violations=learner.violations + inv_viol)
+
+    if "proposer" in ablate:
+        return state.replace(
+            acceptor=acc,
+            learner=learner,
+            requests=requests,
+            replies=replies,
+            tick=state.tick + 1,
+        )
 
     # ---- Proposer half-tick: fold all delivered replies ----
     prop = state.proposer
@@ -290,22 +356,23 @@ def apply_tick(
     timer = jnp.where(expired, -masks.backoff, timer)
 
     # Emit: ACCEPT broadcast on phase-1 completion, PREPARE broadcast on retry.
-    requests = net.send(
-        requests, ACCEPT,
-        send_mask=jnp.broadcast_to(p1_done[:, None], (n_prop, n_acc, n_inst)),
-        bal=prop.bal[:, None],
-        v1=prop_val[:, None],
-        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=masks.keep_p2,
-    )
-    requests = net.send(
-        requests, PREPARE,
-        send_mask=jnp.broadcast_to(expired[:, None], (n_prop, n_acc, n_inst)),
-        bal=bal_next[:, None],
-        v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=masks.keep_p1,
-    )
+    if "sends" not in ablate:
+        requests = net.send(
+            requests, ACCEPT,
+            send_mask=jnp.broadcast_to(p1_done[:, None], (n_prop, n_acc, n_inst)),
+            bal=prop.bal[:, None],
+            v1=prop_val[:, None],
+            v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
+            keep=masks.keep_p2,
+        )
+        requests = net.send(
+            requests, PREPARE,
+            send_mask=jnp.broadcast_to(expired[:, None], (n_prop, n_acc, n_inst)),
+            bal=bal_next[:, None],
+            v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
+            v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
+            keep=masks.keep_p1,
+        )
 
     prop = prop.replace(
         bal=bal_next,

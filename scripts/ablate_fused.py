@@ -1,0 +1,128 @@
+"""Ablation timing of the FUSED engine's tick components (VERDICT r3 #7).
+
+The old ``scripts/ablate.py`` times the XLA engine by monkeypatching module
+globals; it predates the fused Pallas engine that carries every headline
+number.  This tool ablates the fused kernel itself via the feature flags
+threaded through ``fused_fns(protocol, ablate=...)`` — each variant is a
+DIFFERENT traced program the compiler sees (no runtime branches, no
+monkeypatching), so the deltas measure what Mosaic actually schedules.
+
+Flags (interpreted in ``protocols/paxos.apply_tick`` /
+``multipaxos.apply_tick_mp`` and the ``counter_masks`` samplers):
+
+- ``prng``:     constant masks instead of counter-PRNG draws
+- ``select``:   acceptors select nothing (no request processing)
+- ``sends``:    no reply/request writes
+- ``consume``:  delivered/selected buffers never cleared
+- ``learner``:  no omniscient checker / invariants
+- ``proposer``: no proposer half-tick
+
+Ablated kernels are NOT the protocol (an ablated run's schedule is
+meaningless); the only valid use is comparing their wall-clock against the
+full kernel at identical shapes.  Component "shares" are reported as
+``1 - t_ablated / t_full`` — overlapping work (e.g. sends feed consume)
+means shares need not sum to 1.
+
+Usage (TPU; CPU-interpret works but measures nothing real):
+
+    python scripts/ablate_fused.py --protocol multipaxos --n-inst 1048576
+    python scripts/ablate_fused.py --protocol paxos --record ablate.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.harness.cli import CONFIGS
+from paxos_tpu.harness.run import init_plan, init_state
+from paxos_tpu.kernels.fused_tick import fused_chunk, fused_fns
+
+FLAGS = ("prng", "select", "sends", "consume", "learner", "proposer")
+
+
+def time_variant(cfg, ablate, n_ticks, reps, interpret):
+    apply_fn, mask_fn, block = fused_fns(cfg.protocol, frozenset(ablate))
+    plan = init_plan(cfg)
+
+    def chunk(state):
+        return fused_chunk(
+            state, jnp.int32(cfg.seed), plan, cfg.fault, n_ticks,
+            apply_fn, mask_fn, block=None, interpret=interpret,
+            default=block,
+        )
+
+    state = chunk(init_state(cfg))  # compile + warm
+    int(state.tick)  # device->host readback (axon: block_until_ready lies)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        state = chunk(state)
+        int(state.tick)
+        best = min(best, time.perf_counter() - t0)
+    return best / n_ticks
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--protocol", choices=["paxos", "multipaxos"],
+                    default="paxos")
+    ap.add_argument("--config", default=None,
+                    help="config name (default: config2 for paxos, "
+                    "config3 for multipaxos)")
+    ap.add_argument("--n-inst", type=int, default=None)
+    ap.add_argument("--ticks", type=int, default=256)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--record", default=None, help="write the table as JSON")
+    args = ap.parse_args()
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    interpret = not on_tpu
+    default_inst = (1 << 20) if on_tpu else (1 << 10)
+    n_inst = args.n_inst or default_inst
+    name = args.config or ("config2" if args.protocol == "paxos" else "config3")
+    cfg = CONFIGS[name](n_inst=n_inst, seed=0)
+    if cfg.protocol != args.protocol:
+        raise SystemExit(f"config {name} is {cfg.protocol}, not {args.protocol}")
+    if not on_tpu:
+        print("# WARNING: not on TPU — interpret-mode times are meaningless; "
+              "this run only validates that every variant compiles+runs")
+
+    rows = []
+    full = time_variant(cfg, (), args.ticks, args.reps, interpret)
+    rows.append({"variant": "full", "us_per_tick": full * 1e6, "share": 0.0})
+    print(f"{'full':12s} {full * 1e6:9.2f} us/tick")
+    for flag in FLAGS:
+        t = time_variant(cfg, (flag,), args.ticks, args.reps, interpret)
+        share = 1.0 - t / full
+        rows.append({"variant": f"no-{flag}",
+                     "us_per_tick": t * 1e6, "share": share})
+        print(f"{'no-' + flag:12s} {t * 1e6:9.2f} us/tick   "
+              f"share {share * 100:5.1f}%")
+
+    out = {
+        "protocol": args.protocol,
+        "config": name,
+        "n_inst": n_inst,
+        "ticks_per_chunk": args.ticks,
+        "platform": jax.devices()[0].platform,
+        "rows": rows,
+    }
+    print(json.dumps(out))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(out, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
