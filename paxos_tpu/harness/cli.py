@@ -118,6 +118,13 @@ def build_parser() -> argparse.ArgumentParser:
     so.add_argument("--target-rounds", type=float, default=1e9)
     so.add_argument("--ticks-per-seed", type=int, default=256)
     so.add_argument("--chunk", type=int, default=64)
+    so.add_argument(
+        "--min-replication", type=float, default=None,
+        help="long-log configs: fail (exit 3) if any campaign replicates "
+        "fewer slots per lane-tick than this; defaults to 0.7x the recorded "
+        "rate for known long-log configs (config.REPLICATION_RATES), 'off' "
+        "for ad-hoc ones; pass 0 to disable",
+    )
 
     k = sub.add_parser(
         "shrink",
@@ -378,6 +385,30 @@ def cmd_soak(args: argparse.Namespace) -> int:
     if args.n_inst:
         kw["n_inst"] = args.n_inst
     cfg = CONFIGS[args.config](**kw)
+    band = args.min_replication
+    if band is None:
+        rec = config_mod.REPLICATION_RATES.get(args.config)
+        if rec is not None:
+            # The recorded rate is slots/lane-tick while the log lasts, but
+            # two mathematical ceilings cap what a HEALTHY run can achieve:
+            # a budget long enough to finish the whole log caps it at
+            # log_total/ticks_per_seed, and compaction only advancing `base`
+            # at chunk boundaries caps it at window/chunk.  Gate at 0.7x
+            # (the perf gate's band discipline) of the lowest of the three,
+            # else a fully-replicated or coarse-chunk soak would fail while
+            # perfectly healthy.
+            cap = min(
+                cfg.fault.log_total / args.ticks_per_seed,
+                cfg.log_len / args.chunk,
+            )
+            band = round(0.7 * min(rec, cap), 6)
+    elif band and not (cfg.protocol == "multipaxos" and cfg.fault.log_total):
+        # An explicit band on a config that never reports slots_replicated
+        # would be silently inert (the gate never evaluates) — refuse.
+        print(f"error: --min-replication needs a long-log config "
+              f"(got {args.config}, which reports no replication rate)",
+              file=sys.stderr)
+        return 1
     report = soak(
         cfg,
         target_rounds=args.target_rounds,
@@ -385,10 +416,15 @@ def cmd_soak(args: argparse.Namespace) -> int:
         chunk=args.chunk,
         engine=args.engine,
         log=lambda s: print(f"# {s}", file=sys.stderr),
+        min_slots_per_lane_tick=band or None,
     )
     report["config"] = args.config
     print(json.dumps(report))
-    return 0 if report["violations"] == 0 else 2
+    if report["violations"]:
+        return 2
+    if not report.get("replication_ok", True):
+        return 3
+    return 0
 
 
 def cmd_check(args: argparse.Namespace) -> int:

@@ -123,6 +123,18 @@ def config3_long(
     )
 
 
+# Recorded long-log replication rate (slots replicated per lane-tick) at the
+# soak operating point (ticks_per_seed=512, chunk=64, fused engine, 1M
+# instances): BASELINE.md's config3long soak replicates decided_frac 0.498
+# of a 256-slot log in a 512-tick budget -> 0.249 slots/lane-tick.  The soak
+# CLI gates long-log campaigns at 0.7x this (VERDICT r3 #8) — the same band
+# discipline as the perf-regression gate — so a replication slowdown fails
+# the soak loudly instead of drifting a statistic.  The rate is per-lane, so
+# it holds across instance counts; re-record if the config's fault mix or
+# the soak cadence changes.
+REPLICATION_RATES = {"config3long": 0.249}
+
+
 def config4_byzantine(n_inst: int = 4096, seed: int = 0) -> SimConfig:
     """Config 4: acceptor equivocation (double-promise) to validate the checker."""
     return SimConfig(

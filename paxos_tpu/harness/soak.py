@@ -62,6 +62,7 @@ def soak(
     recheck_doublings: int = 4,
     transient_retries: int = 2,
     retry_backoff_s: float = 5.0,
+    min_slots_per_lane_tick: Optional[float] = None,
 ) -> dict[str, Any]:
     """Run campaigns over rotating seeds until ``target_rounds`` accumulate.
 
@@ -85,8 +86,14 @@ def soak(
     final chunk's compaction removes every decided row from the window, so
     the residual rows are undecided by construction and ``stuck_frac``
     reads ~1.0 on a perfectly healthy config3long soak (measured).  For
-    long-log configs the livelock signal is the ``decided_frac`` trend
-    (global replication progress per fixed budget), not ``stuck_frac``.
+    long-log configs the livelock signal is the REPLICATION RATE, and it
+    is gated, not a trend (VERDICT r3 #8): each campaign's
+    ``slots_replicated / (n_inst * ticks_per_seed)`` aggregates into
+    ``slots_per_lane_tick_mean/min``, and when ``min_slots_per_lane_tick``
+    is set (the CLI defaults it to 0.7x the recorded rate for known
+    long-log configs, like the perf gate's 0.7x band) the report carries
+    ``replication_ok`` — False fails the soak loudly (CLI exit 3) instead
+    of drifting a statistic nobody gates on.
 
     **Transient-failure resilience:** each campaign retries up to
     ``transient_retries`` times on backend runtime errors (tunnel
@@ -123,6 +130,8 @@ def soak(
     stuck_max = 0
     lanes_total = 0
     decided_fracs: list[float] = []
+    slots_total = 0
+    rep_rates: list[float] = []  # slots replicated per lane-tick, per campaign
     retries_used = 0
     t0 = time.perf_counter()
     while rounds < target_rounds:
@@ -168,12 +177,31 @@ def soak(
         stuck_max = max(stuck_max, report["stuck_lanes"])
         lanes_total += sum(report["chosen_tick_hist"])  # valid slot-lanes
         decided_fracs.append(report["decided_frac"])
+        if "slots_replicated" in report:  # long-log configs only
+            slots_total += report["slots_replicated"]
+            rep_rates.append(
+                report["slots_replicated"] / (scfg.n_inst * ticks_per_seed)
+            )
         rounds += scfg.n_inst * ticks_per_seed
         seeds += 1
         say(f"seed {scfg.seed}: {rounds:.3e} rounds, {violations} violations, "
             f"{report['stuck_lanes']} stuck")
     dt = time.perf_counter() - t0
-    return {
+    replication: dict[str, Any] = {}
+    if rep_rates:
+        replication = {
+            "slots_replicated": slots_total,
+            "slots_per_lane_tick_mean": round(
+                sum(rep_rates) / len(rep_rates), 6
+            ),
+            "slots_per_lane_tick_min": round(min(rep_rates), 6),
+        }
+        if min_slots_per_lane_tick is not None:
+            replication["replication_band"] = min_slots_per_lane_tick
+            replication["replication_ok"] = (
+                min(rep_rates) >= min_slots_per_lane_tick
+            )
+    return replication | {
         "metric": "soak",
         "rounds": rounds,
         "violations": violations,

@@ -442,13 +442,21 @@ def reference_chunk(
     n_ticks: int,
     apply_fn: Callable | None = None,
     mask_fn: Callable | None = None,
+    blk_id: "jnp.ndarray | int" = 0,
 ) -> Any:
     """Non-Pallas replay of the fused engine's exact schedule (single block).
 
     Runs the identical ``apply_fn`` + counter-PRNG stream in plain XLA for
-    a state that fits one block (``blk_id = 0``): the fused kernel must
-    produce bit-identical results — the equivalence oracle for the Pallas
-    lowering itself (tests/test_fused.py).  Defaults to single-decree paxos.
+    a state that fits one block: the fused kernel must produce bit-identical
+    results — the equivalence oracle for the Pallas lowering itself
+    (tests/test_fused.py).  Defaults to single-decree paxos.
+
+    ``blk_id`` is the block's GLOBAL stream id (default 0: a single-block
+    unsharded state).  Passing ``jax.lax.axis_index(...)`` inside a
+    ``shard_map`` whose local shard is one block replays the sharded fused
+    engine's stream — used by the multi-controller test, where the Pallas
+    TPU-interpret emulation itself deadlocks across processes
+    (tests/_dist_child.py documents the minimal repro).
     """
     if (apply_fn is None) != (mask_fn is None):
         raise ValueError(
@@ -460,9 +468,10 @@ def reference_chunk(
 
         apply_fn, mask_fn = apply_tick, counter_masks
     seed = jnp.asarray(seed, jnp.int32)
+    blk_id = jnp.asarray(blk_id, jnp.int32)
 
     def body(t, st):
-        tick_seed = mix(seed, st.tick, jnp.int32(0))
+        tick_seed = mix(seed, st.tick, blk_id)
         return apply_fn(st, mask_fn(cfg, tick_seed, st), plan, cfg)
 
     return jax.lax.fori_loop(0, n_ticks, body, state)

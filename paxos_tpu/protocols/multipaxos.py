@@ -104,9 +104,7 @@ def mp_counter_masks(
     edge = (n_prop, n_acc, n_inst)
     if "prng" in ablate:
         return MPTickMasks(
-            sel_score=jnp.broadcast_to(
-                jax.lax.broadcasted_iota(jnp.int32, slot, 3), slot
-            ),
+            sel_score=jax.lax.broadcasted_iota(jnp.int32, slot, 3),
             busy=None, dup_req=None, prom_deliver=None, accd_deliver=None,
             keep_prom=None, keep_accd=None, keep_prep=None, keep_acc=None,
             jitter=jnp.zeros((n_prop, n_inst), jnp.int32),

@@ -125,9 +125,7 @@ def counter_masks(
     edge = (n_prop, n_acc, n_inst)
     if "prng" in ablate:
         return TickMasks(
-            sel_score=jnp.broadcast_to(
-                jax.lax.broadcasted_iota(jnp.int32, slot, 3), slot
-            ),
+            sel_score=jax.lax.broadcasted_iota(jnp.int32, slot, 3),
             busy=None, deliver=None, dup_req=None, dup_rep=None,
             keep_prom=None, keep_accd=None, keep_p1=None, keep_p2=None,
             backoff=jnp.zeros((n_prop, n_inst), jnp.int32),

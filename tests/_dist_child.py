@@ -51,17 +51,21 @@ def main() -> None:
     cfg = config2_dueling_drop(n_inst=64, seed=3)
     mesh = make_instances_mesh()
 
-    def constrain(tree):
-        def leaf(x):
-            if getattr(x, "ndim", 0) >= 1 and x.shape[-1] == cfg.n_inst:
-                spec = P(*([None] * (x.ndim - 1)), INSTANCES_AXIS)
-            else:
-                spec = P()
-            return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, spec)
-            )
+    def leaf_spec(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[-1] == cfg.n_inst:
+            return P(*([None] * (x.ndim - 1)), INSTANCES_AXIS)
+        return P()
 
-        return jax.tree.map(leaf, tree)
+    def spec_of(tree):
+        return jax.tree.map(leaf_spec, tree)
+
+    def constrain(tree):
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, leaf_spec(x))
+            ),
+            tree,
+        )
 
     from paxos_tpu.harness.run import get_step_fn
 
@@ -83,6 +87,56 @@ def main() -> None:
         }
 
     out = {k: int(v) for k, v in jax.device_get(campaign()).items()}
+
+    # The sharded FUSED engine's stream over the same process-spanning mesh
+    # (VERDICT r3 #6): the flagship path's global block-offset arithmetic
+    # (fused_chunk_sharded: axis_index * blocks_per_shard) must hold when
+    # the instances axis crosses a process boundary, not just on a
+    # single-process 8-device mesh.  The Pallas TPU-interpret emulation
+    # itself DEADLOCKS under a multi-process shard_map (minimal repro: a
+    # 2-process 2-device mesh running a trivial `o_ref[...] = x_ref[...]+1`
+    # interpret-mode pallas_call via shard_map blocks both controllers
+    # indefinitely at ~10% CPU — a JAX emulation limitation, not a kernel
+    # property; on real multi-host TPUs interpret mode is never used), so
+    # the kernel body here is the fused engine's bit-exact stream oracle
+    # `reference_chunk` with the shard's global block id from axis_index —
+    # the exact arithmetic under test.  Each local shard is ONE block
+    # (block = 64/4 = 16), so the parent can compare these metrics against
+    # a single-process fused_chunk at block=16 bit-for-bit.
+    from paxos_tpu.kernels.fused_tick import fused_fns, reference_chunk
+
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+
+    @jax.jit
+    def fused_campaign():
+        # Same multi-controller idiom as the XLA campaign: state materializes
+        # as addressable shards under a sharding constraint, never as a
+        # host-side global array.
+        state = constrain(init_state(cfg))
+        plan = constrain(init_plan(cfg))
+
+        def local_fused(st, pln):
+            blk = jax.lax.axis_index(INSTANCES_AXIS)
+            return reference_chunk(
+                st, jnp.int32(cfg.seed), pln, cfg.fault, 32,
+                apply_fn, mask_fn, blk_id=blk,
+            )
+
+        state = jax.shard_map(
+            local_fused, mesh=mesh,
+            in_specs=(spec_of(state), spec_of(plan)),
+            out_specs=spec_of(state), check_vma=False,
+        )(state, plan)
+        return {
+            "chosen": state.learner.chosen.sum(),
+            "violations": state.learner.violations.sum(),
+            "evictions": state.learner.evictions.sum(),
+            "tick": state.tick,
+        }
+
+    out["fused"] = {
+        k: int(v) for k, v in jax.device_get(fused_campaign()).items()
+    }
     out["process"] = pid
     print(json.dumps(out), flush=True)
 

@@ -87,3 +87,29 @@ def test_two_process_rendezvous_smoke():
     assert outs[0]["violations"] == 0
     assert outs[0]["tick"] == 32
     assert outs[0]["chosen"] > 0
+
+    # The child also ran the fused engine's stream over the process-spanning
+    # 4-device mesh (VERDICT r3 #6; stream via reference_chunk + axis_index
+    # block ids — _dist_child.py documents why interpret-mode Pallas cannot
+    # run multi-process).  Global block ids are mesh-invariant at a fixed
+    # block, so the 2-process run must equal a single-process UNSHARDED
+    # fused run at block=16 (= the child's local shard) bit-for-bit —
+    # validating the block-offset arithmetic across process boundaries.
+    import jax.numpy as jnp
+
+    from paxos_tpu.harness.run import init_plan, init_state
+    from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+
+    cfg = config2_dueling_drop(n_inst=64, seed=3)
+    st = FUSED_CHUNKS["paxos"](
+        init_state(cfg), jnp.int32(cfg.seed), init_plan(cfg), cfg.fault, 32,
+        block=16, interpret=True,
+    )
+    expected = {
+        "chosen": int(st.learner.chosen.sum()),
+        "violations": int(st.learner.violations.sum()),
+        "evictions": int(st.learner.evictions.sum()),
+        "tick": int(st.tick),
+    }
+    assert outs[0]["fused"] == expected, (outs[0]["fused"], expected)
+    assert expected["violations"] == 0 and expected["chosen"] > 0

@@ -46,6 +46,88 @@ def test_soak_reports_liveness():
     assert clean["decided_frac_mean"] == 1.0
 
 
+def test_soak_gates_longlog_replication_rate():
+    """VERDICT r3 #8: a long-log soak must GATE the replication rate, not
+    just report it — a 2x replication slowdown previously only moved a
+    statistic nobody failed on.  A healthy mini-soak reports the rate and
+    passes a band below it; the same run judged against a band above the
+    measured rate must say replication_ok=False (anti-vacuity: the gate can
+    actually fire)."""
+    from paxos_tpu.harness.config import config3_long
+
+    cfg = config3_long(n_inst=64, seed=2, log_total=24, window=8)
+    rounds = 2 * 64 * 64  # two campaigns of 64 ticks
+    healthy = soak(
+        cfg, target_rounds=rounds, ticks_per_seed=64, chunk=16,
+        min_slots_per_lane_tick=1e-4,
+    )
+    assert healthy["slots_replicated"] > 0
+    assert healthy["slots_per_lane_tick_min"] > 0
+    assert (healthy["slots_per_lane_tick_mean"]
+            >= healthy["slots_per_lane_tick_min"])
+    assert healthy["replication_ok"] is True
+
+    rate = healthy["slots_per_lane_tick_min"]
+    gated = soak(
+        cfg, target_rounds=rounds, ticks_per_seed=64, chunk=16,
+        min_slots_per_lane_tick=rate * 2,  # pretend the recorded rate was 2x
+    )
+    assert gated["replication_ok"] is False, (
+        "a sub-band replication rate must fail the gate"
+    )
+
+    # Non-long-log configs must not grow replication fields at all.
+    plain = soak(
+        config2_dueling_drop(n_inst=128, seed=1),
+        target_rounds=128 * 32, ticks_per_seed=32, chunk=16,
+    )
+    assert "slots_replicated" not in plain
+    assert "replication_ok" not in plain
+
+
+def test_cli_soak_band_derivation_and_exit_codes(capsys):
+    """The cmd_soak wiring around the gate (VERDICT r3 #8 + review): the
+    auto band must respect BOTH achievable-rate ceilings (whole log done:
+    log_total/ticks_per_seed; compaction cadence: window/chunk), a healthy
+    coarse-chunk soak must exit 0, an explicit impossible band must exit 3,
+    and --min-replication on a non-long-log config must be refused."""
+    import json
+
+    from paxos_tpu.harness.cli import main
+
+    # Coarse chunk: the achievable ceiling is window/chunk = 16/128 = 0.125,
+    # BELOW 0.7x the recorded 0.249 — the auto band must shrink to match,
+    # so this healthy run exits 0 (pre-fix: exit 3 at band 0.1743).
+    rc = main([
+        "--platform", "cpu", "soak", "--config", "config3long", "--engine",
+        "xla", "--n-inst", "64", "--target-rounds", "8192",
+        "--ticks-per-seed", "128", "--chunk", "128",
+    ])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, report
+    assert report["replication_band"] == round(0.7 * (16 / 128), 6)
+    assert report["replication_ok"] is True
+
+    # The exit-3 leg: a band above the mathematical ceiling cannot pass.
+    rc = main([
+        "--platform", "cpu", "soak", "--config", "config3long", "--engine",
+        "xla", "--n-inst", "64", "--target-rounds", "4096",
+        "--ticks-per-seed", "64", "--chunk", "64", "--min-replication", "0.9",
+    ])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 3
+    assert report["replication_ok"] is False
+
+    # Misuse: an explicit band on a config that never reports a replication
+    # rate must be refused, not silently ignored (vacuous exit 0).
+    rc = main([
+        "--platform", "cpu", "soak", "--config", "config2", "--engine",
+        "xla", "--n-inst", "64", "--target-rounds", "1024",
+        "--min-replication", "0.2",
+    ])
+    assert rc == 1
+
+
 def test_soak_retries_transient_backend_errors(monkeypatch):
     """A transient backend failure (tunnel remote-compile 500s) mid-soak
     must retry the campaign — an exact replay, campaigns being
