@@ -187,6 +187,15 @@ def soak(
         say(f"seed {scfg.seed}: {rounds:.3e} rounds, {violations} violations, "
             f"{report['stuck_lanes']} stuck")
     dt = time.perf_counter() - t0
+    if min_slots_per_lane_tick is not None and not rep_rates:
+        # The gate would otherwise be silently inert (no campaign reported
+        # slots_replicated), and report.get("replication_ok", True) would
+        # read as a vacuous pass — refuse at the library layer so every
+        # caller is protected, not just the CLI (which pre-validates).
+        raise ValueError(
+            "min_slots_per_lane_tick set but the config reports no "
+            "replication rate (not a long-log config)"
+        )
     replication: dict[str, Any] = {}
     if rep_rates:
         replication = {

@@ -1,0 +1,302 @@
+"""Roofline / utilization model for both engines (VERDICT r3 #1).
+
+Answers "how close to the chip's ceiling is each sweep case?" with three
+measurements and one static analysis:
+
+1. **Op census** (static): count the VPU work one protocol tick compiles to —
+   walk the jaxpr of ``apply_tick`` + ``counter_masks`` at the fused block's
+   shapes and tally elementwise-ALU output elements, reduction input
+   elements, and layout-op elements per instance-tick.  This is the work XLA
+   *must* schedule on the 8x128 VPU (int32 lanes); fusion can eliminate
+   layout ops but not ALU math.
+2. **VPU ceiling** (measured): a Pallas kernel with the fused engine's exact
+   structure (state resident in VMEM, a serial tick loop, elementwise int32
+   ops over (8, block) tiles) but pure ALU chains — the attainable
+   int32-op/s ceiling for THIS kernel shape, measured on the chip rather
+   than taken from a spec sheet.
+3. **HBM ceiling** (measured): a big jnp copy — the streaming bound the XLA
+   engine (whole state through HBM every tick) runs against.
+
+Utilization = measured throughput x ops-per-lane-tick / VPU ceiling (fused)
+or x bytes-per-lane-tick / HBM ceiling (XLA).  Recorded in BASELINE.md's
+utilization table; the fused multipaxos "gap" question (169.6M vs 377.9M
+r/s) is answered by comparing WORK per tick, not just throughput.
+
+Usage (TPU for the measured legs; census-only works anywhere):
+
+    python scripts/roofline.py                  # census + ceilings + table
+    python scripts/roofline.py --census-only    # no TPU needed
+    python scripts/roofline.py --record ROOFLINE.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Primitive classes for the census.  ALU = one VPU op per output element;
+# REDUCE = roughly one op per INPUT element (tree-reduced on the VPU);
+# LAYOUT = copies/moves the compiler can often fold away (tracked separately
+# so the ALU count is a lower bound on scheduled work, not an upper).
+ALU = {
+    "add", "sub", "mul", "max", "min", "and", "or", "xor", "not", "neg",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "select_n", "eq", "ne", "lt", "le", "gt", "ge", "convert_element_type",
+    "rem", "div", "clamp", "population_count", "sign", "abs",
+}
+REDUCE = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_and", "reduce_or",
+    "argmax", "argmin", "reduce_prod",
+}
+LAYOUT = {
+    "broadcast_in_dim", "reshape", "transpose", "slice", "concatenate",
+    "iota", "squeeze", "dynamic_slice", "dynamic_update_slice", "pad",
+    "rev", "copy",
+}
+
+
+def _elems(v) -> int:
+    return int(np.prod(v.aval.shape)) if v.aval.shape else 1
+
+
+def census_jaxpr(jaxpr, counts):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        nested = [
+            p for p in eqn.params.values()
+            if hasattr(p, "eqns") or hasattr(p, "jaxpr")
+        ]
+        if nested:
+            for p in nested:
+                census_jaxpr(getattr(p, "jaxpr", p), counts)
+            continue
+        if name in ALU:
+            counts["alu"] += sum(_elems(v) for v in eqn.outvars)
+        elif name in REDUCE:
+            counts["reduce"] += sum(_elems(v) for v in eqn.invars)
+        elif name in LAYOUT:
+            counts["layout"] += sum(_elems(v) for v in eqn.outvars)
+        else:
+            counts.setdefault("other", {}).setdefault(name, 0)
+            counts["other"][name] += sum(_elems(v) for v in eqn.outvars)
+    return counts
+
+
+def tick_census(cfg, block: int) -> dict:
+    """Per-instance-tick op counts for a config's fused tick at ``block``."""
+    import dataclasses
+
+    from paxos_tpu.harness.run import init_plan, init_state
+    from paxos_tpu.kernels.fused_tick import fused_fns
+
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    small = dataclasses.replace(cfg, n_inst=block)
+    state, plan = init_state(small), init_plan(small)
+
+    def tick(st):
+        masks = mask_fn(cfg.fault, jnp.int32(1), st)
+        return apply_fn(st, masks, plan, cfg.fault)
+
+    closed = jax.make_jaxpr(tick)(state)
+    counts = census_jaxpr(closed.jaxpr, {"alu": 0, "reduce": 0, "layout": 0})
+    state_bytes = sum(
+        np.prod(l.shape) * l.dtype.itemsize for l in jax.tree.leaves(state)
+        if getattr(l, "ndim", 0)
+    )
+    return {
+        "alu_per_lane_tick": counts["alu"] / block,
+        "reduce_per_lane_tick": counts["reduce"] / block,
+        "layout_per_lane_tick": counts["layout"] / block,
+        "other": {k: v / block for k, v in counts.get("other", {}).items()},
+        "state_bytes_per_lane": float(state_bytes) / block,
+    }
+
+
+# ---- Measured ceilings ------------------------------------------------------
+
+_PROBE_OPS_PER_ITER = 8  # keep in sync with the kernel body below
+
+# The axon tunnel adds ~110 ms of FIXED latency to every dispatch+readback
+# (measured; independent of payload size), which would swamp any one-shot
+# probe.  Both ceilings therefore time the SAME program at two iteration
+# counts and divide the work delta by the time delta — the overhead cancels
+# exactly, the same discipline the bench uses (amortize, then best-of-N).
+
+
+def _delta_time(make_call, work_of, k1: int, k2: int, reps: int) -> float:
+    """work/sec from the (k2 - k1) iteration delta; overhead-free."""
+    c1, c2 = make_call(k1), make_call(k2)
+    c1()
+    c2()  # compile + warm both
+    best = float("inf")
+    for _r in range(reps):
+        t0 = time.perf_counter()
+        c1()
+        t1 = time.perf_counter()
+        c2()
+        t2 = time.perf_counter()
+        best = min(best, (t2 - t1) - (t1 - t0))
+    return (work_of(k2) - work_of(k1)) / best
+
+
+def vpu_ceiling(block: int = 1024, rows: int = 256, grid: int = 16,
+                reps: int = 5) -> float:
+    """Attainable int32 VPU ops/sec for a fused-engine-shaped kernel.
+
+    Mirrors the fused tick's structure — VMEM-resident carry, a serial
+    fori_loop over "ticks", elementwise int32 ops — with ample ILP per op
+    ((rows, block) = 2048 vregs of independent lanes; a narrow dependent
+    chain measures op LATENCY, ~12x below throughput).  The body is 8
+    dependent ALU ops per element per iteration (adds, xors, shifts, a
+    mul+max) matching the protocol mix.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    x = jnp.ones((rows, block * grid), jnp.int32)
+
+    def make_call(iters):
+        def kern(x_ref, o_ref):
+            def body(i, x):
+                x = x + jnp.int32(-1640531527)        # 1 (0x9E3779B9 as i32)
+                x = x ^ (x << 13)                     # 2 (xor + shift)
+                x = x ^ (x >> 7)                      # 2
+                x = jnp.maximum(x, x * jnp.int32(5))  # 2 (mul + max)
+                return x + i                          # 1  -> 8 ops total
+
+            o_ref[...] = jax.lax.fori_loop(0, iters, body, x_ref[...])
+
+        call = pl.pallas_call(
+            kern,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((rows, block), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((rows, block), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        )
+        return lambda: int(call(x)[0, 0])  # readback = the only real sync
+
+    def work_of(iters):
+        return rows * block * grid * iters * _PROBE_OPS_PER_ITER
+
+    return _delta_time(make_call, work_of, 1024, 9216, reps)
+
+
+def hbm_ceiling(mb: int = 512, reps: int = 5) -> float:
+    """Attainable HBM streaming bytes/sec (read+write) via chained big adds.
+
+    Each iteration reads and writes the whole ``mb``-MiB array (far beyond
+    VMEM, so every round trips HBM); iteration-count delta-timing cancels
+    the tunnel's fixed dispatch+readback latency.
+    """
+    n = mb * (1 << 20) // 4
+    x = jnp.ones((n,), jnp.int32)
+
+    def make_call(iters):
+        @jax.jit
+        def f(a):
+            def body(i, y):
+                return y + 1
+
+            return jax.lax.fori_loop(0, iters, body, a)
+
+        return lambda: int(f(x)[0])
+
+    def work_of(iters):
+        return 2 * n * 4 * iters  # read + write per iteration
+
+    return _delta_time(make_call, work_of, 8, 72, reps)
+
+
+# ---- Table ------------------------------------------------------------------
+
+
+def build_table(census_only: bool, sweep_path: str) -> dict:
+    from bench import _configs
+    from paxos_tpu.kernels.fused_tick import fused_fns
+
+    on_tpu = (not census_only) and jax.devices()[0].platform == "tpu"
+    out: dict = {"platform": jax.devices()[0].platform if on_tpu else "census"}
+
+    if on_tpu:
+        out["vpu_ops_per_sec"] = vpu_ceiling()
+        out["hbm_bytes_per_sec"] = hbm_ceiling()
+
+    recorded = {}
+    if os.path.exists(sweep_path):
+        for c in json.loads(open(sweep_path).read()):
+            if c["platform"] == "tpu":
+                recorded[(c["case"], c["engine"])] = c["value"]
+
+    uniq: dict = {}
+    for name, cfg, _eng, _chunk in _configs("tpu"):
+        uniq.setdefault(name, cfg)
+    rows = []
+    for name, cfg in uniq.items():
+        _, _, dblk = fused_fns(cfg.protocol)
+        cen = tick_census(cfg, dblk)
+        row = {"case": name, "block": dblk, **cen}
+        for engine in ("fused", "xla"):
+            val = recorded.get((name, engine))
+            if val is None:
+                continue
+            row[f"{engine}_rps"] = val
+            if engine == "fused" and "vpu_ops_per_sec" in out:
+                ops = val * (cen["alu_per_lane_tick"]
+                             + cen["reduce_per_lane_tick"])
+                row["fused_alu_ops_per_sec"] = ops
+                row["fused_vpu_utilization"] = ops / out["vpu_ops_per_sec"]
+            if engine == "xla" and "hbm_bytes_per_sec" in out:
+                # The XLA engine streams the full state through HBM twice a
+                # tick (scan carry in + out); masks/temporaries add more, so
+                # this is a LOWER bound on its achieved bandwidth.
+                by = val * 2 * cen["state_bytes_per_lane"]
+                row["xla_hbm_bytes_per_sec"] = by
+                row["xla_hbm_utilization"] = by / out["hbm_bytes_per_sec"]
+        rows.append(row)
+    out["cases"] = rows
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--census-only", action="store_true",
+                    help="skip the TPU-measured ceilings")
+    ap.add_argument("--sweep", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_SWEEP.json"))
+    ap.add_argument("--record", default=None)
+    args = ap.parse_args()
+
+    out = build_table(args.census_only, args.sweep)
+    if "vpu_ops_per_sec" in out:
+        print(f"# VPU ceiling: {out['vpu_ops_per_sec']:.3e} int32 ops/s   "
+              f"HBM ceiling: {out['hbm_bytes_per_sec'] / 1e9:.0f} GB/s")
+    for r in out["cases"]:
+        line = (f"{r['case']:22s} alu/lane-tick {r['alu_per_lane_tick']:8.1f} "
+                f"state {r['state_bytes_per_lane']:7.1f} B")
+        if "fused_vpu_utilization" in r:
+            line += (f"  fused {r['fused_rps'] / 1e6:6.1f}M r/s = "
+                     f"{r['fused_vpu_utilization'] * 100:5.1f}% VPU")
+        if "xla_hbm_utilization" in r:
+            line += (f"  xla {r['xla_rps'] / 1e6:5.1f}M = "
+                     f"{r['xla_hbm_utilization'] * 100:5.1f}% HBM")
+        print(line)
+    print(json.dumps(out))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(out, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
