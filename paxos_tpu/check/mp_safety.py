@@ -2,10 +2,21 @@
 
 The same agreement oracle as :mod:`paxos_tpu.check.safety`, lifted to a log
 axis: every (instance, slot) pair is its own consensus instance, tracked by
-a K-row (ballot, value) -> voter-bitmask table.  Accept events carry a slot
-index; the fold is an unrolled loop over the (small) acceptors axis with
-one-hot slot masks — fixed shapes, instance-minor layout (L, K, I), no
-gathers with dynamic extents.
+a K-row table per slot.  Accept events carry a slot index; the fold is an
+unrolled loop over the (small) acceptors axis with one-hot slot masks —
+fixed shapes, instance-minor layout (L, K, I), no gathers with dynamic
+extents.
+
+Rows store PACKED (ballot, value) pairs (``core.mp_state.pack_bv``: one
+int32, ballot in the high bits) next to the voter bitmask: the roofline
+work (BASELINE.md utilization table) showed the wide passes here are the
+fused MP tick's dominant cost (58% by ablation pre-packing), and packing
+halves both the row compares (one ``lt_bv`` probe instead of bal + val)
+and the insert writes.  The eviction victim is the row with the minimum
+packed pair — i.e. the minimum ballot, tie-broken by value, where the old
+code broke ties by row order; either policy is sound (eviction choice is
+checker bookkeeping, counted either way) and the scalar interpreter
+mirrors this one exactly.
 """
 
 from __future__ import annotations
@@ -13,7 +24,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from paxos_tpu.check.safety import first_true
-from paxos_tpu.core.mp_state import MPLearnerState
+from paxos_tpu.core.mp_state import MPLearnerState, bv_bal, bv_val, pack_bv
 from paxos_tpu.utils.bitops import popcount
 
 
@@ -27,15 +38,28 @@ def mp_learner_observe(
     quorum: int,
 ) -> MPLearnerState:
     n_acc = ev_flag.shape[0]
-    n_slots, k, _ = learner.lt_bal.shape
-    lt_bal, lt_val, lt_mask = learner.lt_bal, learner.lt_val, learner.lt_mask
+    n_slots, k, n_inst = learner.lt_bv.shape
     evictions = learner.evictions
     slot_ids = jnp.arange(n_slots, dtype=jnp.int32)[:, None]  # (L, 1)
 
-    pre_chosen_rows = popcount(lt_mask) >= quorum  # (L, K, I)
+    # The fold runs on the table viewed as (L*K, I): every wide pass is then
+    # a full-tile (8, 128)-aligned elementwise op over the same two arrays,
+    # where both the original direct (L, K, I) fold and the gathered (K, I)
+    # formulation spend their time in half-empty (K=4) sublane tiles and
+    # mixed-rank broadcasts (measured: the learner was 61% of the fused MP
+    # tick even with packed rows).  The flat view is layout-free (instances
+    # stay minor) and each row's slot is a static iota — the one-hot becomes
+    # a direct compare, no broadcast.
+    lk = n_slots * k
+    lt_bv = learner.lt_bv.reshape(lk, n_inst)
+    lt_mask = learner.lt_mask.reshape(lk, n_inst)
+    row_slot = (jnp.arange(lk, dtype=jnp.int32) // k)[:, None]  # (LK, 1)
+
+    pre_chosen_rows = popcount(lt_mask) >= quorum  # (LK, I)
 
     for a in range(n_acc):
         b, s, v = ev_bal[a], ev_slot[a], ev_val[a]  # (I,)
+        bv = pack_bv(b, v)
         f = ev_flag[a] & (b > 0)
         oh_slot = s[None] == slot_ids  # (L, I)
 
@@ -47,47 +71,41 @@ def mp_learner_observe(
         cv_s = jnp.where(oh_slot, learner.chosen_val, 0).sum(axis=0)  # (I,)
         f = f & ~(ch_s & (v == cv_s))
 
-        # GATHER the event slot's K rows to (K, I), decide there, then make
-        # one (L, K, I) write pass per field.  Bit-identical to the direct
-        # (L, K, I) fold (the gathered rows ARE the target slot's rows —
-        # other slots can't match through the one-hot), but the wide table
-        # is touched ~9x per acceptor instead of ~14x; measured via
-        # scripts/ablate_fused.py, the learner is the fused MP tick's
-        # dominant component (58% at the r3 shapes), so these passes are
-        # the throughput.
-        ohk = oh_slot[:, None]  # (L, 1, I)
-        row_bal = jnp.where(ohk, lt_bal, 0).sum(axis=0)  # (K, I)
-        row_val = jnp.where(ohk, lt_val, 0).sum(axis=0)  # (K, I)
+        oh_row = s[None] == row_slot  # (LK, I)
+        match = oh_row & (lt_bv == bv[None]) & f[None]
+        any_match = match.any(axis=0)  # (I,)
 
-        match_row = (row_bal == b[None]) & (row_val == v[None]) & f[None]
-        any_match = match_row.any(axis=0)  # (I,)
-
-        # Candidate insertion row: the min-ballot row of the event's slot.
-        min_bal = row_bal.min(axis=0)  # (I,)
-        ins_row = first_true(row_bal == min_bal[None], axis=0)  # (K, I)
-        can_insert = (min_bal == 0) | (b > min_bal)
+        # Candidate insertion row: the min-packed (= min-ballot, value
+        # tiebreak) row of the event's slot; 0 = an empty row.
+        masked = jnp.where(oh_row, lt_bv, jnp.int32(0x7FFFFFFF))
+        min_bv = masked.min(axis=0)  # (I,)
+        can_insert = (min_bv == 0) | (b > bv_bal(min_bv))
         do_insert = f & ~any_match & can_insert
         missed = f & ~any_match & ~can_insert
         bit = jnp.asarray(1 << a, jnp.int32)
 
-        match = ohk & match_row[None]  # (L, K, I)
-        ins = ohk & (ins_row & do_insert[None])[None]  # (L, K, I)
+        ins = first_true(
+            oh_row & (lt_bv == min_bv[None]), axis=0
+        ) & do_insert[None]  # (LK, I): first min-packed row of the slot
         lt_mask = jnp.where(
             ins, bit, jnp.where(match, lt_mask | bit, lt_mask)
         )
-        lt_bal = jnp.where(ins, b[None, None], lt_bal)
-        lt_val = jnp.where(ins, v[None, None], lt_val)
+        lt_bv = jnp.where(ins, bv[None], lt_bv)
         evictions = (
             evictions
             + missed.astype(jnp.int32)
-            + (do_insert & (min_bal != 0)).astype(jnp.int32)
+            + (do_insert & (min_bv != 0)).astype(jnp.int32)
         )
 
+    lt_bv = lt_bv.reshape(n_slots, k, n_inst)
+    lt_mask = lt_mask.reshape(n_slots, k, n_inst)
+    pre_chosen_rows = pre_chosen_rows.reshape(n_slots, k, n_inst)
     chosen_rows = popcount(lt_mask) >= quorum  # (L, K, I)
     newly = chosen_rows & ~pre_chosen_rows
     any_new = newly.any(axis=1)  # (L, I)
 
-    first_val = jnp.where(first_true(newly, axis=1), lt_val, 0).sum(axis=1)  # (L, I)
+    lt_v = bv_val(lt_bv)  # (L, K, I): one unpack pass shared below
+    first_val = jnp.where(first_true(newly, axis=1), lt_v, 0).sum(axis=1)  # (L, I)
 
     chosen_val = jnp.where(
         learner.chosen, learner.chosen_val, jnp.where(any_new, first_val, 0)
@@ -98,13 +116,12 @@ def mp_learner_observe(
     )
 
     viol = (
-        (newly & (lt_val != chosen_val[:, None]) & chosen[:, None])
+        (newly & (lt_v != chosen_val[:, None]) & chosen[:, None])
         .sum(axis=(0, 1), dtype=jnp.int32)
     )
 
     return learner.replace(
-        lt_bal=lt_bal,
-        lt_val=lt_val,
+        lt_bv=lt_bv,
         lt_mask=lt_mask,
         chosen=chosen,
         chosen_val=chosen_val,

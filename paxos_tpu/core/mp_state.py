@@ -33,19 +33,59 @@ FOLLOW = 0  # passive: watching progress, lease ticking
 CANDIDATE = 1  # phase-1 outstanding
 LEAD = 2  # distinguished leader, driving slots
 
+# ---- Packed (ballot, value) pairs -------------------------------------------
+#
+# Every slot-indexed (ballot, value) pair in the Multi-Paxos state rides in
+# ONE int32: ``bal << 16 | val``.  The round-4 roofline (BASELINE.md
+# utilization table) located the fused MP throughput gap in the wide-table
+# passes over exactly these arrays — the (L, K, I) learner rows, the
+# (P, A, L, I) promise payloads, the (A, L, I) acceptor log — and packing
+# halves both their VMEM footprint and the number of gather/write passes
+# per tick.  It also strengthens the recovery fold: the per-slot "highest
+# accepted ballot, its value" max-trick over two arrays becomes ONE lexical
+# max over packed pairs (bal in the high bits dominates; at equal ballot
+# the values agree — one value per (slot, ballot), equivocator payloads
+# zeroed — so the value tiebreak never changes the outcome).
+#
+# Bit budget: ``val`` is ``(pid + 1) * 1000 + global_slot`` <= 8*1000 + 255
+# < 2^16 (``own_slot_value``; MAX_PROPOSERS = 8, log_total <= 256) and
+# ``bal = rnd * 8 + pid + 1`` needs rnd <= 4094 to stay under 2^15 —
+# elections cost at least a lease period (~24 ticks), so even a 4096-tick
+# campaign peaks near rnd ~ 170.  Packed pairs are non-negative int32s, so
+# integer compares order them lexicographically by (bal, val) and 0 is
+# still the NIL sentinel.
+#
+# The helpers work on Python ints too — the scalar interpreter
+# (cpu_ref/interp.py) uses THESE functions, so the packed layout cannot
+# drift between the kernels and the differential oracle.
+
+BV_SHIFT = 16
+BV_VAL_MASK = (1 << BV_SHIFT) - 1
+
+
+def pack_bv(bal, val):
+    """One int32 per (ballot, value) pair; 0 stays the NIL sentinel."""
+    return (bal << BV_SHIFT) | val
+
+
+def bv_bal(bv):
+    return bv >> BV_SHIFT
+
+
+def bv_val(bv):
+    return bv & BV_VAL_MASK
+
 
 @struct.dataclass
 class MPAcceptorState:
     promised: jnp.ndarray  # (A, I) int32 — one promise covers every slot
-    log_bal: jnp.ndarray  # (A, L, I) int32 accepted ballot per slot
-    log_val: jnp.ndarray  # (A, L, I) int32 accepted value per slot
+    log: jnp.ndarray  # (A, L, I) int32 packed accepted (ballot, value) per slot
 
     @classmethod
     def init(cls, n_inst: int, n_acc: int, log_len: int) -> "MPAcceptorState":
         return cls(
             promised=jnp.zeros((n_acc, n_inst), jnp.int32),
-            log_bal=jnp.zeros((n_acc, log_len, n_inst), jnp.int32),
-            log_val=jnp.zeros((n_acc, log_len, n_inst), jnp.int32),
+            log=jnp.zeros((n_acc, log_len, n_inst), jnp.int32),
         )
 
 
@@ -55,8 +95,7 @@ class MPProposerState:
     phase: jnp.ndarray  # (P, I) int32 in {FOLLOW, CANDIDATE, LEAD}
     heard: jnp.ndarray  # (P, I) int32 acceptor bitmask (phase-1 or current slot)
     commit_idx: jnp.ndarray  # (P, I) int32 next slot this leader drives
-    recov_bal: jnp.ndarray  # (P, L, I) int32 highest accepted ballot per slot (from promises)
-    recov_val: jnp.ndarray  # (P, L, I) int32 its value
+    recov_bv: jnp.ndarray  # (P, L, I) int32 packed highest accepted (bal, val) per slot
     lease_timer: jnp.ndarray  # (P, I) int32 ticks since observed progress
     last_chosen_count: jnp.ndarray  # (P, I) int32 chosen slots last observed
     candidate_timer: jnp.ndarray  # (P, I) int32 ticks spent as candidate
@@ -73,8 +112,7 @@ class MPProposerState:
             phase=z(),  # FOLLOW
             heard=z(),
             commit_idx=z(),
-            recov_bal=jnp.zeros((n_prop, log_len, n_inst), jnp.int32),
-            recov_val=jnp.zeros((n_prop, log_len, n_inst), jnp.int32),
+            recov_bv=jnp.zeros((n_prop, log_len, n_inst), jnp.int32),
             # Head start: the first election should not wait a full lease.
             lease_timer=jnp.full((n_prop, n_inst), lease_init, jnp.int32),
             last_chosen_count=z(),
@@ -90,8 +128,7 @@ class MPLearnerState:
     Multi-Paxos uses few ballots per slot; evictions are counted).
     """
 
-    lt_bal: jnp.ndarray  # (L, K, I) int32
-    lt_val: jnp.ndarray  # (L, K, I) int32
+    lt_bv: jnp.ndarray  # (L, K, I) int32 packed (ballot, value) per row
     lt_mask: jnp.ndarray  # (L, K, I) int32
     chosen: jnp.ndarray  # (L, I) bool
     chosen_val: jnp.ndarray  # (L, I) int32
@@ -105,8 +142,7 @@ class MPLearnerState:
             return jnp.zeros((log_len, k, n_inst), jnp.int32)
 
         return cls(
-            lt_bal=zk(),
-            lt_val=zk(),
+            lt_bv=zk(),
             lt_mask=zk(),
             chosen=jnp.zeros((log_len, n_inst), jnp.bool_),
             chosen_val=jnp.zeros((log_len, n_inst), jnp.int32),
@@ -122,16 +158,14 @@ class PromiseBuf:
 
     present: jnp.ndarray  # (P, A, I) bool
     bal: jnp.ndarray  # (P, A, I) int32 — the promised ballot
-    pb: jnp.ndarray  # (P, A, L, I) int32 — accepted ballot per log slot
-    pv: jnp.ndarray  # (P, A, L, I) int32 — accepted value per log slot
+    p_bv: jnp.ndarray  # (P, A, L, I) int32 — packed accepted (bal, val) per slot
 
     @classmethod
     def empty(cls, n_inst: int, n_prop: int, n_acc: int, log_len: int) -> "PromiseBuf":
         return cls(
             present=jnp.zeros((n_prop, n_acc, n_inst), jnp.bool_),
             bal=jnp.zeros((n_prop, n_acc, n_inst), jnp.int32),
-            pb=jnp.zeros((n_prop, n_acc, log_len, n_inst), jnp.int32),
-            pv=jnp.zeros((n_prop, n_acc, log_len, n_inst), jnp.int32),
+            p_bv=jnp.zeros((n_prop, n_acc, log_len, n_inst), jnp.int32),
         )
 
 
@@ -200,4 +234,4 @@ class MultiPaxosState:
 
     @property
     def log_len(self) -> int:
-        return self.acceptor.log_bal.shape[1]
+        return self.acceptor.log.shape[1]

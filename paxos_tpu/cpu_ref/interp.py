@@ -767,9 +767,17 @@ def _mp_learner_fold(
     tick: int,
     quorum: int,
 ) -> None:
-    """check.mp_safety.mp_learner_observe, scalar: per-slot (b, v) tables."""
-    L = len(lrn["lt_bal"])
-    K = len(lrn["lt_bal"][0])
+    """check.mp_safety.mp_learner_observe, scalar: per-slot packed tables.
+
+    Rows are packed (ballot, value) pairs (``core.mp_state.pack_bv`` — the
+    SAME helper the kernels use, so the layout cannot drift); the eviction
+    victim is the min-packed row (min ballot, value tiebreak), mirroring
+    the kernel's ``row_bv.min`` policy.
+    """
+    from paxos_tpu.core.mp_state import bv_bal, bv_val, pack_bv
+
+    L = len(lrn["lt_bv"])
+    K = len(lrn["lt_bv"][0])
     pre_chosen = copy.deepcopy(lrn["chosen"])  # events all see pre-tick chosen
     pre_val = copy.deepcopy(lrn["chosen_val"])
     pre = [
@@ -784,20 +792,20 @@ def _mp_learner_fold(
         # cannot disagree; keeps eviction pressure meaningful).
         if pre_chosen[s] and v == pre_val[s]:
             continue
-        row_bal = lrn["lt_bal"][s]
-        match = [row_bal[k] == b and lrn["lt_val"][s][k] == v for k in range(K)]
+        row_bv = lrn["lt_bv"][s]
+        bv = pack_bv(b, v)
+        match = [row_bv[k] == bv for k in range(K)]
         if any(match):
             for k in range(K):
                 if match[k]:
                     lrn["lt_mask"][s][k] |= 1 << a
             continue
-        min_bal = min(row_bal)
-        if min_bal == 0 or b > min_bal:
-            k = row_bal.index(min_bal)
-            row_bal[k] = b
-            lrn["lt_val"][s][k] = v
+        min_bv = min(row_bv)
+        if min_bv == 0 or b > bv_bal(min_bv):
+            k = row_bv.index(min_bv)
+            row_bv[k] = bv
             lrn["lt_mask"][s][k] = 1 << a
-            if min_bal != 0:
+            if min_bv != 0:
                 lrn["evictions"] += 1
         else:
             lrn["evictions"] += 1
@@ -809,13 +817,14 @@ def _mp_learner_fold(
         if not lrn["chosen"][s] and any(newly):
             first = next(k for k in range(K) if newly[k])
             lrn["chosen"][s] = True
-            lrn["chosen_val"][s] = lrn["lt_val"][s][first]
+            lrn["chosen_val"][s] = bv_val(lrn["lt_bv"][s][first])
             lrn["chosen_tick"][s] = tick
         if lrn["chosen"][s]:
             lrn["violations"] += sum(
                 1
                 for k in range(K)
-                if newly[k] and lrn["lt_val"][s][k] != lrn["chosen_val"][s]
+                if newly[k]
+                and bv_val(lrn["lt_bv"][s][k]) != lrn["chosen_val"][s]
             )
 
 
@@ -826,9 +835,11 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
     ``m`` is a :func:`lane_of` slice of ``MPTickMasks`` (note the per-kind
     reply delivery masks and the jitter draw, absent from paxos' masks).
     """
+    from paxos_tpu.core.mp_state import bv_bal, bv_val, pack_bv
+
     A = len(st["acceptor"]["promised"])
     P = len(st["proposer"]["bal"])
-    L = len(st["acceptor"]["log_bal"][0])
+    L = len(st["acceptor"]["log"][0])
     quorum = _majority(A)
     tick = st["tick"]
     acc, prop, lrn = st["acceptor"], st["proposer"], st["learner"]
@@ -838,7 +849,7 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
             if plan["crash_end"][a] == tick:
                 acc["promised"][a] = 0
                 for s in range(L):
-                    acc["log_bal"][a][s] = acc["log_val"][a][s] = 0
+                    acc["log"][a][s] = 0
 
     link = _link_fn(plan, tick, cfg)
 
@@ -884,11 +895,8 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 st["promises"]["present"][p][a] = True
                 st["promises"]["bal"][p][a] = bal
                 for s in range(L):  # full-log recovery payload (pre-update)
-                    st["promises"]["pb"][p][a][s] = (
-                        0 if eq else acc["log_bal"][a][s]
-                    )
-                    st["promises"]["pv"][p][a][s] = (
-                        0 if eq else acc["log_val"][a][s]
+                    st["promises"]["p_bv"][p][a][s] = (
+                        0 if eq else acc["log"][a][s]
                     )
             if honest_ok:
                 acc["promised"][a] = bal
@@ -898,8 +906,7 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 acc["promised"][a] = max(acc["promised"][a], bal)
             if honest_ok or eq:
                 if 0 <= slot < L:
-                    acc["log_bal"][a][slot] = bal
-                    acc["log_val"][a][slot] = val
+                    acc["log"][a][slot] = pack_bv(bal, val)
                 events[a] = (True, bal, slot, val)
                 if _mask2(m["keep_accd"], p, a):
                     st["accepted"]["present"][p][a] = True
@@ -924,25 +931,15 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 and phase == CANDIDATE
             ):
                 heard |= 1 << a
-        # Whole-log recovery: per-slot max over valid promises (max-trick).
+        # Whole-log recovery: per-slot max over valid promises.  Packed
+        # pairs order lexicographically by (ballot, value) — one max, no
+        # value ride-along (mirrors apply_tick_mp's jnp.maximum fold).
         for s in range(L):
-            pbs = [
-                pre_prom["pb"][p][a][s]
-                if (
-                    prom_del[p][a]
-                    and pre_prom["bal"][p][a] == bal
-                    and phase == CANDIDATE
-                )
-                else 0
-                for a in range(A)
-            ]
-            cand_bal = max(pbs)
-            cand_val = max(
+            cand_bv = max(
                 (
-                    pre_prom["pv"][p][a][s]
+                    pre_prom["p_bv"][p][a][s]
                     if (
-                        pbs[a] == cand_bal
-                        and prom_del[p][a]
+                        prom_del[p][a]
                         and pre_prom["bal"][p][a] == bal
                         and phase == CANDIDATE
                     )
@@ -950,9 +947,7 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
                 )
                 for a in range(A)
             )
-            if cand_bal > prop["recov_bal"][p][s]:
-                prop["recov_bal"][p][s] = cand_bal
-                prop["recov_val"][p][s] = cand_val
+            prop["recov_bv"][p][s] = max(prop["recov_bv"][p][s], cand_bv)
         for a in range(A):
             if (
                 accd_del[p][a]
@@ -1010,7 +1005,7 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
             bal = _make_ballot(_ballot_round(bal) + 1, p)
             prop["bal"][p] = bal
             for s in range(L):
-                prop["recov_bal"][p][s] = prop["recov_val"][p][s] = 0
+                prop["recov_bv"][p][s] = 0
         if p1_done:
             prop["commit_idx"][p] = 0
         if slot_done:
@@ -1033,10 +1028,9 @@ def multipaxos_tick(st: dict, m: dict, plan: dict, cfg) -> None:
         if cfg.log_total:
             drive = drive and st["base"] + prop["commit_idx"][p] < cfg.log_total
         if drive:
-            rb = prop["recov_bal"][p][ci]
-            rv = prop["recov_val"][p][ci]
+            rbv = prop["recov_bv"][p][ci]
             # Command payloads are keyed by GLOBAL slot (base + ci).
-            pval = rv if rb > 0 else (p + 1) * 1000 + st["base"] + ci
+            pval = bv_val(rbv) if rbv > 0 else (p + 1) * 1000 + st["base"] + ci
             for a in range(A):
                 _send(st["requests"], 1, p, a, m["keep_acc"], bal, pval, ci)
 
@@ -1069,11 +1063,9 @@ def multipaxos_compact_lane(st: dict) -> tuple:
         return lst[shift:] + [fill] * shift
 
     for a in range(A):
-        acc["log_bal"][a] = sh(acc["log_bal"][a])
-        acc["log_val"][a] = sh(acc["log_val"][a])
+        acc["log"][a] = sh(acc["log"][a])
     for p in range(P):
-        prop["recov_bal"][p] = sh(prop["recov_bal"][p])
-        prop["recov_val"][p] = sh(prop["recov_val"][p])
+        prop["recov_bv"][p] = sh(prop["recov_bv"][p])
         # Mirror of compact_mp: a leader whose driven slot was compacted
         # under it re-collects votes for the (different) slot it clamps to.
         if prop["phase"][p] == LEAD and shift > prop["commit_idx"][p]:
@@ -1082,7 +1074,7 @@ def multipaxos_compact_lane(st: dict) -> tuple:
         prop["last_chosen_count"][p] = max(
             prop["last_chosen_count"][p] - shift, 0
         )
-    for key in ("lt_bal", "lt_val", "lt_mask"):
+    for key in ("lt_bv", "lt_mask"):
         # Fresh row lists (a shared fill list would alias mutations).
         lrn[key] = lrn[key][shift:] + [
             [0] * len(lrn[key][0]) for _ in range(shift)

@@ -32,7 +32,7 @@ from paxos_tpu.harness.config import SimConfig
 # shape or structure (axis order, new FaultPlan fields, ...); restore()
 # refuses snapshots from a different schema with a clear message instead of
 # a deep orbax structure error.
-LAYOUT_VERSION = "instance-minor-v4"  # v4: MultiPaxosState.base (long logs)
+LAYOUT_VERSION = "instance-minor-v5"  # v5: packed (bal, val) pairs in MP arrays
 
 
 def save(

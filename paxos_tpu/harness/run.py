@@ -43,8 +43,22 @@ def get_step_fn(protocol: str) -> Callable:
 
 def init_state(cfg: SimConfig):
     if cfg.protocol == "multipaxos":
-        from paxos_tpu.core.mp_state import MultiPaxosState
+        from paxos_tpu.core.ballot import MAX_PROPOSERS
+        from paxos_tpu.core.mp_state import BV_SHIFT, MultiPaxosState
 
+        # Packed-pair bit budget (core.mp_state): command payloads are
+        # own_slot_value(pid, base + slot) <= MAX_PROPOSERS*1000 + log_total
+        # and must fit the value field, else pack_bv would bleed value bits
+        # into the ballot and the agreement oracle would compare corrupted
+        # pairs.  Fail at config time, not via silent corruption.
+        max_val = MAX_PROPOSERS * 1000 + max(cfg.fault.log_total, cfg.log_len)
+        if max_val >= (1 << BV_SHIFT):
+            raise ValueError(
+                f"log_total={cfg.fault.log_total} overflows the packed "
+                f"(ballot, value) layout: own_slot_value can reach "
+                f"{max_val} >= 2^{BV_SHIFT}; keep log_total <= "
+                f"{(1 << BV_SHIFT) - MAX_PROPOSERS * 1000 - 1}"
+            )
         return MultiPaxosState.init(
             cfg.n_inst,
             cfg.n_prop,

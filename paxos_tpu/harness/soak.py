@@ -117,6 +117,17 @@ def soak(
     raw pre-escalation count).
     """
     say = log or (lambda s: None)
+    if min_slots_per_lane_tick is not None and not (
+        cfg.protocol == "multipaxos" and cfg.fault.log_total
+    ):
+        # Fail BEFORE the (potentially hours-long) campaign loop: only
+        # long-log configs report slots_replicated, so the gate would be
+        # silently inert and report.get("replication_ok", True) a vacuous
+        # pass for every other config.
+        raise ValueError(
+            "min_slots_per_lane_tick set but the config reports no "
+            "replication rate (not a long-log config)"
+        )
 
     rounds = 0
     violations = 0
@@ -187,15 +198,6 @@ def soak(
         say(f"seed {scfg.seed}: {rounds:.3e} rounds, {violations} violations, "
             f"{report['stuck_lanes']} stuck")
     dt = time.perf_counter() - t0
-    if min_slots_per_lane_tick is not None and not rep_rates:
-        # The gate would otherwise be silently inert (no campaign reported
-        # slots_replicated), and report.get("replication_ok", True) would
-        # read as a vacuous pass — refuse at the library layer so every
-        # caller is protected, not just the CLI (which pre-validates).
-        raise ValueError(
-            "min_slots_per_lane_tick set but the config reports no "
-            "replication rate (not a long-log config)"
-        )
     replication: dict[str, Any] = {}
     if rep_rates:
         replication = {

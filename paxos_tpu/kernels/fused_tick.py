@@ -613,13 +613,20 @@ def fused_fns(protocol: str, ablate: frozenset = frozenset()):
     if protocol == "multipaxos":
         from paxos_tpu.protocols.multipaxos import apply_tick_mp, mp_counter_masks
 
+        # 128 (the lane-tiling floor): measured best for the packed MP state
+        # (214M vs 202M r/s at 256, 181M at 1024 on config3 @ 1M lanes) —
+        # the wide (P, A, L, I)/(L, K, I) arrays make bigger blocks trade
+        # VMEM pressure for no reuse win.  Block is stream-relevant; the
+        # round-4 default change starts a fresh schedule lineage for MP
+        # (replays of pre-change campaigns must pass block=256 explicitly).
+        mp_block = 128
         if ablate:
             return (
                 functools.partial(apply_tick_mp, ablate=ablate),
                 functools.partial(mp_counter_masks, ablate=ablate),
-                256,
+                mp_block,
             )
-        return apply_tick_mp, mp_counter_masks, 256
+        return apply_tick_mp, mp_counter_masks, mp_block
     raise ValueError(f"unknown protocol: {protocol!r}")
 
 

@@ -32,7 +32,14 @@ from flax import struct
 from paxos_tpu.check.mp_safety import mp_learner_observe
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core.messages import ACCEPT, PREPARE
-from paxos_tpu.core.mp_state import CANDIDATE, FOLLOW, LEAD, MultiPaxosState
+from paxos_tpu.core.mp_state import (
+    CANDIDATE,
+    FOLLOW,
+    LEAD,
+    MultiPaxosState,
+    bv_val,
+    pack_bv,
+)
 from paxos_tpu.faults.injector import FaultConfig, FaultPlan
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
@@ -153,8 +160,7 @@ def apply_tick_mp(
         rec = plan.recovering(state.tick)
         acc = acc.replace(
             promised=jnp.where(rec, 0, acc.promised),
-            log_bal=jnp.where(rec[:, None], 0, acc.log_bal),
-            log_val=jnp.where(rec[:, None], 0, acc.log_val),
+            log=jnp.where(rec[:, None], 0, acc.log),
         )
 
     # ---- Reply delivery decided & cleared before new writes (no clobber) ----
@@ -218,21 +224,24 @@ def apply_tick_mp(
     slot_ids = jnp.arange(n_slots, dtype=jnp.int32)[None, :, None]  # (1, L, 1)
     oh_slot = msg_slot[:, None] == slot_ids  # (A, L, I)
     wr = ok_acc[:, None] & oh_slot
-    log_bal = jnp.where(wr, msg_bal[:, None], acc.log_bal)
-    log_val = jnp.where(wr, msg_val[:, None], acc.log_val)
+    log = jnp.where(wr, pack_bv(msg_bal, msg_val)[:, None], acc.log)
 
     # Promise replies carry the acceptor's full log (equivocators hide theirs).
+    # (A lax.cond gate on "any promise sent this tick" was tried here and on
+    # the recovery fold — elections are rare in steady state — but measured
+    # SLOWER on hardware: 222.6M -> 205.4M r/s on config3.  The branchy
+    # kernel costs more than the masked no-op writes it skips.)
     if "sends" not in ablate:
         prom_send = sel[PREPARE] & ok_prep[None]  # (P, A, I)
         if masks.keep_prom is not None:
             prom_send = prom_send & masks.keep_prom
-        payload_pb = jnp.where(equiv[:, None], 0, acc.log_bal)  # (A, L, I)
-        payload_pv = jnp.where(equiv[:, None], 0, acc.log_val)
+        payload_bv = jnp.where(equiv[:, None], 0, acc.log)  # (A, L, I)
         promises = promises.replace(
             present=promises.present | prom_send,
             bal=jnp.where(prom_send, msg_bal[None], promises.bal),
-            pb=jnp.where(prom_send[:, :, None], payload_pb[None], promises.pb),
-            pv=jnp.where(prom_send[:, :, None], payload_pv[None], promises.pv),
+            p_bv=jnp.where(
+                prom_send[:, :, None], payload_bv[None], promises.p_bv
+            ),
         )
 
         accd_send = sel[ACCEPT] & ok_acc[None]  # (P, A, I)
@@ -249,7 +258,7 @@ def apply_tick_mp(
         requests = state.requests
     else:
         requests = net.consume(state.requests, sel, stay=masks.dup_req)
-    acc = acc.replace(promised=promised, log_bal=log_bal, log_val=log_val)
+    acc = acc.replace(promised=promised, log=log)
 
     # ---- Learner / checker ----
     if "learner" in ablate:
@@ -284,17 +293,15 @@ def apply_tick_mp(
         prop.phase == CANDIDATE
     )[:, None]  # (P, A, I)
     heard = prop.heard | jnp.where(pv_ok, bits, 0).sum(axis=1, dtype=jnp.int32)
-    # Per-slot max-fold over acceptors; value rides along via the max-trick
-    # (at a given ballot all honest acceptors store one value per slot, and
-    # equivocators' payloads are zeroed; a zero max never improves).
-    cand_pb = jnp.where(pv_ok[:, :, None], state.promises.pb, 0)  # (P, A, L, I)
-    cand_bal = cand_pb.max(axis=1)  # (P, L, I)
-    cand_val = jnp.where(
-        (cand_pb == cand_bal[:, None]) & pv_ok[:, :, None], state.promises.pv, 0
-    ).max(axis=1)
-    improve = cand_bal > prop.recov_bal  # (P, L, I)
-    recov_bal = jnp.where(improve, cand_bal, prop.recov_bal)
-    recov_val = jnp.where(improve, cand_val, prop.recov_val)
+    # Per-slot max-fold over acceptors.  Packed pairs order lexicographically
+    # by (ballot, value), so ONE max replaces the old two-array max-trick
+    # (ballot max + value ride-along): the ballot dominates, and at equal
+    # ballot all honest acceptors store the same value per slot
+    # (equivocators' payloads are zeroed), so the value tiebreak is inert.
+    cand_bv = jnp.where(
+        pv_ok[:, :, None], state.promises.p_bv, 0
+    ).max(axis=1)  # (P, L, I)
+    recov_bv = jnp.maximum(prop.recov_bv, cand_bv)
 
     # Accepted (phase 2): only votes for the slot currently being driven.
     av_ok = (
@@ -359,8 +366,7 @@ def apply_tick_mp(
     commit_idx = jnp.where(p1_done, 0, prop.commit_idx)
     commit_idx = jnp.where(slot_done, commit_idx + 1, commit_idx)
     heard = jnp.where(p1_done | slot_done | start_elec | cand_fail | demote, 0, heard)
-    recov_bal = jnp.where(start_elec[:, None], 0, recov_bal)
-    recov_val = jnp.where(start_elec[:, None], 0, recov_val)
+    recov_bv = jnp.where(start_elec[:, None], 0, recov_bv)
     lease_timer = jnp.where(start_elec | p1_done | slot_done, 0, lease_timer)
     # Failed candidacy / demotion: retreat below the election threshold by a
     # random backoff so rivals separate instead of re-colliding every tick.
@@ -392,11 +398,12 @@ def apply_tick_mp(
         is_lead = is_lead & (state.base[None] + commit_idx < cfg.log_total)
     ci = jnp.minimum(commit_idx, n_slots - 1)  # (P, I)
     ci_hot = ci[:, None] == jnp.arange(n_slots, dtype=jnp.int32)[None, :, None]
-    rb = jnp.where(ci_hot, recov_bal, 0).sum(axis=1)  # (P, I)
-    rv = jnp.where(ci_hot, recov_val, 0).sum(axis=1)
+    rbv = jnp.where(ci_hot, recov_bv, 0).sum(axis=1)  # (P, I) packed
     # Command payloads are keyed by GLOBAL slot (base + window index), so a
     # slot's value is stable across window shifts (base is 0 in plain mode).
-    pval = jnp.where(rb > 0, rv, own_slot_value(pid, state.base[None] + ci))
+    pval = jnp.where(
+        rbv > 0, bv_val(rbv), own_slot_value(pid, state.base[None] + ci)
+    )
     if "sends" not in ablate:
         requests = net.send(
             requests, ACCEPT,
@@ -412,8 +419,7 @@ def apply_tick_mp(
         phase=phase,
         heard=heard,
         commit_idx=commit_idx,
-        recov_bal=recov_bal,
-        recov_val=recov_val,
+        recov_bv=recov_bv,
         lease_timer=lease_timer,
         last_chosen_count=last_chosen_count,
         candidate_timer=candidate_timer,
@@ -527,14 +533,12 @@ def compact_mp_body(state: MultiPaxosState):
     return (
         state.replace(
             acceptor=acc.replace(
-                log_bal=_shift_slots(acc.log_bal, shift, 1),
-                log_val=_shift_slots(acc.log_val, shift, 1),
+                log=_shift_slots(acc.log, shift, 1),
             ),
             proposer=prop.replace(
                 commit_idx=dec(prop.commit_idx),
                 last_chosen_count=dec(prop.last_chosen_count),
-                recov_bal=_shift_slots(prop.recov_bal, shift, 1),
-                recov_val=_shift_slots(prop.recov_val, shift, 1),
+                recov_bv=_shift_slots(prop.recov_bv, shift, 1),
                 # A leader whose in-progress slot was compacted under it
                 # (shift > commit_idx) clamps to window slot 0 — a DIFFERENT
                 # global slot — so ACCEPTED votes folded for the old slot
@@ -550,8 +554,7 @@ def compact_mp_body(state: MultiPaxosState):
                 ),
             ),
             learner=lrn.replace(
-                lt_bal=_shift_slots(lrn.lt_bal, shift, 0),
-                lt_val=_shift_slots(lrn.lt_val, shift, 0),
+                lt_bv=_shift_slots(lrn.lt_bv, shift, 0),
                 lt_mask=_shift_slots(lrn.lt_mask, shift, 0),
                 chosen=_shift_slots(lrn.chosen, shift, 0, fill=False),
                 chosen_val=_shift_slots(lrn.chosen_val, shift, 0),
@@ -559,8 +562,8 @@ def compact_mp_body(state: MultiPaxosState):
             ),
             requests=req,
             # In-flight promises DROP on compaction instead of shifting:
-            # their (P, A, L, I) payloads are the two largest arrays in the
-            # state, and the 17-pass shift on them dominated compaction
+            # their (P, A, L, I) packed payload is the largest array in the
+            # state, and the 17-pass shift on it dominated compaction
             # cost.  Dropping is just message loss (a candidate re-elects on
             # timeout), which the schedule space already contains — never a
             # safety event.  Replies with zero shift keep flying.

@@ -184,7 +184,11 @@ def vpu_ceiling(block: int = 1024, rows: int = 256, grid: int = 16,
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         )
-        return lambda: int(call(x)[0, 0])  # readback = the only real sync
+        # Readback through a full reduction: on the axon tunnel an
+        # element-[0] fetch (like block_until_ready) can return BEFORE the
+        # whole buffer is computed, which once inflated the HBM probe 30x;
+        # the sum depends on every element, so int() really is the sync.
+        return lambda: int(jnp.sum(call(x), dtype=jnp.int32))
 
     def work_of(iters):
         return rows * block * grid * iters * _PROBE_OPS_PER_ITER
@@ -210,7 +214,9 @@ def hbm_ceiling(mb: int = 512, reps: int = 5) -> float:
 
             return jax.lax.fori_loop(0, iters, body, a)
 
-        return lambda: int(f(x)[0])
+        # Full-reduction readback: see vpu_ceiling — a [0] fetch can return
+        # before the streaming computation finishes on the tunnel backend.
+        return lambda: int(jnp.sum(f(x), dtype=jnp.int32))
 
     def work_of(iters):
         return 2 * n * 4 * iters  # read + write per iteration

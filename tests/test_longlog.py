@@ -116,9 +116,9 @@ def test_longlog_completes_clean_o_window():
     assert report["replicated_frac"] == 1.0
     assert report["slots_replicated"] == 128 * 64
     # O(window) memory: no state array grew with log_total.
-    assert state.acceptor.log_bal.shape[1] == 8
+    assert state.acceptor.log.shape[1] == 8
     assert state.learner.chosen.shape[0] == 8
-    assert state.promises.pb.shape[2] == 8
+    assert state.promises.p_bv.shape[2] == 8
 
 
 def test_longlog_liveness_window_relative():

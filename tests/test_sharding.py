@@ -62,7 +62,7 @@ def test_sharded_xla_longlog_compact_matches_unsharded():
     for _ in range(6):
         s8 = adv8(s8, 8)
 
-    assert len(s8.acceptor.log_bal.sharding.device_set) == 8
+    assert len(s8.acceptor.log.sharding.device_set) == 8
     assert (jax.device_get(s8.base) > 0).any(), "vacuous: nothing compacted"
     _assert_trees_equal(s1, s8, "sharded xla long-log diverged")
 
@@ -88,7 +88,7 @@ def test_sharded_fused_longlog_compact_matches_unsharded():
     for _ in range(6):
         s8 = adv8(s8, 8)
 
-    assert len(s8.acceptor.log_bal.sharding.device_set) == 8
+    assert len(s8.acceptor.log.sharding.device_set) == 8
     assert (jax.device_get(s8.base) > 0).any(), "vacuous: nothing compacted"
     _assert_trees_equal(s1, s8, "sharded fused long-log diverged")
 
