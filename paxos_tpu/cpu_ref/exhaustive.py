@@ -230,6 +230,26 @@ def _init_state(n_prop: int, n_acc: int) -> State:
     return (accs, props, net, ())
 
 
+def _merge(net: tuple, out: list, slot_net: bool) -> tuple:
+    """Add emitted messages to the in-flight set.
+
+    ``slot_net=False``: the classic multiset union (a message in flight
+    forever unless delivered — loss is "never scheduled").  ``slot_net=True``
+    models the TPU transport's fixed-slot buffers instead: one in-flight
+    message per (kind, src, dst) edge, a new send OVERWRITING the old (the
+    ``core.messages`` bounded-channel semantics).  The slot-quotiented
+    reachable set is exactly what the batched fuzzer can in principle
+    reach, which is what makes fuzz coverage measurable against it
+    (``check/coverage.py``).
+    """
+    if not slot_net:
+        return tuple(sorted(net + tuple(out)))
+    d = {(m[0], m[1], m[2]): m for m in net}
+    for m in out:
+        d[(m[0], m[1], m[2])] = m
+    return tuple(sorted(d.values()))
+
+
 def _own_val(pid: int) -> int:
     return 100 + pid
 
@@ -245,12 +265,14 @@ def _record_vote(voters: tuple, a: int, bal: int, val: int) -> tuple:
 
 
 def _deliver(
-    state: State, i: int, quorum: int, n_acc: int, unsafe_accept: bool = False
+    state: State, i: int, quorum: int, n_acc: int, unsafe_accept: bool = False,
+    slot_net: bool = False,
 ) -> State:
     """Deliver (and consume) in-flight message ``i``; pure.
 
     ``unsafe_accept=True`` injects the classic bug (accept below the
     promise) — the checker must then find a counterexample schedule.
+    ``slot_net`` selects the fixed-slot transport merge (:func:`_merge`).
     """
     accs, props, net, voters = state
     kind, src, dst, bal, v1, v2 = net[i]
@@ -289,10 +311,12 @@ def _deliver(
                 phase, dec = DONE, pv
             props = props[:dst] + ((phase, rnd, heard, bb, bv, pv, dec),) + props[dst + 1 :]
 
-    return (accs, props, tuple(sorted(net + tuple(out))), voters)
+    return (accs, props, _merge(net, out, slot_net), voters)
 
 
-def _timeout(state: State, p: int, n_acc: int, bump: bool = True) -> State:
+def _timeout(
+    state: State, p: int, n_acc: int, bump: bool = True, slot_net: bool = False
+) -> State:
     """Proposer ``p`` abandons its ballot and retries one round higher.
 
     ``bump=False`` is the injected LIVENESS bug (retry without ballot
@@ -305,8 +329,8 @@ def _timeout(state: State, p: int, n_acc: int, bump: bool = True) -> State:
         rnd += 1
     bal = make_ballot(rnd, p)
     props = props[:p] + ((P1, rnd, 0, 0, 0, 0, dec),) + props[p + 1 :]
-    out = tuple((PREPARE, p, a, bal, 0, 0) for a in range(n_acc))
-    return (accs, props, tuple(sorted(net + out)), voters)
+    out = [(PREPARE, p, a, bal, 0, 0) for a in range(n_acc)]
+    return (accs, props, _merge(net, out, slot_net), voters)
 
 
 def _gc(state: State, unsafe_accept: bool = False, dedup: bool = False) -> State:
@@ -371,6 +395,8 @@ def check_exhaustive(
     unsafe_accept: bool = False,
     liveness_bound: "int | None" = None,
     livelock_bug: bool = False,
+    visit=None,
+    slot_net: bool = False,
 ) -> CheckResult:
     """Exhaustively explore every schedule; assert agreement + validity.
 
@@ -390,6 +416,12 @@ def check_exhaustive(
     injects retry-without-ballot-increase into BOTH the explored timeouts
     and the completion schedule; the leg must then produce a lasso
     counterexample (tests/test_exhaustive.py asserts both directions).
+
+    ``visit`` (optional callable) receives every reachable state once —
+    the coverage probe's hook (``check/coverage.py``).  ``slot_net=True``
+    explores under the fixed-slot transport (:func:`_merge`): the quotient
+    of the schedule space the batched fuzzer's overwriting message buffers
+    can reach.
     """
     if n_prop > 8:
         raise ValueError("n_prop > 8 collides packed ballots (make_ballot)")
@@ -426,11 +458,12 @@ def check_exhaustive(
     if liveness_bound is not None:
         fair_next, is_decided = make_fair_completion(
             lambda s: (("d", s[2][0]), _gc(
-                _deliver(s, 0, quorum, n_acc, unsafe_accept),
+                _deliver(s, 0, quorum, n_acc, unsafe_accept, slot_net),
                 unsafe_accept, dedup=livelock_bug,
             )),
             lambda s, p: _gc(
-                _timeout(s, p, n_acc, bump=not livelock_bug),
+                _timeout(s, p, n_acc, bump=not livelock_bug,
+                         slot_net=slot_net),
                 unsafe_accept, dedup=livelock_bug,
             ),
             done_phase=DONE,
@@ -441,6 +474,8 @@ def check_exhaustive(
 
     def check_both(state: State, trace: tuple) -> None:
         check_state(state, trace)
+        if visit is not None:
+            visit(state)
         if live_check is not None:
             live_check(state, trace)
 
@@ -449,13 +484,14 @@ def check_exhaustive(
         accs, props, net, voters = state
         for i in range(len(net)):
             yield ("d", net[i]), _gc(
-                _deliver(state, i, quorum, n_acc, unsafe_accept),
+                _deliver(state, i, quorum, n_acc, unsafe_accept, slot_net),
                 unsafe_accept, dedup=livelock_bug,
             )
         for p in range(n_prop):
             if props[p][0] != DONE and props[p][1] < max_round[p]:
                 yield ("t", p), _gc(
-                    _timeout(state, p, n_acc, bump=not livelock_bug),
+                    _timeout(state, p, n_acc, bump=not livelock_bug,
+                             slot_net=slot_net),
                     unsafe_accept, dedup=livelock_bug,
                 )
 

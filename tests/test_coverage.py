@@ -1,0 +1,83 @@
+"""Fuzz-coverage probe (VERDICT r3 #3): soundness, measurement, anti-vacuity.
+
+The probe's load-bearing claim is the SOUNDNESS dual: every in-bounds state
+a fuzz lane occupies at a tick boundary must be reachable in the bounded
+model under slot-transport semantics.  A projection bug, an engine/model
+semantic drift, or a transport the model can't express would all surface as
+``out_of_space > 0`` here.
+"""
+
+import pytest
+
+from paxos_tpu.check.coverage import canon, coverage_probe, project_lane
+from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
+
+
+def test_probe_sound_and_measures():
+    r = coverage_probe(
+        max_round=(1, 0), n_inst=128, ticks=16, seeds=2, max_states=200_000
+    )
+    # Soundness: no fuzz state outside the slot-transport model space.
+    assert r["out_of_space"] == 0, r["out_of_space_sample"]
+    # It actually measures something.
+    assert r["visited"] > 50
+    assert 0 < r["coverage_slot"] <= 1
+    assert r["visited_in_slot"] == r["visited"]
+    # The transport quotient is real and EXACT: the multiset model reaches
+    # states (>= 2 same-edge in-flight messages) the slot transport cannot,
+    # and the two enumerations agree on the shared core — both sides of
+    # |S_multi ∩ S_slot| computed from either space's totals must match.
+    assert r["transport_excluded"] > 0
+    assert (r["space_multiset"] - r["transport_excluded"]
+            == r["space_slot"] - r["slot_only"])
+    # Growth curve is monotone, one entry per seed.
+    assert r["growth"] == sorted(r["growth"]) and len(r["growth"]) == 2
+    # The consequential corners are covered far more densely than the
+    # transient average: decisions happen in every lane.
+    assert r["decided_states"]["coverage"] > r["coverage_slot"]
+
+
+def test_probe_catches_projection_drift(monkeypatch):
+    """Anti-vacuity: the soundness leg must FIRE if the projection (or the
+    engine semantics it mirrors) drifts — here a deliberately corrupted
+    ballot-round mapping."""
+    import paxos_tpu.check.coverage as cov
+
+    real = cov.project_lane
+
+    def corrupted(h, i, n_prop, n_acc):
+        accs, props, net, voters = real(h, i, n_prop, n_acc)
+        broken = tuple(
+            (ph, rnd, heard, bb, bv, pv, dec + 7)  # impossible decided_val
+            for (ph, rnd, heard, bb, bv, pv, dec) in props
+        )
+        return (accs, broken, net, voters)
+
+    monkeypatch.setattr(cov, "project_lane", corrupted)
+    r = cov.coverage_probe(
+        max_round=(1, 0), n_inst=64, ticks=10, seeds=1, max_states=200_000
+    )
+    assert r["out_of_space"] > 0
+
+
+def test_slot_space_cross_validates_at_trivial_bounds():
+    """With a single proposer and no retries the slot and multiset spaces
+    coincide (no re-send ever overwrites a live slot), so the slot_net
+    variant must reproduce the classic count exactly."""
+    multi = check_exhaustive(n_prop=1, n_acc=3, max_round=0, max_states=10_000)
+    slot = check_exhaustive(
+        n_prop=1, n_acc=3, max_round=0, max_states=10_000, slot_net=True
+    )
+    assert multi.states == slot.states
+    assert multi.decided_states == slot.decided_states
+
+
+def test_canon_is_idempotent_and_stable():
+    seen = []
+    check_exhaustive(
+        n_prop=2, n_acc=3, max_round=(1, 0), max_states=200_000,
+        visit=lambda s: seen.append(s) if len(seen) < 500 else None,
+    )
+    for s in seen[:500]:
+        c = canon(s)
+        assert canon(c) == c
