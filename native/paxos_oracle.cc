@@ -1381,6 +1381,375 @@ inline ExploreResult explore(const ECfg& c, int64_t max_states,
 
 }  // namespace px_explore
 
+// ---------------------------------------------------------------------------
+// Bounded exhaustive exploration of MULTI-PAXOS — the native counterpart of
+// cpu_ref/mp_exhaustive.check_mp_exhaustive, sharing px_explore's dedup
+// machinery (128-bit fingerprints, byte-arena DFS).  The transition system
+// mirrors the Python checker action for action: whole-log phase 1 (PROMISE
+// carries the acceptor's full accepted log), slot-by-slot phase 2 with
+// per-slot max-ballot recovery, nondeterministic leadership challenges
+// bounded by max_round, and the same GC rules.  One encoding change, state
+// counts unaffected: command values own_slot_value(p, s) = (p+1)*1000 + s
+// don't fit a byte, so they ride as compact ids p*L + s + 1 — the map is a
+// bijection that preserves comparison order (pid-major, then slot; slot <
+// L <= 1000), so per-slot max folds and canonical sort orders agree with
+// Python's and the two state graphs are isomorphic (cross-validated:
+// tests/test_native_oracle.py asserts exact count equality at shared
+// bounds).
+// ---------------------------------------------------------------------------
+
+namespace mp_explore {
+
+constexpr int kMaxAccE = 8;
+constexpr int kMaxPropE = 3;
+constexpr int kMaxLogE = 4;
+constexpr int FOLLOW = 0, CAND = 1, LEAD = 2, MDONE = 3;
+
+struct MpMsg {
+  // (kind, src, dst, bal, slot, val, payload) — Python's 7-tuple order.
+  // payload: PROMISE only, the full log as 2L bytes of (bal, val_id).
+  uint8_t f[6];
+  std::array<uint8_t, 2 * kMaxLogE> payload;
+
+  bool less(const MpMsg& o, int plen) const {
+    for (int i = 0; i < 6; ++i) {
+      if (f[i] != o.f[i]) return f[i] < o.f[i];
+    }
+    // Same kind; payloads are both empty (non-PROMISE) or both 2L bytes.
+    if (f[0] != 1) return false;
+    for (int i = 0; i < plen; ++i)
+      if (payload[i] != o.payload[i]) return payload[i] < o.payload[i];
+    return false;
+  }
+};
+
+struct MpState {
+  uint8_t promised[kMaxAccE];
+  uint8_t log[kMaxAccE][2 * kMaxLogE];  // (bal, val_id) per slot
+  // prop: phase, rnd, heard, ci + recov[2L] + dec[L]
+  uint8_t prop[kMaxPropE][4];
+  uint8_t recov[kMaxPropE][2 * kMaxLogE];
+  uint8_t dec[kMaxPropE][kMaxLogE];
+  std::vector<std::array<uint8_t, 4>> votes;  // (slot, bal, val_id, mask)
+  std::vector<MpMsg> net;
+};
+
+struct MpCfg {
+  int n_prop, n_acc, log_len, quorum;
+  int max_round[kMaxPropE];
+  bool no_recovery;
+};
+
+inline int vid(int p, int s, int L) { return p * L + s + 1; }
+
+inline void mp_serialize(const MpCfg& c, const MpState& s,
+                         std::vector<uint8_t>* out) {
+  out->clear();
+  const int L2 = 2 * c.log_len;
+  for (int a = 0; a < c.n_acc; ++a) {
+    out->push_back(s.promised[a]);
+    out->insert(out->end(), s.log[a], s.log[a] + L2);
+  }
+  for (int p = 0; p < c.n_prop; ++p) {
+    out->insert(out->end(), s.prop[p], s.prop[p] + 4);
+    out->insert(out->end(), s.recov[p], s.recov[p] + L2);
+    out->insert(out->end(), s.dec[p], s.dec[p] + c.log_len);
+  }
+  out->push_back(static_cast<uint8_t>(s.votes.size() & 0xff));
+  out->push_back(static_cast<uint8_t>(s.votes.size() >> 8));
+  for (const auto& v : s.votes) out->insert(out->end(), v.begin(), v.end());
+  out->push_back(static_cast<uint8_t>(s.net.size() & 0xff));
+  out->push_back(static_cast<uint8_t>(s.net.size() >> 8));
+  for (const auto& m : s.net) {
+    out->insert(out->end(), m.f, m.f + 6);
+    if (m.f[0] == 1)  // PROMISE payload
+      out->insert(out->end(), m.payload.begin(), m.payload.begin() + L2);
+  }
+}
+
+inline void mp_deserialize(const MpCfg& c, const uint8_t* b, MpState* s) {
+  const int L2 = 2 * c.log_len;
+  for (int a = 0; a < c.n_acc; ++a) {
+    s->promised[a] = *b++;
+    std::memcpy(s->log[a], b, L2);
+    b += L2;
+  }
+  for (int p = 0; p < c.n_prop; ++p) {
+    std::memcpy(s->prop[p], b, 4);
+    b += 4;
+    std::memcpy(s->recov[p], b, L2);
+    b += L2;
+    std::memcpy(s->dec[p], b, c.log_len);
+    b += c.log_len;
+  }
+  int nv = b[0] | (b[1] << 8);
+  b += 2;
+  s->votes.assign(nv, {});
+  for (int i = 0; i < nv; ++i) {
+    std::memcpy(s->votes[i].data(), b, 4);
+    b += 4;
+  }
+  int nm = b[0] | (b[1] << 8);
+  b += 2;
+  s->net.assign(nm, {});
+  for (int i = 0; i < nm; ++i) {
+    std::memcpy(s->net[i].f, b, 6);
+    b += 6;
+    if (s->net[i].f[0] == 1) {
+      std::memcpy(s->net[i].payload.data(), b, L2);
+      b += L2;
+    }
+  }
+}
+
+inline void mp_push_msg(const MpCfg& c, MpState* s, MpMsg m) {
+  const int L2 = 2 * c.log_len;
+  auto it = s->net.begin();
+  while (it != s->net.end() && it->less(m, L2)) ++it;
+  s->net.insert(it, m);
+}
+
+inline void mp_record(MpState* s, int a, int slot, int bal, int val) {
+  for (auto& v : s->votes) {
+    if (v[0] == slot && v[1] == bal && v[2] == val) {
+      v[3] |= static_cast<uint8_t>(1u << a);
+      return;
+    }
+  }
+  std::array<uint8_t, 4> e = {static_cast<uint8_t>(slot),
+                              static_cast<uint8_t>(bal),
+                              static_cast<uint8_t>(val),
+                              static_cast<uint8_t>(1u << a)};
+  auto it = s->votes.begin();
+  while (it != s->votes.end() &&
+         std::lexicographical_compare(it->begin(), it->begin() + 3,
+                                      e.begin(), e.begin() + 3))
+    ++it;
+  s->votes.insert(it, e);
+}
+
+// mp_exhaustive._drive: the leader's ACCEPT broadcast (or DONE past the log).
+inline void mp_drive(const MpCfg& c, MpState* s, int p) {
+  int ci = s->prop[p][3];
+  if (ci >= c.log_len) {
+    s->prop[p][0] = MDONE;
+    s->prop[p][2] = 0;
+    return;
+  }
+  int rb = s->recov[p][2 * ci], rv = s->recov[p][2 * ci + 1];
+  int val = (c.no_recovery || rb == 0) ? vid(p, ci, c.log_len) : rv;
+  int bal = make_ballot(s->prop[p][1], p);
+  s->prop[p][0] = LEAD;
+  s->prop[p][2] = 0;
+  for (int a = 0; a < c.n_acc; ++a) {
+    MpMsg m{};
+    m.f[0] = 2;  // ACCEPT
+    m.f[1] = static_cast<uint8_t>(p);
+    m.f[2] = static_cast<uint8_t>(a);
+    m.f[3] = static_cast<uint8_t>(bal);
+    m.f[4] = static_cast<uint8_t>(ci);
+    m.f[5] = static_cast<uint8_t>(val);
+    mp_push_msg(c, s, m);
+  }
+}
+
+// mp_exhaustive._deliver; consumes net[i].
+inline void mp_deliver(const MpCfg& c, MpState* s, size_t i) {
+  MpMsg m = s->net[i];
+  s->net.erase(s->net.begin() + i);
+  const int L2 = 2 * c.log_len;
+  int kind = m.f[0], src = m.f[1], dst = m.f[2], bal = m.f[3], slot = m.f[4],
+      val = m.f[5];
+
+  if (kind == 0) {  // PREPARE: promise + full-log payload
+    if (bal > s->promised[dst]) {
+      MpMsg r{};
+      r.f[0] = 1;  // PROMISE
+      r.f[1] = static_cast<uint8_t>(dst);
+      r.f[2] = static_cast<uint8_t>(src);
+      r.f[3] = static_cast<uint8_t>(bal);
+      std::memcpy(r.payload.data(), s->log[dst], L2);  // pre-promise log
+      s->promised[dst] = static_cast<uint8_t>(bal);
+      mp_push_msg(c, s, r);
+    }
+  } else if (kind == 2) {  // ACCEPT
+    if (bal >= s->promised[dst]) {
+      s->log[dst][2 * slot] = static_cast<uint8_t>(bal);
+      s->log[dst][2 * slot + 1] = static_cast<uint8_t>(val);
+      if (bal > s->promised[dst]) s->promised[dst] = static_cast<uint8_t>(bal);
+      mp_record(s, dst, slot, bal, val);
+      MpMsg r{};
+      r.f[0] = 3;  // ACCEPTED
+      r.f[1] = static_cast<uint8_t>(dst);
+      r.f[2] = static_cast<uint8_t>(src);
+      r.f[3] = static_cast<uint8_t>(bal);
+      r.f[4] = static_cast<uint8_t>(slot);
+      r.f[5] = static_cast<uint8_t>(val);
+      mp_push_msg(c, s, r);
+    }
+  } else if (kind == 1) {  // PROMISE
+    uint8_t* p = s->prop[dst];
+    if (p[0] == CAND && bal == make_ballot(p[1], dst)) {
+      p[2] |= static_cast<uint8_t>(1u << src);
+      if (!c.no_recovery) {
+        // Per-slot max over (bal, val) pairs — val_id order matches
+        // own_slot_value order, so ties break exactly as in Python.
+        for (int t = 0; t < c.log_len; ++t) {
+          uint8_t* r = &s->recov[dst][2 * t];
+          const uint8_t* q = &m.payload[2 * t];
+          if (q[0] > r[0] || (q[0] == r[0] && q[1] > r[1])) {
+            r[0] = q[0];
+            r[1] = q[1];
+          }
+        }
+      }
+      if (__builtin_popcount(p[2]) >= c.quorum) {
+        p[3] = 0;  // commit_idx = 0
+        mp_drive(c, s, dst);
+      }
+    }
+  } else {  // ACCEPTED
+    uint8_t* p = s->prop[dst];
+    if (p[0] == LEAD && bal == make_ballot(p[1], dst) && slot == p[3]) {
+      p[2] |= static_cast<uint8_t>(1u << src);
+      if (__builtin_popcount(p[2]) >= c.quorum) {
+        s->dec[dst][slot] = static_cast<uint8_t>(val);
+        p[3] = static_cast<uint8_t>(slot + 1);
+        mp_drive(c, s, dst);
+      }
+    }
+  }
+}
+
+// mp_exhaustive._timeout: challenge for leadership at the next ballot.
+inline void mp_timeout(const MpCfg& c, MpState* s, int p) {
+  int rnd = s->prop[p][1] + 1;
+  int bal = make_ballot(rnd, p);
+  s->prop[p][0] = CAND;
+  s->prop[p][1] = static_cast<uint8_t>(rnd);
+  s->prop[p][2] = 0;
+  s->prop[p][3] = 0;
+  std::memset(s->recov[p], 0, 2 * c.log_len);
+  for (int a = 0; a < c.n_acc; ++a) {
+    MpMsg m{};
+    m.f[0] = 0;  // PREPARE
+    m.f[1] = static_cast<uint8_t>(p);
+    m.f[2] = static_cast<uint8_t>(a);
+    m.f[3] = static_cast<uint8_t>(bal);
+    mp_push_msg(c, s, m);
+  }
+}
+
+// mp_exhaustive._gc.
+inline void mp_gc(const MpCfg& c, MpState* s) {
+  size_t w = 0;
+  for (size_t i = 0; i < s->net.size(); ++i) {
+    const MpMsg& m = s->net[i];
+    int kind = m.f[0], dst = m.f[2], bal = m.f[3], slot = m.f[4];
+    bool drop = false;
+    if (kind == 0) {
+      drop = bal <= s->promised[dst];
+    } else if (kind == 2) {
+      drop = bal < s->promised[dst];
+    } else {
+      int phase = s->prop[dst][0], rnd = s->prop[dst][1];
+      if (phase == MDONE || bal != make_ballot(rnd, dst)) drop = true;
+      else if (kind == 1 && phase != CAND) drop = true;
+      else if (kind == 3 && (phase != LEAD || slot != s->prop[dst][3]))
+        drop = true;
+    }
+    if (!drop) s->net[w++] = s->net[i];
+  }
+  s->net.resize(w);
+}
+
+// mp_exhaustive.check_state: per-slot agreement + validity + DONE-log match.
+inline bool mp_check(const MpCfg& c, const MpState& s,
+                     px_explore::ExploreResult* r) {
+  // Per-slot chosen-value masks over val_ids (<= kMaxPropE * kMaxLogE = 12).
+  uint32_t chosen[kMaxLogE] = {0, 0, 0, 0};
+  for (const auto& v : s.votes) {
+    if (__builtin_popcount(v[3]) >= c.quorum) chosen[v[0]] |= 1u << v[2];
+  }
+  bool ok = true;
+  for (int t = 0; t < c.log_len; ++t) {
+    uint32_t m = chosen[t];
+    if (__builtin_popcount(m) > 1) ok = false;
+    while (m) {
+      int id = __builtin_ctz(m);
+      m &= m - 1;
+      int p = (id - 1) / c.log_len, sl = (id - 1) % c.log_len;
+      if (sl != t || p < 0 || p >= c.n_prop) ok = false;
+      r->chosen_union |= 1u << (id - 1);
+    }
+  }
+  bool any_done = false;
+  for (int p = 0; p < c.n_prop; ++p) {
+    if (s.prop[p][0] != MDONE) continue;
+    any_done = true;
+    // The DONE proposer's replicated log must be exactly the chosen set
+    // per slot (Python: per_slot[s] == {dec[s]} — set equality).
+    for (int t = 0; t < c.log_len; ++t)
+      if (s.dec[p][t] == 0 || chosen[t] != (1u << s.dec[p][t])) ok = false;
+  }
+  if (any_done) ++r->decided_states;
+  return ok;
+}
+
+inline px_explore::ExploreResult mp_explore_run(const MpCfg& c,
+                                                int64_t max_states,
+                                                int64_t progress_every) {
+  px_explore::ExploreResult r;
+  MpState init{};  // all-zero roles, empty net/votes
+
+  px_explore::FpSet visited;
+  px_explore::StateStack stack;
+  std::vector<uint8_t> buf, popped;
+  mp_serialize(c, init, &buf);
+  visited.insert(px_explore::fingerprint(buf));
+  stack.push(buf);
+
+  MpState s, succ;
+  while (stack.pop(&popped)) {
+    mp_deserialize(c, popped.data(), &s);
+    ++r.states;
+    if (!mp_check(c, s, &r)) {
+      r.violation = 1;
+      r.status = 1;
+      return r;
+    }
+    if (r.states > max_states) {
+      r.status = 2;
+      return r;
+    }
+    if (progress_every && r.states % progress_every == 0)
+      std::fprintf(stderr, "# mp explore: %lld states, frontier %zu\n",
+                   static_cast<long long>(r.states), stack.size());
+    size_t nm = s.net.size();
+    for (size_t i = 0; i < nm; ++i) {
+      succ = s;
+      mp_deliver(c, &succ, i);
+      mp_gc(c, &succ);
+      mp_serialize(c, succ, &buf);
+      if (visited.insert(px_explore::fingerprint(buf))) stack.push(buf);
+    }
+    for (int p = 0; p < c.n_prop; ++p) {
+      if (s.prop[p][0] != MDONE && s.prop[p][1] < c.max_round[p]) {
+        succ = s;
+        mp_timeout(c, &succ, p);
+        mp_gc(c, &succ);
+        mp_serialize(c, succ, &buf);
+        if (visited.insert(px_explore::fingerprint(buf))) stack.push(buf);
+      }
+    }
+    if (static_cast<int64_t>(stack.size()) > r.peak_frontier)
+      r.peak_frontier = static_cast<int64_t>(stack.size());
+  }
+  return r;
+}
+
+}  // namespace mp_explore
+
 }  // namespace
 
 extern "C" {
@@ -1493,6 +1862,46 @@ int64_t bench_steps(uint64_t seed0, int32_t n_runs, int32_t n_prop,
 // status: 0 clean, 1 violation found, 2 max_states exceeded, -1 invalid
 // topology.  progress_every > 0 prints a stderr line every that many
 // states.
+// Bounded exhaustive exploration of Multi-Paxos (native counterpart of
+// cpu_ref/mp_exhaustive.check_mp_exhaustive; see mp_explore above).  Same
+// out[0..5] layout as explore_paxos, except out[4]'s chosen bitmask is over
+// compact value ids p * log_len + s (the wrapper decodes to
+// own_slot_value).  no_recovery injects the skipped-recovery bug (must
+// find a violation at the same bounds Python does).
+void explore_multipaxos(int32_t n_prop, int32_t n_acc, int32_t log_len,
+                        const int32_t* max_round, int64_t max_states,
+                        int32_t no_recovery, int64_t progress_every,
+                        int64_t* out) {
+  for (int i = 0; i < 6; ++i) out[i] = 0;
+  if (n_prop < 1 || n_prop > mp_explore::kMaxPropE || n_acc < 1 ||
+      n_acc > mp_explore::kMaxAccE || log_len < 1 ||
+      log_len > mp_explore::kMaxLogE) {
+    out[3] = -1;
+    return;
+  }
+  mp_explore::MpCfg c;
+  c.n_prop = n_prop;
+  c.n_acc = n_acc;
+  c.log_len = log_len;
+  c.quorum = n_acc / 2 + 1;
+  c.no_recovery = no_recovery != 0;
+  for (int p = 0; p < n_prop; ++p) {
+    if (max_round[p] < 0 || max_round[p] > 29) {
+      out[3] = -1;
+      return;
+    }
+    c.max_round[p] = max_round[p];
+  }
+  px_explore::ExploreResult r =
+      mp_explore::mp_explore_run(c, max_states, progress_every);
+  out[0] = r.states;
+  out[1] = r.decided_states;
+  out[2] = r.violation;
+  out[3] = r.status;
+  out[4] = r.chosen_union;
+  out[5] = r.peak_frontier;
+}
+
 void explore_paxos(int32_t n_prop, int32_t n_acc, const int32_t* max_round,
                    int64_t max_states, int32_t unsafe_accept,
                    int64_t progress_every, int64_t* out) {

@@ -86,6 +86,12 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int64),
         ]
         lib.explore_paxos.restype = None
+        lib.explore_multipaxos.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.explore_multipaxos.restype = None
         _LIB = lib
     return _LIB
 
@@ -380,6 +386,78 @@ def explore_native(
             f"state space exceeds max_states={max_states}; tighten bounds"
         )
     chosen = {100 + v for v in range(n_prop) if chosen_mask & (1 << v)}
+    if violation:
+        raise AssertionError(
+            f"invariant violated after {states} states (native explorer "
+            f"reports existence; rerun the Python checker at the same "
+            f"bounds for the counterexample trace)"
+        )
+    return NativeExploreResult(
+        states=int(states),
+        decided_states=int(decided),
+        violation=False,
+        chosen_values=chosen,
+        peak_frontier=int(peak),
+    )
+
+
+def explore_mp_native(
+    n_prop: int = 2,
+    n_acc: int = 3,
+    log_len: int = 2,
+    max_round: "int | tuple[int, ...]" = 1,
+    max_states: int = 2_000_000_000,
+    no_recovery: bool = False,
+    progress_every: int = 0,
+) -> NativeExploreResult:
+    """Exhaustively enumerate every schedule of bounded MULTI-PAXOS in
+    native code — the same transition system as
+    ``cpu_ref.mp_exhaustive.check_mp_exhaustive`` (whole-log phase 1,
+    slot-by-slot phase 2, per-slot max-ballot recovery, same GC), state
+    counts cross-validated EXACTLY at shared bounds
+    (tests/test_native_oracle.py).  Values ride internally as compact
+    order-isomorphic ids; ``chosen_values`` decodes them back to
+    ``own_slot_value`` form.
+
+    Raises ``AssertionError`` on an invariant violation (existence — the
+    Python checker at the same bounds yields the trace) and
+    ``RuntimeError`` past ``max_states``.
+    """
+    if isinstance(max_round, int):
+        max_round = (max_round,) * n_prop
+    if len(max_round) != n_prop:
+        raise ValueError(
+            f"max_round has {len(max_round)} bounds for n_prop={n_prop}"
+        )
+    if not 1 <= n_prop <= 3:
+        raise ValueError(f"mp explorer n_prop={n_prop} outside [1, 3]")
+    if not 1 <= n_acc <= 8:
+        raise ValueError(f"mp explorer n_acc={n_acc} outside [1, 8]")
+    if not 1 <= log_len <= 4:
+        raise ValueError(f"mp explorer log_len={log_len} outside [1, 4]")
+    if any(not 0 <= r <= 29 for r in max_round):
+        raise ValueError("mp explorer max_round outside [0, 29]")
+    lib = _load()
+    mr = (ctypes.c_int32 * n_prop)(*max_round)
+    out = (ctypes.c_int64 * 6)()
+    lib.explore_multipaxos(
+        n_prop, n_acc, log_len, mr, max_states, int(no_recovery),
+        progress_every, out,
+    )
+    states, decided, violation, status, chosen_mask, peak = (
+        out[0], out[1], out[2], out[3], out[4], out[5],
+    )
+    if status == -1:
+        raise ValueError("invalid mp explorer topology (C-side check)")
+    if status == 2:
+        raise RuntimeError(
+            f"state space exceeds max_states={max_states}; tighten bounds"
+        )
+    chosen = {
+        (vid // log_len + 1) * 1000 + (vid % log_len)
+        for vid in range(n_prop * log_len)
+        if chosen_mask & (1 << vid)
+    }
     if violation:
         raise AssertionError(
             f"invariant violated after {states} states (native explorer "

@@ -220,8 +220,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     c.add_argument(
         "--native", action="store_true",
-        help="classic paxos only: run the native (C++) explorer — same "
-        "transition system and GC, ~100x faster, counts cross-validated "
+        help="paxos/multipaxos: run the native (C++) explorer — same "
+        "transition system and GC, ~70-150x faster, counts cross-validated "
         "against the Python checker; traces and the liveness leg stay "
         "Python-side",
     )
@@ -461,13 +461,49 @@ def cmd_check(args: argparse.Namespace) -> int:
               "leg is what detects it)", file=sys.stderr)
         return 1
     if args.native and (
-        args.protocol != "paxos" or args.liveness_bound is not None
+        args.protocol not in ("paxos", "multipaxos")
+        or args.liveness_bound is not None
     ):
-        print("error: --native supports --protocol paxos without "
+        print("error: --native supports --protocol paxos/multipaxos without "
               "--liveness-bound (liveness and traces are Python-side)",
               file=sys.stderr)
         return 1
     try:
+        if args.native:
+            # ONE native dispatch + result block for every explorer the
+            # C++ tier grows (paxos today, multipaxos today, others later).
+            if args.protocol == "multipaxos":
+                from paxos_tpu.cpu_ref.native import explore_mp_native
+
+                nr = explore_mp_native(
+                    n_prop=args.n_prop,
+                    n_acc=args.n_acc,
+                    log_len=args.log_len,
+                    max_round=mr,
+                    max_states=args.max_states,
+                    no_recovery=args.no_recovery,
+                    progress_every=args.progress_every,
+                )
+            else:
+                from paxos_tpu.cpu_ref.native import explore_native
+
+                nr = explore_native(
+                    n_prop=args.n_prop,
+                    n_acc=args.n_acc,
+                    max_round=mr,
+                    max_states=args.max_states,
+                    unsafe_accept=args.unsafe_accept,
+                    progress_every=args.progress_every,
+                )
+            print(json.dumps({
+                "ok": True,
+                "states": nr.states,
+                "decided_states": nr.decided_states,
+                "chosen_values": sorted(nr.chosen_values),
+                "native": True,
+                "peak_frontier": nr.peak_frontier,
+            }))
+            return 0
         if args.protocol == "multipaxos":
             from paxos_tpu.cpu_ref.mp_exhaustive import check_mp_exhaustive
 
@@ -509,26 +545,6 @@ def cmd_check(args: argparse.Namespace) -> int:
                 liveness_bound=args.liveness_bound,
                 livelock_bug=args.livelock_bug,
             )
-        elif args.native:
-            from paxos_tpu.cpu_ref.native import explore_native
-
-            nr = explore_native(
-                n_prop=args.n_prop,
-                n_acc=args.n_acc,
-                max_round=mr,
-                max_states=args.max_states,
-                unsafe_accept=args.unsafe_accept,
-                progress_every=args.progress_every,
-            )
-            print(json.dumps({
-                "ok": True,
-                "states": nr.states,
-                "decided_states": nr.decided_states,
-                "chosen_values": sorted(nr.chosen_values),
-                "native": True,
-                "peak_frontier": nr.peak_frontier,
-            }))
-            return 0
         else:
             from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
 

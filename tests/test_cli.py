@@ -219,3 +219,32 @@ def test_cli_check_fastpaxos(capsys):
     ]) == 2
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert not out["ok"] and "invariant violated" in out["counterexample"]
+
+
+def test_cli_check_native(capsys):
+    """`check --native` (paxos and multipaxos): counts match the recorded
+    canonical spaces, unsupported combinations are refused."""
+    import json
+
+    from paxos_tpu.harness.cli import main
+
+    assert main([
+        "--platform", "cpu", "check", "--native", "--max-round", "1", "0",
+    ]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] and out["native"] and out["states"] == 48_839
+
+    assert main([
+        "--platform", "cpu", "check", "--native", "--protocol", "multipaxos",
+        "--max-round", "1",
+    ]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] and out["native"] and out["states"] == 30_562
+
+    # Unsupported: raftcore native, native + liveness.
+    assert main([
+        "--platform", "cpu", "check", "--native", "--protocol", "raftcore",
+    ]) == 1
+    assert main([
+        "--platform", "cpu", "check", "--native", "--liveness-bound", "20",
+    ]) == 1
