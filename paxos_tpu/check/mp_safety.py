@@ -60,7 +60,12 @@ def mp_learner_observe(
     for a in range(n_acc):
         b, s, v = ev_bal[a], ev_slot[a], ev_val[a]  # (I,)
         bv = pack_bv(b, v)
-        f = ev_flag[a] & (b > 0)
+        # Out-of-window slots must not reach the fold: with no matching
+        # one-hot row, min_bv would read 0x7FFFFFFF and the event would be
+        # miscounted as an eviction ("missed").  Senders currently clamp
+        # (ci = min(commit_idx, n_slots - 1)), so this is a belt against a
+        # future unclamped sender, not a reachable path today.
+        f = ev_flag[a] & (b > 0) & (s >= 0) & (s < n_slots)
         oh_slot = s[None] == slot_ids  # (L, I)
 
         # Re-confirmations of an already-chosen value carry no violation

@@ -32,7 +32,43 @@ from paxos_tpu.harness.config import SimConfig
 # shape or structure (axis order, new FaultPlan fields, ...); restore()
 # refuses snapshots from a different schema with a clear message instead of
 # a deep orbax structure error.
+#
+# Migration note (ADVICE r4): v4 -> v5 repacked the MP log_bal/log_val
+# arrays into packed (ballot, value) pairs AND the MP fused block default
+# changed 256 -> 128 (a fresh schedule lineage), so pre-round-4 MP
+# snapshots are deliberately stranded — a mechanical repack shim would
+# restore the ARRAYS but silently resume a DIFFERENT schedule under the
+# new block default, which is exactly the corruption the stream guard
+# below exists to prevent.  Re-run stranded campaigns from scratch.
 LAYOUT_VERSION = "instance-minor-v5"  # v5: packed (bal, val) pairs in MP arrays
+
+
+def stream_id(cfg: SimConfig, engine: str, block: Optional[int] = None) -> dict:
+    """The schedule-stream lineage of a campaign (VERDICT r4 weak#3).
+
+    Fused streams are keyed per (seed, tick, BLOCK) — resuming a
+    checkpoint under a different effective block replays a different
+    schedule with the same seed, silently.  This records everything the
+    stream identity depends on: the engine, the EFFECTIVE fused block
+    (protocol default resolved at save time, so a later default change
+    cannot reinterpret it), and the counter-PRNG scheme version.
+    """
+    if engine == "fused":
+        if block is None:
+            from paxos_tpu.kernels.fused_tick import fused_fns
+
+            block = fused_fns(cfg.protocol)[2]
+        # Fused masks come from the on-core splitmix counter-PRNG.
+        prng = "splitmix-counter-v1"
+    else:
+        # XLA-engine masks come from jax.random under the ACTIVE impl
+        # (bench.py switches to rbg; the CLI default is threefry) — part
+        # of the stream identity, so record it.
+        import jax
+
+        block = None
+        prng = f"jax.random-{jax.config.jax_default_prng_impl}"
+    return {"engine": engine, "block": block, "prng_scheme": prng}
 
 
 def save(
@@ -40,8 +76,15 @@ def save(
     state: PaxosState,
     plan: FaultPlan,
     cfg: SimConfig,
+    engine: Optional[str] = None,
+    block: Optional[int] = None,
 ) -> None:
-    """Write a complete, resumable snapshot to ``path`` (a directory)."""
+    """Write a complete, resumable snapshot to ``path`` (a directory).
+
+    ``engine``/``block`` record the saving campaign's stream lineage
+    (:func:`stream_id`) so a resume under a different engine or fused
+    block — a silently different schedule — can be refused.
+    """
     path = pathlib.Path(path).absolute()
     path.parent.mkdir(parents=True, exist_ok=True)
     with ocp.PyTreeCheckpointer() as ckptr:
@@ -54,13 +97,24 @@ def save(
             force=True,
         )
     meta = dataclasses.asdict(cfg) | {"layout_version": LAYOUT_VERSION}
+    if engine is not None:
+        meta["stream"] = stream_id(cfg, engine, block)
     (path / "simconfig.json").write_text(json.dumps(meta))
 
 
 def restore(
     path: str | pathlib.Path,
+    engine: Optional[str] = None,
+    block: Optional[int] = None,
 ) -> tuple[PaxosState, FaultPlan, SimConfig]:
-    """Read a snapshot back; arrays land on the default device, unsharded."""
+    """Read a snapshot back; arrays land on the default device, unsharded.
+
+    When ``engine`` is given, the resuming campaign's stream lineage is
+    checked against the one recorded at save time: a mismatch (e.g. an MP
+    checkpoint saved under the pre-round-4 block=256 default resumed under
+    the 128 default) raises instead of silently replaying a different
+    schedule.  Snapshots without stream metadata warn and proceed.
+    """
     path = pathlib.Path(path).absolute()
     raw = json.loads((path / "simconfig.json").read_text())
     found = raw.pop("layout_version", "pre-instance-minor")
@@ -70,10 +124,30 @@ def restore(
             f"build expects {LAYOUT_VERSION!r}; re-run the campaign from "
             "scratch (state array axis order changed)"
         )
+    saved_stream = raw.pop("stream", None)
     fault = raw.pop("fault")
     from paxos_tpu.faults.injector import FaultConfig
 
     cfg = SimConfig(**raw, fault=FaultConfig(**fault))
+
+    if engine is not None:
+        want = stream_id(cfg, engine, block)
+        if saved_stream is None:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint at {path} predates stream metadata: cannot "
+                f"verify the resume replays the saved schedule (resuming "
+                f"as {want})",
+                stacklevel=2,
+            )
+        elif saved_stream != want:
+            raise ValueError(
+                f"checkpoint at {path} was written by stream {saved_stream}"
+                f" but this resume would run stream {want}: same seed, "
+                "DIFFERENT schedule.  Pass the saved engine/block "
+                "explicitly (e.g. --block) or re-run from scratch."
+            )
 
     # Restore against concrete templates so pytree structure (dataclasses,
     # not dicts) and dtypes come back exactly.

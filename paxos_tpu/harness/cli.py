@@ -84,6 +84,12 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--checkpoint-dir", default=None)
     r.add_argument("--checkpoint-every", type=int, default=0, help="ticks (0=off)")
     r.add_argument("--resume", default=None, help="checkpoint dir to resume from")
+    r.add_argument(
+        "--block", type=int, default=None,
+        help="fused block size override (stream-relevant: fused schedules "
+        "key on (seed, tick, block)); --resume verifies it against the "
+        "block recorded in the checkpoint",
+    )
     r.add_argument("--trace", default=None, help="jax.profiler trace logdir")
     r.add_argument(
         "--liveness",
@@ -246,6 +252,7 @@ def cmd_run(args: argparse.Namespace) -> int:
     from paxos_tpu.harness import trace as trace_mod
     from paxos_tpu.harness.metrics import MetricsLog
     from paxos_tpu.harness.run import (
+        MeasurementCorrupted,
         init_plan,
         init_state,
         make_advance,
@@ -260,7 +267,11 @@ def cmd_run(args: argparse.Namespace) -> int:
 
     log = MetricsLog(args.log)
     if args.resume:
-        state, plan, cfg = ckpt.restore(args.resume)
+        # Stream-lineage guard (VERDICT r4 weak#3): refuse to resume under
+        # a different engine/block than the one that wrote the snapshot.
+        state, plan, cfg = ckpt.restore(
+            args.resume, engine=args.engine, block=args.block
+        )
         log.emit("resume", path=args.resume, tick=int(state.tick))
     else:
         kw = {"seed": args.seed}
@@ -287,12 +298,26 @@ def cmd_run(args: argparse.Namespace) -> int:
     # (make_advance; the XLA engine ignores the mesh — sharded inputs
     # alone drive pjit).
     advance = make_advance(
-        cfg, plan, args.engine, compact=bool(ll),
+        cfg, plan, args.engine, block=args.block, compact=bool(ll),
         mesh=mesh if (args.shard and args.engine == "fused") else None,
     )
 
     log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
              n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)
+
+    def observe(**kw):
+        # The ballot-overflow guard (harness.run.summarize) raises
+        # MeasurementCorrupted when a campaign's measurements stop being
+        # trustworthy — surface that as a structured CLI failure (logged,
+        # clean message, exit 1), not a raw traceback.  Infrastructure
+        # RuntimeErrors (XLA OOMs etc.) keep their tracebacks.
+        try:
+            return summarize(state, log_total=cfg.fault.log_total, **kw)
+        except MeasurementCorrupted as e:
+            log.emit("error", message=str(e), tick=int(state.tick))
+            log.close()
+            print(f"error: {e}", file=sys.stderr)
+            raise SystemExit(1)
 
     done, since_ckpt = 0, 0
     with trace_mod.profile(args.trace):
@@ -301,12 +326,13 @@ def cmd_run(args: argparse.Namespace) -> int:
             state = advance(state, n)
             done += n
             since_ckpt += n
-            rep = summarize(state, log_total=cfg.fault.log_total)
+            rep = observe()
             log.emit("chunk", **rep)
             if args.events:
                 trace_mod.event_dump(state)
             if args.checkpoint_every and since_ckpt >= args.checkpoint_every:
-                ckpt.save(args.checkpoint_dir, state, plan, cfg)
+                ckpt.save(args.checkpoint_dir, state, plan, cfg,
+                          engine=args.engine, block=args.block)
                 log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
                 since_ckpt = 0
             # Exact check (a float32 mean can round to != 1.0 at huge scales).
@@ -314,14 +340,13 @@ def cmd_run(args: argparse.Namespace) -> int:
                 if (ll.done(state) if ll else bool(state.learner.chosen.all())):
                     break
 
-    report = summarize(
-        state, liveness=args.liveness, log_total=cfg.fault.log_total
-    )
+    report = observe(liveness=args.liveness)
     report["config_fingerprint"] = cfg.fingerprint()
     if ll:
         report.update(ll.report_fields(state))
     if args.checkpoint_dir:
-        ckpt.save(args.checkpoint_dir, state, plan, cfg)
+        ckpt.save(args.checkpoint_dir, state, plan, cfg,
+                  engine=args.engine, block=args.block)
         log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
     log.emit("final", **report)
     log.close()
@@ -427,6 +452,13 @@ def cmd_soak(args: argparse.Namespace) -> int:
     print(json.dumps(report))
     if report["violations"]:
         return 2
+    if "measurement_corrupted" in report:
+        # A seed's measurements went untrustworthy (ballot overflow): the
+        # tally above covers only the seeds BEFORE it — fail, don't let a
+        # truncated soak read as a completed one.
+        print(f"error: seed {report['measurement_corrupted']} corrupted its "
+              "measurements (see stderr); tally truncated", file=sys.stderr)
+        return 1
     if not report.get("replication_ok", True):
         return 3
     return 0

@@ -20,6 +20,13 @@ from paxos_tpu.faults.injector import FaultConfig, FaultPlan
 from paxos_tpu.harness.config import SimConfig
 
 
+class MeasurementCorrupted(RuntimeError):
+    """A campaign's measurements stopped being trustworthy (e.g. packed
+    ballots overflowed): distinct from infrastructure RuntimeErrors so CLI
+    handlers can convert THIS to a clean failure without masking device or
+    compiler errors."""
+
+
 def get_step_fn(protocol: str) -> Callable:
     """Resolve a protocol name to its step function (shared signature)."""
     if protocol == "paxos":
@@ -300,6 +307,13 @@ def summarize(
     }
 
     if chosen.ndim == 2:  # Multi-Paxos: chosen_frac is slot-level
+        # Packed-pair bit budget, ballot side (core.mp_state: bal < 2^15
+        # keeps bal << 16 | val non-negative so int32 compares stay
+        # lexicographic).  The value side is guarded at config time in
+        # init_state; ballots grow with elections, so the bound is enforced
+        # on every report: an election-heavy campaign that overflowed would
+        # otherwise corrupt recovery/learner compares SILENTLY.
+        out["max_ballot"] = prop.bal.max()
         if log_total > 0:
             # Long-log: the window is a moving residual, so "fraction of
             # instances with a full window" reads ~0 on a HEALTHY run
@@ -331,6 +345,17 @@ def summarize(
         k: (v.item() if hasattr(v, "item") else v)
         for k, v in jax.device_get(out).items()
     }
+    if "max_ballot" in out:
+        from paxos_tpu.core.mp_state import BV_SHIFT
+
+        bal_bits = 31 - BV_SHIFT  # sign bit must stay clear after bal << 16
+        if out.pop("max_ballot") >= (1 << bal_bits):
+            raise MeasurementCorrupted(
+                "Multi-Paxos ballot overflowed the packed (ballot, value) "
+                f"layout (bal >= 2^{bal_bits}): recovery/learner compares "
+                "are no longer trustworthy for this campaign; shorten "
+                "ticks_per_seed or raise lease_len (ADVICE r4)"
+            )
     if liveness:
         from paxos_tpu.check.liveness import liveness_report
 

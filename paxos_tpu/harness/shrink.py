@@ -41,6 +41,7 @@ from paxos_tpu.harness.run import (
     init_state,
     make_advance,
     make_longlog,
+    summarize,
 )
 
 
@@ -103,6 +104,10 @@ def _violations_at(
         n = min(chunk, ticks - done)
         state = advance(state, n)
         done += n
+    # Measurement audit: summarize runs the packed-ballot overflow guard —
+    # minimizing against post-overflow violation bits would "shrink" noise
+    # (MeasurementCorrupted propagates to the caller).
+    summarize(state, log_total=cfg.fault.log_total)
     return jax.device_get(state.learner.violations)
 
 

@@ -58,3 +58,50 @@ def test_mp_equivocation_trips_checker():
     )
     report = run(cfg, total_ticks=400)
     assert report["violations"] > 0  # the MP checker must be falsifiable too
+
+
+def test_mp_ballot_overflow_guard():
+    """ADVICE r4: the packed (ballot, value) layout needs bal < 2^15; a
+    campaign whose ballots cross that line must FAIL its report rather
+    than silently corrupt lexicographic compares."""
+    import pytest
+
+    from paxos_tpu.harness.run import init_state, summarize
+
+    cfg = config3_multipaxos(n_inst=8, seed=0)
+    state = init_state(cfg)
+    bad = state.replace(
+        proposer=state.proposer.replace(
+            bal=state.proposer.bal + jnp.int32(1 << 15)
+        )
+    )
+    with pytest.raises(RuntimeError, match="overflow"):
+        summarize(bad)
+    summarize(state)  # healthy ballots pass
+
+
+def test_mp_checker_ignores_out_of_window_slots():
+    """ADVICE r4: an ACCEPT event with a slot outside [0, n_slots) must be
+    dropped by the learner fold, not miscounted as an eviction (min_bv
+    reads 0x7FFFFFFF when no one-hot row matches)."""
+    from paxos_tpu.check.mp_safety import mp_learner_observe
+    from paxos_tpu.core.mp_state import MPLearnerState
+
+    n_inst, n_slots, n_acc = 4, 2, 3
+    lrn = MPLearnerState.init(n_inst, n_slots, k=2)
+    flag = jnp.ones((n_acc, n_inst), bool)
+    bal = jnp.full((n_acc, n_inst), 9, jnp.int32)
+    val = jnp.full((n_acc, n_inst), 1005, jnp.int32)
+    for bad_slot in (-1, n_slots, n_slots + 7):
+        out = mp_learner_observe(
+            lrn, flag, bal, jnp.full((n_acc, n_inst), bad_slot, jnp.int32),
+            val, jnp.int32(0), quorum=2,
+        )
+        assert int(out.evictions.sum()) == 0
+        assert not bool(out.chosen.any())
+    # Control: the same event at a VALID slot does land.
+    out = mp_learner_observe(
+        lrn, flag, bal, jnp.zeros((n_acc, n_inst), jnp.int32), val,
+        jnp.int32(0), quorum=2,
+    )
+    assert bool(out.chosen[0].all())
