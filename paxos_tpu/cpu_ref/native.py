@@ -92,6 +92,18 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
         ]
         lib.explore_multipaxos.restype = None
+        lib.explore_fastpaxos.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.explore_fastpaxos.restype = None
+        lib.explore_raftcore.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.explore_raftcore.restype = None
         _LIB = lib
     return _LIB
 
@@ -358,46 +370,17 @@ def explore_native(
     the Python checker at the same bounds for the trace) and
     ``RuntimeError`` past ``max_states``, mirroring check_exhaustive.
     """
-    if isinstance(max_round, int):
-        max_round = (max_round,) * n_prop
-    if len(max_round) != n_prop:
-        raise ValueError(
-            f"max_round has {len(max_round)} bounds for n_prop={n_prop}"
-        )
-    if not 1 <= n_prop <= 4:
-        raise ValueError(f"explorer n_prop={n_prop} outside [1, 4]")
+    max_round = _norm_max_round(max_round, n_prop)
     if not 1 <= n_acc <= 8:
         raise ValueError(f"explorer n_acc={n_acc} outside [1, 8]")
-    if any(not 0 <= r <= 29 for r in max_round):
-        raise ValueError("explorer max_round outside [0, 29] (uint8 ballots)")
     lib = _load()
     mr = (ctypes.c_int32 * n_prop)(*max_round)
     out = (ctypes.c_int64 * 6)()
     lib.explore_paxos(
         n_prop, n_acc, mr, max_states, int(unsafe_accept), progress_every, out
     )
-    states, decided, violation, status, chosen_mask, peak = (
-        out[0], out[1], out[2], out[3], out[4], out[5],
-    )
-    if status == -1:
-        raise ValueError("invalid explorer topology (C-side check)")
-    if status == 2:
-        raise RuntimeError(
-            f"state space exceeds max_states={max_states}; tighten bounds"
-        )
-    chosen = {100 + v for v in range(n_prop) if chosen_mask & (1 << v)}
-    if violation:
-        raise AssertionError(
-            f"invariant violated after {states} states (native explorer "
-            f"reports existence; rerun the Python checker at the same "
-            f"bounds for the counterexample trace)"
-        )
-    return NativeExploreResult(
-        states=int(states),
-        decided_states=int(decided),
-        violation=False,
-        chosen_values=chosen,
-        peak_frontier=int(peak),
+    return _decode_explore_out(
+        out, max_states, "paxos", _own_vals_decoder(n_prop)
     )
 
 
@@ -423,20 +406,13 @@ def explore_mp_native(
     Python checker at the same bounds yields the trace) and
     ``RuntimeError`` past ``max_states``.
     """
-    if isinstance(max_round, int):
-        max_round = (max_round,) * n_prop
-    if len(max_round) != n_prop:
-        raise ValueError(
-            f"max_round has {len(max_round)} bounds for n_prop={n_prop}"
-        )
+    max_round = _norm_max_round(max_round, n_prop)
     if not 1 <= n_prop <= 3:
         raise ValueError(f"mp explorer n_prop={n_prop} outside [1, 3]")
     if not 1 <= n_acc <= 8:
         raise ValueError(f"mp explorer n_acc={n_acc} outside [1, 8]")
     if not 1 <= log_len <= 4:
         raise ValueError(f"mp explorer log_len={log_len} outside [1, 4]")
-    if any(not 0 <= r <= 29 for r in max_round):
-        raise ValueError("mp explorer max_round outside [0, 29]")
     lib = _load()
     mr = (ctypes.c_int32 * n_prop)(*max_round)
     out = (ctypes.c_int64 * 6)()
@@ -444,20 +420,28 @@ def explore_mp_native(
         n_prop, n_acc, log_len, mr, max_states, int(no_recovery),
         progress_every, out,
     )
+    return _decode_explore_out(
+        out, max_states, "mp",
+        # Compact order-isomorphic ids back to own_slot_value form.
+        lambda mask: {
+            (vid // log_len + 1) * 1000 + (vid % log_len)
+            for vid in range(n_prop * log_len)
+            if mask & (1 << vid)
+        },
+    )
+
+
+def _decode_explore_out(out, max_states: int, what: str, decode_chosen):
+    """Shared result decoding for every native explorer (out[0..5] ABI)."""
     states, decided, violation, status, chosen_mask, peak = (
         out[0], out[1], out[2], out[3], out[4], out[5],
     )
     if status == -1:
-        raise ValueError("invalid mp explorer topology (C-side check)")
+        raise ValueError(f"invalid {what} explorer topology (C-side check)")
     if status == 2:
         raise RuntimeError(
             f"state space exceeds max_states={max_states}; tighten bounds"
         )
-    chosen = {
-        (vid // log_len + 1) * 1000 + (vid % log_len)
-        for vid in range(n_prop * log_len)
-        if chosen_mask & (1 << vid)
-    }
     if violation:
         raise AssertionError(
             f"invariant violated after {states} states (native explorer "
@@ -468,6 +452,97 @@ def explore_mp_native(
         states=int(states),
         decided_states=int(decided),
         violation=False,
-        chosen_values=chosen,
+        chosen_values=decode_chosen(int(chosen_mask)),
         peak_frontier=int(peak),
+    )
+
+
+def _own_vals_decoder(n_prop: int):
+    """Chosen-bitmask decoder for single-decree protocols (bit v = 100+v)."""
+    return lambda mask: {100 + v for v in range(n_prop) if mask & (1 << v)}
+
+
+def _norm_max_round(max_round, n_prop: int):
+    if isinstance(max_round, int):
+        max_round = (max_round,) * n_prop
+    if len(max_round) != n_prop:
+        raise ValueError(
+            f"max_round has {len(max_round)} bounds for n_prop={n_prop}"
+        )
+    if not 1 <= n_prop <= 4:
+        raise ValueError(f"explorer n_prop={n_prop} outside [1, 4]")
+    if any(not 0 <= r <= 29 for r in max_round):
+        raise ValueError("explorer max_round outside [0, 29] (uint8 ballots)")
+    return max_round
+
+
+def explore_fp_native(
+    n_prop: int = 2,
+    n_acc: int = 5,
+    max_round: "int | tuple[int, ...]" = (1, 0),
+    max_states: int = 2_000_000_000,
+    q1: int = 0,
+    q2: int = 0,
+    q_fast: int = 0,
+    adopt_any: bool = False,
+    progress_every: int = 0,
+) -> NativeExploreResult:
+    """Exhaustively enumerate every schedule of bounded FAST PAXOS in
+    native code — the same transition system as
+    ``cpu_ref.fp_exhaustive.check_fp_exhaustive`` (shared fast ballot,
+    vote-at-most-once acceptors, choosable-rule recovery, same GC), state
+    counts cross-validated EXACTLY at shared bounds
+    (tests/test_native_oracle.py: 4,013,181 at 2x5, retries (1, 0)).
+    ``q1``/``q2``/``q_fast`` of 0 select the classic defaults; unsafe FFP
+    triples and ``adopt_any`` are falsifiability legs (must raise
+    ``AssertionError``).  ``RuntimeError`` past ``max_states``.
+    """
+    max_round = _norm_max_round(max_round, n_prop)
+    if not 1 <= n_acc <= 8:
+        raise ValueError(f"fp explorer n_acc={n_acc} outside [1, 8]")
+    for name, q in (("q1", q1), ("q2", q2), ("q_fast", q_fast)):
+        if not 0 <= q <= n_acc:
+            raise ValueError(f"{name}={q} outside [0, n_acc={n_acc}]")
+    lib = _load()
+    mr = (ctypes.c_int32 * n_prop)(*max_round)
+    out = (ctypes.c_int64 * 6)()
+    lib.explore_fastpaxos(
+        n_prop, n_acc, q1, q2, q_fast, mr, max_states, int(adopt_any),
+        progress_every, out,
+    )
+    return _decode_explore_out(out, max_states, "fp", _own_vals_decoder(n_prop))
+
+
+def explore_raft_native(
+    n_prop: int = 2,
+    n_acc: int = 3,
+    max_round: "int | tuple[int, ...]" = 1,
+    max_states: int = 2_000_000_000,
+    no_restriction: bool = False,
+    no_adoption: bool = False,
+    progress_every: int = 0,
+) -> NativeExploreResult:
+    """Exhaustively enumerate every schedule of bounded RAFT-CORE in native
+    code — the same transition system as
+    ``cpu_ref.raft_exhaustive.check_raft_exhaustive`` (election
+    restriction, one-vote-per-term, adoption from grants AND denials, same
+    GC), state counts cross-validated EXACTLY at shared bounds
+    (tests/test_native_oracle.py: 1,233,894 at 2x3, symmetric retry).
+    ``no_restriction``/``no_adoption`` disable one safety leg each —
+    either alone stays clean, both off must raise ``AssertionError`` (the
+    Python decomposition, reproduced natively).  ``RuntimeError`` past
+    ``max_states``.
+    """
+    max_round = _norm_max_round(max_round, n_prop)
+    if not 1 <= n_acc <= 8:
+        raise ValueError(f"raft explorer n_acc={n_acc} outside [1, 8]")
+    lib = _load()
+    mr = (ctypes.c_int32 * n_prop)(*max_round)
+    out = (ctypes.c_int64 * 6)()
+    lib.explore_raftcore(
+        n_prop, n_acc, mr, max_states, int(no_restriction), int(no_adoption),
+        progress_every, out,
+    )
+    return _decode_explore_out(
+        out, max_states, "raft", _own_vals_decoder(n_prop)
     )

@@ -226,10 +226,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     c.add_argument(
         "--native", action="store_true",
-        help="paxos/multipaxos: run the native (C++) explorer — same "
-        "transition system and GC, ~70-150x faster, counts cross-validated "
-        "against the Python checker; traces and the liveness leg stay "
-        "Python-side",
+        help="run the native (C++) explorer — same transition system and "
+        "GC as the Python checker for all four protocols, ~20-150x "
+        "faster, counts cross-validated bit-for-bit; traces and the "
+        "liveness leg stay Python-side",
     )
     c.add_argument(
         "--progress-every", type=int, default=0, metavar="N",
@@ -492,18 +492,14 @@ def cmd_check(args: argparse.Namespace) -> int:
         print("error: --livelock-bug needs --liveness-bound (the liveness "
               "leg is what detects it)", file=sys.stderr)
         return 1
-    if args.native and (
-        args.protocol not in ("paxos", "multipaxos")
-        or args.liveness_bound is not None
-    ):
-        print("error: --native supports --protocol paxos/multipaxos without "
-              "--liveness-bound (liveness and traces are Python-side)",
-              file=sys.stderr)
+    if args.native and args.liveness_bound is not None:
+        print("error: --native excludes --liveness-bound (liveness and "
+              "traces are Python-side)", file=sys.stderr)
         return 1
     try:
         if args.native:
-            # ONE native dispatch + result block for every explorer the
-            # C++ tier grows (paxos today, multipaxos today, others later).
+            # ONE native dispatch + result block for the full explorer
+            # matrix (all four protocols as of round 5).
             if args.protocol == "multipaxos":
                 from paxos_tpu.cpu_ref.native import explore_mp_native
 
@@ -514,6 +510,32 @@ def cmd_check(args: argparse.Namespace) -> int:
                     max_round=mr,
                     max_states=args.max_states,
                     no_recovery=args.no_recovery,
+                    progress_every=args.progress_every,
+                )
+            elif args.protocol == "fastpaxos":
+                from paxos_tpu.cpu_ref.native import explore_fp_native
+
+                nr = explore_fp_native(
+                    n_prop=args.n_prop,
+                    n_acc=args.n_acc,
+                    max_round=mr,
+                    max_states=args.max_states,
+                    q1=args.q1,
+                    q2=args.q2,
+                    q_fast=args.q_fast,
+                    adopt_any=args.adopt_any,
+                    progress_every=args.progress_every,
+                )
+            elif args.protocol == "raftcore":
+                from paxos_tpu.cpu_ref.native import explore_raft_native
+
+                nr = explore_raft_native(
+                    n_prop=args.n_prop,
+                    n_acc=args.n_acc,
+                    max_round=mr,
+                    max_states=args.max_states,
+                    no_restriction=args.no_restriction,
+                    no_adoption=args.no_adoption,
                     progress_every=args.progress_every,
                 )
             else:

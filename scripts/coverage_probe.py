@@ -34,14 +34,26 @@ def main() -> int:
     ap.add_argument("--seed0", type=int, default=0)
     ap.add_argument("--max-states", type=int, default=50_000_000)
     ap.add_argument("--record", default=None)
+    ap.add_argument(
+        "--analyze-residue", action="store_true",
+        help="append residue_analysis (what the UNREACHED states share) "
+        "to the report — the design input for targeted adversaries",
+    )
+    ap.add_argument(
+        "--profile", type=int, default=None,
+        help="pin ONE portfolio profile index for every seed (default: "
+        "rotate the full portfolio)",
+    )
     args = ap.parse_args()
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # the probe is a CPU tool
 
-    from paxos_tpu.check.coverage import coverage_probe
+    from paxos_tpu.check.coverage import PORTFOLIO, coverage_probe
 
+    if args.profile is not None and not 0 <= args.profile < len(PORTFOLIO):
+        ap.error(f"--profile must be in [0, {len(PORTFOLIO) - 1}]")
     mr = args.max_round[0] if len(args.max_round) == 1 else tuple(args.max_round)
     out = coverage_probe(
         n_prop=args.n_prop,
@@ -53,6 +65,10 @@ def main() -> int:
         seed0=args.seed0,
         max_states=args.max_states,
         log=lambda s: print(f"# {s}", file=sys.stderr),
+        probe_cfg_kw=(
+            None if args.profile is None else PORTFOLIO[args.profile]
+        ),
+        analyze_residue=args.analyze_residue,
     )
     sample = out.pop("out_of_space_sample")
     print(json.dumps(out))

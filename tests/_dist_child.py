@@ -137,6 +137,49 @@ def main() -> None:
     out["fused"] = {
         k: int(v) for k, v in jax.device_get(fused_campaign()).items()
     }
+
+    # VERDICT r4 #7: the REAL Pallas lowering crossing process boundaries.
+    # The interpret-mode emulation deadlocks under a multi-process
+    # shard_map (documented above), so sidestep shard_map entirely: this
+    # controller runs plain ``fused_chunk`` (the actual pallas_call,
+    # interpret mode, no mesh) on its process's DISJOINT half of the lanes
+    # with the manually-computed global ``block_offset`` the sharded
+    # wrapper would have assigned (pid * blocks_per_shard).  The parent
+    # concatenates both halves' state digests and asserts bit-equality
+    # with a single-process full-width ``fused_chunk`` — the lowering
+    # itself, not just the stream oracle, validated across processes.
+    import hashlib
+
+    import numpy as np
+
+    from paxos_tpu.kernels.fused_tick import fused_chunk
+
+    block = 16
+    half = cfg.n_inst // 2
+    blocks_per_shard = half // block
+
+    def slice_half(tree):
+        return jax.tree.map(
+            lambda x: (
+                x[..., pid * half:(pid + 1) * half]
+                if getattr(x, "ndim", 0) >= 1 and x.shape[-1] == cfg.n_inst
+                else x
+            ),
+            tree,
+        )
+
+    local = fused_chunk(
+        slice_half(init_state(cfg)), jnp.int32(cfg.seed),
+        slice_half(init_plan(cfg)), cfg.fault, 32, apply_fn, mask_fn,
+        block=block, interpret=True, block_offset=pid * blocks_per_shard,
+    )
+    digest = hashlib.sha256()
+    for leaf in jax.tree.leaves(jax.device_get(local)):
+        arr = np.asarray(leaf)
+        digest.update(str((arr.dtype.str, arr.shape)).encode())
+        digest.update(arr.tobytes())
+    out["pallas_shard_digest"] = digest.hexdigest()
+
     out["process"] = pid
     print(json.dumps(out), flush=True)
 

@@ -81,6 +81,9 @@ def test_two_process_rendezvous_smoke():
                 p.kill()
 
     assert outs[0]["process"] == 0 and outs[1]["process"] == 1
+    # The per-process Pallas shard digests are shard-LOCAL (disjoint lanes),
+    # so pull them out before the replicated-metrics equality check.
+    shard_digests = [o.pop("pallas_shard_digest") for o in outs]
     for o in outs:
         del o["process"]
     assert outs[0] == outs[1], outs  # identical metrics on both controllers
@@ -113,3 +116,32 @@ def test_two_process_rendezvous_smoke():
     }
     assert outs[0]["fused"] == expected, (outs[0]["fused"], expected)
     assert expected["violations"] == 0 and expected["chosen"] > 0
+
+    # VERDICT r4 #7: the REAL Pallas lowering across process boundaries.
+    # Each child ran plain fused_chunk (the actual pallas_call, interpret
+    # mode, NO shard_map — the emulation deadlocks there) on its disjoint
+    # half of the lanes with the manually-computed global block_offset
+    # (pid * blocks_per_shard).  The same kernel run single-process over
+    # the full width, sliced per half and digested identically, must match
+    # bit for bit — validating the lowering's block-offset arithmetic, not
+    # just the reference_chunk stream oracle, in a multi-controller
+    # program.
+    import hashlib
+
+    import numpy as np
+
+    half = cfg.n_inst // 2
+
+    def digest_half(tree, pid):
+        d = hashlib.sha256()
+        for leaf in jax.tree.leaves(jax.device_get(tree)):
+            arr = np.asarray(leaf)
+            if arr.ndim >= 1 and arr.shape[-1] == cfg.n_inst:
+                arr = arr[..., pid * half:(pid + 1) * half]
+            d.update(str((arr.dtype.str, arr.shape)).encode())
+            d.update(arr.tobytes())
+        return d.hexdigest()
+
+    assert [digest_half(st, 0), digest_half(st, 1)] == shard_digests, (
+        "per-process Pallas shards diverged from the single-process kernel"
+    )

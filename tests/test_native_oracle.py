@@ -318,3 +318,91 @@ def test_native_mp_explorer_finds_skipped_recovery_bug():
 
     with pytest.raises(AssertionError, match="invariant violated"):
         explore_mp_native(max_round=(2, 1), no_recovery=True)
+
+
+def test_native_fp_explorer_cross_validates_python_counts():
+    """The C++ Fast Paxos explorer (round-5 matrix completion) mirrors
+    cpu_ref/fp_exhaustive.py — shared fast ballot, vote-at-most-once
+    acceptors, choosable-rule recovery, same GC; state AND decided counts
+    and chosen-value sets must match the Python checker EXACTLY at shared
+    bounds, including an FFP quorum triple (non-majority code path)."""
+    from paxos_tpu.cpu_ref.fp_exhaustive import check_fp_exhaustive
+    from paxos_tpu.cpu_ref.native import explore_fp_native
+
+    for kw in (
+        {"max_round": (0, 0), "n_acc": 5},
+        {"max_round": (1, 0), "n_acc": 3},
+        {"max_round": (1, 1), "n_acc": 3},
+        {"max_round": (1, 0), "n_acc": 5, "q1": 4, "q2": 2, "q_fast": 4},
+    ):
+        py = check_fp_exhaustive(max_states=10_000_000, **kw)
+        nat = explore_fp_native(**kw)
+        assert (nat.states, nat.decided_states) == (
+            py.states, py.decided_states,
+        ), kw
+        assert nat.chosen_values == py.chosen_values, kw
+
+
+def test_native_fp_explorer_reproduces_canonical_bound():
+    """BASELINE.md's recorded FP bound (2 fast proposers x 5 acceptors, one
+    coordinated recovery round: 4,013,181 states, ~3.5 min Python) in
+    seconds."""
+    from paxos_tpu.cpu_ref.native import explore_fp_native
+
+    nat = explore_fp_native(n_acc=5, max_round=(1, 0))
+    assert nat.states == 4_013_181
+    assert nat.chosen_values == {100, 101}
+
+
+def test_native_fp_explorer_finds_injected_bugs():
+    """Both FP falsifiability legs fire natively: adopt_any (skip the
+    choosable rule) and an unsafe FFP fast quorum (q_fast=3 over n=5
+    violates the intersection condition)."""
+    import pytest
+
+    from paxos_tpu.cpu_ref.native import explore_fp_native
+
+    with pytest.raises(AssertionError, match="invariant violated"):
+        explore_fp_native(n_acc=5, max_round=(1, 0), adopt_any=True)
+    with pytest.raises(AssertionError, match="invariant violated"):
+        explore_fp_native(n_acc=5, max_round=(1, 0), q_fast=3)
+
+
+def test_native_raft_explorer_cross_validates_python_counts():
+    """The C++ Raft-core explorer (round-5 matrix completion) mirrors
+    cpu_ref/raft_exhaustive.py — election restriction, one-vote-per-term,
+    adoption from grants AND denials, same conservative GC; counts must
+    match the Python checker EXACTLY at shared bounds."""
+    from paxos_tpu.cpu_ref.native import explore_raft_native
+    from paxos_tpu.cpu_ref.raft_exhaustive import check_raft_exhaustive
+
+    for kw in (
+        {"max_round": (0, 0)},
+        {"max_round": (1, 0)},
+        {"max_round": (1, 1)},
+        # 5-acceptor quorum path with one candidate: the cheapest bound
+        # that exercises the wide-quorum encoding in both checkers (two
+        # candidates at 5 acceptors start at 4.5M states — native-only
+        # territory; see the BASELINE.md deep-bound rows).
+        {"n_prop": 1, "max_round": (2,), "n_acc": 5},
+    ):
+        py = check_raft_exhaustive(max_states=10_000_000, **kw)
+        nat = explore_raft_native(**kw)
+        assert (nat.states, nat.decided_states) == (
+            py.states, py.decided_states,
+        ), kw
+        assert nat.chosen_values == py.chosen_values, kw
+
+
+def test_native_raft_explorer_two_leg_decomposition():
+    """The mechanized safety decomposition reproduces natively: either leg
+    alone (restriction or adoption) keeps the bounded space clean;
+    disabling BOTH yields a violation."""
+    import pytest
+
+    from paxos_tpu.cpu_ref.native import explore_raft_native
+
+    assert explore_raft_native(max_round=1, no_restriction=True).states > 0
+    assert explore_raft_native(max_round=1, no_adoption=True).states > 0
+    with pytest.raises(AssertionError, match="invariant violated"):
+        explore_raft_native(max_round=1, no_restriction=True, no_adoption=True)
