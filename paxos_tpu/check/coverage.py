@@ -283,6 +283,148 @@ def _decided(state) -> bool:
     return any(pr[0] == DONE for pr in state[1])
 
 
+def _lane_matrix(cols, n_inst: int) -> np.ndarray:
+    """Stack per-lane state columns into an (I, F) int32 matrix.
+
+    Row i is a byte-exact fingerprint of everything the projection reads
+    for lane i — two lanes with equal rows project to the SAME canonical
+    state, so the probe only runs the (Python, slow) projection once per
+    distinct row and serves repeats from a cache.  At 3.65M samples over
+    ~7k distinct states this is a ~100x probe speedup, which is what
+    makes plateau-length campaigns (VERDICT r4 #1) tractable.
+    """
+    return np.ascontiguousarray(np.concatenate(
+        [np.asarray(c).astype(np.int32).reshape(-1, n_inst) for c in cols],
+        axis=0,
+    ).T)
+
+
+def _paxos_lane_cols(h):
+    acc, pro, lrn = h.acceptor, h.proposer, h.learner
+    req, rep = h.requests, h.replies
+    return (
+        acc.promised, acc.acc_bal, acc.acc_val,
+        pro.phase, pro.bal, pro.heard, pro.best_bal, pro.best_val,
+        pro.prop_val, pro.decided_val,
+        req.present, req.bal, req.v1, req.v2,
+        rep.present, rep.bal, rep.v1, rep.v2,
+        lrn.lt_bal, lrn.lt_val, lrn.lt_mask,
+    )
+
+
+def probe_lanes(
+    cfgs, step, lane_cols, project, in_bounds, n_inst: int, ticks: int, say,
+) -> dict:
+    """The shared lane-sampling driver for every protocol's coverage probe.
+
+    Runs each config for ``ticks`` single-tick chunks, fingerprints every
+    in-bounds lane per tick (:func:`_lane_matrix` over ``lane_cols(h)``),
+    projects each DISTINCT raw row once (``project(h, i)`` -> canonical
+    state, or ``None`` for protocol-specific nonconforming transients,
+    which are excluded and counted), and counts canonical-state ENTRIES
+    (a lane leaving one canonical state for another = one detection) —
+    the abundance statistics the Chao1 estimator feeds on.
+    """
+    import jax
+
+    from paxos_tpu.harness.run import (
+        base_key, init_plan, init_state, run_chunk,
+    )
+
+    counts: dict = {}
+    samples = detections = nonconforming = deeper = 0
+    growth = []
+    # One cache across every config: projections depend only on the
+    # fingerprinted lane bytes, so distinct states common to many seeds
+    # project exactly once.
+    proj_cache: dict = {}  # raw lane bytes -> canonical state (or None)
+    _MISS = object()
+    for cfg in cfgs:
+        state = init_state(cfg)
+        plan = init_plan(cfg)
+        key = base_key(cfg)
+        prev: list = [None] * n_inst  # per-lane previous raw bytes
+        for t in range(ticks + 1):
+            if t > 0:
+                state = run_chunk(state, key, plan, cfg.fault, 1, step)
+            h = jax.device_get(state)
+            in_b = in_bounds(h)
+            # A lane whose table evicted has an incomplete voters
+            # projection forever after (evictions are monotone) — exclude
+            # it.  Only lanes far past the ballot bounds can evict
+            # (k_slots exceeds the in-bounds distinct-pair count), so this
+            # never drops an in-bounds-reachable state.
+            evicted = np.asarray(h.learner.evictions) > 0
+            assert not (in_b & evicted).any(), (
+                "in-bounds lane evicted: k_slots below the in-bounds "
+                "distinct-ballot count — raise it"
+            )
+            deeper += int((~in_b).sum())
+            mat = _lane_matrix(lane_cols(h), n_inst)
+            for i in np.nonzero(in_b)[0]:
+                raw = mat[i].tobytes()
+                st = proj_cache.get(raw, _MISS)
+                if st is _MISS:
+                    st = project(h, int(i))
+                    proj_cache[raw] = st
+                if st is None:  # nonconforming transient: excluded
+                    nonconforming += 1
+                    prev[i] = raw
+                    continue
+                samples += 1
+                if raw == prev[i]:
+                    continue  # same dwell: not a new detection
+                # Raw rows can differ while projecting to the same
+                # canonical state (dead-field churn): a detection is a
+                # CANONICAL-state entry.
+                if prev[i] is None or proj_cache.get(prev[i]) != st:
+                    counts[st] = counts.get(st, 0) + 1
+                    detections += 1
+                prev[i] = raw
+        growth.append(len(counts))
+        say(f"seed {cfg.seed}: |visited|={len(counts)} "
+            f"({samples} samples, {nonconforming} nonconforming, "
+            f"{deeper} deeper)")
+    return {
+        "counts": counts,
+        "samples": samples,
+        "detections": detections,
+        "nonconforming": nonconforming,
+        "deeper": deeper,
+        "growth": growth,
+    }
+
+
+def chao1_estimate(counts: dict, detections: int) -> dict:
+    """Chao1 asymptote + Good-Turing sample coverage over DETECTION counts
+    (state entries, not per-tick dwell — see :func:`probe_lanes`)."""
+    f1 = sum(1 for c in counts.values() if c == 1)
+    f2 = sum(1 for c in counts.values() if c == 2)
+    visited = len(counts)
+    chao1 = (
+        visited + f1 * f1 / (2 * f2) if f2 else visited + f1 * (f1 - 1) / 2
+    )
+    return {
+        "singletons": f1,
+        "doubletons": f2,
+        "chao1": round(chao1, 1),
+        "good_turing_sample_coverage": round(
+            1.0 - f1 / max(detections, 1), 6
+        ),
+    }
+
+
+def category_block(space: set, visited: set, pred) -> dict:
+    """Coverage of a predicate-defined state class within ``space``."""
+    space_c = sum(1 for s in space if pred(s))
+    vis_c = sum(1 for s in visited if s in space and pred(s))
+    return {
+        "space": space_c,
+        "visited": vis_c,
+        "coverage": round(vis_c / max(space_c, 1), 6),
+    }
+
+
 def coverage_probe(
     n_prop: int = 2,
     n_acc: int = 3,
@@ -311,11 +453,7 @@ def coverage_probe(
     and QUIET states (network drained — the configurations every real
     execution passes through).
     """
-    import jax
-
-    from paxos_tpu.harness.run import (
-        base_key, get_step_fn, init_plan, init_state, run_chunk,
-    )
+    from paxos_tpu.harness.run import get_step_fn
 
     say = log or (lambda s: None)
     mr = (max_round,) * n_prop if isinstance(max_round, int) else tuple(max_round)
@@ -334,90 +472,38 @@ def coverage_probe(
     )
     say(f"slot: {r_slot.states} raw, {len(slot)} canonical")
 
-    step = get_step_fn("paxos")
-    # canonical state -> number of DETECTIONS: a lane entering the state
-    # (counted once per consecutive dwell, so abundance reflects how many
-    # times the process produced the state, not how long lanes idle in
-    # it — dwell counts would collapse the singleton statistics the Chao1
-    # estimator below feeds on).
-    counts: dict = {}
-    deeper = 0
-    samples = 0
-    detections = 0
-    growth = []
     bounds = np.asarray(mr)[:, None]
+
+    def in_bounds(h):
+        rnds = (np.asarray(h.proposer.bal) - 1) // _MAX_PROPS  # (P, I)
+        return (rnds <= bounds).all(axis=0)
+
+    cfgs = []
     for s_idx in range(seeds):
         kw = probe_cfg_kw
         if kw is None:
             kw = PORTFOLIO[s_idx % len(PORTFOLIO)]
-        cfg = probe_config(n_inst, seed0 + s_idx, n_prop, n_acc, **kw)
-        state = init_state(cfg)
-        plan = init_plan(cfg)
-        key = base_key(cfg)
-        prev: list = [None] * n_inst  # per-lane previous projected state
-        for t in range(ticks + 1):
-            if t > 0:
-                state = run_chunk(state, key, plan, cfg.fault, 1, step)
-            h = jax.device_get(state)
-            rnds = (np.asarray(h.proposer.bal) - 1) // _MAX_PROPS  # (P, I)
-            in_b = (rnds <= bounds).all(axis=0)
-            # A lane whose table evicted has an incomplete voters
-            # projection forever after (evictions are monotone) — exclude
-            # it.  Only lanes far past the ballot bounds can evict (k_slots
-            # exceeds the in-bounds distinct-pair count), so this never
-            # drops an in-bounds-reachable state; asserted below.
-            evicted = np.asarray(h.learner.evictions) > 0
-            assert not (in_b & evicted).any(), (
-                "in-bounds lane evicted: k_slots below the in-bounds "
-                "distinct-ballot count — raise it"
-            )
-            deeper += int((~in_b).sum())
-            for i in np.nonzero(in_b)[0]:
-                st = project_lane(h, int(i), n_prop, n_acc)
-                samples += 1
-                if st != prev[i]:  # a new dwell = one detection
-                    counts[st] = counts.get(st, 0) + 1
-                    detections += 1
-                    prev[i] = st
-        growth.append(len(counts))
-        say(f"seed {cfg.seed}: |visited|={len(counts)} "
-            f"({samples} in-bounds samples, {deeper} deeper)")
+        cfgs.append(probe_config(n_inst, seed0 + s_idx, n_prop, n_acc, **kw))
+    run_stats = probe_lanes(
+        cfgs, get_step_fn("paxos"), _paxos_lane_cols,
+        lambda h, i: project_lane(h, i, n_prop, n_acc),
+        in_bounds, n_inst, ticks, say,
+    )
+    counts = run_stats["counts"]
 
     visited = set(counts)
     out_of_space = visited - slot
     in_slot = len(visited) - len(out_of_space)
     in_multi = len(visited & multi)
 
-    # Chao1 asymptote (VERDICT r4 #1): the abundance-based estimate of how
-    # many distinct states THIS sampling process would reach at infinite
-    # samples — S_obs + F1^2 / (2 F2) (bias-corrected form when F2 = 0),
-    # over DETECTION counts (state entries), not per-tick dwell counts.
-    # Chao1 estimates the sampling process's own support, not the space:
-    # chao1 << |slot| means the residue needs schedules this process
-    # cannot produce (observation-structural), chao1 ~ |slot| means it is
-    # merely seed-starved.
-    f1 = sum(1 for c in counts.values() if c == 1)
-    f2 = sum(1 for c in counts.values() if c == 2)
-    if f2:
-        chao1 = len(visited) + f1 * f1 / (2 * f2)
-    else:
-        chao1 = len(visited) + f1 * (f1 - 1) / 2
-    sample_coverage = 1.0 - f1 / max(detections, 1)  # Good-Turing
-
-    def category(pred):
-        space_c = sum(1 for s in slot if pred(s))
-        vis_c = sum(1 for s in visited if s in slot and pred(s))
-        return {
-            "space": space_c,
-            "visited": vis_c,
-            "coverage": round(vis_c / max(space_c, 1), 6),
-        }
-
-    decided_cov = category(_decided)
-    quiet_cov = category(lambda s: not s[2])
     extra: dict[str, Any] = {}
     if analyze_residue:
         extra["residue"] = residue_analysis(slot, visited)
+    # Chao1 (chao1_estimate) reads: the estimator bounds what THIS sampling
+    # process would reach at infinite samples, not the space — chao1 <<
+    # |slot| means the residue needs schedules the process cannot produce
+    # (observation-structural); chao1 ~ |slot| means merely seed-starved.
+    chao = chao1_estimate(counts, run_stats["detections"])
     return extra | {
         "metric": "fuzz-coverage",
         "bounds": {"n_prop": n_prop, "n_acc": n_acc, "max_round": list(mr)},
@@ -437,18 +523,14 @@ def coverage_probe(
         "coverage_multiset": round(in_multi / max(len(multi), 1), 6),
         "out_of_space": len(out_of_space),  # MUST be 0 (soundness)
         "out_of_space_sample": sorted(out_of_space)[:3],
-        "decided_states": decided_cov,
-        "quiet_states": quiet_cov,
-        "growth": growth,
-        "samples": samples,
-        "detections": detections,
-        "deeper_than_bounds_samples": deeper,
-        "singletons": f1,
-        "doubletons": f2,
-        "chao1": round(chao1, 1),
-        "chao1_vs_slot": round(chao1 / max(len(slot), 1), 4),
-        "good_turing_sample_coverage": round(sample_coverage, 6),
+        "decided_states": category_block(slot, visited, _decided),
+        "quiet_states": category_block(slot, visited, lambda s: not s[2]),
+        "growth": run_stats["growth"],
+        "samples": run_stats["samples"],
+        "detections": run_stats["detections"],
+        "deeper_than_bounds_samples": run_stats["deeper"],
+        "chao1_vs_slot": round(chao["chao1"] / max(len(slot), 1), 4),
         "n_inst": n_inst,
         "ticks": ticks,
         "seeds": seeds,
-    }
+    } | chao

@@ -1,0 +1,407 @@
+"""Multi-Paxos fuzz-coverage against the exhaustive space (VERDICT r4 #3).
+
+``check/coverage.py`` measures classic-Paxos fuzz occupancy of the bounded
+model's state space; this sibling lifts the measurement to MULTI-PAXOS —
+log state, whole-log recovery, elections — so the README's "the engines
+and the checker agree about the protocol" claim is a two-protocol
+measurement, not a one-protocol fact quoted as a framework property.
+
+Same three state sets, at shared (n_prop, n_acc, log_len, max_round)
+bounds, all quotiented by the same ``canon_mp``:
+
+- ``S_multi`` — ``cpu_ref.mp_exhaustive``'s multiset-network space;
+- ``S_slot`` — the same transition system under the TPU transport's
+  fixed-slot buffers (``check_mp_exhaustive(slot_net=True)``; the MP
+  state's request/promise/accepted buffers are exactly one slot per
+  (kind, src, dst) edge), so ``S_multi - S_slot`` is the EXACT
+  transport-excluded remainder;
+- ``V`` — the fuzz lanes' tick-boundary states through
+  :func:`project_mp_lane`.
+
+**Ballot alignment**: the kernel's first election runs at packed round 0
+(``bal = make_ballot(ballot_round(0) + 1 = 0, pid)``) while the model's
+first challenge runs at round 1 (``_timeout`` increments from the initial
+0), so the projection shifts every nonzero kernel ballot up one round
+(+MAX_PROPOSERS).  The shift is order-preserving, so folds and GC agree.
+
+**The canon_mp quotient** (applied to BOTH the enumerated spaces and the
+projections; every quotiented field is write-only until a phase
+transition resets it, except ``recov`` — see below):
+
+- ``heard`` zeroed outside CANDIDATE/LEAD; ``commit_idx`` zeroed outside
+  LEAD; ``dec`` zeroed everywhere (write-only bookkeeping in the model:
+  transitions never read it).
+- ``recov`` zeroed EVERYWHERE — a deliberate coarsening, not a dead-field
+  erasure.  Batched promise folds legitimately accumulate past the
+  model's at-quorum stop (three same-tick promises fold three payloads
+  where the single-delivery model stops at quorum and GCs the third),
+  and unlike classic Paxos' phase-1 ``best_*`` accumulators the MP
+  recovery array stays LIVE into LEAD (each slot advance reads it), so
+  the exact values are not comparable state-by-state.  Nothing is
+  hidden from the metric: recovery's downstream effect — the values
+  actually driven — is fully visible through the ACCEPT traffic,
+  acceptor logs, and vote rows, and the fold's CONTENT is verified
+  tick-exactly by the differential interpreter and exhaustively by the
+  checker's own safety leg.
+- vote rows of a CHOSEN slot collapse to one ``((slot, -1, value), -1)``
+  marker: the kernel's learner suppresses re-confirmation votes after
+  choice (table-pressure control) while the model records them at every
+  ballot; votes are write-only w.r.t. transitions, so the collapse is a
+  sound quotient and keeps the decided corner first-class.
+
+**Projection-only reductions** (kernel-transient structure the model
+never produces; each drops a message whose delivery is a no-op modulo
+idempotent re-emission):
+
+- an ACCEPT(b, s, v) to an acceptor whose log already holds (b, v) at
+  slot s (the leader re-broadcasts its current slot every tick;
+  re-accepting is idempotent);
+- an ACCEPTED(b, s, v) whose voter bit is already folded into the
+  addressee's ``heard`` for its current slot (the re-broadcast's echo).
+
+**Exclusions** (counted, not silently dropped): lanes where any proposer
+sits in FOLLOW with a nonzero ballot — the kernel's failed-candidacy /
+demotion transient (``cand_fail``/``demote`` zero ``heard`` and fall
+back to FOLLOW; the model has no corresponding action, and the
+promises the failed candidacy consumed are unrecoverable from the
+state).  Such lanes re-conform at their next election, so the exclusion
+is transient; the report carries the excluded-sample count.
+
+Probe fault model: selection entropy + ``p_idle`` + ``p_hold`` +
+election timing (lease/jitter/backoff draws) — the full asynchrony
+adversary; ``p_drop``/``p_dup`` stay 0 by construction (loss = delay
+forever, as in the classic probe).
+
+Reference parity: the reference has no analog (SURVEY.md §5 [B]); this
+is the TPU twin's own-verification tier.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from paxos_tpu.check.coverage import (
+    category_block,
+    chao1_estimate,
+    probe_lanes,
+)
+from paxos_tpu.core.mp_state import BV_SHIFT
+from paxos_tpu.cpu_ref.mp_exhaustive import (
+    ACCEPT as M_ACCEPT,
+    ACCEPTED as M_ACCEPTED,
+    CAND,
+    DONE,
+    FOLLOW,
+    LEAD,
+    PREPARE as M_PREPARE,
+    PROMISE as M_PROMISE,
+    _gc,
+    check_mp_exhaustive,
+)
+from paxos_tpu.faults.injector import FaultConfig
+from paxos_tpu.harness.config import SimConfig
+
+_MAX_PROPS = 8
+_REQ_PREPARE, _REQ_ACCEPT = 0, 1
+
+
+def _shift(bal: int) -> int:
+    """Kernel ballot -> model ballot (one round up; 0 stays NIL)."""
+    return bal + _MAX_PROPS if bal > 0 else 0
+
+
+def canon_mp(state, quorum: int):
+    """Quotient a model/projected MP state (see module docstring)."""
+    accs, props, net, votes = state
+    log_len = len(accs[0][1])
+    zero_recov = ((0, 0),) * log_len
+    zero_dec = (0,) * log_len
+    props2 = tuple(
+        (
+            ph,
+            rnd,
+            heard if ph in (CAND, LEAD) else 0,
+            zero_recov,
+            ci if ph == LEAD else 0,
+            zero_dec,
+        )
+        for (ph, rnd, heard, recov, ci, dec) in props
+    )
+    chosen = {}
+    for (s, b, v), m in votes:
+        if bin(m).count("1") >= quorum:
+            chosen[s] = v
+    votes2 = tuple(sorted(
+        [((s, b, v), m) for (s, b, v), m in votes if s not in chosen]
+        + [((s, -1, v), -1) for s, v in chosen.items()]
+    ))
+    return (accs, props2, net, votes2)
+
+
+def project_mp_lane(h, i: int, n_prop: int, n_acc: int, log_len: int):
+    """One fuzz lane's host-side ``MultiPaxosState`` -> canonical model
+    state, or ``None`` when the lane is in a nonconforming transient
+    (a failed-candidacy FOLLOW; see module docstring)."""
+    acc, pro = h.acceptor, h.proposer
+    lrn = h.learner
+
+    props = []
+    for p in range(n_prop):
+        bal = int(pro.bal[p, i])
+        phase = int(pro.phase[p, i])
+        ci = int(pro.commit_idx[p, i])
+        if phase == FOLLOW and bal > 0:
+            return None  # failed-candidacy / demotion transient
+        rnd = 0 if bal == 0 else (bal - 1) // _MAX_PROPS + 1
+        if phase == LEAD and ci >= log_len:
+            phase = DONE  # the model's terminal leader
+        props.append((
+            phase,
+            rnd,
+            int(pro.heard[p, i]),
+            ((0, 0),) * log_len,  # recov: quotiented (canon_mp zeroes too)
+            ci,
+            (0,) * log_len,
+        ))
+    props = tuple(props)
+
+    accs = []
+    for a in range(n_acc):
+        log = tuple(
+            ((bv >> BV_SHIFT) + _MAX_PROPS if bv > 0 else 0,
+             bv & ((1 << BV_SHIFT) - 1))
+            for s in range(log_len)
+            for bv in (int(acc.log[a, s, i]),)
+        )
+        accs.append((_shift(int(acc.promised[a, i])), log))
+    accs = tuple(accs)
+
+    def lead_slot(p):
+        # The addressee's live (ballot, slot) pair, for the idempotent-
+        # ACCEPTED reduction.
+        return (
+            int(pro.phase[p, i]) == LEAD,
+            _shift(int(pro.bal[p, i])),
+            int(pro.commit_idx[p, i]),
+            int(pro.heard[p, i]),
+        )
+
+    net = []
+    req, prom, accd = h.requests, h.promises, h.accepted
+    for p in range(n_prop):
+        for a in range(n_acc):
+            if req.present[_REQ_PREPARE, p, a, i]:
+                net.append((
+                    M_PREPARE, p, a,
+                    _shift(int(req.bal[_REQ_PREPARE, p, a, i])), 0, 0, (),
+                ))
+            if req.present[_REQ_ACCEPT, p, a, i]:
+                b = _shift(int(req.bal[_REQ_ACCEPT, p, a, i]))
+                v = int(req.v1[_REQ_ACCEPT, p, a, i])
+                s = int(req.v2[_REQ_ACCEPT, p, a, i])
+                # Idempotent re-broadcast: already accepted verbatim.
+                if not (accs[a][0] >= b and accs[a][1][s] == (b, v)):
+                    net.append((M_ACCEPT, p, a, b, s, v, ()))
+            if prom.present[p, a, i]:
+                payload = tuple(
+                    ((bv >> BV_SHIFT) + _MAX_PROPS if bv > 0 else 0,
+                     bv & ((1 << BV_SHIFT) - 1))
+                    for s in range(log_len)
+                    for bv in (int(prom.p_bv[p, a, s, i]),)
+                )
+                net.append((
+                    M_PROMISE, a, p, _shift(int(prom.bal[p, a, i])),
+                    0, 0, payload,
+                ))
+            if accd.present[p, a, i]:
+                b = _shift(int(accd.bal[p, a, i]))
+                s = int(accd.slot[p, a, i])
+                v = int(accd.val[p, a, i])
+                is_lead, pbal, pci, pheard = lead_slot(p)
+                # Idempotent echo: the voter bit is already folded.
+                if not (
+                    is_lead and b == pbal and s == pci
+                    and (pheard >> a) & 1
+                ):
+                    net.append((M_ACCEPTED, a, p, b, s, v, ()))
+
+    k_rows = lrn.lt_bv.shape[1]
+    votes: dict = {}
+    for s in range(log_len):
+        for k in range(k_rows):
+            bv = int(lrn.lt_bv[s, k, i])
+            if bv > 0:
+                key = (
+                    s, (bv >> BV_SHIFT) + _MAX_PROPS,
+                    bv & ((1 << BV_SHIFT) - 1),
+                )
+                votes[key] = votes.get(key, 0) | int(lrn.lt_mask[s, k, i])
+    votes = tuple(sorted(votes.items()))
+
+    quorum = n_acc // 2 + 1
+    state = (accs, props, tuple(sorted(net)), votes)
+    return canon_mp(_gc(state, log_len), quorum)
+
+
+def probe_mp_config(
+    n_inst: int,
+    seed: int,
+    n_prop: int = 2,
+    n_acc: int = 3,
+    log_len: int = 2,
+    p_idle: float = 0.25,
+    p_hold: float = 0.25,
+    lease_len: int = 6,
+    timeout: int = 12,
+    backoff_max: int = 3,
+) -> SimConfig:
+    """The MP coverage probe's fuzz config (delay/reorder, no loss).
+
+    ``timeout`` (the candidacy-failure clock) defaults HIGH relative to
+    the classic probe: a failed candidacy throws the lane into the
+    nonconforming FOLLOW transient (excluded samples), so giving
+    candidacies room to complete keeps sample efficiency up.
+    """
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=n_prop,
+        n_acc=n_acc,
+        log_len=log_len,
+        k_slots=8,
+        seed=seed,
+        protocol="multipaxos",
+        fault=FaultConfig(
+            p_idle=p_idle, p_hold=p_hold, lease_len=lease_len,
+            timeout=timeout, backoff_max=backoff_max,
+        ),
+    )
+
+
+MP_PORTFOLIO = (
+    {"p_idle": 0.25, "p_hold": 0.25, "lease_len": 6},
+    {"p_idle": 0.55, "p_hold": 0.55, "lease_len": 8},
+    {"p_idle": 0.4, "p_hold": 0.1, "lease_len": 4},
+    {"p_idle": 0.1, "p_hold": 0.4, "lease_len": 10},
+    {"p_idle": 0.7, "p_hold": 0.7, "lease_len": 12, "timeout": 20},
+)
+
+
+def _mp_decided(state) -> bool:
+    return any(pr[0] == DONE for pr in state[1])
+
+
+def _mp_lane_cols(h):
+    """Everything ``project_mp_lane`` reads (recov_bv excluded: quotiented
+    away, never read by the projection)."""
+    acc, pro, lrn = h.acceptor, h.proposer, h.learner
+    req, prom, accd = h.requests, h.promises, h.accepted
+    return (
+        acc.promised, acc.log,
+        pro.bal, pro.phase, pro.heard, pro.commit_idx,
+        req.present, req.bal, req.v1, req.v2,
+        prom.present, prom.bal, prom.p_bv,
+        accd.present, accd.bal, accd.slot, accd.val,
+        lrn.lt_bv, lrn.lt_mask,
+    )
+
+
+def mp_coverage_probe(
+    n_prop: int = 2,
+    n_acc: int = 3,
+    log_len: int = 2,
+    max_round: "int | tuple[int, ...]" = (1, 1),
+    n_inst: int = 2048,
+    ticks: int = 64,
+    seeds: int = 5,
+    seed0: int = 0,
+    max_states: int = 50_000_000,
+    log=None,
+    probe_cfg_kw: Optional[dict] = None,
+) -> dict[str, Any]:
+    """Run the MP probe; returns the coverage report.
+
+    ``out_of_space`` MUST be 0 — a nonzero count means a fuzz-lane state
+    the bounded MP model cannot reach (treat like a safety violation).
+    """
+    from paxos_tpu.harness.run import get_step_fn
+
+    say = log or (lambda s: None)
+    mr = (max_round,) * n_prop if isinstance(max_round, int) else tuple(max_round)
+
+    say("enumerating MP multiset space ...")
+    multi: set = set()
+    quorum = n_acc // 2 + 1
+    r_multi = check_mp_exhaustive(
+        n_prop, n_acc, log_len, mr, max_states,
+        visit=lambda s: multi.add(canon_mp(s, quorum)),
+    )
+    say(f"multiset: {r_multi.states} raw, {len(multi)} canonical")
+    say("enumerating MP slot-transport space ...")
+    slot: set = set()
+    r_slot = check_mp_exhaustive(
+        n_prop, n_acc, log_len, mr, max_states, slot_net=True,
+        visit=lambda s: slot.add(canon_mp(s, quorum)),
+    )
+    say(f"slot: {r_slot.states} raw, {len(slot)} canonical")
+
+    bounds = np.asarray(mr)[:, None]
+
+    def in_bounds(h):
+        bal = np.asarray(h.proposer.bal)  # (P, I)
+        rnds = np.where(bal > 0, (bal - 1) // _MAX_PROPS + 1, 0)
+        return (rnds <= bounds).all(axis=0)
+
+    cfgs = []
+    for s_idx in range(seeds):
+        kw = probe_cfg_kw
+        if kw is None:
+            kw = MP_PORTFOLIO[s_idx % len(MP_PORTFOLIO)]
+        cfgs.append(probe_mp_config(
+            n_inst, seed0 + s_idx, n_prop, n_acc, log_len, **kw
+        ))
+    run_stats = probe_lanes(
+        cfgs, get_step_fn("multipaxos"), _mp_lane_cols,
+        lambda h, i: project_mp_lane(h, i, n_prop, n_acc, log_len),
+        in_bounds, n_inst, ticks, say,
+    )
+    counts = run_stats["counts"]
+
+    visited = set(counts)
+    out_of_space = visited - slot
+    in_slot = len(visited) - len(out_of_space)
+    in_multi = len(visited & multi)
+    chao = chao1_estimate(counts, run_stats["detections"])
+
+    return {
+        "metric": "mp-fuzz-coverage",
+        "bounds": {
+            "n_prop": n_prop, "n_acc": n_acc, "log_len": log_len,
+            "max_round": list(mr),
+        },
+        "space_multiset_raw": r_multi.states,
+        "space_multiset": len(multi),
+        "space_slot_raw": r_slot.states,
+        "space_slot": len(slot),
+        "transport_excluded": len(multi - slot),
+        "slot_only": len(slot - multi),
+        "visited": len(visited),
+        "visited_in_slot": in_slot,
+        "visited_in_multiset": in_multi,
+        "coverage_slot": round(in_slot / max(len(slot), 1), 6),
+        "coverage_multiset": round(in_multi / max(len(multi), 1), 6),
+        "out_of_space": len(out_of_space),  # MUST be 0 (soundness)
+        "out_of_space_sample": sorted(out_of_space)[:3],
+        "decided_states": category_block(slot, visited, _mp_decided),
+        "quiet_states": category_block(slot, visited, lambda s: not s[2]),
+        "growth": run_stats["growth"],
+        "samples": run_stats["samples"],
+        "detections": run_stats["detections"],
+        "nonconforming_samples": run_stats["nonconforming"],
+        "deeper_than_bounds_samples": run_stats["deeper"],
+        "chao1_vs_slot": round(chao["chao1"] / max(len(slot), 1), 4),
+        "n_inst": n_inst,
+        "ticks": ticks,
+        "seeds": seeds,
+    } | chao

@@ -80,6 +80,24 @@ def _chosen_per_slot(votes: tuple, quorum: int, log_len: int) -> list:
     return out
 
 
+def _merge(net: tuple, out, slot_net: bool) -> tuple:
+    """Add emitted messages to the in-flight set.
+
+    ``slot_net=True`` models the TPU transport's fixed-slot buffers (one
+    in-flight message per (kind, src, dst) edge, a new send OVERWRITING
+    the old — ``core.messages`` semantics; the MP state's request /
+    promise / accepted buffers are exactly one slot per (kind, p, a)).
+    The slot-quotiented reachable set is what the batched fuzzer can in
+    principle occupy — the denominator of ``check/mp_coverage.py``.
+    """
+    if not slot_net:
+        return tuple(sorted(net + tuple(out)))
+    d = {(m[0], m[1], m[2]): m for m in net}
+    for m in out:
+        d[(m[0], m[1], m[2])] = m
+    return tuple(sorted(d.values()))
+
+
 def _drive(p: int, prop, log_len: int, n_acc: int, no_recovery: bool):
     """The leader's ACCEPT broadcast for its current slot (or DONE)."""
     phase, rnd, heard, recov, ci, dec = prop
@@ -101,6 +119,7 @@ def _deliver(
     log_len: int,
     quorum: int,
     no_recovery: bool,
+    slot_net: bool = False,
 ):
     accs, props, net, votes = state
     kind, src, dst, bal, slot, val, payload = net[i]
@@ -154,10 +173,13 @@ def _deliver(
             else:
                 props = props[:dst] + ((phase, rnd, heard, recov, ci, dec),) + props[dst + 1 :]
 
-    return (accs, props, tuple(sorted(net + tuple(out))), votes)
+    return (accs, props, _merge(net, out, slot_net), votes)
 
 
-def _timeout(state, p: int, n_acc: int, log_len: int, bump: bool = True):
+def _timeout(
+    state, p: int, n_acc: int, log_len: int, bump: bool = True,
+    slot_net: bool = False,
+):
     """Proposer ``p`` challenges for leadership at its next ballot (the
     lease-expiry surrogate: any challenge schedule must be safe).
 
@@ -173,7 +195,7 @@ def _timeout(state, p: int, n_acc: int, log_len: int, bump: bool = True):
     bal = make_ballot(rnd, p)
     props = props[:p] + ((CAND, rnd, 0, ((0, 0),) * log_len, 0, dec),) + props[p + 1 :]
     out = tuple((PREPARE, p, a, bal, 0, 0, ()) for a in range(n_acc))
-    return (accs, props, tuple(sorted(net + out)), votes)
+    return (accs, props, _merge(net, out, slot_net), votes)
 
 
 def _gc(state, log_len: int, dedup: bool = False):
@@ -216,8 +238,16 @@ def check_mp_exhaustive(
     no_recovery: bool = False,
     liveness_bound: "int | None" = None,
     livelock_bug: bool = False,
+    visit=None,
+    slot_net: bool = False,
 ) -> CheckResult:
     """Exhaustively explore every Multi-Paxos schedule at small bounds.
+
+    ``visit`` (optional callable) receives every reachable state once —
+    the MP coverage probe's hook (``check/mp_coverage.py``).
+    ``slot_net=True`` explores under the fixed-slot transport
+    (:func:`_merge`): the quotient of the schedule space the batched
+    fuzzer's overwriting message buffers can reach.
 
     ``decided_states`` counts states where some proposer replicated the
     FULL log; ``chosen_values`` is the union over slots.
@@ -272,11 +302,13 @@ def check_mp_exhaustive(
     if liveness_bound is not None:
         fair_next, is_decided = make_fair_completion(
             lambda s: (("d", s[2][0]), _gc(
-                _deliver(s, 0, n_acc, log_len, quorum, no_recovery),
+                _deliver(s, 0, n_acc, log_len, quorum, no_recovery,
+                         slot_net),
                 log_len, dedup=livelock_bug,
             )),
             lambda s, p: _gc(
-                _timeout(s, p, n_acc, log_len, bump=not livelock_bug),
+                _timeout(s, p, n_acc, log_len, bump=not livelock_bug,
+                         slot_net=slot_net),
                 log_len, dedup=livelock_bug,
             ),
             done_phase=DONE,
@@ -287,6 +319,8 @@ def check_mp_exhaustive(
 
     def check_both(state, trace) -> None:
         check_state(state, trace)
+        if visit is not None:
+            visit(state)
         if live_check is not None:
             live_check(state, trace)
 
@@ -294,13 +328,15 @@ def check_mp_exhaustive(
         accs, props, net, votes = state
         for i in range(len(net)):
             yield ("d", net[i]), _gc(
-                _deliver(state, i, n_acc, log_len, quorum, no_recovery),
+                _deliver(state, i, n_acc, log_len, quorum, no_recovery,
+                         slot_net),
                 log_len, dedup=livelock_bug,
             )
         for p in range(n_prop):
             if props[p][0] != DONE and props[p][1] < max_round[p]:
                 yield ("t", p), _gc(
-                    _timeout(state, p, n_acc, log_len, bump=not livelock_bug),
+                    _timeout(state, p, n_acc, log_len, bump=not livelock_bug,
+                             slot_net=slot_net),
                     log_len, dedup=livelock_bug,
                 )
 

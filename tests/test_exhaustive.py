@@ -28,6 +28,7 @@ def test_exhaustive_with_preemption_clean(bounds):
     assert r.chosen_values == {100, 101}
 
 
+@pytest.mark.slow
 def test_exhaustive_symmetric_retries_clean():
     """Both proposers retry: ~600k distinct states, all invariant-clean."""
     r = check_exhaustive(n_prop=2, n_acc=3, max_round=1)
@@ -44,6 +45,7 @@ def test_exhaustive_finds_injected_bug():
         )
 
 
+@pytest.mark.slow
 def test_exhaustive_five_acceptors_clean():
     r = check_exhaustive(n_prop=2, n_acc=5, max_round=0)
     assert r.counterexample is None
@@ -53,6 +55,7 @@ def test_exhaustive_five_acceptors_clean():
 # ---- Fast Paxos (cpu_ref/fp_exhaustive.py; round-1 verdict #3) ----
 
 
+@pytest.mark.slow
 def test_fp_exhaustive_clean():
     """Every schedule of 2 fast proposers x 4 acceptors with one recovery
     round: the fast round, vote-once rule, and choosable-rule recovery are
@@ -84,6 +87,7 @@ def test_fp_exhaustive_finds_unsafe_ffp_quorum():
         check_fp_exhaustive(n_prop=2, n_acc=5, q_fast=3)
 
 
+@pytest.mark.slow
 def test_fp_exhaustive_safe_ffp_quorum_clean():
     """A SAFE non-default FFP triple (n=4: q1=3, q2=2, q_fast=3 satisfies
     q1+q2 > n and q1 + 2*q_fast > 2n) stays clean across the space."""
@@ -95,6 +99,7 @@ def test_fp_exhaustive_safe_ffp_quorum_clean():
 # ---- Multi-Paxos (cpu_ref/mp_exhaustive.py) ----
 
 
+@pytest.mark.slow
 def test_mp_exhaustive_clean():
     """Every schedule of 2 proposers x 3 acceptors x 2-slot logs with one
     election each: whole-log phase 1, per-slot max-ballot recovery, and
@@ -109,6 +114,7 @@ def test_mp_exhaustive_clean():
     assert r.chosen_values == {1000, 1001, 2000, 2001}
 
 
+@pytest.mark.slow
 def test_mp_exhaustive_three_slots_clean():
     r = check_mp_exhaustive(n_prop=2, n_acc=3, log_len=3, max_round=1)
     assert r.counterexample is None
@@ -128,6 +134,7 @@ def test_mp_exhaustive_finds_no_recovery_bug():
 # ---- Raft-core (cpu_ref/raft_exhaustive.py) ----
 
 
+@pytest.mark.slow
 def test_raft_exhaustive_clean():
     """Every schedule of 2 candidates x 3 voters with one retry: election
     restriction + one-vote-per-term + adoption + append/ack commit are
@@ -139,6 +146,7 @@ def test_raft_exhaustive_clean():
     assert r.chosen_values == {100, 101}
 
 
+@pytest.mark.slow
 def test_raft_exhaustive_each_safety_leg_suffices():
     """The kernel's safety argument rests on TWO mechanisms — the election
     restriction (real Raft's) and entry adoption from vote replies (the
@@ -171,6 +179,7 @@ def test_raft_exhaustive_finds_double_bug():
 from paxos_tpu.cpu_ref.exhaustive import LivenessViolation  # noqa: E402
 
 
+@pytest.mark.slow
 def test_liveness_paxos_clean():
     r = check_exhaustive(max_round=1, liveness_bound=60)
     assert r.states == 602_641  # liveness leg must not perturb the space
@@ -190,6 +199,7 @@ def test_liveness_paxos_livelock_bug_found():
         check_exhaustive(max_round=1, liveness_bound=60, livelock_bug=True)
 
 
+@pytest.mark.slow
 def test_liveness_fastpaxos_clean_and_collision_recovery():
     """Fast Paxos is where the timeout arm of the fair completion earns
     its keep: a collided fast round leaves an EMPTY network with nobody
@@ -227,6 +237,7 @@ def test_liveness_multipaxos_frozen_challenge_bug_found():
         )
 
 
+@pytest.mark.slow
 def test_liveness_raft_clean():
     r = check_raft_exhaustive(max_round=(1, 0), liveness_bound=80)
     assert r.max_completion > 0

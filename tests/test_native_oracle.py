@@ -224,6 +224,7 @@ def test_native_raft_two_leg_safety():
 # ---- Native bounded exhaustive explorer (VERDICT r3 #4) ----
 
 
+@pytest.mark.slow
 def test_native_explorer_cross_validates_python_counts():
     """The C++ explorer mirrors cpu_ref/exhaustive.py's transition system
     (same actions, same GC reductions) — distinct-state AND decided-state
@@ -276,6 +277,7 @@ def test_native_explorer_max_states_guard():
         explore_native(n_prop=2, n_acc=3, max_round=1, max_states=10_000)
 
 
+@pytest.mark.slow
 def test_native_mp_explorer_cross_validates_python_counts():
     """The C++ Multi-Paxos explorer mirrors cpu_ref/mp_exhaustive.py —
     whole-log phase 1, slot-by-slot phase 2, per-slot max recovery, same
@@ -320,6 +322,7 @@ def test_native_mp_explorer_finds_skipped_recovery_bug():
         explore_mp_native(max_round=(2, 1), no_recovery=True)
 
 
+@pytest.mark.slow
 def test_native_fp_explorer_cross_validates_python_counts():
     """The C++ Fast Paxos explorer (round-5 matrix completion) mirrors
     cpu_ref/fp_exhaustive.py — shared fast ballot, vote-at-most-once
@@ -368,6 +371,7 @@ def test_native_fp_explorer_finds_injected_bugs():
         explore_fp_native(n_acc=5, max_round=(1, 0), q_fast=3)
 
 
+@pytest.mark.slow
 def test_native_raft_explorer_cross_validates_python_counts():
     """The C++ Raft-core explorer (round-5 matrix completion) mirrors
     cpu_ref/raft_exhaustive.py — election restriction, one-vote-per-term,
