@@ -322,7 +322,7 @@ def mp_coverage_probe(
     max_round: "int | tuple[int, ...]" = (1, 1),
     n_inst: int = 2048,
     ticks: int = 64,
-    seeds: int = 5,
+    seeds: int = 6,  # one full MP_PORTFOLIO rotation (incl. the dup profile)
     seed0: int = 0,
     max_states: int = 50_000_000,
     log=None,

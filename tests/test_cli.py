@@ -241,10 +241,24 @@ def test_cli_check_native(capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["ok"] and out["native"] and out["states"] == 30_562
 
-    # Unsupported: raftcore native, native + liveness.
+    # Round 5: the native matrix is square — raftcore and fastpaxos
+    # dispatch natively too (counts = raw explored-state counts,
+    # cross-validated against the Python checkers in
+    # tests/test_native_oracle.py).
     assert main([
         "--platform", "cpu", "check", "--native", "--protocol", "raftcore",
-    ]) == 1
+        "--max-round", "1", "0",
+    ]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] and out["native"] and out["states"] == 88_680
+    assert main([
+        "--platform", "cpu", "check", "--native", "--protocol", "fastpaxos",
+        "--n-acc", "3", "--max-round", "1", "0",
+    ]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] and out["native"] and out["states"] == 7_839
+
+    # Still refused: native + liveness (liveness is Python-side).
     assert main([
         "--platform", "cpu", "check", "--native", "--liveness-bound", "20",
     ]) == 1
