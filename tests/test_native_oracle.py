@@ -410,3 +410,18 @@ def test_native_raft_explorer_two_leg_decomposition():
     assert explore_raft_native(max_round=1, no_adoption=True).states > 0
     with pytest.raises(AssertionError, match="invariant violated"):
         explore_raft_native(max_round=1, no_restriction=True, no_adoption=True)
+
+
+def test_native_explorer_three_proposers_cross_validates():
+    """VERDICT r4 #8: a third proposer reaches schedule corners two cannot
+    (three-way promise splits, simultaneous duels); the native 3-proposer
+    space must match Python exactly at a shared bound, with all three
+    values chosen somewhere in the space."""
+    from paxos_tpu.cpu_ref.exhaustive import check_exhaustive
+    from paxos_tpu.cpu_ref.native import explore_native
+
+    py = check_exhaustive(n_prop=3, n_acc=3, max_round=0, max_states=1_000_000)
+    nat = explore_native(n_prop=3, n_acc=3, max_round=0)
+    assert (nat.states, nat.decided_states) == (py.states, py.decided_states)
+    assert nat.states == 206_317
+    assert nat.chosen_values == py.chosen_values == {100, 101, 102}
