@@ -90,7 +90,14 @@ class FastPaxosState:
     tick: jnp.ndarray  # () int32
 
     @classmethod
-    def init(cls, n_inst: int, n_prop: int, n_acc: int, k: int = 8) -> "FastPaxosState":
+    def init(
+        cls,
+        n_inst: int,
+        n_prop: int,
+        n_acc: int,
+        k: int = 8,
+        stale: bool = False,
+    ) -> "FastPaxosState":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
         from paxos_tpu.utils.bitops import MAX_ACCEPTORS
 
@@ -117,7 +124,7 @@ class FastPaxosState:
             present=requests.present.at[ACCEPT].set(True),
         )
         return cls(
-            acceptor=AcceptorState.init(n_inst, n_acc),
+            acceptor=AcceptorState.init(n_inst, n_acc, stale=stale),
             proposer=proposer,
             learner=LearnerState.init(n_inst, k),
             requests=requests,

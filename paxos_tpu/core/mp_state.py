@@ -22,6 +22,8 @@ Protocol shape: classic Multi-Paxos with a distinguished leader.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 from flax import struct
 
@@ -80,12 +82,25 @@ def bv_val(bv):
 class MPAcceptorState:
     promised: jnp.ndarray  # (A, I) int32 — one promise covers every slot
     log: jnp.ndarray  # (A, L, I) int32 packed accepted (ballot, value) per slot
+    # Stale-snapshot shadows (FaultConfig.stale_k); None when the knob is off.
+    snap_promised: Optional[jnp.ndarray] = None  # (A, I) int32
+    snap_log: Optional[jnp.ndarray] = None  # (A, L, I) int32
 
     @classmethod
-    def init(cls, n_inst: int, n_acc: int, log_len: int) -> "MPAcceptorState":
+    def init(
+        cls, n_inst: int, n_acc: int, log_len: int, stale: bool = False
+    ) -> "MPAcceptorState":
         return cls(
             promised=jnp.zeros((n_acc, n_inst), jnp.int32),
             log=jnp.zeros((n_acc, log_len, n_inst), jnp.int32),
+            snap_promised=(
+                jnp.zeros((n_acc, n_inst), jnp.int32) if stale else None
+            ),
+            snap_log=(
+                jnp.zeros((n_acc, log_len, n_inst), jnp.int32)
+                if stale
+                else None
+            ),
         )
 
 
@@ -213,6 +228,7 @@ class MultiPaxosState:
         log_len: int = 8,
         k: int = 4,
         lease_init: int = 0,
+        stale: bool = False,
     ) -> "MultiPaxosState":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
         from paxos_tpu.utils.bitops import MAX_ACCEPTORS
@@ -222,7 +238,7 @@ class MultiPaxosState:
         if not 1 <= n_acc <= MAX_ACCEPTORS:
             raise ValueError(f"n_acc={n_acc} exceeds {MAX_ACCEPTORS}")
         return cls(
-            acceptor=MPAcceptorState.init(n_inst, n_acc, log_len),
+            acceptor=MPAcceptorState.init(n_inst, n_acc, log_len, stale=stale),
             proposer=MPProposerState.init(n_inst, n_prop, log_len, lease_init),
             learner=MPLearnerState.init(n_inst, log_len, k),
             requests=MsgBuf.empty(n_inst, n_prop, n_acc),

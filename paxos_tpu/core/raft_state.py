@@ -18,6 +18,8 @@ integer compare — the same compare unit the quorum kernel runs on.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 from flax import struct
 
@@ -54,13 +56,24 @@ class VoterState:
     voted: jnp.ndarray  # (A, I) int32 packed term; 0 = none yet
     ent_term: jnp.ndarray  # (A, I) int32 packed term of stored entry; 0 = empty
     ent_val: jnp.ndarray  # (A, I) int32 stored entry value
+    # Stale-snapshot shadows (FaultConfig.stale_k); None when the knob is off.
+    snap_voted: Optional[jnp.ndarray] = None  # (A, I) int32
+    snap_term: Optional[jnp.ndarray] = None  # (A, I) int32
+    snap_val: Optional[jnp.ndarray] = None  # (A, I) int32
 
     @classmethod
-    def init(cls, n_inst: int, n_acc: int) -> "VoterState":
+    def init(cls, n_inst: int, n_acc: int, stale: bool = False) -> "VoterState":
         def z():
             return jnp.zeros((n_acc, n_inst), jnp.int32)
 
-        return cls(voted=z(), ent_term=z(), ent_val=z())
+        return cls(
+            voted=z(),
+            ent_term=z(),
+            ent_val=z(),
+            snap_voted=z() if stale else None,
+            snap_term=z() if stale else None,
+            snap_val=z() if stale else None,
+        )
 
 
 @struct.dataclass
@@ -108,7 +121,14 @@ class RaftState:
     tick: jnp.ndarray  # () int32
 
     @classmethod
-    def init(cls, n_inst: int, n_prop: int, n_acc: int, k: int = 8) -> "RaftState":
+    def init(
+        cls,
+        n_inst: int,
+        n_prop: int,
+        n_acc: int,
+        k: int = 8,
+        stale: bool = False,
+    ) -> "RaftState":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
         from paxos_tpu.utils.bitops import MAX_ACCEPTORS
 
@@ -131,7 +151,7 @@ class RaftState:
             present=requests.present.at[REQVOTE].set(True),
         )
         return cls(
-            acceptor=VoterState.init(n_inst, n_acc),
+            acceptor=VoterState.init(n_inst, n_acc, stale=stale),
             proposer=proposer,
             learner=LearnerState.init(n_inst, k),
             requests=requests,

@@ -21,6 +21,8 @@ immutable flax pytrees, so the whole simulator state is one pytree that
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 from flax import struct
 
@@ -38,14 +40,28 @@ class AcceptorState:
     promised: jnp.ndarray  # (A, I) int32 ballot; highest ballot promised
     acc_bal: jnp.ndarray  # (A, I) int32 ballot of last accepted proposal
     acc_val: jnp.ndarray  # (A, I) int32 value of last accepted proposal
+    # Stale-snapshot shadows (FaultConfig.stale_k bug injection): the
+    # durable image a recovering acceptor rolls back to.  None (pruned from
+    # the pytree) unless the knob is on — default states keep their
+    # pre-gray structure.
+    snap_promised: Optional[jnp.ndarray] = None  # (A, I) int32
+    snap_bal: Optional[jnp.ndarray] = None  # (A, I) int32
+    snap_val: Optional[jnp.ndarray] = None  # (A, I) int32
 
     @classmethod
-    def init(cls, n_inst: int, n_acc: int) -> "AcceptorState":
+    def init(cls, n_inst: int, n_acc: int, stale: bool = False) -> "AcceptorState":
         # Fresh buffer per field: aliased leaves break buffer donation.
         def z():
             return jnp.zeros((n_acc, n_inst), jnp.int32)
 
-        return cls(promised=z(), acc_bal=z(), acc_val=z())
+        return cls(
+            promised=z(),
+            acc_bal=z(),
+            acc_val=z(),
+            snap_promised=z() if stale else None,
+            snap_bal=z() if stale else None,
+            snap_val=z() if stale else None,
+        )
 
 
 @struct.dataclass
@@ -132,7 +148,14 @@ class PaxosState:
     tick: jnp.ndarray  # () int32 global tick counter
 
     @classmethod
-    def init(cls, n_inst: int, n_prop: int, n_acc: int, k: int = 8) -> "PaxosState":
+    def init(
+        cls,
+        n_inst: int,
+        n_prop: int,
+        n_acc: int,
+        k: int = 8,
+        stale: bool = False,
+    ) -> "PaxosState":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
         from paxos_tpu.utils.bitops import MAX_ACCEPTORS
 
@@ -157,7 +180,7 @@ class PaxosState:
             present=requests.present.at[0].set(True),
         )
         return cls(
-            acceptor=AcceptorState.init(n_inst, n_acc),
+            acceptor=AcceptorState.init(n_inst, n_acc, stale=stale),
             proposer=proposer,
             learner=LearnerState.init(n_inst, k),
             requests=requests,

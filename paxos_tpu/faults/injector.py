@@ -18,17 +18,56 @@ processes [CH].  Here both collapse into data:
 Crashed acceptors stop processing but *keep their state* across recovery —
 Paxos' durable-storage assumption.  Amnesia on recovery (a real-world bug the
 checker should catch) is a separate switch, as is equivocation (config 4).
+
+Gray failures (PR 1) extend the plan beyond symmetric, clean faults:
+one-way partition cuts (``p_asym``), per-link Bernoulli loss/duplication
+rate matrices (``p_flaky``), in-flight payload corruption (``p_corrupt``,
+bug injection the checker must flag), per-proposer timeout/backoff skew
+(``timeout_skew``/``backoff_skew``), and stale-snapshot recovery
+(``stale_k`` — amnesia generalized to "roll back to the last snapshot").
+Every gray knob defaults OFF, and every gray plan field is ``None`` when
+its knob is off — the pruned pytree and the untouched PRNG stream keep
+default-config schedules bit-identical to pre-gray builds
+(tests/test_gray.py golden digests).
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from flax import struct
 
 NEVER = jnp.iinfo(jnp.int32).max
+
+# Per-link Bernoulli rates are stored as uint32 thresholds in int32 bit
+# patterns (Mosaic has no uint32 vectors): P(bits < t) = rate for uniform
+# bits, compared with the same sign-flip trick as counter_prng.bern.
+_TWO32 = float(1 << 32)
+
+
+def rate_threshold(rate: jnp.ndarray) -> jnp.ndarray:
+    """uint32 threshold (as int32 bit pattern) with P(bits < t) ~= rate.
+
+    float32 quantizes the rate to ~2^-24 — far finer than any fuzzing
+    config needs.  ``rate >= 1`` saturates near-certain (misses w.p.
+    ~2^-24); per-link rates are chaos knobs, not exactness contracts.
+    """
+    t = jnp.clip(jnp.asarray(rate, jnp.float32), 0.0, 1.0) * _TWO32
+    t = jnp.minimum(t, jnp.float32(_TWO32 - 256.0))  # stay uint32-convertible
+    return jax.lax.bitcast_convert_type(t.astype(jnp.uint32), jnp.int32)
+
+
+def bits_below(bits: jnp.ndarray, threshold: jnp.ndarray) -> jnp.ndarray:
+    """True where uint32(bits) < uint32(threshold), both int32 bit patterns.
+
+    Sign-flip unsigned compare (Mosaic-safe, same trick as
+    ``counter_prng.bern``); works in both engines.
+    """
+    sign = jnp.int32(-(1 << 31))
+    return (bits ^ sign) < (threshold ^ sign)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,6 +97,35 @@ class FaultConfig:
     part_max_len: int = 16  # episode length ~ U[1, part_max_len]
     # Byzantine (config 4)
     p_equiv: float = 0.0  # per (instance, acceptor): equivocates forever
+    # --- Gray failures (all default OFF; default-off streams bit-identical) ---
+    # Asymmetric partitions: a partitioned instance's cut is one-way with
+    # probability p_asym — either requests P->A stall while replies flow, or
+    # the reverse (the classic one-way link that livelocks naive proposers).
+    p_asym: float = 0.0
+    # Per-link flaky loss/duplication: each (proposer, acceptor, instance)
+    # link is flaky with probability p_flaky; a flaky link's drop rate is
+    # ~ U[0, flaky_drop] and its dup rate ~ U[0, flaky_dup], while healthy
+    # links keep the uniform p_drop/p_dup — the single global rate is the
+    # p_flaky = 0 special case of the same masks.
+    p_flaky: float = 0.0
+    flaky_drop: float = 0.5
+    flaky_dup: float = 0.0
+    # (bug injection) In-flight payload corruption: with probability
+    # p_corrupt per delivered request, perturb the value of an ACCEPT-class
+    # message and the ballot of a PREPARE-class one.  The safety checker
+    # MUST flag campaigns run with this on (like unsafe quorums, this
+    # validates the checker, not the protocol).
+    p_corrupt: float = 0.0
+    # Per-proposer timer skew: proposer timeouts get ~ U[0, timeout_skew]
+    # extra ticks and backoffs a ~ U[1, backoff_skew] multiplier, so retry
+    # storms and dueling-proposer races become schedulable.
+    timeout_skew: int = 0
+    backoff_skew: int = 0
+    # (bug injection) Stale-snapshot recovery: amnesia generalized — a
+    # recovering acceptor restores the snapshot taken at the last multiple
+    # of stale_k ticks (up to stale_k ticks of accepted state silently
+    # lost) instead of losing everything.  0 = off.
+    stale_k: int = 0
     # Proposer timing
     timeout: int = 10  # ticks in a phase before retrying with higher ballot
     backoff_max: int = 8  # retry backoff ~ U[0, backoff_max) extra ticks
@@ -83,6 +151,11 @@ class FaultConfig:
     log_total: int = 0
 
 
+def links_dup(cfg: FaultConfig) -> bool:
+    """Per-link duplication is live: flaky links exist and some dup rate > 0."""
+    return cfg.p_flaky > 0.0 and (cfg.p_dup > 0.0 or cfg.flaky_dup > 0.0)
+
+
 @struct.dataclass
 class FaultPlan:
     """Per-run static fault schedule (device arrays, shard with the state)."""
@@ -96,9 +169,33 @@ class FaultPlan:
     part_end: jnp.ndarray  # (I,) int32
     aside: jnp.ndarray  # (A, I) bool — acceptor's side of the cut
     pside: jnp.ndarray  # (P, I) bool — proposer's side of the cut
+    # Gray-failure fields — None (pruned from the pytree) when the owning
+    # knob is off, so default plans keep their pre-gray structure and the
+    # fused engine's VMEM footprint.
+    part_dir: Optional[jnp.ndarray] = None  # (I,) int32: 0 = two-way cut,
+    #   1 = only requests P->A cut, 2 = only replies A->P cut (p_asym)
+    link_drop: Optional[jnp.ndarray] = None  # (P, A, I) int32 — per-link
+    #   drop-rate uint32 threshold (bit pattern; p_flaky)
+    link_dup: Optional[jnp.ndarray] = None  # (P, A, I) int32 — dup threshold
+    ptimeout: Optional[jnp.ndarray] = None  # (P, I) int32 extra timeout ticks
+    pboff: Optional[jnp.ndarray] = None  # (P, I) int32 backoff multiplier >= 1
 
     @classmethod
-    def none(cls, n_inst: int, n_acc: int, n_prop: int = 1) -> "FaultPlan":
+    def none(
+        cls,
+        n_inst: int,
+        n_acc: int,
+        n_prop: int = 1,
+        cfg: "FaultConfig | None" = None,
+    ) -> "FaultPlan":
+        """The fault-free plan.
+
+        With ``cfg`` given, gray fields gated on by its knobs are present
+        but benign (no per-link variation, no skew) so the pytree structure
+        matches ``sample(cfg)`` — checkpoint restore templates need this.
+        """
+        cfg = cfg or FaultConfig()
+        edge = (n_prop, n_acc, n_inst)
         return cls(
             crash_start=jnp.full((n_acc, n_inst), NEVER, jnp.int32),
             crash_end=jnp.full((n_acc, n_inst), NEVER, jnp.int32),
@@ -109,6 +206,29 @@ class FaultPlan:
             part_end=jnp.full((n_inst,), NEVER, jnp.int32),
             aside=jnp.zeros((n_acc, n_inst), jnp.bool_),
             pside=jnp.zeros((n_prop, n_inst), jnp.bool_),
+            part_dir=(
+                jnp.zeros((n_inst,), jnp.int32) if cfg.p_asym > 0.0 else None
+            ),
+            link_drop=(
+                jnp.broadcast_to(rate_threshold(cfg.p_drop), edge)
+                if cfg.p_flaky > 0.0
+                else None
+            ),
+            link_dup=(
+                jnp.broadcast_to(rate_threshold(cfg.p_dup), edge)
+                if links_dup(cfg)
+                else None
+            ),
+            ptimeout=(
+                jnp.zeros((n_prop, n_inst), jnp.int32)
+                if cfg.timeout_skew > 0
+                else None
+            ),
+            pboff=(
+                jnp.ones((n_prop, n_inst), jnp.int32)
+                if cfg.backoff_skew > 1
+                else None
+            ),
         )
 
     @classmethod
@@ -149,6 +269,62 @@ class FaultPlan:
         ka, kpr = jax.random.split(k_side)
         aside = jax.random.uniform(ka, (n_acc, n_inst)) < 0.5
         pside = jax.random.uniform(kpr, (n_prop, n_inst)) < 0.5
+
+        # Gray fields draw from fold_in-derived keys (NOT extra splits of
+        # ``key``) so the pre-gray streams above stay bit-identical.
+        part_dir = None
+        if cfg.p_asym > 0.0:
+            one_way = (
+                jax.random.uniform(jax.random.fold_in(key, 101), (n_inst,))
+                < cfg.p_asym
+            )
+            cut_req = jax.random.bernoulli(
+                jax.random.fold_in(key, 102), 0.5, (n_inst,)
+            )
+            part_dir = jnp.where(
+                one_way, jnp.where(cut_req, 1, 2), 0
+            ).astype(jnp.int32)
+
+        link_drop = link_dup = None
+        if cfg.p_flaky > 0.0:
+            edge = (n_prop, n_acc, n_inst)
+            flaky = (
+                jax.random.uniform(jax.random.fold_in(key, 103), edge)
+                < cfg.p_flaky
+            )
+            drop_rate = jnp.where(
+                flaky,
+                jax.random.uniform(jax.random.fold_in(key, 104), edge)
+                * cfg.flaky_drop,
+                cfg.p_drop,
+            )
+            link_drop = rate_threshold(drop_rate)
+            if links_dup(cfg):
+                dup_rate = jnp.where(
+                    flaky,
+                    jax.random.uniform(jax.random.fold_in(key, 105), edge)
+                    * cfg.flaky_dup,
+                    cfg.p_dup,
+                )
+                link_dup = rate_threshold(dup_rate)
+
+        ptimeout = None
+        if cfg.timeout_skew > 0:
+            ptimeout = jax.random.randint(
+                jax.random.fold_in(key, 106),
+                (n_prop, n_inst),
+                0,
+                cfg.timeout_skew + 1,
+            )
+        pboff = None
+        if cfg.backoff_skew > 1:
+            pboff = jax.random.randint(
+                jax.random.fold_in(key, 107),
+                (n_prop, n_inst),
+                1,
+                cfg.backoff_skew + 1,
+            )
+
         return cls(
             crash_start=crash_start,
             crash_end=crash_end,
@@ -159,20 +335,37 @@ class FaultPlan:
             part_end=part_end,
             aside=aside,
             pside=pside,
+            part_dir=part_dir,
+            link_drop=link_drop,
+            link_dup=link_dup,
+            ptimeout=ptimeout,
+            pboff=pboff,
         )
 
     def alive(self, tick: jnp.ndarray) -> jnp.ndarray:
         """(A, I) bool: acceptor is up at ``tick``."""
         return ~((self.crash_start <= tick) & (tick < self.crash_end))
 
-    def link_ok(self, tick: jnp.ndarray) -> jnp.ndarray:
+    def link_ok(
+        self, tick: jnp.ndarray, direction: "str | None" = None
+    ) -> jnp.ndarray:
         """(P, A, I) bool: the proposer<->acceptor link delivers at ``tick``.
 
         False only inside the instance's partition window for pairs on
         opposite sides of the cut; in-flight messages are not dropped, they
         stall until the partition heals (delivery masks AND with this).
+
+        ``direction`` selects the traffic direction for asymmetric cuts:
+        ``"req"`` (proposer->acceptor requests) or ``"rep"``
+        (acceptor->proposer replies).  With ``part_dir`` sampled, a one-way
+        cut blocks only its direction — ``part_dir == 1`` cuts requests,
+        ``part_dir == 2`` cuts replies, 0 cuts both.  ``direction=None``
+        (or no ``part_dir`` in the plan) is the symmetric two-way view.
         """
         cut = (self.part_start <= tick) & (tick < self.part_end)  # (I,)
+        if direction is not None and self.part_dir is not None:
+            spares = jnp.int32(2 if direction == "req" else 1)
+            cut = cut & (self.part_dir != spares)
         same = self.pside[:, None] == self.aside[None]  # (P, A, I)
         return same | ~cut[None, None]
 

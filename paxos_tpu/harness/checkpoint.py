@@ -155,7 +155,11 @@ def restore(
 
     template = {
         "state": jax.device_get(init_state(cfg)),
-        "plan": jax.device_get(FaultPlan.none(cfg.n_inst, cfg.n_acc, cfg.n_prop)),
+        # cfg-aware: the template must carry the gray-failure plan fields
+        # (part_dir, link_drop, ...) exactly when the config's knobs do.
+        "plan": jax.device_get(
+            FaultPlan.none(cfg.n_inst, cfg.n_acc, cfg.n_prop, cfg=cfg.fault)
+        ),
     }
     with ocp.PyTreeCheckpointer() as ckptr:
         out = ckptr.restore(path, item=template)

@@ -169,6 +169,119 @@ def config_partition(n_inst: int = 65_536, seed: int = 0) -> SimConfig:
     )
 
 
+def config_gray_chaos(n_inst: int = 65_536, seed: int = 0) -> SimConfig:
+    """Gray-failure chaos: asymmetric cuts, flaky links, skewed timers.
+
+    Every gray knob that is CHAOS (schedule-space enrichment, not a bug)
+    at once: one-way partitions (``p_asym``), per-link Bernoulli loss and
+    duplication rate matrices (``p_flaky``/``flaky_drop``/``flaky_dup``),
+    and per-proposer timeout/backoff skew.  Safety must hold at any soak
+    length; liveness must survive the heal.
+    """
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        seed=seed,
+        fault=FaultConfig(
+            p_idle=0.1,
+            p_hold=0.1,
+            p_dup=0.05,
+            p_part=0.5,
+            part_max_start=40,
+            part_max_len=30,
+            p_asym=0.7,
+            p_flaky=0.4,
+            flaky_drop=0.4,
+            flaky_dup=0.2,
+            timeout_skew=6,
+            backoff_skew=3,
+        ),
+    )
+
+
+def config_corrupt(n_inst: int = 4096, seed: int = 0) -> SimConfig:
+    """Message corruption bug injection: in-flight payload bit flips.
+
+    ACCEPT values flip bits and PREPARE ballots bump between send and
+    process (``p_corrupt``) — acceptors vote for values nobody proposed,
+    which the agreement checker MUST flag (within a 256-tick campaign at
+    this rate/scale; tests/test_gray.py pins it).
+    """
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        seed=seed,
+        fault=FaultConfig(
+            p_drop=0.1, p_idle=0.2, p_hold=0.2, p_corrupt=0.2, timeout=6
+        ),
+    )
+
+
+def config_stale(n_inst: int = 4096, seed: int = 0) -> SimConfig:
+    """Stale-snapshot recovery bug injection (amnesia generalized).
+
+    Crashed acceptors recover to their durable image as of the last
+    multiple of ``stale_k`` ticks — up to ``stale_k`` ticks of promises
+    and accepts silently lost; the checker must flag the consequences.
+    """
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        seed=seed,
+        fault=FaultConfig(
+            p_drop=0.1,
+            p_idle=0.1,
+            p_hold=0.1,
+            timeout=6,
+            stale_k=8,
+            p_crash=0.4,
+            crash_max_start=60,
+            crash_max_len=20,
+        ),
+    )
+
+
+def apply_fault_overrides(cfg: SimConfig, overrides) -> SimConfig:
+    """Apply generic ``key=value`` fault-knob overrides to a config.
+
+    The CLI's ``--fault`` escape hatch: any :class:`FaultConfig` field by
+    name, value coerced to the field's current type (bool fields accept
+    true/false/1/0).  Unknown keys raise ``ValueError`` listing the valid
+    knobs, so a typo'd knob fails loudly instead of silently fuzzing the
+    wrong space.
+    """
+    if not overrides:
+        return cfg
+    valid = {f.name for f in dataclasses.fields(FaultConfig)}
+    patch = {}
+    for item in overrides:
+        key, sep, raw = item.partition("=")
+        if not sep:
+            raise ValueError(f"fault override must be key=value, got {item!r}")
+        if key not in valid:
+            raise ValueError(
+                f"unknown fault knob {key!r}; valid: {', '.join(sorted(valid))}"
+            )
+        cur = getattr(cfg.fault, key)
+        if isinstance(cur, bool):
+            if raw.lower() not in {"true", "false", "1", "0"}:
+                raise ValueError(f"{key} is a flag; use {key}=true/false")
+            val: object = raw.lower() in {"true", "1"}
+        elif isinstance(cur, int):
+            val = int(raw)
+        elif isinstance(cur, float):
+            val = float(raw)
+        else:
+            val = raw
+        patch[key] = val
+    return dataclasses.replace(
+        cfg, fault=dataclasses.replace(cfg.fault, **patch)
+    )
+
+
 def config_flex(
     q1: int, q2: int, n_inst: int = 16_384, seed: int = 0
 ) -> SimConfig:

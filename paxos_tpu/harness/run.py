@@ -49,6 +49,7 @@ def get_step_fn(protocol: str) -> Callable:
 
 
 def init_state(cfg: SimConfig):
+    stale = cfg.fault.stale_k > 0  # allocate stale-snapshot shadow arrays
     if cfg.protocol == "multipaxos":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
         from paxos_tpu.core.mp_state import BV_SHIFT, MultiPaxosState
@@ -73,16 +74,23 @@ def init_state(cfg: SimConfig):
             cfg.log_len,
             k=cfg.k_slots,
             lease_init=cfg.fault.lease_len,
+            stale=stale,
         )
     if cfg.protocol == "fastpaxos":
         from paxos_tpu.core.fp_state import FastPaxosState
 
-        return FastPaxosState.init(cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots)
+        return FastPaxosState.init(
+            cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots, stale=stale
+        )
     if cfg.protocol == "raftcore":
         from paxos_tpu.core.raft_state import RaftState
 
-        return RaftState.init(cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots)
-    return PaxosState.init(cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots)
+        return RaftState.init(
+            cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots, stale=stale
+        )
+    return PaxosState.init(
+        cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots, stale=stale
+    )
 
 
 def init_plan(cfg: SimConfig) -> FaultPlan:

@@ -112,9 +112,26 @@ def _violations_at(
 
 
 def _lane_only(plan: FaultPlan, lane: int) -> FaultPlan:
-    """Benign-ify every lane except ``lane`` (lanes are independent)."""
+    """Benign-ify every lane except ``lane`` (lanes are independent).
+
+    Gray-failure fields stay structurally present (pytree structure is part
+    of the compiled program) but collapse to their neutral elements outside
+    the victim lane: threshold 0 (never drop/dup), direction 0 (two-way),
+    patience 0, backoff multiplier 1.
+    """
     n_inst = plan.part_start.shape[0]
     keep = jnp.arange(n_inst) == lane  # (I,)
+    gray = {}
+    if plan.part_dir is not None:
+        gray["part_dir"] = jnp.where(keep, plan.part_dir, 0)
+    if plan.link_drop is not None:
+        gray["link_drop"] = jnp.where(keep[None, None], plan.link_drop, 0)
+    if plan.link_dup is not None:
+        gray["link_dup"] = jnp.where(keep[None, None], plan.link_dup, 0)
+    if plan.ptimeout is not None:
+        gray["ptimeout"] = jnp.where(keep[None], plan.ptimeout, 0)
+    if plan.pboff is not None:
+        gray["pboff"] = jnp.where(keep[None], plan.pboff, 1)
     return FaultPlan(
         crash_start=jnp.where(keep[None], plan.crash_start, NEVER),
         crash_end=jnp.where(keep[None], plan.crash_end, NEVER),
@@ -125,6 +142,7 @@ def _lane_only(plan: FaultPlan, lane: int) -> FaultPlan:
         part_end=jnp.where(keep, plan.part_end, NEVER),
         aside=plan.aside,
         pside=plan.pside,
+        **gray,
     )
 
 
@@ -172,6 +190,67 @@ def _atom_removals(plan: FaultPlan, lane: int) -> list[tuple[str, Callable]]:
                 part_end=p.part_end.at[lane].set(NEVER),
             ),
         ))
+    # Gray atoms: asymmetry -> symmetric, per-link rates -> zero, per-lane
+    # timer skew -> neutral.  Each removal is independently revertible by
+    # the greedy loop, so only load-bearing gray faults survive.
+    if plan.part_dir is not None and part != NEVER:
+        if int(jax.device_get(plan.part_dir[lane])) != 0:
+            atoms.append((
+                "asym-partition",
+                lambda p: p.replace(part_dir=p.part_dir.at[lane].set(0)),
+            ))
+    if plan.link_drop is not None:
+        ld = jax.device_get(plan.link_drop[:, :, lane])
+        lu = (
+            jax.device_get(plan.link_dup[:, :, lane])
+            if plan.link_dup is not None
+            else None
+        )
+        for pr in range(n_prop):
+            for a in range(n_acc):
+                live = int(ld[pr, a]) != 0 or (
+                    lu is not None and int(lu[pr, a]) != 0
+                )
+                if not live:
+                    continue
+
+                def calm(p, pr=pr, a=a):
+                    p = p.replace(
+                        link_drop=p.link_drop.at[pr, a, lane].set(0)
+                    )
+                    if p.link_dup is not None:
+                        p = p.replace(
+                            link_dup=p.link_dup.at[pr, a, lane].set(0)
+                        )
+                    return p
+
+                atoms.append((f"flaky[link=({pr},{a})]", calm))
+    if plan.ptimeout is not None or plan.pboff is not None:
+        pt = (
+            jax.device_get(plan.ptimeout[:, lane])
+            if plan.ptimeout is not None
+            else None
+        )
+        pb = (
+            jax.device_get(plan.pboff[:, lane])
+            if plan.pboff is not None
+            else None
+        )
+        for pr in range(n_prop):
+            live = (pt is not None and int(pt[pr]) != 0) or (
+                pb is not None and int(pb[pr]) != 1
+            )
+            if not live:
+                continue
+
+            def unskew(p, pr=pr):
+                if p.ptimeout is not None:
+                    p = p.replace(ptimeout=p.ptimeout.at[pr, lane].set(0))
+                if p.pboff is not None:
+                    p = p.replace(pboff=p.pboff.at[pr, lane].set(1))
+                return p
+
+            atoms.append((f"skew[proposer={pr}]", unskew))
     return atoms
 
 

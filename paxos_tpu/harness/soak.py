@@ -22,6 +22,20 @@ from paxos_tpu.harness.config import SimConfig
 from paxos_tpu.harness.run import MeasurementCorrupted, run
 
 
+def _retry_schedule(
+    transient_retries: int, base_s: float = 5.0, cap_s: float = 60.0
+) -> list[float]:
+    """Planned pre-retry delays: exponential from ``base_s``, capped.
+
+    Doubling per attempt models the two real failure modes: blips (first
+    retry lands) and minutes-long outages (tunnel restart, preemption),
+    where hammering a recovering endpoint every 5 s just extends the
+    outage.  The cap keeps the worst wait ~1 min so a soak never stalls
+    much longer than the thing it waited out.
+    """
+    return [min(base_s * (2.0 ** i), cap_s) for i in range(transient_retries)]
+
+
 def _run_with_retries(
     run_fn: Callable[[], dict],
     say: Callable[[str], None],
@@ -34,22 +48,30 @@ def _run_with_retries(
     infra errors (remote-compile HTTP 500s, dropped response bodies) that
     have nothing to do with the campaign.  Campaigns are deterministic in
     (config, seed), so re-running one is an exact replay — retrying never
-    changes what is measured.  Returns (report, retries_used); re-raises
-    once the budget is exhausted.
+    changes what is measured.  Delays follow :func:`_retry_schedule`
+    (exponential, capped) with equal jitter — sleep drawn from
+    [delay/2, delay] — so concurrent soaks sharing one backend desync
+    instead of re-colliding in lockstep.  Returns (report, retries_used);
+    re-raises once the budget is exhausted.
     """
+    import random
+
     import jax
 
+    schedule = _retry_schedule(transient_retries, backoff_s)
     for attempt in range(transient_retries + 1):
         try:
             return run_fn(), attempt
         except jax.errors.JaxRuntimeError as e:
             if attempt >= transient_retries:
                 raise
+            delay = schedule[attempt]
+            sleep = delay * (0.5 + random.random() / 2)
             first_line = (str(e).splitlines() or [""])[0][:120]
             say(f"transient backend error (attempt {attempt + 1}/"
                 f"{transient_retries + 1}): {first_line}; "
-                f"retrying in {backoff_s:.0f}s")
-            time.sleep(backoff_s)
+                f"retrying in {sleep:.1f}s")
+            time.sleep(sleep)
     raise AssertionError("unreachable")
 
 
@@ -238,6 +260,9 @@ def soak(
         # denominator) excludes them while the throughput figure counts them.
         "recheck_rounds": recheck_rounds,
         "transient_retries_used": retries_used,
+        # Planned pre-retry delays (pre-jitter), for post-mortem reading of
+        # a soak that survived flaky infrastructure.
+        "retry_schedule_s": _retry_schedule(transient_retries, retry_backoff_s),
         "stuck_lanes": stuck_total,
         "stuck_lanes_max": stuck_max,
         "stuck_frac": round(stuck_total / max(lanes_total, 1), 6),

@@ -54,6 +54,14 @@ from jax.experimental.pallas import tpu as pltpu
 from paxos_tpu.faults.injector import FaultConfig, FaultPlan
 from paxos_tpu.kernels.counter_prng import mix
 
+# TPU interpret mode (emulates TPU-specific primitives on CPU) arrived after
+# jax 0.4.x; the kernel body is Mosaic-clean int32/bool arithmetic with no
+# TPU-only primitives, so the generic Pallas interpreter is an equivalent
+# oracle on older versions.
+_INTERPRET = (
+    pltpu.InterpretParams() if hasattr(pltpu, "InterpretParams") else True
+)
+
 DEFAULT_BLOCK = 1024
 
 # Largest instance count one pallas_call compiles at (measured: 4M compiles
@@ -306,10 +314,10 @@ def fused_chunk(
         out_specs=out_specs,
         out_shape=out_shape,
         input_output_aliases=aliases,
-        # TPU interpret mode (not the generic interpreter): it emulates
-        # TPU-specific primitives on CPU, which is what the CPU test rig
-        # runs equivalence checks under.
-        interpret=pltpu.InterpretParams() if interpret else False,
+        # TPU interpret mode where available (it emulates TPU-specific
+        # primitives on CPU), generic interpreter otherwise — the CPU test
+        # rig runs equivalence checks under whichever this build supports.
+        interpret=_INTERPRET if interpret else False,
     )(
         jnp.reshape(jnp.asarray(seed, jnp.int32), (1, 1)),
         jnp.reshape(tick, (1, 1)),
@@ -489,10 +497,20 @@ def _sharded_impl(
     state, seed, plan, *, cfg, n_ticks, apply_fn, mask_fn, mesh, block,
     blocks_per_shard, interpret,
 ):
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from paxos_tpu.parallel.mesh import INSTANCES_AXIS
+
+    try:
+        from jax import shard_map as _shard_map
+
+        def shard_map(f, **kw):
+            return _shard_map(f, check_vma=False, **kw)
+    except ImportError:  # older jax: experimental API, check_rep kwarg
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, **kw):
+            return _shard_map(f, check_rep=False, **kw)
 
     n_inst = jax.tree.leaves(state)[0].shape[-1]
 
@@ -516,7 +534,6 @@ def _sharded_impl(
         mesh=mesh,
         in_specs=(state_spec, P(), plan_spec),
         out_specs=state_spec,
-        check_vma=False,
     )(state, seed, plan)
 
 

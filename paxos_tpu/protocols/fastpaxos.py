@@ -45,7 +45,7 @@ from paxos_tpu.core.fp_state import (
     FastPaxosState,
 )
 from paxos_tpu.core.messages import ACCEPT, ACCEPTED, PREPARE, PROMISE
-from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan, bits_below
 from paxos_tpu.kernels.quorum import fast_quorum, majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
 from paxos_tpu.utils.bitops import popcount
@@ -71,7 +71,22 @@ def apply_tick_fast(
     alive = plan.alive(state.tick)  # (A, I)
     equiv = plan.equivocate  # (A, I)
 
-    if cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
+    if cfg.stale_k > 0:  # bug injection: recovery restores a stale snapshot
+        rec = plan.recovering(state.tick)
+        acc = acc.replace(
+            promised=jnp.where(rec, acc.snap_promised, acc.promised),
+            acc_bal=jnp.where(rec, acc.snap_bal, acc.acc_bal),
+            acc_val=jnp.where(rec, acc.snap_val, acc.acc_val),
+        )
+        snap = jnp.broadcast_to(
+            state.tick % jnp.int32(cfg.stale_k) == 0, rec.shape
+        )
+        acc = acc.replace(
+            snap_promised=jnp.where(snap, acc.promised, acc.snap_promised),
+            snap_bal=jnp.where(snap, acc.acc_bal, acc.snap_bal),
+            snap_val=jnp.where(snap, acc.acc_val, acc.snap_val),
+        )
+    elif cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
         rec = plan.recovering(state.tick)
         acc = acc.replace(
             promised=jnp.where(rec, 0, acc.promised),
@@ -82,20 +97,44 @@ def apply_tick_fast(
 
     # Reply delivery decided & delivered slots cleared BEFORE new writes
     # (same no-clobber discipline as protocols.paxos).
-    link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
+    if cfg.p_part > 0.0:
+        if cfg.p_asym > 0.0:  # per-direction cuts (gray asymmetric links)
+            link_req = plan.link_ok(state.tick, "req")  # (P, A, I)
+            link_rep = plan.link_ok(state.tick, "rep")
+        else:
+            link_req = link_rep = plan.link_ok(state.tick)
+    else:
+        link_req = link_rep = None
+
+    # Per-link loss/duplication (p_flaky): this tick's raw bits vs the
+    # plan's per-link thresholds; p_flaky == 0 is the uniform special case.
+    if cfg.p_flaky > 0.0:
+        keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
+        keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
+        keep_p1 = ~bits_below(masks.link_bits[2], plan.link_drop)
+        keep_p2 = ~bits_below(masks.link_bits[3], plan.link_drop)
+        if masks.dup_bits is not None:
+            dup_req = bits_below(masks.dup_bits[0], plan.link_dup[None])
+            dup_rep = bits_below(masks.dup_bits[1], plan.link_dup[None])
+        else:
+            dup_req = dup_rep = None
+    else:
+        keep_prom, keep_accd = masks.keep_prom, masks.keep_accd
+        keep_p1, keep_p2 = masks.keep_p1, masks.keep_p2
+        dup_req, dup_rep = masks.dup_req, masks.dup_rep
 
     delivered = state.replies.present
     if masks.deliver is not None:
         delivered = delivered & masks.deliver
-    if link is not None:  # partitioned links stall replies in flight
-        delivered = delivered & link[None]
-    replies = net.consume(state.replies, delivered, stay=masks.dup_rep)
+    if link_rep is not None:  # partitioned links stall replies in flight
+        delivered = delivered & link_rep[None]
+    replies = net.consume(state.replies, delivered, stay=dup_rep)
 
     # ---- Acceptor half-tick ----
     sel = net.select_from_scores(state.requests.present, masks.sel_score, masks.busy)
     sel = sel & alive[None, None]
-    if link is not None:  # partitioned links stall requests in flight
-        sel = sel & link[None]
+    if link_req is not None:  # partitioned links stall requests in flight
+        sel = sel & link_req[None]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(0, 1))
@@ -104,6 +143,10 @@ def apply_tick_fast(
     msg_val = gather(state.requests.v1)  # (A, I)
     is_prep = sel[PREPARE].any(axis=0)
     is_acc = sel[ACCEPT].any(axis=0)
+
+    if cfg.p_corrupt > 0.0:  # bug injection: in-flight bit flips, checker must flag
+        msg_val = jnp.where(masks.corrupt & is_acc, msg_val ^ 64, msg_val)
+        msg_bal = jnp.where(masks.corrupt & is_prep, msg_bal + 1, msg_bal)
 
     ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
     ok_prep = ok_prep_h | (is_prep & equiv)
@@ -129,7 +172,7 @@ def apply_tick_fast(
         bal=msg_bal[None],
         v1=prom_payload_bal[None],
         v2=prom_payload_val[None],
-        keep=masks.keep_prom,
+        keep=keep_prom,
     )
     replies = net.send(
         replies, ACCEPTED,
@@ -137,9 +180,9 @@ def apply_tick_fast(
         bal=msg_bal[None],
         v1=msg_val[None],
         v2=jnp.zeros_like(msg_val)[None],
-        keep=masks.keep_accd,
+        keep=keep_accd,
     )
-    requests = net.consume(state.requests, sel, stay=masks.dup_req)
+    requests = net.consume(state.requests, sel, stay=dup_req)
     acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
 
     # ---- Learner / safety checker (fast-quorum-aware thresholds) ----
@@ -234,10 +277,15 @@ def apply_tick_fast(
     )
 
     timer = jnp.where(prop.phase == DONE, prop.timer, prop.timer + 1)
+    # Timer skew (gray): per-proposer extra patience / backoff multiplier.
+    timeout = cfg.timeout if cfg.timeout_skew <= 0 else cfg.timeout + plan.ptimeout
+    backoff = (
+        masks.backoff if cfg.backoff_skew <= 1 else masks.backoff * plan.pboff
+    )
     expired = (
         (prop.phase != DONE)
         & ~p1_done & ~p2_done & ~fast_done
-        & (timer > cfg.timeout)
+        & (timer > timeout)
     )
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
@@ -255,7 +303,7 @@ def apply_tick_fast(
     best_bal = jnp.where(expired, 0, best_bal)
     rep_mask = jnp.where(expired[:, None], 0, rep_mask)
     timer = jnp.where(p1_done, 0, timer)
-    timer = jnp.where(expired, -masks.backoff, timer)
+    timer = jnp.where(expired, -backoff, timer)
 
     # Emit: classic ACCEPT on phase-1 completion, PREPARE on retry.
     requests = net.send(
@@ -264,7 +312,7 @@ def apply_tick_fast(
         bal=prop.bal[:, None],
         v1=prop_val[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=masks.keep_p2,
+        keep=keep_p2,
     )
     requests = net.send(
         requests, PREPARE,
@@ -272,7 +320,7 @@ def apply_tick_fast(
         bal=bal_next[:, None],
         v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=masks.keep_p1,
+        keep=keep_p1,
     )
 
     prop = prop.replace(

@@ -40,7 +40,7 @@ from paxos_tpu.core.mp_state import (
     bv_val,
     pack_bv,
 )
-from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan, bits_below, links_dup
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
 
@@ -65,6 +65,12 @@ class MPTickMasks:
     keep_acc: Optional[jnp.ndarray]  # (P, A, I) bool — ACCEPT not dropped
     jitter: jnp.ndarray  # (P, I) int32 — election-threshold jitter
     backoff: jnp.ndarray  # (P, I) int32 — post-failure retreat draw
+    # Gray-failure extensions (None unless the FaultConfig knob is on).
+    # Raw PRNG bits, compared against the plan's per-link thresholds inside
+    # apply_tick_mp — kind axis: 0=PROMISE 1=ACCEPTED 2=PREPARE 3=ACCEPT.
+    link_bits: Optional[jnp.ndarray] = None  # (4, P, A, I) int32
+    dup_bits: Optional[jnp.ndarray] = None  # (2, P, A, I) int32 — request dup
+    corrupt: Optional[jnp.ndarray] = None  # (A, I) bool — in-flight bit flip
 
 
 def sample_mp_masks(
@@ -75,22 +81,35 @@ def sample_mp_masks(
      k_drop_prep, k_drop_acc, k_jit, k_back) = jax.random.split(key, 11)
     slot = (2, n_prop, n_acc, n_inst)
     edge = (n_prop, n_acc, n_inst)
+    # Per-link loss replaces the uniform keep/dup masks with raw bits the
+    # tick compares against plan thresholds (fold_in, never extra splits:
+    # the pre-gray stream stays bit-identical when the knobs are off).
+    flaky = cfg.p_flaky > 0.0
+
+    def raw_bits(const: int, shape):
+        k = jax.random.fold_in(key, const)
+        return jax.random.bits(k, shape, jnp.uint32).astype(jnp.int32)
 
     return MPTickMasks(
         sel_score=jax.random.bits(k_sel, slot, jnp.uint32).astype(jnp.int32),
         busy=net.keep_mask(k_idle, (1, 1, n_acc, n_inst), cfg.p_idle),
-        dup_req=net.stay_mask(k_dup_req, slot, cfg.p_dup),
+        dup_req=None if flaky else net.stay_mask(k_dup_req, slot, cfg.p_dup),
         prom_deliver=net.keep_mask(k_hold_pr, edge, cfg.p_hold),
         accd_deliver=net.keep_mask(k_hold_ac, edge, cfg.p_hold),
-        keep_prom=net.keep_mask(k_drop_pr, edge, cfg.p_drop),
-        keep_accd=net.keep_mask(k_drop_ac, edge, cfg.p_drop),
-        keep_prep=net.keep_mask(k_drop_prep, edge, cfg.p_drop),
-        keep_acc=net.keep_mask(k_drop_acc, edge, cfg.p_drop),
+        keep_prom=None if flaky else net.keep_mask(k_drop_pr, edge, cfg.p_drop),
+        keep_accd=None if flaky else net.keep_mask(k_drop_ac, edge, cfg.p_drop),
+        keep_prep=None if flaky else net.keep_mask(k_drop_prep, edge, cfg.p_drop),
+        keep_acc=None if flaky else net.keep_mask(k_drop_acc, edge, cfg.p_drop),
         jitter=jax.random.randint(
             k_jit, (n_prop, n_inst), 0, max(cfg.backoff_max, 1), jnp.int32
         ),
         backoff=jax.random.randint(
             k_back, (n_prop, n_inst), 0, 2 * max(cfg.backoff_max, 1), jnp.int32
+        ),
+        link_bits=raw_bits(100, (4,) + edge) if flaky else None,
+        dup_bits=raw_bits(101, slot) if links_dup(cfg) else None,
+        corrupt=net.stay_mask(
+            jax.random.fold_in(key, 102), (n_acc, n_inst), cfg.p_corrupt
         ),
     )
 
@@ -117,20 +136,26 @@ def mp_counter_masks(
             jitter=jnp.zeros((n_prop, n_inst), jnp.int32),
             backoff=jnp.zeros((n_prop, n_inst), jnp.int32),
         )
+    flaky = cfg.p_flaky > 0.0
     return MPTickMasks(
         sel_score=cp.counter_bits(tick_seed, 0, slot),
         busy=cp.bern_not(tick_seed, 1, (1, 1, n_acc, n_inst), cfg.p_idle),
-        dup_req=cp.bern(tick_seed, 2, slot, cfg.p_dup),
+        dup_req=None if flaky else cp.bern(tick_seed, 2, slot, cfg.p_dup),
         prom_deliver=cp.bern_not(tick_seed, 3, edge, cfg.p_hold),
         accd_deliver=cp.bern_not(tick_seed, 4, edge, cfg.p_hold),
-        keep_prom=cp.bern_not(tick_seed, 5, edge, cfg.p_drop),
-        keep_accd=cp.bern_not(tick_seed, 6, edge, cfg.p_drop),
-        keep_prep=cp.bern_not(tick_seed, 7, edge, cfg.p_drop),
-        keep_acc=cp.bern_not(tick_seed, 8, edge, cfg.p_drop),
+        keep_prom=None if flaky else cp.bern_not(tick_seed, 5, edge, cfg.p_drop),
+        keep_accd=None if flaky else cp.bern_not(tick_seed, 6, edge, cfg.p_drop),
+        keep_prep=None if flaky else cp.bern_not(tick_seed, 7, edge, cfg.p_drop),
+        keep_acc=None if flaky else cp.bern_not(tick_seed, 8, edge, cfg.p_drop),
         jitter=cp.randint(tick_seed, 9, (n_prop, n_inst), max(cfg.backoff_max, 1)),
         backoff=cp.randint(
             tick_seed, 10, (n_prop, n_inst), 2 * max(cfg.backoff_max, 1)
         ),
+        link_bits=(
+            cp.counter_bits(tick_seed, 11, (4,) + edge) if flaky else None
+        ),
+        dup_bits=cp.counter_bits(tick_seed, 12, slot) if links_dup(cfg) else None,
+        corrupt=cp.bern(tick_seed, 13, (n_acc, n_inst), cfg.p_corrupt),
     )
 
 
@@ -156,7 +181,20 @@ def apply_tick_mp(
     p_alive = plan.prop_alive(state.tick)  # (P, I)
     equiv = plan.equivocate  # (A, I)
 
-    if cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
+    if cfg.stale_k > 0:  # bug injection: recovery restores a stale snapshot
+        rec = plan.recovering(state.tick)
+        acc = acc.replace(
+            promised=jnp.where(rec, acc.snap_promised, acc.promised),
+            log=jnp.where(rec[:, None], acc.snap_log, acc.log),
+        )
+        snap = jnp.broadcast_to(
+            state.tick % jnp.int32(cfg.stale_k) == 0, rec.shape
+        )
+        acc = acc.replace(
+            snap_promised=jnp.where(snap, acc.promised, acc.snap_promised),
+            snap_log=jnp.where(snap[:, None], acc.log, acc.snap_log),
+        )
+    elif cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
         rec = plan.recovering(state.tick)
         acc = acc.replace(
             promised=jnp.where(rec, 0, acc.promised),
@@ -164,7 +202,31 @@ def apply_tick_mp(
         )
 
     # ---- Reply delivery decided & cleared before new writes (no clobber) ----
-    link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
+    if cfg.p_part > 0.0:
+        if cfg.p_asym > 0.0:  # per-direction cuts (gray asymmetric links)
+            link_req = plan.link_ok(state.tick, "req")  # (P, A, I)
+            link_rep = plan.link_ok(state.tick, "rep")
+        else:
+            link_req = link_rep = plan.link_ok(state.tick)
+    else:
+        link_req = link_rep = None
+
+    # Per-link loss/duplication: compare this tick's raw bits against the
+    # plan's per-(p, a) thresholds; the uniform masks are the off path.
+    if cfg.p_flaky > 0.0:
+        keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
+        keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
+        keep_prep = ~bits_below(masks.link_bits[2], plan.link_drop)
+        keep_acc = ~bits_below(masks.link_bits[3], plan.link_drop)
+        dup_req = (
+            bits_below(masks.dup_bits, plan.link_dup[None])
+            if masks.dup_bits is not None
+            else None
+        )
+    else:
+        keep_prom, keep_accd = masks.keep_prom, masks.keep_accd
+        keep_prep, keep_acc = masks.keep_prep, masks.keep_acc
+        dup_req = masks.dup_req
 
     prom_del = state.promises.present
     if masks.prom_deliver is not None:
@@ -172,9 +234,9 @@ def apply_tick_mp(
     accd_del = state.accepted.present
     if masks.accd_deliver is not None:
         accd_del = accd_del & masks.accd_deliver
-    if link is not None:  # partitioned links stall replies in flight
-        prom_del = prom_del & link
-        accd_del = accd_del & link
+    if link_rep is not None:  # partitioned links stall replies in flight
+        prom_del = prom_del & link_rep
+        accd_del = accd_del & link_rep
     if "consume" in ablate:
         promises, accepted = state.promises, state.accepted
     else:
@@ -202,8 +264,8 @@ def apply_tick_mp(
             state.requests.present, masks.sel_score, masks.busy
         )
     sel = sel & alive[None, None]
-    if link is not None:  # partitioned links stall requests in flight
-        sel = sel & link[None]
+    if link_req is not None:  # partitioned links stall requests in flight
+        sel = sel & link_req[None]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(0, 1))
@@ -213,6 +275,10 @@ def apply_tick_mp(
     msg_slot = gather(state.requests.v2)  # (A, I)
     is_prep = sel[PREPARE].any(axis=0)
     is_acc = sel[ACCEPT].any(axis=0)
+
+    if cfg.p_corrupt > 0.0:  # bug injection: in-flight bit flips, checker must flag
+        msg_val = jnp.where(masks.corrupt & is_acc, msg_val ^ 64, msg_val)
+        msg_bal = jnp.where(masks.corrupt & is_prep, msg_bal + 1, msg_bal)
 
     ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
     ok_prep = ok_prep_h | (is_prep & equiv)
@@ -233,8 +299,8 @@ def apply_tick_mp(
     # kernel costs more than the masked no-op writes it skips.)
     if "sends" not in ablate:
         prom_send = sel[PREPARE] & ok_prep[None]  # (P, A, I)
-        if masks.keep_prom is not None:
-            prom_send = prom_send & masks.keep_prom
+        if keep_prom is not None:
+            prom_send = prom_send & keep_prom
         payload_bv = jnp.where(equiv[:, None], 0, acc.log)  # (A, L, I)
         promises = promises.replace(
             present=promises.present | prom_send,
@@ -245,8 +311,8 @@ def apply_tick_mp(
         )
 
         accd_send = sel[ACCEPT] & ok_acc[None]  # (P, A, I)
-        if masks.keep_accd is not None:
-            accd_send = accd_send & masks.keep_accd
+        if keep_accd is not None:
+            accd_send = accd_send & keep_accd
         accepted = accepted.replace(
             present=accepted.present | accd_send,
             bal=jnp.where(accd_send, msg_bal[None], accepted.bal),
@@ -257,7 +323,7 @@ def apply_tick_mp(
     if "consume" in ablate:
         requests = state.requests
     else:
-        requests = net.consume(state.requests, sel, stay=masks.dup_req)
+        requests = net.consume(state.requests, sel, stay=dup_req)
     acc = acc.replace(promised=promised, log=log)
 
     # ---- Learner / checker ----
@@ -350,8 +416,12 @@ def apply_tick_mp(
     new_bal = bal_mod.make_ballot(bal_mod.ballot_round(prop.bal) + 1, pid)
 
     # Candidate timeout: back to follower, retry later with the next ballot.
+    # Timeout skew (gray): each proposer lane runs its own deadline.
+    timeout = (
+        cfg.timeout if cfg.timeout_skew <= 0 else cfg.timeout + plan.ptimeout
+    )
     candidate_timer = jnp.where(prop.phase == CANDIDATE, prop.candidate_timer + 1, 0)
-    cand_fail = (prop.phase == CANDIDATE) & (candidate_timer > cfg.timeout) & ~p1_done
+    cand_fail = (prop.phase == CANDIDATE) & (candidate_timer > timeout) & ~p1_done
 
     # Stale leader demotes itself after a lease of no progress.
     demote = (prop.phase == LEAD) & lease_out & ~slot_done & ~log_full
@@ -370,8 +440,12 @@ def apply_tick_mp(
     lease_timer = jnp.where(start_elec | p1_done | slot_done, 0, lease_timer)
     # Failed candidacy / demotion: retreat below the election threshold by a
     # random backoff so rivals separate instead of re-colliding every tick.
+    # Backoff skew (gray): per-proposer multiplier stretches the retreat.
+    backoff = (
+        masks.backoff if cfg.backoff_skew <= 1 else masks.backoff * plan.pboff
+    )
     lease_timer = jnp.where(
-        cand_fail | demote, cfg.lease_len - masks.backoff, lease_timer
+        cand_fail | demote, cfg.lease_len - backoff, lease_timer
     )
     candidate_timer = jnp.where(start_elec, 0, candidate_timer)
 
@@ -387,7 +461,7 @@ def apply_tick_mp(
             bal=bal_next[:, None],
             v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
             v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-            keep=masks.keep_prep,
+            keep=keep_prep,
         )
     # Leaders re-broadcast the current slot's Accept every tick (idempotent,
     # self-healing under loss).
@@ -411,7 +485,7 @@ def apply_tick_mp(
             bal=bal_next[:, None],
             v1=pval[:, None],
             v2=ci[:, None],
-            keep=masks.keep_acc,
+            keep=keep_acc,
         )
 
     prop = prop.replace(

@@ -47,7 +47,12 @@ from paxos_tpu.check.safety import acceptor_invariants, learner_observe
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core.messages import ACCEPT, ACCEPTED, PREPARE, PROMISE
 from paxos_tpu.core.state import DONE, P1, P2, PaxosState
-from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.faults.injector import (
+    FaultConfig,
+    FaultPlan,
+    bits_below,
+    links_dup,
+)
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
 
@@ -71,6 +76,15 @@ class TickMasks:
     keep_p1: Optional[jnp.ndarray]  # (P, A, I) bool — PREPARE not dropped
     keep_p2: Optional[jnp.ndarray]  # (P, A, I) bool — ACCEPT not dropped
     backoff: jnp.ndarray  # (P, I) int32 — retry backoff draw
+    # Gray failures (None unless the owning FaultConfig knob is on).  With
+    # p_flaky > 0 the keep_*/dup_* masks above are None and delivery draws
+    # come from these raw bits, compared in apply_tick against the plan's
+    # per-link thresholds (FaultPlan.link_drop / link_dup).
+    link_bits: Optional[jnp.ndarray] = None  # (4, P, A, I) int32 raw bits,
+    #   kind axis: 0=PROMISE 1=ACCEPTED 2=PREPARE 3=ACCEPT sends
+    dup_bits: Optional[jnp.ndarray] = None  # (2, 2, P, A, I) int32 raw bits,
+    #   leading axis: 0=requests 1=replies
+    corrupt: Optional[jnp.ndarray] = None  # (A, I) bool — payload perturbed
 
 
 def sample_masks(
@@ -82,20 +96,38 @@ def sample_masks(
     slot = (2, n_prop, n_acc, n_inst)
     edge = (n_prop, n_acc, n_inst)
 
+    # Gray draws use fold_in-derived keys, NOT extra splits: the 10-way
+    # split above must keep producing the exact pre-gray streams when every
+    # gray knob is off.
+    flaky = cfg.p_flaky > 0.0
+
+    def raw_bits(const: int, shape):
+        k = jax.random.fold_in(key, const)
+        return jax.random.bits(k, shape, jnp.uint32).astype(jnp.int32)
+
     return TickMasks(
         # int32 everywhere (matching the counter-PRNG path and Mosaic's
         # signed-only lowering); the uint32→int32 astype wraps bit-exactly.
         sel_score=jax.random.bits(k_sel, slot, jnp.uint32).astype(jnp.int32),
         busy=net.keep_mask(k_idle, (1, 1, n_acc, n_inst), cfg.p_idle),
         deliver=net.keep_mask(k_hold, slot, cfg.p_hold),
-        dup_req=net.stay_mask(k_dup_req, slot, cfg.p_dup),
-        dup_rep=net.stay_mask(k_dup_rep, slot, cfg.p_dup),
-        keep_prom=net.keep_mask(k_drop_prom, edge, cfg.p_drop),
-        keep_accd=net.keep_mask(k_drop_accd, edge, cfg.p_drop),
-        keep_p1=net.keep_mask(k_drop_p1, edge, cfg.p_drop),
-        keep_p2=net.keep_mask(k_drop_p2, edge, cfg.p_drop),
+        dup_req=None if flaky else net.stay_mask(k_dup_req, slot, cfg.p_dup),
+        dup_rep=None if flaky else net.stay_mask(k_dup_rep, slot, cfg.p_dup),
+        keep_prom=(
+            None if flaky else net.keep_mask(k_drop_prom, edge, cfg.p_drop)
+        ),
+        keep_accd=(
+            None if flaky else net.keep_mask(k_drop_accd, edge, cfg.p_drop)
+        ),
+        keep_p1=None if flaky else net.keep_mask(k_drop_p1, edge, cfg.p_drop),
+        keep_p2=None if flaky else net.keep_mask(k_drop_p2, edge, cfg.p_drop),
         backoff=jax.random.randint(
             k_backoff, (n_prop, n_inst), 0, max(cfg.backoff_max, 1), jnp.int32
+        ),
+        link_bits=raw_bits(100, (4,) + edge) if flaky else None,
+        dup_bits=raw_bits(101, (2,) + slot) if links_dup(cfg) else None,
+        corrupt=net.stay_mask(
+            jax.random.fold_in(key, 102), (n_acc, n_inst), cfg.p_corrupt
         ),
     )
 
@@ -130,17 +162,27 @@ def counter_masks(
             keep_prom=None, keep_accd=None, keep_p1=None, keep_p2=None,
             backoff=jnp.zeros((n_prop, n_inst), jnp.int32),
         )
+    # Gray draws live on streams >= 10 so streams 0-9 stay the exact
+    # pre-gray schedule when every gray knob is off.
+    flaky = cfg.p_flaky > 0.0
     return TickMasks(
         sel_score=cp.counter_bits(tick_seed, 0, slot),
         busy=cp.bern_not(tick_seed, 1, (1, 1, n_acc, n_inst), cfg.p_idle),
         deliver=cp.bern_not(tick_seed, 2, slot, cfg.p_hold),
-        dup_req=cp.bern(tick_seed, 3, slot, cfg.p_dup),
-        dup_rep=cp.bern(tick_seed, 4, slot, cfg.p_dup),
-        keep_prom=cp.bern_not(tick_seed, 5, edge, cfg.p_drop),
-        keep_accd=cp.bern_not(tick_seed, 6, edge, cfg.p_drop),
-        keep_p1=cp.bern_not(tick_seed, 7, edge, cfg.p_drop),
-        keep_p2=cp.bern_not(tick_seed, 8, edge, cfg.p_drop),
+        dup_req=None if flaky else cp.bern(tick_seed, 3, slot, cfg.p_dup),
+        dup_rep=None if flaky else cp.bern(tick_seed, 4, slot, cfg.p_dup),
+        keep_prom=None if flaky else cp.bern_not(tick_seed, 5, edge, cfg.p_drop),
+        keep_accd=None if flaky else cp.bern_not(tick_seed, 6, edge, cfg.p_drop),
+        keep_p1=None if flaky else cp.bern_not(tick_seed, 7, edge, cfg.p_drop),
+        keep_p2=None if flaky else cp.bern_not(tick_seed, 8, edge, cfg.p_drop),
         backoff=cp.randint(tick_seed, 9, (n_prop, n_inst), max(cfg.backoff_max, 1)),
+        link_bits=cp.counter_bits(tick_seed, 10, (4,) + edge) if flaky else None,
+        dup_bits=(
+            cp.counter_bits(tick_seed, 11, (2,) + slot)
+            if links_dup(cfg)
+            else None
+        ),
+        corrupt=cp.bern(tick_seed, 12, (n_acc, n_inst), cfg.p_corrupt),
     )
 
 
@@ -178,7 +220,29 @@ def apply_tick(
     alive = plan.alive(state.tick)  # (A, I)
     equiv = plan.equivocate  # (A, I)
 
-    if cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
+    if cfg.stale_k > 0:
+        # Bug injection: recovery restores the snapshot from the last
+        # multiple of stale_k ticks — up to stale_k ticks of promises and
+        # accepts silently lost (amnesia generalized from "lose all").
+        # Restored BEFORE acc_pre: the checker must flag the protocol
+        # consequences (conflicting choices), not the rollback write itself.
+        rec = plan.recovering(state.tick)
+        acc = acc.replace(
+            promised=jnp.where(rec, acc.snap_promised, acc.promised),
+            acc_bal=jnp.where(rec, acc.snap_bal, acc.acc_bal),
+            acc_val=jnp.where(rec, acc.snap_val, acc.acc_val),
+        )
+        # Refresh AFTER restore: a snapshot boundary landing on the
+        # recovery tick re-snapshots the (stale) restored state.
+        snap = jnp.broadcast_to(
+            state.tick % jnp.int32(cfg.stale_k) == 0, rec.shape
+        )
+        acc = acc.replace(
+            snap_promised=jnp.where(snap, acc.promised, acc.snap_promised),
+            snap_bal=jnp.where(snap, acc.acc_bal, acc.snap_bal),
+            snap_val=jnp.where(snap, acc.acc_val, acc.snap_val),
+        )
+    elif cfg.amnesia:  # bug injection: acceptor forgets durable state on recovery
         rec = plan.recovering(state.tick)
         acc = acc.replace(
             promised=jnp.where(rec, 0, acc.promised),
@@ -191,17 +255,44 @@ def apply_tick(
     # acceptor half-tick writes new replies: otherwise a reply written this
     # tick could land in a slot being consumed and be lost even on a
     # fault-free network.  Proposers read payloads from the pre-tick buffer.
-    link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
+    # Asymmetric cuts (p_asym) split the link view per traffic direction;
+    # symmetric plans use one view for both (the identical trace).
+    if cfg.p_part > 0.0:
+        if cfg.p_asym > 0.0:
+            link_req = plan.link_ok(state.tick, "req")  # (P, A, I)
+            link_rep = plan.link_ok(state.tick, "rep")
+        else:
+            link_req = link_rep = plan.link_ok(state.tick)
+    else:
+        link_req = link_rep = None
+
+    # Per-link loss/duplication (p_flaky): this tick's raw bits vs the
+    # plan's per-link thresholds; p_flaky == 0 is the uniform special case
+    # carried by the scalar-threshold masks.
+    if cfg.p_flaky > 0.0:
+        keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
+        keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
+        keep_p1 = ~bits_below(masks.link_bits[2], plan.link_drop)
+        keep_p2 = ~bits_below(masks.link_bits[3], plan.link_drop)
+        if masks.dup_bits is not None:
+            dup_req = bits_below(masks.dup_bits[0], plan.link_dup[None])
+            dup_rep = bits_below(masks.dup_bits[1], plan.link_dup[None])
+        else:
+            dup_req = dup_rep = None
+    else:
+        keep_prom, keep_accd = masks.keep_prom, masks.keep_accd
+        keep_p1, keep_p2 = masks.keep_p1, masks.keep_p2
+        dup_req, dup_rep = masks.dup_req, masks.dup_rep
 
     delivered = state.replies.present
     if masks.deliver is not None:
         delivered = delivered & masks.deliver
-    if link is not None:  # partitioned links stall replies in flight
-        delivered = delivered & link[None]
+    if link_rep is not None:  # partitioned links stall replies in flight
+        delivered = delivered & link_rep[None]
     if "consume" in ablate:
         replies = state.replies
     else:
-        replies = net.consume(state.replies, delivered, stay=masks.dup_rep)
+        replies = net.consume(state.replies, delivered, stay=dup_rep)
 
     # ---- Acceptor half-tick: select one request per (instance, acceptor) ----
     if "select" in ablate:
@@ -220,8 +311,8 @@ def apply_tick(
             state.requests.present, masks.sel_score, masks.busy
         )
     sel = sel & alive[None, None]  # crashed acceptors process nothing
-    if link is not None:  # partitioned links stall requests in flight
-        sel = sel & link[None]
+    if link_req is not None:  # partitioned links stall requests in flight
+        sel = sel & link_req[None]
 
     # Gather the selected message's fields onto (A, I).
     def gather(x):
@@ -231,6 +322,15 @@ def apply_tick(
     msg_val = gather(state.requests.v1)  # (A, I) (ACCEPT payload)
     is_prep = sel[PREPARE].any(axis=0)  # (A, I)
     is_acc = sel[ACCEPT].any(axis=0)  # (A, I)
+
+    if cfg.p_corrupt > 0.0:
+        # Bug injection: the payload is perturbed between send and process.
+        # An ACCEPT's value flips bits (xor stays clear of every legitimate
+        # value encoding) — acceptors then vote for a value nobody proposed,
+        # which the agreement checker MUST flag; a PREPARE's ballot bumps,
+        # impersonating a neighboring proposer's ballot (liveness chaos).
+        msg_val = jnp.where(masks.corrupt & is_acc, msg_val ^ 64, msg_val)
+        msg_bal = jnp.where(masks.corrupt & is_prep, msg_bal + 1, msg_bal)
 
     # PREPARE(b): honest promise iff b > promised; equivocators "promise"
     # unconditionally, never record it, and hide their accepted pair.
@@ -255,7 +355,7 @@ def apply_tick(
             bal=msg_bal[None],
             v1=prom_payload_bal[None],
             v2=prom_payload_val[None],
-            keep=masks.keep_prom,
+            keep=keep_prom,
         )
         replies = net.send(
             replies, ACCEPTED,
@@ -263,12 +363,12 @@ def apply_tick(
             bal=msg_bal[None],
             v1=msg_val[None],
             v2=jnp.zeros_like(msg_val)[None],
-            keep=masks.keep_accd,
+            keep=keep_accd,
         )
     if "consume" in ablate:
         requests = state.requests
     else:
-        requests = net.consume(state.requests, sel, stay=masks.dup_req)
+        requests = net.consume(state.requests, sel, stay=dup_req)
     acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
 
     # ---- Learner / safety checker (omniscient: sees accept events directly) ----
@@ -333,8 +433,14 @@ def apply_tick(
     v_chosen_by_p1 = jnp.where(best_bal > 0, best_val, prop.own_val)
 
     timer = jnp.where(prop.phase == DONE, prop.timer, prop.timer + 1)
+    # Timer skew (timeout_skew / backoff_skew): per-proposer extra patience
+    # and backoff multipliers from the plan; off = the uniform timers.
+    timeout = cfg.timeout if cfg.timeout_skew <= 0 else cfg.timeout + plan.ptimeout
+    backoff = (
+        masks.backoff if cfg.backoff_skew <= 1 else masks.backoff * plan.pboff
+    )
     expired = (
-        (prop.phase != DONE) & ~p1_done & ~p2_done & (timer > cfg.timeout)
+        (prop.phase != DONE) & ~p1_done & ~p2_done & (timer > timeout)
     )
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
@@ -351,7 +457,7 @@ def apply_tick(
     best_bal = jnp.where(expired, 0, best_bal)
     best_val = jnp.where(expired, 0, best_val)
     timer = jnp.where(p1_done, 0, timer)
-    timer = jnp.where(expired, -masks.backoff, timer)
+    timer = jnp.where(expired, -backoff, timer)
 
     # Emit: ACCEPT broadcast on phase-1 completion, PREPARE broadcast on retry.
     if "sends" not in ablate:
@@ -361,7 +467,7 @@ def apply_tick(
             bal=prop.bal[:, None],
             v1=prop_val[:, None],
             v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-            keep=masks.keep_p2,
+            keep=keep_p2,
         )
         requests = net.send(
             requests, PREPARE,
@@ -369,7 +475,7 @@ def apply_tick(
             bal=bal_next[:, None],
             v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
             v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-            keep=masks.keep_p1,
+            keep=keep_p1,
         )
 
     prop = prop.replace(

@@ -49,7 +49,7 @@ from paxos_tpu.core.raft_state import (
     VOTE,
     RaftState,
 )
-from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan, bits_below
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
 
@@ -70,7 +70,22 @@ def apply_tick_raft(
     alive = plan.alive(state.tick)  # (A, I)
     equiv = plan.equivocate  # (A, I)
 
-    if cfg.amnesia:  # bug injection: voter forgets durable state on recovery
+    if cfg.stale_k > 0:  # bug injection: recovery restores a stale snapshot
+        rec = plan.recovering(state.tick)
+        voter = voter.replace(
+            voted=jnp.where(rec, voter.snap_voted, voter.voted),
+            ent_term=jnp.where(rec, voter.snap_term, voter.ent_term),
+            ent_val=jnp.where(rec, voter.snap_val, voter.ent_val),
+        )
+        snap = jnp.broadcast_to(
+            state.tick % jnp.int32(cfg.stale_k) == 0, rec.shape
+        )
+        voter = voter.replace(
+            snap_voted=jnp.where(snap, voter.voted, voter.snap_voted),
+            snap_term=jnp.where(snap, voter.ent_term, voter.snap_term),
+            snap_val=jnp.where(snap, voter.ent_val, voter.snap_val),
+        )
+    elif cfg.amnesia:  # bug injection: voter forgets durable state on recovery
         rec = plan.recovering(state.tick)
         voter = voter.replace(
             voted=jnp.where(rec, 0, voter.voted),
@@ -79,20 +94,44 @@ def apply_tick_raft(
         )
     voter_pre = voter
 
-    link = plan.link_ok(state.tick) if cfg.p_part > 0.0 else None  # (P, A, I)
+    if cfg.p_part > 0.0:
+        if cfg.p_asym > 0.0:  # per-direction cuts (gray asymmetric links)
+            link_req = plan.link_ok(state.tick, "req")  # (P, A, I)
+            link_rep = plan.link_ok(state.tick, "rep")
+        else:
+            link_req = link_rep = plan.link_ok(state.tick)
+    else:
+        link_req = link_rep = None
+
+    # Per-link loss/duplication (p_flaky): this tick's raw bits vs the
+    # plan's per-link thresholds; p_flaky == 0 is the uniform special case.
+    if cfg.p_flaky > 0.0:
+        keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
+        keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
+        keep_p1 = ~bits_below(masks.link_bits[2], plan.link_drop)
+        keep_p2 = ~bits_below(masks.link_bits[3], plan.link_drop)
+        if masks.dup_bits is not None:
+            dup_req = bits_below(masks.dup_bits[0], plan.link_dup[None])
+            dup_rep = bits_below(masks.dup_bits[1], plan.link_dup[None])
+        else:
+            dup_req = dup_rep = None
+    else:
+        keep_prom, keep_accd = masks.keep_prom, masks.keep_accd
+        keep_p1, keep_p2 = masks.keep_p1, masks.keep_p2
+        dup_req, dup_rep = masks.dup_req, masks.dup_rep
 
     delivered = state.replies.present
     if masks.deliver is not None:
         delivered = delivered & masks.deliver
-    if link is not None:  # partitioned links stall replies in flight
-        delivered = delivered & link[None]
-    replies = net.consume(state.replies, delivered, stay=masks.dup_rep)
+    if link_rep is not None:  # partitioned links stall replies in flight
+        delivered = delivered & link_rep[None]
+    replies = net.consume(state.replies, delivered, stay=dup_rep)
 
     # ---- Voter half-tick: select one request per (instance, voter) ----
     sel = net.select_from_scores(state.requests.present, masks.sel_score, masks.busy)
     sel = sel & alive[None, None]
-    if link is not None:  # partitioned links stall requests in flight
-        sel = sel & link[None]
+    if link_req is not None:  # partitioned links stall requests in flight
+        sel = sel & link_req[None]
 
     def gather(x):
         return jnp.where(sel, x, 0).sum(axis=(0, 1))
@@ -101,6 +140,10 @@ def apply_tick_raft(
     msg_v1 = gather(state.requests.v1)  # (A, I): REQVOTE cand_last / APPEND value
     is_rv = sel[REQVOTE].any(axis=0)  # (A, I)
     is_ap = sel[APPEND].any(axis=0)
+
+    if cfg.p_corrupt > 0.0:  # bug injection: in-flight bit flips, checker must flag
+        msg_v1 = jnp.where(masks.corrupt & is_ap, msg_v1 ^ 64, msg_v1)
+        msg_bal = jnp.where(masks.corrupt & is_rv, msg_bal + 1, msg_bal)
 
     # RequestVote: one vote per term + election restriction.  Equivocators
     # grant everything and hide their entry (config-4-style double vote).
@@ -125,7 +168,7 @@ def apply_tick_raft(
         bal=msg_bal[None],
         v1=(vote_payload_t * 2 + grant.astype(jnp.int32))[None],
         v2=vote_payload_v[None],
-        keep=masks.keep_prom,
+        keep=keep_prom,
     )
     replies = net.send(
         replies, ACK,
@@ -133,9 +176,9 @@ def apply_tick_raft(
         bal=msg_bal[None],
         v1=msg_v1[None],
         v2=jnp.zeros_like(msg_v1)[None],
-        keep=masks.keep_accd,
+        keep=keep_accd,
     )
-    requests = net.consume(state.requests, sel, stay=masks.dup_req)
+    requests = net.consume(state.requests, sel, stay=dup_req)
     voter = voter.replace(voted=voted, ent_term=ent_term, ent_val=ent_val)
 
     # ---- Learner / safety checker (append-accept events, majority commit) ----
@@ -187,8 +230,13 @@ def apply_tick_raft(
     committed = (cand.phase == LEAD) & quorum_reached(heard, quorum)
 
     timer = jnp.where(cand.phase == DONE, cand.timer, cand.timer + 1)
+    # Timer skew (gray): per-candidate extra patience / backoff multiplier.
+    timeout = cfg.timeout if cfg.timeout_skew <= 0 else cfg.timeout + plan.ptimeout
+    backoff = (
+        masks.backoff if cfg.backoff_skew <= 1 else masks.backoff * plan.pboff
+    )
     expired = (
-        (cand.phase != DONE) & ~elected & ~committed & (timer > cfg.timeout)
+        (cand.phase != DONE) & ~elected & ~committed & (timer > timeout)
     )
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
@@ -208,7 +256,7 @@ def apply_tick_raft(
     bal_next = jnp.where(expired, new_bal, cand.bal)
     heard = jnp.where(elected | expired, 0, heard)
     timer = jnp.where(elected, 0, timer)
-    timer = jnp.where(expired, -masks.backoff, timer)
+    timer = jnp.where(expired, -backoff, timer)
 
     # Emit: leaders re-broadcast AppendEntries every tick; expired candidates
     # broadcast RequestVote at the next term, declaring their entry term.
@@ -219,7 +267,7 @@ def apply_tick_raft(
         bal=bal_next[:, None],
         v1=prop_val[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=masks.keep_p2,
+        keep=keep_p2,
     )
     requests = net.send(
         requests, REQVOTE,
@@ -227,7 +275,7 @@ def apply_tick_raft(
         bal=bal_next[:, None],
         v1=ent_term_c[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
-        keep=masks.keep_p1,
+        keep=keep_p1,
     )
 
     cand = cand.replace(

@@ -2,6 +2,7 @@
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from paxos_tpu.harness.config import config2_dueling_drop
 from paxos_tpu.harness.run import base_key, get_step_fn, init_plan, init_state, run_chunk
@@ -71,6 +72,11 @@ def test_two_process_rendezvous_smoke():
     try:
         for p in procs:
             out, err = p.communicate(timeout=300)
+            if "aren't implemented on the CPU backend" in err:
+                # jaxlib builds without CPU collectives (e.g. 0.4.x) cannot
+                # run the rendezvous at all — an environment limitation, not
+                # a regression in the helpers under test.
+                pytest.skip("this jaxlib's CPU backend lacks multiprocess support")
             assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
             outs.append(json.loads(out.strip().splitlines()[-1]))
     finally:

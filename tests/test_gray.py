@@ -1,0 +1,330 @@
+"""Gray-failure fault injection (PR 1): stream identity + knob semantics.
+
+Two contracts guard this layer:
+
+1. **Default-off is free**: every gray knob off means every gray plan field
+   is ``None`` (pruned from the pytree), no extra PRNG draws happen, and the
+   default-config schedule streams are BIT-IDENTICAL to the pre-gray build.
+   The golden digests below were recorded at the pre-PR commit and must
+   never drift — a digest change means the fuzzing schedules (and thus every
+   recorded soak/BASELINE number) silently changed.
+2. **Knobs do what they claim**: chaos knobs (asymmetric cuts, flaky links,
+   timer skew) enrich the schedule space without breaking safety; bug
+   injections (``p_corrupt``, ``stale_k``) must light up the checker.
+"""
+
+import dataclasses
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paxos_tpu.faults.injector import (
+    NEVER,
+    FaultConfig,
+    FaultPlan,
+    bits_below,
+    rate_threshold,
+)
+from paxos_tpu.harness import config as C
+from paxos_tpu.harness.checkpoint import stream_id
+from paxos_tpu.harness.run import (
+    base_key,
+    get_step_fn,
+    init_plan,
+    init_state,
+    run,
+    run_chunk,
+)
+
+
+def _digest(state) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        h.update(jax.device_get(leaf).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _xla_digest(cfg, n_ticks=32) -> str:
+    state = run_chunk(
+        init_state(cfg), base_key(cfg), init_plan(cfg), cfg.fault, n_ticks,
+        get_step_fn(cfg.protocol),
+    )
+    return _digest(state)
+
+
+def _ctr_digest(cfg, n_ticks=32) -> str:
+    from paxos_tpu.kernels.fused_tick import fused_fns, reference_chunk
+
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    state = reference_chunk(
+        init_state(cfg), cfg.seed, init_plan(cfg), cfg.fault, n_ticks,
+        apply_fn=apply_fn, mask_fn=mask_fn, blk_id=0,
+    )
+    return _digest(state)
+
+
+# Recorded at the pre-gray commit (n_inst=256, seed=7, 32 ticks, CPU):
+# full-state sha256 prefixes per config, XLA engine (jax.random streams).
+_GOLDEN_XLA = {
+    "config1": (lambda: C.config1_no_faults(256, 7), "d8c7672c63eebd78"),
+    "config2": (lambda: C.config2_dueling_drop(256, 7), "83347bc41b16a2aa"),
+    "config3": (lambda: C.config3_multipaxos(256, 7), "93a2dd9d7b8d66e4"),
+    "config4": (lambda: C.config4_byzantine(256, 7), "7b0072765edd14f8"),
+    "fastpaxos": (lambda: C.config5_sweep(256, 7)[1], "c43658973b29e73e"),
+    "raftcore": (lambda: C.config5_sweep(256, 7)[2], "4662db6b2c5a39d3"),
+}
+# Same contract for the counter-PRNG stream (fused engine's reference twin).
+_GOLDEN_CTR = {
+    "config2": (lambda: C.config2_dueling_drop(256, 7), "db6db6f40f16eb7b"),
+    "config3": (lambda: C.config3_multipaxos(256, 7), "4b6525460815d9c5"),
+    "fastpaxos": (lambda: C.config5_sweep(256, 7)[1], "72beea3ccdacab94"),
+    "raftcore": (lambda: C.config5_sweep(256, 7)[2], "eb285905571b709f"),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_GOLDEN_XLA))
+def test_default_stream_bit_identical_xla(name):
+    make, want = _GOLDEN_XLA[name]
+    assert _xla_digest(make()) == want, (
+        f"{name}: default-config XLA schedule stream drifted from the "
+        "pre-gray build — gray knobs must be free when off"
+    )
+
+
+@pytest.mark.parametrize("name", sorted(_GOLDEN_CTR))
+def test_default_stream_bit_identical_counter(name):
+    make, want = _GOLDEN_CTR[name]
+    assert _ctr_digest(make()) == want, (
+        f"{name}: default-config counter-PRNG stream drifted from the "
+        "pre-gray build — gray knobs must be free when off"
+    )
+
+
+def test_stream_id_unchanged_by_gray_knobs():
+    """Stream lineage depends on engine/block/prng scheme only — turning a
+    gray knob on (or the knobs existing at all) must not relabel streams."""
+    plain = C.config2_dueling_drop(64, 0)
+    gray = C.config_gray_chaos(64, 0)
+    assert stream_id(plain, "xla") == stream_id(gray, "xla")
+    assert stream_id(plain, "fused") == stream_id(gray, "fused")
+
+
+def test_default_plan_prunes_gray_fields():
+    """Every gray field is None when its knob is off — default plans keep
+    their pre-gray pytree structure (and the fused engine's VMEM budget)."""
+    cfg = FaultConfig(p_drop=0.1, p_part=0.5, p_crash=0.2)  # no gray knobs
+    sampled = FaultPlan.sample(jax.random.PRNGKey(0), cfg, 32, 5, 2)
+    for plan in (FaultPlan.none(32, 5, 2), sampled):
+        assert plan.part_dir is None
+        assert plan.link_drop is None
+        assert plan.link_dup is None
+        assert plan.ptimeout is None
+        assert plan.pboff is None
+    # Structural equality matters for checkpoint restore templates.
+    none_t = jax.tree_util.tree_structure(FaultPlan.none(32, 5, 2, cfg=cfg))
+    assert none_t == jax.tree_util.tree_structure(sampled)
+
+
+def test_gray_plan_fields_present_and_shaped():
+    cfg = C.config_gray_chaos(64, 3).fault
+    plan = FaultPlan.sample(jax.random.PRNGKey(1), cfg, 64, 5, 2)
+    assert plan.part_dir.shape == (64,)
+    assert set(jax.device_get(plan.part_dir).tolist()) <= {0, 1, 2}
+    assert plan.link_drop.shape == (2, 5, 64)
+    assert plan.link_dup.shape == (2, 5, 64)
+    assert plan.ptimeout.shape == (2, 64)
+    assert int(plan.ptimeout.max()) <= cfg.timeout_skew
+    assert plan.pboff.shape == (2, 64)
+    assert int(plan.pboff.min()) >= 1
+    assert int(plan.pboff.max()) <= cfg.backoff_skew
+    # The checkpoint restore template must mirror the sampled structure.
+    tmpl = FaultPlan.none(64, 5, 2, cfg=cfg)
+    assert jax.tree_util.tree_structure(tmpl) == (
+        jax.tree_util.tree_structure(plan)
+    )
+
+
+def test_rate_threshold_bernoulli_semantics():
+    bits = jax.random.bits(
+        jax.random.PRNGKey(0), (1 << 16,), jnp.uint32
+    ).astype(jnp.int32)
+    # Rate 0 never fires; rate ~1 (saturated) essentially always fires.
+    assert not bool(bits_below(bits, rate_threshold(0.0)).any())
+    assert float(bits_below(bits, rate_threshold(1.0)).mean()) > 0.999
+    got = float(bits_below(bits, rate_threshold(0.3)).mean())
+    assert abs(got - 0.3) < 0.02  # 256-sigma-safe at 2^16 draws
+
+
+@pytest.mark.parametrize("protocol", ["paxos", "multipaxos", "raftcore"])
+def test_flaky_zero_rates_are_neutral(protocol):
+    """p_flaky > 0 with all-zero drop/dup rates reroutes delivery through the
+    per-link threshold path but must not change a single outcome: the
+    uniform global rates are the exact special case of the link matrices."""
+    base = {
+        "paxos": C.config2_dueling_drop,
+        "multipaxos": C.config3_multipaxos,
+        "raftcore": lambda n, s: C.config5_sweep(n, s)[2],
+    }[protocol](128, 9)
+    plain = dataclasses.replace(
+        base, fault=dataclasses.replace(base.fault, p_drop=0.0, p_dup=0.0)
+    )
+    flaky = dataclasses.replace(
+        plain,
+        fault=dataclasses.replace(
+            plain.fault, p_flaky=0.5, flaky_drop=0.0, flaky_dup=0.0
+        ),
+    )
+    assert _xla_digest(plain) == _xla_digest(flaky)
+
+
+def test_link_ok_directional_cuts():
+    """part_dir semantics: 0 cuts both directions, 1 only requests (P->A),
+    2 only replies (A->P); healed windows deliver everything."""
+    n_inst, n_acc, n_prop = 3, 2, 1
+    plan = FaultPlan.none(n_inst, n_acc, n_prop)
+    plan = plan.replace(
+        part_start=jnp.zeros((n_inst,), jnp.int32),
+        part_end=jnp.full((n_inst,), 8, jnp.int32),
+        pside=jnp.ones((n_prop, n_inst), jnp.bool_),
+        aside=jnp.zeros((n_acc, n_inst), jnp.bool_),  # every link crosses
+        part_dir=jnp.array([0, 1, 2], jnp.int32),
+    )
+    t = jnp.int32(3)
+    req = jax.device_get(plan.link_ok(t, "req"))[0, 0]  # (I,)
+    rep = jax.device_get(plan.link_ok(t, "rep"))[0, 0]
+    sym = jax.device_get(plan.link_ok(t))[0, 0]
+    assert req.tolist() == [False, False, True]  # dir 2 spares requests
+    assert rep.tolist() == [False, True, False]  # dir 1 spares replies
+    assert sym.tolist() == [False, False, False]  # direction-blind view
+    healed = jax.device_get(plan.link_ok(jnp.int32(8), "req"))
+    assert bool(healed.all())
+
+
+def test_gray_chaos_config_safe_and_live():
+    """The chaos side of the fault model: asymmetric cuts + flaky links +
+    skewed timers must never trip the checker, and lanes must decide once
+    partitions heal (windows end by tick 70 at the config's defaults)."""
+    # k_slots=16: flaky duplication re-delivers ACCEPTs across ballots, which
+    # is learner-table pressure; a bigger table keeps accounting complete at
+    # test scale (soak-scale runs recheck evicting seeds instead).
+    cfg = dataclasses.replace(C.config_gray_chaos(n_inst=2048, seed=3),
+                              k_slots=16)
+    report = run(cfg, total_ticks=192)
+    assert report["violations"] == 0
+    assert report["evictions"] == 0
+    assert report["proposer_disagree"] == 0
+    assert report["chosen_frac"] == 1.0
+
+
+def test_corrupt_violates_within_256_ticks():
+    """The bug-injection side: in-flight payload corruption makes acceptors
+    vote for values nobody proposed — the agreement checker MUST flag it
+    within one 256-tick campaign at config_corrupt's rate/scale."""
+    report = run(C.config_corrupt(n_inst=1024, seed=0), total_ticks=256)
+    assert report["violations"] > 0
+
+
+def test_stale_snapshot_violates():
+    """Stale-snapshot recovery (amnesia generalized): rolling acceptors back
+    up to stale_k ticks on recovery forgets promises/accepts, which under
+    crash-heavy dueling eventually yields conflicting choices."""
+    base = C.config_stale(n_inst=4096, seed=3)
+    violations = 0
+    for protocol in ("paxos", "fastpaxos"):
+        cfg = dataclasses.replace(base, protocol=protocol)
+        violations += run(cfg, total_ticks=192)["violations"]
+    assert violations > 0
+
+
+@pytest.mark.parametrize(
+    "protocol", ["paxos", "multipaxos", "fastpaxos", "raftcore"]
+)
+def test_fused_matches_reference_under_gray(protocol):
+    """The fused Pallas kernel must stay bit-exact vs its XLA twin with
+    EVERY gray knob lit: gray plan leaves thread through the generic
+    pytree flattening and gray mask draws through the counter streams."""
+    from paxos_tpu.kernels.fused_tick import (
+        fused_chunk,
+        fused_fns,
+        reference_chunk,
+    )
+
+    gray = dict(
+        p_part=0.5, part_max_start=20, part_max_len=12, p_asym=0.7,
+        p_flaky=0.4, flaky_drop=0.4, flaky_dup=0.2, p_dup=0.05,
+        timeout_skew=4, backoff_skew=3, p_corrupt=0.05, stale_k=8,
+        p_crash=0.2, crash_max_start=20, crash_max_len=8,
+    )
+    base = {
+        "paxos": C.config2_dueling_drop(64, 5),
+        "multipaxos": C.config3_multipaxos(64, 5),
+        "fastpaxos": C.config5_sweep(64, 5)[1],
+        "raftcore": C.config5_sweep(64, 5)[2],
+    }[protocol]
+    cfg = dataclasses.replace(
+        base, fault=dataclasses.replace(base.fault, **gray)
+    )
+    plan = init_plan(cfg)
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    ref = reference_chunk(
+        init_state(cfg), cfg.seed, plan, cfg.fault, 24,
+        apply_fn=apply_fn, mask_fn=mask_fn, blk_id=0,
+    )
+    fus = fused_chunk(
+        init_state(cfg), cfg.seed, plan, cfg.fault, 24,
+        apply_fn, mask_fn, block=64, interpret=True,
+    )
+    assert _digest(ref) == _digest(fus)
+
+
+def test_shrink_gray_repro():
+    """A gray-failure violation must shrink to a minimized, replayable plan:
+    the corruption drives the violation, so the shrinker should be able to
+    strip the chaos atoms (flaky links, asymmetric cut, skew) and the
+    result must still reproduce."""
+    from paxos_tpu.harness.shrink import replay, shrink
+
+    base = C.config_corrupt(n_inst=512, seed=5)
+    cfg = dataclasses.replace(
+        base,
+        fault=dataclasses.replace(
+            base.fault,
+            p_part=0.4, part_max_start=30, part_max_len=20, p_asym=0.6,
+            p_flaky=0.3, flaky_drop=0.3, timeout_skew=4, backoff_skew=3,
+        ),
+    )
+    result = shrink(cfg, max_ticks=192, chunk=32)
+    assert result is not None, "corruption config must violate within budget"
+    assert replay(cfg, result)
+
+
+def test_fault_override_parsing():
+    cfg = C.config1_no_faults(64, 0)
+    out = C.apply_fault_overrides(
+        cfg, ["p_corrupt=0.1", "timeout_skew=4", "amnesia=true"]
+    )
+    assert out.fault.p_corrupt == 0.1
+    assert out.fault.timeout_skew == 4
+    assert out.fault.amnesia is True
+    assert cfg.fault.p_corrupt == 0.0  # original untouched
+    with pytest.raises(ValueError, match="unknown fault knob"):
+        C.apply_fault_overrides(cfg, ["p_corupt=0.1"])
+    with pytest.raises(ValueError, match="key=value"):
+        C.apply_fault_overrides(cfg, ["p_corrupt"])
+
+
+@pytest.mark.slow
+def test_gray_chaos_soak_1e8_clean():
+    """ISSUE acceptance: the asymmetric-partition chaos config soaks clean
+    at >= 1e8 instance-rounds (rotating seeds)."""
+    from paxos_tpu.harness.soak import soak
+
+    report = soak(
+        C.config_gray_chaos(n_inst=65_536, seed=0),
+        target_rounds=1e8, ticks_per_seed=256, chunk=64,
+    )
+    assert report["rounds"] >= 1e8
+    assert report["violations"] == 0
+    assert report["evictions"] == 0

@@ -10,7 +10,7 @@ after it heals.
 import jax
 import jax.numpy as jnp
 
-from paxos_tpu.core.messages import PROMISE
+from paxos_tpu.core.messages import PREPARE, PROMISE
 from paxos_tpu.core.state import PaxosState
 from paxos_tpu.faults.injector import NEVER, FaultConfig, FaultPlan
 from paxos_tpu.harness.config import SimConfig, config_partition
@@ -57,6 +57,67 @@ def test_cross_cut_links_stall_and_heal():
     state = run_chunk(state, key, plan, cfg, 30, paxos_step)
     heard = jax.device_get(state.proposer.heard[0])
     assert (heard == 0b111).all(), "after healing every acceptor must answer"
+
+
+def _asym_plan(n_inst, n_acc, part_dir):
+    """Every link crosses the cut; window [0, 8); one-way per ``part_dir``."""
+    plan = FaultPlan.none(n_inst, n_acc, 1)
+    return plan.replace(
+        part_start=jnp.zeros((n_inst,), jnp.int32),
+        part_end=jnp.full((n_inst,), 8, jnp.int32),
+        pside=jnp.ones((1, n_inst), jnp.bool_),
+        aside=jnp.zeros((n_acc, n_inst), jnp.bool_),
+        part_dir=jnp.full((n_inst,), part_dir, jnp.int32),
+    )
+
+
+def test_asymmetric_cut_requests_stall_and_heal():
+    """part_dir=1 — requests P->A cut, replies spared: PREPAREs must STALL
+    in flight (not be lost) for the whole window, then deliver on heal."""
+    n_inst, n_acc = 4, 3
+    cfg = FaultConfig(p_part=1.0, p_asym=1.0, timeout=1000)
+    state = PaxosState.init(n_inst, 1, n_acc)
+    plan = _asym_plan(n_inst, n_acc, part_dir=1)
+    key = jax.random.PRNGKey(0)
+
+    state = run_chunk(state, key, plan, cfg, 6, paxos_step)
+    assert not jax.device_get(state.proposer.heard).any(), (
+        "no acceptor may receive a request across a one-way request cut"
+    )
+    assert bool(jax.device_get(state.requests.present[PREPARE, 0]).all()), (
+        "cut PREPAREs must still be in flight, not lost"
+    )
+
+    state = run_chunk(state, key, plan, cfg, 30, paxos_step)
+    assert (jax.device_get(state.proposer.heard[0]) == 0b111).all(), (
+        "after healing the preserved PREPAREs must deliver and be answered"
+    )
+
+
+def test_asymmetric_cut_replies_stall_and_heal():
+    """part_dir=2 — replies A->P cut, requests spared: acceptors promise,
+    but the PROMISEs must STALL in flight until the window closes."""
+    n_inst, n_acc = 4, 3
+    cfg = FaultConfig(p_part=1.0, p_asym=1.0, timeout=1000)
+    state = PaxosState.init(n_inst, 1, n_acc)
+    plan = _asym_plan(n_inst, n_acc, part_dir=2)
+    key = jax.random.PRNGKey(0)
+
+    state = run_chunk(state, key, plan, cfg, 6, paxos_step)
+    assert not jax.device_get(state.proposer.heard).any(), (
+        "replies may not cross a one-way reply cut"
+    )
+    # Requests DID flow: acceptors processed the PREPAREs and promised...
+    assert bool((jax.device_get(state.acceptor.promised) > 0).all())
+    # ...and the resulting PROMISEs are parked in flight, preserved.
+    assert bool(jax.device_get(state.replies.present[PROMISE, 0]).all()), (
+        "cut PROMISEs must still be in flight, not lost"
+    )
+
+    state = run_chunk(state, key, plan, cfg, 30, paxos_step)
+    assert (jax.device_get(state.proposer.heard[0]) == 0b111).all(), (
+        "after healing the preserved PROMISEs must deliver"
+    )
 
 
 def test_link_ok_shape_and_default():

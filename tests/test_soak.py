@@ -141,6 +141,48 @@ def test_cli_soak_band_derivation_and_exit_codes(capsys):
     assert rc == 1
 
 
+def test_retry_backoff_schedule():
+    """Retry delays grow exponentially from the base and cap at ~60 s —
+    a blip costs one short wait, a minutes-long outage stops being hammered
+    — and the soak report records the planned schedule."""
+    from paxos_tpu.harness.soak import _retry_schedule, soak
+
+    assert _retry_schedule(6) == [5.0, 10.0, 20.0, 40.0, 60.0, 60.0]
+    assert _retry_schedule(0) == []
+    assert _retry_schedule(3, base_s=1.0) == [1.0, 2.0, 4.0]
+    assert max(_retry_schedule(40), default=0.0) == 60.0  # capped forever
+
+    cfg = config2_dueling_drop(n_inst=128, seed=0)
+    report = soak(cfg, target_rounds=128 * 32, ticks_per_seed=32, chunk=16)
+    assert report["retry_schedule_s"] == _retry_schedule(2)  # default budget
+
+
+def test_retry_sleeps_follow_schedule_with_jitter(monkeypatch):
+    """The actual sleeps must draw from [delay/2, delay] of the scheduled
+    exponential delays (equal jitter), not a constant backoff."""
+    import jax
+
+    from paxos_tpu.harness import soak as soak_mod
+
+    sleeps: list[float] = []
+    monkeypatch.setattr(soak_mod.time, "sleep", sleeps.append)
+
+    calls = {"n": 0}
+
+    def always_fails():
+        calls["n"] += 1
+        raise jax.errors.JaxRuntimeError("INTERNAL: synthetic outage")
+
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        soak_mod._run_with_retries(
+            always_fails, lambda s: None, transient_retries=4, backoff_s=5.0
+        )
+    assert calls["n"] == 5  # initial try + 4 retries
+    assert len(sleeps) == 4
+    for got, planned in zip(sleeps, [5.0, 10.0, 20.0, 40.0]):
+        assert planned / 2 <= got <= planned
+
+
 def test_soak_retries_transient_backend_errors(monkeypatch):
     """A transient backend failure (tunnel remote-compile 500s) mid-soak
     must retry the campaign — an exact replay, campaigns being
