@@ -42,6 +42,9 @@ def _configs(platform: str):
     a bigger chunk leaves lanes idle at a full window, padding the metric
     with non-work ticks) — it stays at the run/soak operating default 64.
     """
+    import dataclasses
+
+    from paxos_tpu.core.telemetry import TelemetryConfig
     from paxos_tpu.harness.config import (
         config2_dueling_drop,
         config3_long,
@@ -52,8 +55,18 @@ def _configs(platform: str):
     on_tpu = platform == "tpu"
     n = 1 << 20 if on_tpu else 1 << 13
     sweep = {c.protocol: c for c in config5_sweep(n_inst=n)}
+    # Telemetry-overhead row: flagship config with the full flight recorder
+    # on (counters + ring + histogram).  The recorder-OFF row above is the
+    # one the perf gate bands at 0.7x — off must stay free (same schedule,
+    # same fingerprint); this row measures what ON costs, for the README
+    # overhead table.
+    tel_cfg = dataclasses.replace(
+        config2_dueling_drop(n_inst=n),
+        telemetry=TelemetryConfig(counters=True, ring_depth=64, hist_bins=16),
+    )
     cases = [
         ("config2-paxos", config2_dueling_drop(n_inst=n), 1024),
+        ("config2-paxos-telemetry", tel_cfg, 1024),
         ("config5-fastpaxos", sweep["fastpaxos"], 256),
         ("config5-raftcore", sweep["raftcore"], 256),
         ("config3-multipaxos", config3_multipaxos(n_inst=n), 256),

@@ -23,12 +23,15 @@ Fast Paxos (Lamport, 2006) specifics carried per proposer lane:
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 from flax import struct
 
 from paxos_tpu.core.ballot import make_ballot
 from paxos_tpu.core.messages import ACCEPT, MsgBuf
 from paxos_tpu.core.state import AcceptorState, LearnerState
+from paxos_tpu.core.telemetry import TelemetryState
 
 # Proposer phases (P1/P2/DONE match core.state so summarize() is shared).
 P1 = 0  # classic recovery: prepare sent, collecting promises
@@ -88,6 +91,8 @@ class FastPaxosState:
     requests: MsgBuf  # proposer -> acceptor (PREPARE / ACCEPT)
     replies: MsgBuf  # acceptor -> proposer (PROMISE / ACCEPTED)
     tick: jnp.ndarray  # () int32
+    # Flight recorder / telemetry (core.telemetry): None when disabled.
+    telemetry: Optional[TelemetryState] = None
 
     @classmethod
     def init(

@@ -29,6 +29,7 @@ from flax import struct
 
 from paxos_tpu.core.ballot import make_ballot
 from paxos_tpu.core.messages import MsgBuf
+from paxos_tpu.core.telemetry import TelemetryState
 
 # Proposer phases
 FOLLOW = 0  # passive: watching progress, lease ticking
@@ -218,6 +219,8 @@ class MultiPaxosState:
     # decided-prefix slots compacted out so far (0 in plain mode).  Message
     # slots stay window-relative; values/termination use base + slot.
     base: jnp.ndarray
+    # Flight recorder / telemetry (core.telemetry): None when disabled.
+    telemetry: Optional[TelemetryState] = None
 
     @classmethod
     def init(

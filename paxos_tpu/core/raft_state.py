@@ -26,6 +26,7 @@ from flax import struct
 from paxos_tpu.core.ballot import make_ballot
 from paxos_tpu.core.messages import MsgBuf
 from paxos_tpu.core.state import LearnerState
+from paxos_tpu.core.telemetry import TelemetryState
 
 # Candidate phases (values match core.state.P1/P2/DONE so summarize() and
 # liveness stats are shared across protocols).
@@ -119,6 +120,8 @@ class RaftState:
     requests: MsgBuf  # candidate -> voter (REQVOTE / APPEND)
     replies: MsgBuf  # voter -> candidate (VOTE / ACK)
     tick: jnp.ndarray  # () int32
+    # Flight recorder / telemetry (core.telemetry): None when disabled.
+    telemetry: Optional[TelemetryState] = None
 
     @classmethod
     def init(

@@ -28,6 +28,7 @@ from flax import struct
 
 from paxos_tpu.core.ballot import make_ballot
 from paxos_tpu.core.messages import MsgBuf
+from paxos_tpu.core.telemetry import TelemetryState
 
 # Proposer phases
 P1 = 0  # prepare sent, collecting promises
@@ -146,6 +147,10 @@ class PaxosState:
     requests: MsgBuf  # proposer -> acceptor (PREPARE / ACCEPT)
     replies: MsgBuf  # acceptor -> proposer (PROMISE / ACCEPTED)
     tick: jnp.ndarray  # () int32 global tick counter
+    # Flight recorder / telemetry (core.telemetry): None when disabled —
+    # pruned from the pytree, so default states keep the pre-telemetry
+    # structure (same contract as the snap_* gray fields above).
+    telemetry: Optional[TelemetryState] = None
 
     @classmethod
     def init(

@@ -1,0 +1,270 @@
+"""On-device flight recorder + consensus telemetry (default OFF, off is free).
+
+Model-checking practice treats the counterexample *trace* as the product,
+not just the verdict, and hardware-consensus designs keep event accounting
+on the fast path so telemetry costs nothing when idle (PAPERS.md: Spin
+Paxos traces, NetPaxos).  This module is that pattern for the fuzzing
+engines:
+
+- :class:`TelemetryState` — per-lane device arrays: an event-kind counter
+  matrix, a packed-int32 event ring buffer (the flight recorder), and a
+  ticks-to-decide latency histogram.  Every leaf is int32 with trailing
+  ``instances`` axis, so the fused Pallas engine's generic pytree
+  flattening (``kernels/fused_tick``) carries it with ZERO kernel changes,
+  and ``pjit`` shards it with the rest of the state.
+- :func:`record` — the in-tick update.  Pure elementwise/iota-masked
+  ``where`` ops (no scatter, no unsigned math: Mosaic-clean) and **no PRNG
+  draws**: everything is computed from signals the tick already produced,
+  so enabling telemetry cannot perturb a schedule.
+- Host-side decoding (:func:`decode_lane`, :func:`counter_totals`,
+  :func:`hist_totals`) — turns device arrays into human-readable
+  timelines; ``harness/shrink.py`` attaches these to violation repros.
+
+Default-off is free: ``SimConfig.telemetry`` defaults to the disabled
+:class:`TelemetryConfig`, the ``telemetry`` leaf of every protocol state is
+then ``None`` (pruned from the pytree), and schedule streams are
+bit-identical to a build without this module (tests/test_telemetry.py
+reuses the tests/test_gray.py golden digests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# Event kinds: bit i of a ring word's high half, and row i of the counter
+# matrix.  Shared across all four protocols (raft maps votes/acks onto
+# promise/accept; elections onto leader).
+EVENTS = (
+    "promise",  # phase-1 promise recorded (raft: vote granted)
+    "accept",  # phase-2 accept recorded (raft: append acked)
+    "decide",  # lane (multi-paxos: slot) newly chose a value
+    "conflict",  # safety checker recorded a violation
+    "leader",  # leader/ballot change (phase-1 won, election, demotion)
+    "timeout",  # proposer phase timer expired (retry with higher ballot)
+    "drop",  # message dropped by the fault layer
+    "dup",  # duplicate delivery (message processed again)
+    "corrupt",  # in-flight payload corruption applied
+    "part_cut",  # partition window opened on this lane
+    "part_heal",  # partition window closed on this lane
+    "recover",  # crashed node recovered
+)
+N_EVENTS = len(EVENTS)
+
+# Ring word layout: (event bitmask << EVENT_SHIFT) | (tick & TICK_MASK).
+# 16 tick bits wrap at 65536 ticks — campaigns run in chunks far shorter
+# than that, and the decoder only needs ordering within the ring window.
+EVENT_SHIFT = 16
+TICK_MASK = (1 << EVENT_SHIFT) - 1
+
+# Latency histogram: bucket = min(decide_tick // HIST_TICKS_PER_BIN, B-1);
+# the last bucket is the overflow bucket.
+HIST_TICKS_PER_BIN = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Static telemetry knobs (frozen: rides ``SimConfig`` into jit).
+
+    All default OFF.  Any knob on allocates the counter matrix; the ring
+    and histogram are gated individually.
+    """
+
+    counters: bool = False  # per-lane event-kind counters
+    ring_depth: int = 0  # flight-recorder entries per lane (0 = off)
+    hist_bins: int = 0  # ticks-to-decide histogram bins (0 = off)
+
+    def enabled(self) -> bool:
+        return self.counters or self.ring_depth > 0 or self.hist_bins > 0
+
+
+@struct.dataclass
+class TelemetryState:
+    """Per-lane telemetry arrays (all int32, instance-minor).
+
+    Rides as an ``Optional`` leaf of every protocol state: ``None`` when
+    disabled (pruned from the pytree — the default-off-is-free contract),
+    never containing scalar leaves (the fused engine's ``_split_tick``
+    expects exactly one scalar in the whole state: the tick).
+    """
+
+    counters: jnp.ndarray  # (E, I) int32 — per event kind, per lane
+    ring: Optional[jnp.ndarray] = None  # (D, I) int32 packed event words
+    cursor: Optional[jnp.ndarray] = None  # (I,) int32 next slot in [0, D)
+    seq: Optional[jnp.ndarray] = None  # (I,) int32 words ever written
+    hist: Optional[jnp.ndarray] = None  # (B, I) int32 decide-latency bins
+
+    @classmethod
+    def init(cls, n_inst: int, tcfg: TelemetryConfig) -> "TelemetryState":
+        def zi():
+            return jnp.zeros((n_inst,), jnp.int32)
+
+        ring_on = tcfg.ring_depth > 0
+        return cls(
+            counters=jnp.zeros((N_EVENTS, n_inst), jnp.int32),
+            ring=(
+                jnp.zeros((tcfg.ring_depth, n_inst), jnp.int32)
+                if ring_on
+                else None
+            ),
+            cursor=zi() if ring_on else None,
+            seq=zi() if ring_on else None,
+            hist=(
+                jnp.zeros((tcfg.hist_bins, n_inst), jnp.int32)
+                if tcfg.hist_bins > 0
+                else None
+            ),
+        )
+
+
+def lane_count(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce any leading axes of a bool/int event signal to (I,) int32."""
+    x = x.astype(jnp.int32)
+    if x.ndim > 1:
+        x = jnp.sum(x, axis=tuple(range(x.ndim - 1)))
+    return x
+
+
+def record(
+    tel: TelemetryState,
+    tick: jnp.ndarray,
+    *,
+    promise=None,
+    accept=None,
+    decide=None,
+    conflict=None,
+    leader=None,
+    timeout=None,
+    drop=None,
+    dup=None,
+    corrupt=None,
+    part_cut=None,
+    part_heal=None,
+    recover=None,
+) -> TelemetryState:
+    """One tick's telemetry update (pure, PRNG-free, Mosaic-clean).
+
+    Each keyword is ``None`` (event not applicable / its fault knob off —
+    skipped at trace time) or a bool/int32 array whose trailing axis is
+    ``instances``; leading axes are summed into a per-lane count.
+
+    Counters: per-kind elementwise adds (iota row select — no scatter).
+    Ring: at most one packed word per (lane, tick) — the OR of the tick's
+    event bits — appended with an iota-vs-cursor masked ``where``.
+    Histogram: ``decide`` counts land in bucket ``tick // HIST_TICKS_PER_BIN``
+    (clamped to the overflow bucket).
+    """
+    counts = (promise, accept, decide, conflict, leader, timeout, drop, dup,
+              corrupt, part_cut, part_heal, recover)
+    n_inst = tel.counters.shape[-1]
+
+    row = jax.lax.broadcasted_iota(jnp.int32, tel.counters.shape, 0)
+    inc = jnp.zeros_like(tel.counters)
+    word_bits = jnp.zeros((n_inst,), jnp.int32)
+    for e, c in enumerate(counts):
+        if c is None:
+            continue
+        c = lane_count(c)
+        inc = inc + jnp.where(row == e, c[None], 0)
+        word_bits = word_bits | jnp.where(c > 0, jnp.int32(1 << e), 0)
+    tel = tel.replace(counters=tel.counters + inc)
+
+    if tel.ring is not None:
+        depth = tel.ring.shape[0]
+        has = word_bits != 0
+        word = (word_bits << EVENT_SHIFT) | (tick & TICK_MASK)
+        rows_d = jax.lax.broadcasted_iota(jnp.int32, tel.ring.shape, 0)
+        hit = (rows_d == tel.cursor[None]) & has[None]
+        step = has.astype(jnp.int32)
+        nxt = tel.cursor + step
+        tel = tel.replace(
+            ring=jnp.where(hit, word[None], tel.ring),
+            cursor=jnp.where(nxt >= depth, 0, nxt),
+            seq=tel.seq + step,
+        )
+
+    if tel.hist is not None and decide is not None:
+        bins = tel.hist.shape[0]
+        bucket = jnp.minimum(tick // HIST_TICKS_PER_BIN, bins - 1)
+        rows_b = jax.lax.broadcasted_iota(jnp.int32, tel.hist.shape, 0)
+        tel = tel.replace(
+            hist=tel.hist + jnp.where(rows_b == bucket, lane_count(decide)[None], 0)
+        )
+    return tel
+
+
+def fault_lane_events(plan, cfg, tick):
+    """Per-lane fault-plan edge events, shared by all four protocols.
+
+    Returns kwargs for :func:`record` (``part_cut`` / ``part_heal`` /
+    ``recover``), each ``None`` when its fault knob is off (no work traced).
+    """
+    out = {"part_cut": None, "part_heal": None, "recover": None}
+    if cfg.p_part > 0.0:
+        out["part_cut"] = plan.part_start == tick
+        out["part_heal"] = plan.part_end == tick
+    rec = None
+    if cfg.p_crash > 0.0:
+        rec = lane_count(plan.crash_end == tick)
+    if cfg.p_crash_prop > 0.0:
+        prec = lane_count(plan.pcrash_end == tick)
+        rec = prec if rec is None else rec + prec
+    out["recover"] = rec
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side decoding (numpy-friendly: call on device_get'd arrays).
+
+
+def decode_word(word: int) -> dict:
+    """One packed ring word -> {"tick": int, "events": [names]}."""
+    word = int(word)
+    bits = (word >> EVENT_SHIFT) & ((1 << N_EVENTS) - 1)
+    return {
+        "tick": word & TICK_MASK,
+        "events": [EVENTS[i] for i in range(N_EVENTS) if (bits >> i) & 1],
+    }
+
+
+def decode_lane(tel: TelemetryState, lane: int) -> list:
+    """The lane's recorded event window, oldest first (empty if no ring)."""
+    if tel.ring is None:
+        return []
+    ring = jax.device_get(tel.ring[:, lane])
+    cursor = int(jax.device_get(tel.cursor[lane]))
+    seq = int(jax.device_get(tel.seq[lane]))
+    depth = ring.shape[0]
+    if seq <= depth:
+        words = ring[:seq]
+    else:  # wrapped: oldest entry sits at the write cursor
+        words = list(ring[cursor:]) + list(ring[:cursor])
+    return [decode_word(w) for w in words]
+
+
+def counter_totals(tel: TelemetryState) -> dict:
+    """Whole-campaign event counts, summed over lanes: {name: int}."""
+    totals = jax.device_get(tel.counters.sum(axis=-1))
+    return {name: int(v) for name, v in zip(EVENTS, totals)}
+
+
+def hist_totals(tel: TelemetryState) -> list:
+    """Decide-latency histogram summed over lanes (len = hist_bins)."""
+    if tel.hist is None:
+        return []
+    return [int(v) for v in jax.device_get(tel.hist.sum(axis=-1))]
+
+
+def telemetry_report(tel: TelemetryState) -> dict:
+    """Host-readable per-chunk telemetry summary (for MetricsLog / stats)."""
+    report = {"counters": counter_totals(tel)}
+    if tel.hist is not None:
+        report["hist"] = hist_totals(tel)
+        report["hist_ticks_per_bin"] = HIST_TICKS_PER_BIN
+    if tel.seq is not None:
+        report["events_recorded"] = int(jax.device_get(tel.seq.sum()))
+    return report

@@ -126,9 +126,16 @@ def restore(
         )
     saved_stream = raw.pop("stream", None)
     fault = raw.pop("fault")
+    # Tolerate pre-telemetry snapshots (no "telemetry" key): default off.
+    tel = raw.pop("telemetry", None)
+    from paxos_tpu.core.telemetry import TelemetryConfig
     from paxos_tpu.faults.injector import FaultConfig
 
-    cfg = SimConfig(**raw, fault=FaultConfig(**fault))
+    cfg = SimConfig(
+        **raw,
+        fault=FaultConfig(**fault),
+        telemetry=TelemetryConfig(**tel) if tel else TelemetryConfig(),
+    )
 
     if engine is not None:
         want = stream_id(cfg, engine, block)

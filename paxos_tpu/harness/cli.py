@@ -111,7 +111,23 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument(
         "--events",
         action="store_true",
-        help="per-chunk protocol event dump to stderr (debug; slows the loop)",
+        help="per-chunk protocol event dump to stderr, routed through the "
+        "metrics registry (and into --log when set); debug; slows the loop",
+    )
+    r.add_argument(
+        "--telemetry", action="store_true",
+        help="on-device protocol event counters, read back per chunk "
+        "(core.telemetry; default off — off is free and schedule-identical)",
+    )
+    r.add_argument(
+        "--record", type=int, default=0, metavar="DEPTH",
+        help="on-device flight-recorder ring: DEPTH packed event words per "
+        "lane (implies --telemetry); decode with core.telemetry.decode_lane",
+    )
+    r.add_argument(
+        "--hist-bins", type=int, default=0, metavar="N",
+        help="on-device ticks-to-decide histogram with N fixed-width bins "
+        "(implies --telemetry)",
     )
 
     s = sub.add_parser(
@@ -139,6 +155,7 @@ def build_parser() -> argparse.ArgumentParser:
     so.add_argument("--target-rounds", type=float, default=1e9)
     so.add_argument("--ticks-per-seed", type=int, default=256)
     so.add_argument("--chunk", type=int, default=64)
+    so.add_argument("--log", default=None, help="JSONL metrics path")
     so.add_argument(
         "--min-replication", type=float, default=None,
         help="long-log configs: fail (exit 3) if any campaign replicates "
@@ -179,6 +196,16 @@ def build_parser() -> argparse.ArgumentParser:
         "schedule-relevant for long-log configs — compaction fires at "
         "chunk boundaries, so a mismatched chunk explores a different "
         "schedule and can miss the violation)",
+    )
+
+    st = sub.add_parser(
+        "stats",
+        help="summarize a JSONL metrics stream written by run/soak --log",
+    )
+    st.add_argument("path", help="JSONL metrics file")
+    st.add_argument(
+        "--prometheus", action="store_true",
+        help="print the Prometheus text exposition instead of a JSON summary",
     )
 
     c = sub.add_parser(
@@ -265,12 +292,40 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _telemetry_from_args(args: argparse.Namespace):
+    """The run subcommand's telemetry knobs as a TelemetryConfig (or None)."""
+    if not (args.telemetry or args.record or args.hist_bins):
+        return None
+    from paxos_tpu.core.telemetry import TelemetryConfig
+
+    # --record / --hist-bins imply counters: the ring and histogram are
+    # refinements of the same recorder, not independent devices.
+    return TelemetryConfig(
+        counters=True, ring_depth=args.record, hist_bins=args.hist_bins
+    )
+
+
 def cmd_run(args: argparse.Namespace) -> int:
+    from paxos_tpu.harness.metrics import MetricsLog
+
+    if args.checkpoint_every and not args.checkpoint_dir:
+        print("error: --checkpoint-every requires --checkpoint-dir", file=sys.stderr)
+        return 1
+
+    # Context-managed so the JSONL stream closes on EVERY exit path —
+    # early-return errors, MeasurementCorrupted unwinds, and violations.
+    with MetricsLog(args.log) as log:
+        return _cmd_run_logged(args, log)
+
+
+def _cmd_run_logged(args: argparse.Namespace, log) -> int:
+    import dataclasses
+
     import jax
 
     from paxos_tpu.harness import checkpoint as ckpt
     from paxos_tpu.harness import trace as trace_mod
-    from paxos_tpu.harness.metrics import MetricsLog
+    from paxos_tpu.harness.metrics import MetricsRegistry
     from paxos_tpu.harness.run import (
         MeasurementCorrupted,
         init_plan,
@@ -281,16 +336,18 @@ def cmd_run(args: argparse.Namespace) -> int:
     )
     from paxos_tpu.parallel.mesh import make_mesh, shard_pytree
 
-    if args.checkpoint_every and not args.checkpoint_dir:
-        print("error: --checkpoint-every requires --checkpoint-dir", file=sys.stderr)
-        return 1
-
-    log = MetricsLog(args.log)
+    tel_cfg = _telemetry_from_args(args)
+    registry = MetricsRegistry()
     if args.resume:
         if args.fault:
             print("error: --fault cannot be combined with --resume (the "
                   "checkpoint's fault config is part of its schedule "
                   "stream)", file=sys.stderr)
+            return 1
+        if tel_cfg is not None:
+            print("error: --telemetry/--record/--hist-bins cannot be "
+                  "combined with --resume (the recorder's arrays are part "
+                  "of the checkpointed state structure)", file=sys.stderr)
             return 1
         # Stream-lineage guard (VERDICT r4 weak#3): refuse to resume under
         # a different engine/block than the one that wrote the snapshot.
@@ -308,6 +365,8 @@ def cmd_run(args: argparse.Namespace) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
+        if tel_cfg is not None:
+            cfg = dataclasses.replace(cfg, telemetry=tel_cfg)
         state, plan = init_state(cfg), init_plan(cfg)
 
     if args.shard:
@@ -345,7 +404,6 @@ def cmd_run(args: argparse.Namespace) -> int:
             return summarize(state, log_total=cfg.fault.log_total, **kw)
         except MeasurementCorrupted as e:
             log.emit("error", message=str(e), tick=int(state.tick))
-            log.close()
             print(f"error: {e}", file=sys.stderr)
             raise SystemExit(1)
 
@@ -358,8 +416,15 @@ def cmd_run(args: argparse.Namespace) -> int:
             since_ckpt += n
             rep = observe()
             log.emit("chunk", **rep)
+            if "telemetry" in rep:
+                registry.ingest(rep["telemetry"])
             if args.events:
-                trace_mod.event_dump(state)
+                # Registry-routed (and into the JSONL stream), with the
+                # historical stderr line kept for eyeball debugging.
+                rec = trace_mod.event_dump(
+                    state, stream=sys.stderr, registry=registry
+                )
+                log.emit("events", **rec)
             if args.checkpoint_every and since_ckpt >= args.checkpoint_every:
                 ckpt.save(args.checkpoint_dir, state, plan, cfg,
                           engine=args.engine, block=args.block)
@@ -378,8 +443,12 @@ def cmd_run(args: argparse.Namespace) -> int:
         ckpt.save(args.checkpoint_dir, state, plan, cfg,
                   engine=args.engine, block=args.block)
         log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
+    if "telemetry" in report:
+        registry.ingest(report["telemetry"])
+    snap = registry.snapshot()
+    if snap["counters"] or snap["histograms"]:
+        log.emit("metrics", **snap)
     log.emit("final", **report)
-    log.close()
     print(json.dumps(report))
     return 0 if report["violations"] == 0 else 2
 
@@ -391,36 +460,36 @@ def cmd_sweep(args: argparse.Namespace) -> int:
     from paxos_tpu.harness.metrics import MetricsLog
     from paxos_tpu.harness.run import run
 
-    log = MetricsLog(args.log)
-    results = {}
-    worst = 0
-    for cfg in cfg_mod.config5_sweep(n_inst=args.n_inst, seed=args.seed):
-        rep = run(
-            cfg,
-            until_all_chosen=True,
-            max_ticks=args.ticks,
-            chunk=args.chunk,
-        )
-        log.emit("protocol", protocol=cfg.protocol, **rep)
-        results[cfg.protocol] = rep
-        worst = max(worst, rep["violations"])
+    with MetricsLog(args.log) as log:
+        results = {}
+        worst = 0
+        for cfg in cfg_mod.config5_sweep(n_inst=args.n_inst, seed=args.seed):
+            rep = run(
+                cfg,
+                until_all_chosen=True,
+                max_ticks=args.ticks,
+                chunk=args.chunk,
+            )
+            log.emit("protocol", protocol=cfg.protocol, **rep)
+            results[cfg.protocol] = rep
+            worst = max(worst, rep["violations"])
 
-    def liveness_key(p: str):
-        # More decided instances wins; among equals, earlier decisions win.
-        # An undecided protocol reports mean_choose_tick -1.0 — rank it last.
-        rep = results[p]
-        mean = rep["mean_choose_tick"]
-        return (-rep["chosen_frac"], mean if mean >= 0 else float("inf"))
+        def liveness_key(p: str):
+            # More decided instances wins; among equals, earlier decisions
+            # win.  An undecided protocol reports mean_choose_tick -1.0 —
+            # rank it last.
+            rep = results[p]
+            mean = rep["mean_choose_tick"]
+            return (-rep["chosen_frac"], mean if mean >= 0 else float("inf"))
 
-    out = {
-        "sweep": "config5",
-        "n_inst": args.n_inst,
-        "seed": args.seed,
-        "protocols": results,
-        "liveness_rank": sorted(results, key=liveness_key),
-    }
-    log.emit("final", **out)
-    log.close()
+        out = {
+            "sweep": "config5",
+            "n_inst": args.n_inst,
+            "seed": args.seed,
+            "protocols": results,
+            "liveness_rank": sorted(results, key=liveness_key),
+        }
+        log.emit("final", **out)
     print(json.dumps(out))
     return 0 if worst == 0 else 2
 
@@ -474,16 +543,27 @@ def cmd_soak(args: argparse.Namespace) -> int:
               f"(got {args.config}, which reports no replication rate)",
               file=sys.stderr)
         return 1
-    report = soak(
-        cfg,
-        target_rounds=args.target_rounds,
-        ticks_per_seed=args.ticks_per_seed,
-        chunk=args.chunk,
-        engine=args.engine,
-        log=lambda s: print(f"# {s}", file=sys.stderr),
-        min_slots_per_lane_tick=band or None,
-    )
-    report["config"] = args.config
+    from paxos_tpu.harness.metrics import MetricsLog
+
+    with MetricsLog(args.log) as mlog:
+        mlog.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
+                  n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)
+        report = soak(
+            cfg,
+            target_rounds=args.target_rounds,
+            ticks_per_seed=args.ticks_per_seed,
+            chunk=args.chunk,
+            engine=args.engine,
+            log=lambda s: print(f"# {s}", file=sys.stderr),
+            min_slots_per_lane_tick=band or None,
+        )
+        report["config"] = args.config
+        if report["violations"]:
+            # emit() flushes per record, so the violation tally is durable
+            # in the JSONL stream even if the process dies right after.
+            mlog.emit("violation", violations=report["violations"],
+                      violating_seeds=report.get("violating_seeds"))
+        mlog.emit("final", **report)
     print(json.dumps(report))
     if report["violations"]:
         return 2
@@ -496,6 +576,76 @@ def cmd_soak(args: argparse.Namespace) -> int:
         return 1
     if not report.get("replication_ok", True):
         return 3
+    return 0
+
+
+def cmd_stats(args: argparse.Namespace) -> int:
+    """Summarize a JSONL metrics stream; optionally as Prometheus text."""
+    import pathlib
+
+    from paxos_tpu.harness.metrics import MetricsRegistry
+
+    path = pathlib.Path(args.path)
+    if not path.exists():
+        print(f"error: no metrics file at {path}", file=sys.stderr)
+        return 1
+    records, malformed = [], 0
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            malformed += 1
+    if not records:
+        print(f"error: {path} holds no JSONL records", file=sys.stderr)
+        return 1
+
+    registry = MetricsRegistry()
+    kinds: dict[str, int] = {}
+    final = None
+    last_tel = None
+    for rec in records:
+        kind = rec.get("event", "?")
+        kinds[kind] = kinds.get(kind, 0) + 1
+        registry.inc("log_records_total", record=kind)
+        # Device telemetry is cumulative; the LAST report is the campaign
+        # total, whether it rode a chunk record or the final one.
+        if isinstance(rec.get("telemetry"), dict):
+            last_tel = rec["telemetry"]
+        if kind == "final":
+            final = rec
+    if last_tel is not None:
+        registry.ingest(last_tel)
+
+    if args.prometheus:
+        print(registry.to_prometheus(), end="")
+        return 0
+
+    out: dict = {
+        "path": str(path),
+        "records": dict(sorted(kinds.items())),
+        "malformed_lines": malformed,
+    }
+    chunks = [r for r in records if r.get("event") == "chunk"]
+    if chunks:
+        out["chunks"] = len(chunks)
+        last = chunks[-1]
+        out["last_tick"] = last.get("ticks")
+        out["wall_s"] = last.get("t_wall")
+    if final is not None:
+        out["final"] = {
+            k: final[k]
+            for k in (
+                "ticks", "chosen_frac", "decided_frac", "violations",
+                "evictions", "engine", "config_fingerprint",
+            )
+            if k in final
+        }
+    if last_tel is not None:
+        out["telemetry"] = last_tel
+    print(json.dumps(out))
     return 0
 
 
@@ -715,6 +865,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_shrink(args)
     if args.cmd == "check":
         return cmd_check(args)
+    if args.cmd == "stats":
+        return cmd_stats(args)
     return 1
 
 

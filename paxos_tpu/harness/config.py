@@ -14,6 +14,7 @@ import dataclasses
 import hashlib
 import json
 
+from paxos_tpu.core.telemetry import TelemetryConfig
 from paxos_tpu.faults.injector import FaultConfig
 
 
@@ -29,9 +30,21 @@ class SimConfig:
     seed: int = 0
     protocol: str = "paxos"
     fault: FaultConfig = dataclasses.field(default_factory=FaultConfig)
+    # Flight recorder / telemetry (core.telemetry) — default OFF, and off
+    # is free: the state's telemetry leaf prunes to None and schedules are
+    # bit-identical (tests/test_telemetry.py).
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
 
     def fingerprint(self) -> str:
-        blob = json.dumps(dataclasses.asdict(self), sort_keys=True).encode()
+        d = dataclasses.asdict(self)
+        # Telemetry never changes a schedule; with it disabled (the default)
+        # drop it from the fingerprint so recorded artifacts (BENCH_SWEEP,
+        # checkpoints) from pre-telemetry builds keep matching.
+        if d["telemetry"] == dataclasses.asdict(TelemetryConfig()):
+            del d["telemetry"]
+        blob = json.dumps(d, sort_keys=True).encode()
         return hashlib.sha256(blob).hexdigest()[:16]
 
 

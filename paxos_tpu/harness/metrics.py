@@ -22,17 +22,31 @@ import jax
 
 
 class MetricsLog:
-    """Append-only JSONL metrics stream with a wall-clock and tick context."""
+    """Append-only JSONL metrics stream with a wall-clock and tick context.
+
+    Usable as a context manager; the CLI paths enter it with ``with`` so the
+    stream is closed on EVERY exit path (early-return errors included) —
+    before, violation runs could leave the file handle dangling.
+    """
 
     def __init__(self, path: "str | pathlib.Path | None" = None) -> None:
         self._fh: Optional[TextIO] = None
+        self._closed = False
         if path is not None:
             p = pathlib.Path(path)
             p.parent.mkdir(parents=True, exist_ok=True)
             self._fh = p.open("a")
         self._t0 = time.monotonic()
 
+    def __enter__(self) -> "MetricsLog":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
     def emit(self, event: str, **fields: Any) -> dict[str, Any]:
+        if self._closed:
+            raise ValueError("emit() on a closed MetricsLog")
         rec = {"event": event, "t_wall": round(time.monotonic() - self._t0, 4)}
         rec.update(fields)
         if self._fh is not None:
@@ -41,9 +55,109 @@ class MetricsLog:
         return rec
 
     def close(self) -> None:
+        self._closed = True
         if self._fh is not None:
             self._fh.close()
             self._fh = None
+
+
+class MetricsRegistry:
+    """Host-side metrics registry: named counters + fixed-bin histograms.
+
+    The host half of the flight-recorder pipeline (core.telemetry holds the
+    device half): per-chunk telemetry reports fold in via :meth:`ingest`,
+    ad-hoc host counters via :meth:`inc`, and the whole registry exports as
+    a JSONL snapshot record (:meth:`emit`) or Prometheus text exposition
+    (:meth:`to_prometheus`) for scrape-style consumers.  Counters carry
+    optional labels (rendered Prometheus-style); histograms are fixed-width
+    tick bins, matching the on-device layout, merged elementwise.
+    """
+
+    def __init__(self, namespace: str = "paxos_tpu") -> None:
+        self.namespace = namespace
+        # name -> {labels-tuple -> value}; labels-tuple is sorted (k, v) pairs.
+        self._counters: dict[str, dict[tuple, float]] = {}
+        # name -> {"counts": list[int], "bin_width": int}
+        self._hists: dict[str, dict[str, Any]] = {}
+
+    def inc(self, name: str, value: float = 1, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        series = self._counters.setdefault(name, {})
+        series[key] = series.get(key, 0) + value
+
+    def observe_hist(
+        self, name: str, counts: "list[int]", bin_width: int
+    ) -> None:
+        """Merge a fixed-bin histogram (elementwise add; widths must agree)."""
+        hist = self._hists.get(name)
+        if hist is None:
+            self._hists[name] = {"counts": list(counts), "bin_width": bin_width}
+            return
+        if hist["bin_width"] != bin_width or len(hist["counts"]) != len(counts):
+            raise ValueError(
+                f"histogram {name!r} layout changed mid-stream: "
+                f"{len(hist['counts'])}x{hist['bin_width']} vs "
+                f"{len(counts)}x{bin_width}"
+            )
+        hist["counts"] = [a + b for a, b in zip(hist["counts"], counts)]
+
+    def ingest(self, report: dict[str, Any]) -> None:
+        """Fold one ``core.telemetry.telemetry_report`` dict into the registry.
+
+        Telemetry counters are CUMULATIVE on-device, so ingest overwrites
+        rather than adds (the last chunk's report is the campaign total);
+        same for the latency histogram.
+        """
+        for event, total in report.get("counters", {}).items():
+            series = self._counters.setdefault("events_total", {})
+            series[(("event", event),)] = total
+        hist = report.get("hist")
+        if hist is not None:
+            self._hists["ticks_to_decide"] = {
+                "counts": list(hist),
+                "bin_width": report.get("hist_ticks_per_bin", 1),
+            }
+
+    def snapshot(self) -> dict[str, Any]:
+        """One JSON-ready dict of everything in the registry."""
+        counters: dict[str, Any] = {}
+        for name, series in sorted(self._counters.items()):
+            for key, value in sorted(series.items()):
+                label = ",".join(f"{k}={v}" for k, v in key)
+                counters[f"{name}{{{label}}}" if label else name] = value
+        hists = {
+            name: {"counts": h["counts"], "bin_width": h["bin_width"]}
+            for name, h in sorted(self._hists.items())
+        }
+        return {"counters": counters, "histograms": hists}
+
+    def emit(self, log: MetricsLog, event: str = "metrics") -> dict[str, Any]:
+        """Write the current snapshot as one JSONL record to ``log``."""
+        return log.emit(event, **self.snapshot())
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (counters + histograms)."""
+        ns = self.namespace
+        lines: list[str] = []
+        for name, series in sorted(self._counters.items()):
+            lines.append(f"# TYPE {ns}_{name} counter")
+            for key, value in sorted(series.items()):
+                label = ",".join(f'{k}="{v}"' for k, v in key)
+                suffix = f"{{{label}}}" if label else ""
+                lines.append(f"{ns}_{name}{suffix} {int(value)}")
+        for name, h in sorted(self._hists.items()):
+            lines.append(f"# TYPE {ns}_{name} histogram")
+            cum = 0
+            # The device layout's LAST bin is a catch-all (>= top edge), so
+            # it folds into +Inf rather than getting a finite `le`.
+            for i, c in enumerate(h["counts"][:-1]):
+                cum += c
+                le = (i + 1) * h["bin_width"]
+                lines.append(f'{ns}_{name}_bucket{{le="{le}"}} {cum}')
+            cum += h["counts"][-1] if h["counts"] else 0
+            lines.append(f'{ns}_{name}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{ns}_{name}_count {cum}")
+        return "\n".join(lines) + "\n"
 
 
 @contextlib.contextmanager

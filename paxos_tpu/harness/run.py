@@ -49,6 +49,17 @@ def get_step_fn(protocol: str) -> Callable:
 
 
 def init_state(cfg: SimConfig):
+    state = _init_protocol_state(cfg)
+    if cfg.telemetry.enabled():
+        from paxos_tpu.core.telemetry import TelemetryState
+
+        state = state.replace(
+            telemetry=TelemetryState.init(cfg.n_inst, cfg.telemetry)
+        )
+    return state
+
+
+def _init_protocol_state(cfg: SimConfig):
     stale = cfg.fault.stale_k > 0  # allocate stale-snapshot shadow arrays
     if cfg.protocol == "multipaxos":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
@@ -364,6 +375,11 @@ def summarize(
                 "are no longer trustworthy for this campaign; shorten "
                 "ticks_per_seed or raise lease_len (ADVICE r4)"
             )
+    if state.telemetry is not None:
+        from paxos_tpu.core.telemetry import telemetry_report
+
+        # One readback per report (chunk cadence), host-side dict of totals.
+        out["telemetry"] = telemetry_report(state.telemetry)
     if liveness:
         from paxos_tpu.check.liveness import liveness_report
 

@@ -57,9 +57,13 @@ class ShrinkResult:
     # Chunk the repro was minimized at: schedule-relevant for long-log
     # configs (compaction cadence) and the granularity of ``ticks``.
     chunk: int = 64
+    # Victim lane's decoded flight-recorder trace (core.telemetry), e.g.
+    # [{"tick": 3, "events": ["corrupt", "accept"]}, ...] — so a shrunk
+    # repro ships with a human-readable event history, not just atoms.
+    timeline: Optional[list] = None
 
     def to_json(self) -> dict[str, Any]:
-        return {
+        out = {
             "lane": self.lane,
             "ticks": self.ticks,
             "atoms": self.atoms,
@@ -68,6 +72,9 @@ class ShrinkResult:
             "block": self.block,
             "chunk": self.chunk,
         }
+        if self.timeline is not None:
+            out["timeline"] = self.timeline
+        return out
 
 
 def _violations_at(
@@ -315,10 +322,44 @@ def shrink(
     ticks = min(lo * chunk, max_ticks)
     say(f"minimal ticks: {ticks} (chunk granularity {chunk})")
 
-    return ShrinkResult(
+    result = ShrinkResult(
         lane=lane, ticks=ticks, atoms=kept, removed=removed, plan=plan,
         engine=engine, block=block, chunk=chunk,
     )
+    result.timeline = violation_timeline(cfg, result)
+    say(f"timeline: {len(result.timeline)} recorded ticks in lane {lane}")
+    return result
+
+
+def violation_timeline(cfg: SimConfig, result: ShrinkResult) -> list:
+    """Decode the victim lane's flight-recorder trace for a minimized repro.
+
+    Re-runs the repro with the on-device recorder enabled — telemetry draws
+    no randomness (core.telemetry; pinned by tests/test_telemetry.py), so
+    the schedule is exactly the one the shrinker minimized — and decodes
+    the victim lane's event ring into ``[{"tick": t, "events": [...]}]``.
+    The ring is sized to the whole repro (tick budgets are chunk-granular
+    and small), so the "last window" is the full history.
+    """
+    from paxos_tpu.core.telemetry import TelemetryConfig, decode_lane
+
+    tcfg = dataclasses.replace(
+        cfg,
+        telemetry=TelemetryConfig(
+            counters=True, ring_depth=min(result.ticks, 512)
+        ),
+    )
+    state = init_state(tcfg)
+    advance = make_advance(
+        tcfg, result.plan, result.engine, block=result.block,
+        compact=bool(make_longlog(tcfg)),
+    )
+    done = 0
+    while done < result.ticks:
+        n = min(result.chunk, result.ticks - done)
+        state = advance(state, n)
+        done += n
+    return decode_lane(state.telemetry, result.lane)
 
 
 def replay(cfg: SimConfig, result: ShrinkResult) -> bool:

@@ -38,16 +38,23 @@ def profile(logdir: str | None) -> Iterator[None]:
         yield
 
 
-def event_dump(state, stream=None) -> None:
-    """Print one JSON line of per-chunk protocol events (host-side readback).
+def event_dump(state, stream=None, registry=None) -> dict:
+    """One record of per-chunk protocol events (host-side readback).
 
     Works for any protocol state (single-decree or Multi-Paxos learner
     shapes); intended for debugging runs, not the hot path.  ``stream``
     defaults to the CURRENT ``sys.stderr`` at call time — a def-time
     default would bake in whatever stream was installed at first import
     (e.g. a long-closed pytest capture object).
+
+    With a :class:`~paxos_tpu.harness.metrics.MetricsRegistry`, the record
+    routes through the registry instead of raw stderr: the state's
+    telemetry report (if the flight recorder is on) folds into the
+    registry's counters/histograms, and the returned record is the
+    caller's to emit into its MetricsLog.  Pass ``stream`` explicitly to
+    ALSO print.
     """
-    if stream is None:
+    if stream is None and registry is None:
         stream = sys.stderr
     lrn = state.learner
     chosen = lrn.chosen
@@ -64,4 +71,12 @@ def event_dump(state, stream=None) -> None:
         "round_mean": float(jnp.mean(rounds.astype(jnp.float32))),
         "round_max": int(jnp.max(rounds)),
     }
-    print(json.dumps(rec), file=stream)
+    if registry is not None:
+        registry.inc("event_dump_records_total")
+        if getattr(state, "telemetry", None) is not None:
+            from paxos_tpu.core.telemetry import telemetry_report
+
+            registry.ingest(telemetry_report(state.telemetry))
+    if stream is not None:
+        print(json.dumps(rec), file=stream)
+    return rec

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from paxos_tpu.check.safety import acceptor_invariants, learner_observe
 from paxos_tpu.core import ballot as bal_mod
+from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.core.fp_state import (
     DONE,
     FAST,
@@ -334,6 +335,42 @@ def apply_tick_fast(
         decided_val=decided_val,
     )
 
+    # ---- Flight recorder (core.telemetry): PRNG-free, from signals the ----
+    # tick already produced, so enabling it cannot perturb the schedule.
+    tel = state.telemetry
+    if tel is not None:
+        dropped = None
+        if keep_prom is not None:
+            dropped = (
+                tel_mod.lane_count(sel[PREPARE] & ok_prep[None] & ~keep_prom)
+                + tel_mod.lane_count(sel[ACCEPT] & ok_acc[None] & ~keep_accd)
+                + tel_mod.lane_count(p1_done[:, None] & ~keep_p2)
+                + tel_mod.lane_count(expired[:, None] & ~keep_p1)
+            )
+        dups = None
+        if dup_rep is not None:
+            dups = tel_mod.lane_count(delivered & dup_rep) + tel_mod.lane_count(
+                sel & dup_req
+            )
+        tel = tel_mod.record(
+            tel,
+            state.tick,
+            promise=ok_prep,
+            accept=ok_acc,
+            decide=learner.chosen & ~state.learner.chosen,
+            conflict=learner.violations - state.learner.violations,
+            leader=p1_done,
+            timeout=expired,
+            drop=dropped,
+            dup=dups,
+            corrupt=(
+                masks.corrupt & (is_prep | is_acc)
+                if cfg.p_corrupt > 0.0
+                else None
+            ),
+            **tel_mod.fault_lane_events(plan, cfg, state.tick),
+        )
+
     return state.replace(
         acceptor=acc,
         proposer=prop,
@@ -341,6 +378,7 @@ def apply_tick_fast(
         requests=requests,
         replies=replies,
         tick=state.tick + 1,
+        telemetry=tel,
     )
 
 

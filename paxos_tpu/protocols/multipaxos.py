@@ -31,6 +31,7 @@ from flax import struct
 
 from paxos_tpu.check.mp_safety import mp_learner_observe
 from paxos_tpu.core import ballot as bal_mod
+from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.core.messages import ACCEPT, PREPARE
 from paxos_tpu.core.mp_state import (
     CANDIDATE,
@@ -499,6 +500,40 @@ def apply_tick_mp(
         candidate_timer=candidate_timer,
     )
 
+    # ---- Flight recorder (core.telemetry): PRNG-free, from signals the ----
+    # tick already produced, so enabling it cannot perturb the schedule.
+    tel = state.telemetry
+    if tel is not None:
+        dropped = None
+        if keep_prom is not None:
+            edge = (n_prop, n_acc, n_inst)
+            dropped = (
+                tel_mod.lane_count(sel[PREPARE] & ok_prep[None] & ~keep_prom)
+                + tel_mod.lane_count(sel[ACCEPT] & ok_acc[None] & ~keep_accd)
+                + tel_mod.lane_count(prep_mask & ~keep_prep)
+                + tel_mod.lane_count(
+                    jnp.broadcast_to(is_lead[:, None], edge) & ~keep_acc
+                )
+            )
+        tel = tel_mod.record(
+            tel,
+            state.tick,
+            promise=ok_prep,
+            accept=ok_acc,
+            decide=learner.chosen & ~state.learner.chosen,
+            conflict=learner.violations - state.learner.violations,
+            leader=p1_done | demote,
+            timeout=cand_fail,
+            drop=dropped,
+            dup=None if dup_req is None else sel & dup_req,
+            corrupt=(
+                masks.corrupt & (is_prep | is_acc)
+                if cfg.p_corrupt > 0.0
+                else None
+            ),
+            **tel_mod.fault_lane_events(plan, cfg, state.tick),
+        )
+
     return state.replace(
         acceptor=acc,
         proposer=prop,
@@ -507,6 +542,7 @@ def apply_tick_mp(
         promises=promises,
         accepted=accepted,
         tick=state.tick + 1,
+        telemetry=tel,
     )
 
 

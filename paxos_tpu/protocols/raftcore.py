@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from paxos_tpu.check.safety import learner_observe, raft_voter_invariants
 from paxos_tpu.core import ballot as bal_mod
+from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.core.raft_state import (
     ACK,
     APPEND,
@@ -289,6 +290,44 @@ def apply_tick_raft(
         decided_val=decided_val,
     )
 
+    # ---- Flight recorder (core.telemetry): PRNG-free, from signals the ----
+    # tick already produced, so enabling it cannot perturb the schedule.
+    # Raft mapping: grants -> promise, append acks -> accept, elections ->
+    # leader (matching the mask-role mapping in the docstring).
+    tel = state.telemetry
+    if tel is not None:
+        dropped = None
+        if keep_prom is not None:
+            dropped = (
+                tel_mod.lane_count(sel[REQVOTE] & ~keep_prom)
+                + tel_mod.lane_count(sel[APPEND] & ok_ap[None] & ~keep_accd)
+                + tel_mod.lane_count(is_lead[:, None] & ~keep_p2)
+                + tel_mod.lane_count(expired[:, None] & ~keep_p1)
+            )
+        dups = None
+        if dup_rep is not None:
+            dups = tel_mod.lane_count(delivered & dup_rep) + tel_mod.lane_count(
+                sel & dup_req
+            )
+        tel = tel_mod.record(
+            tel,
+            state.tick,
+            promise=grant,
+            accept=ok_ap,
+            decide=learner.chosen & ~state.learner.chosen,
+            conflict=learner.violations - state.learner.violations,
+            leader=elected,
+            timeout=expired,
+            drop=dropped,
+            dup=dups,
+            corrupt=(
+                masks.corrupt & (is_rv | is_ap)
+                if cfg.p_corrupt > 0.0
+                else None
+            ),
+            **tel_mod.fault_lane_events(plan, cfg, state.tick),
+        )
+
     return state.replace(
         acceptor=voter,
         proposer=cand,
@@ -296,6 +335,7 @@ def apply_tick_raft(
         requests=requests,
         replies=replies,
         tick=state.tick + 1,
+        telemetry=tel,
     )
 
 

@@ -5,4 +5,18 @@
 #
 # Usage: scripts/tier1.sh   (from anywhere; cd's to the repo root)
 cd "$(dirname "$0")/.." || exit 1
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+# `stats` smoke: a tiny telemetry-on run must produce a JSONL stream the
+# stats subcommand can summarize (and render as Prometheus text).
+if [ "$rc" -eq 0 ]; then
+  m=/tmp/_t1_metrics.jsonl; rm -f "$m"
+  timeout -k 10 120 env JAX_PLATFORMS=cpu python -m paxos_tpu run \
+    --config config1 --n-inst 64 --ticks 16 --chunk 8 \
+    --telemetry --record 8 --hist-bins 4 --log "$m" >/dev/null 2>&1 \
+  && timeout -k 10 30 env JAX_PLATFORMS=cpu python -m paxos_tpu stats "$m" \
+       | grep -q '"telemetry"' \
+  && timeout -k 10 30 env JAX_PLATFORMS=cpu python -m paxos_tpu stats "$m" --prometheus \
+       | grep -q '^paxos_tpu_events_total' \
+  && echo STATS_SMOKE=ok || { echo STATS_SMOKE=FAILED; rc=1; }
+fi
+exit $rc

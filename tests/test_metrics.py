@@ -1,0 +1,210 @@
+"""harness/metrics.py + harness/trace.py coverage (PR 2, satellite).
+
+MetricsLog emit/close/context-manager semantics, the MetricsRegistry's
+counter/histogram accounting and Prometheus rendering, trace_scope as a
+no-op wrapper, event_dump's shape-polymorphism and registry routing, and
+the `paxos_tpu stats` subcommand end to end.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from paxos_tpu.harness.metrics import MetricsLog, MetricsRegistry, trace_scope
+
+
+def test_metricslog_writes_jsonl(tmp_path):
+    path = tmp_path / "m.jsonl"
+    log = MetricsLog(path)
+    rec = log.emit("start", config="config2", n_inst=64)
+    assert rec["event"] == "start" and rec["n_inst"] == 64
+    assert "t_wall" in rec
+    log.emit("final", violations=0)
+    log.close()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [l["event"] for l in lines] == ["start", "final"]
+
+
+def test_metricslog_context_manager_closes(tmp_path):
+    path = tmp_path / "m.jsonl"
+    with MetricsLog(path) as log:
+        log.emit("start")
+    assert log._fh is None
+    with pytest.raises(ValueError, match="closed"):
+        log.emit("late")
+    # Closes on the error path too (the CLI's early-return contract).
+    with pytest.raises(RuntimeError):
+        with MetricsLog(path) as log2:
+            log2.emit("start")
+            raise RuntimeError("boom")
+    assert log2._fh is None
+
+
+def test_metricslog_pathless_is_noop():
+    with MetricsLog(None) as log:
+        rec = log.emit("chunk", ticks=64)
+    assert rec["ticks"] == 64  # record still returned for callers
+    log.close()  # idempotent
+
+
+def test_trace_scope_noop():
+    with trace_scope("deliver"):
+        x = 1 + 1
+    assert x == 2
+
+
+def test_registry_counters_and_labels():
+    reg = MetricsRegistry()
+    reg.inc("log_records_total", record="chunk")
+    reg.inc("log_records_total", record="chunk")
+    reg.inc("log_records_total", record="final")
+    reg.inc("plain_total")
+    snap = reg.snapshot()
+    assert snap["counters"]["log_records_total{record=chunk}"] == 2
+    assert snap["counters"]["log_records_total{record=final}"] == 1
+    assert snap["counters"]["plain_total"] == 1
+
+
+def test_registry_hist_merge_and_layout_guard():
+    reg = MetricsRegistry()
+    reg.observe_hist("lat", [1, 2, 3], bin_width=8)
+    reg.observe_hist("lat", [1, 0, 1], bin_width=8)
+    assert reg.snapshot()["histograms"]["lat"] == {
+        "counts": [2, 2, 4], "bin_width": 8,
+    }
+    with pytest.raises(ValueError, match="layout changed"):
+        reg.observe_hist("lat", [1, 1], bin_width=8)
+
+
+def test_registry_ingest_is_cumulative_overwrite():
+    """Device telemetry is cumulative; the LAST report wins, not the sum."""
+    reg = MetricsRegistry()
+    reg.ingest({"counters": {"decide": 10}, "hist": [10, 0],
+                "hist_ticks_per_bin": 8})
+    reg.ingest({"counters": {"decide": 25}, "hist": [20, 5],
+                "hist_ticks_per_bin": 8})
+    snap = reg.snapshot()
+    assert snap["counters"]["events_total{event=decide}"] == 25
+    assert snap["histograms"]["ticks_to_decide"]["counts"] == [20, 5]
+
+
+def test_registry_prometheus_format():
+    reg = MetricsRegistry()
+    reg.inc("events_total", 7, event="promise")
+    reg.observe_hist("ticks_to_decide", [5, 2, 1], bin_width=8)
+    text = reg.to_prometheus()
+    assert '# TYPE paxos_tpu_events_total counter' in text
+    assert 'paxos_tpu_events_total{event="promise"} 7' in text
+    # Finite buckets are cumulative; the device catch-all bin folds into +Inf.
+    assert 'paxos_tpu_ticks_to_decide_bucket{le="8"} 5' in text
+    assert 'paxos_tpu_ticks_to_decide_bucket{le="16"} 7' in text
+    assert 'paxos_tpu_ticks_to_decide_bucket{le="+Inf"} 8' in text
+    assert 'paxos_tpu_ticks_to_decide_count 8' in text
+    assert text.endswith("\n")
+
+
+def _tiny_state(protocol: str):
+    from paxos_tpu.harness import config as C
+    from paxos_tpu.harness.run import (
+        base_key, get_step_fn, init_plan, init_state, run_chunk,
+    )
+
+    cfg = (
+        C.config3_multipaxos(32, 0)
+        if protocol == "multipaxos"
+        else C.config1_no_faults(32, 0)
+    )
+    return run_chunk(
+        init_state(cfg), base_key(cfg), init_plan(cfg), cfg.fault, 8,
+        get_step_fn(cfg.protocol),
+    )
+
+
+@pytest.mark.parametrize("protocol", ["paxos", "multipaxos"])
+def test_event_dump_shapes(protocol, capsys):
+    """event_dump handles (I,) and (L, I) learner shapes; prints JSON."""
+    from paxos_tpu.harness.trace import event_dump
+
+    state = _tiny_state(protocol)
+    rec = event_dump(state)
+    err = capsys.readouterr().err
+    assert json.loads(err.strip().splitlines()[-1]) == rec
+    assert rec["tick"] == 8
+    assert 0 <= rec["chosen"] <= rec["chosen_total"]
+    assert rec["violations"] == 0
+    # round_mean can be negative (idle MP proposers sit at round -1).
+    assert isinstance(rec["round_mean"], float)
+    assert rec["round_max"] >= rec["round_mean"]
+
+
+def test_event_dump_registry_routing(capsys):
+    """With a registry, nothing hits stderr; telemetry folds in."""
+    from paxos_tpu.harness import config as C
+    from paxos_tpu.core.telemetry import TelemetryConfig
+    from paxos_tpu.harness.run import (
+        base_key, get_step_fn, init_plan, init_state, run_chunk,
+    )
+    from paxos_tpu.harness.trace import event_dump
+
+    cfg = dataclasses.replace(
+        C.config1_no_faults(32, 0),
+        telemetry=TelemetryConfig(counters=True, hist_bins=4),
+    )
+    state = run_chunk(
+        init_state(cfg), base_key(cfg), init_plan(cfg), cfg.fault, 8,
+        get_step_fn(cfg.protocol),
+    )
+    reg = MetricsRegistry()
+    rec = event_dump(state, registry=reg)
+    assert capsys.readouterr().err == ""
+    assert rec["tick"] == 8
+    snap = reg.snapshot()
+    assert snap["counters"]["event_dump_records_total"] == 1
+    assert snap["counters"]["events_total{event=decide}"] == rec["chosen"]
+    assert "ticks_to_decide" in snap["histograms"]
+
+
+def test_stats_cli(tmp_path, capsys):
+    from paxos_tpu.harness.cli import main
+
+    path = tmp_path / "m.jsonl"
+    tel = {
+        "counters": {"promise": 9, "decide": 4},
+        "hist": [3, 1],
+        "hist_ticks_per_bin": 8,
+    }
+    lines = [
+        {"event": "start", "config": "config2"},
+        {"event": "chunk", "ticks": 8, "t_wall": 0.5, "violations": 0},
+        {"event": "chunk", "ticks": 16, "t_wall": 0.9, "violations": 0,
+         "telemetry": tel},
+        {"event": "final", "ticks": 16, "chosen_frac": 1.0, "violations": 0,
+         "engine": "xla", "telemetry": tel},
+    ]
+    path.write_text(
+        "\n".join(json.dumps(l) for l in lines) + "\nnot json\n"
+    )
+    assert main(["stats", str(path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["records"] == {"start": 1, "chunk": 2, "final": 1}
+    assert out["malformed_lines"] == 1
+    assert out["chunks"] == 2 and out["last_tick"] == 16
+    assert out["final"]["violations"] == 0
+    assert out["telemetry"]["counters"]["decide"] == 4
+
+    assert main(["stats", str(path), "--prometheus"]) == 0
+    text = capsys.readouterr().out
+    assert 'paxos_tpu_events_total{event="decide"} 4' in text
+    assert 'paxos_tpu_log_records_total{record="chunk"} 2' in text
+    assert 'paxos_tpu_ticks_to_decide_bucket{le="+Inf"} 4' in text
+
+
+def test_stats_cli_missing_and_empty(tmp_path, capsys):
+    from paxos_tpu.harness.cli import main
+
+    assert main(["stats", str(tmp_path / "nope.jsonl")]) == 1
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert main(["stats", str(empty)]) == 1
+    capsys.readouterr()

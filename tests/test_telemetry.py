@@ -1,0 +1,284 @@
+"""Flight recorder + telemetry (PR 2): default-off is FREE, on is neutral.
+
+Three contracts guard this layer:
+
+1. **Default-off is free**: with telemetry disabled (the default) the state's
+   ``telemetry`` leaf is ``None`` (pruned from the pytree), schedules are
+   BIT-IDENTICAL to the pre-telemetry build (the PR-1 golden digests of
+   tests/test_gray.py, re-pinned here), and config fingerprints are unchanged
+   so recorded artifacts (BENCH_SWEEP.json, checkpoints) keep matching.
+2. **On is outcome-neutral**: telemetry draws NO randomness — it is computed
+   from signals the tick already produced — so enabling it must leave the
+   protocol schedule bit-identical on BOTH engines, and the fused Pallas
+   kernel must carry the recorder arrays bit-exactly vs its XLA reference.
+3. **The recorder tells the truth**: counters match independent reductions,
+   the ring decodes to a wrap-ordered per-lane timeline, the histogram
+   buckets decide ticks, and a corrupt-config shrink repro's timeline names
+   the injected corruption ticks.
+"""
+
+import dataclasses
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paxos_tpu.core import telemetry as T
+from paxos_tpu.harness import config as C
+from paxos_tpu.harness.run import (
+    base_key,
+    get_step_fn,
+    init_plan,
+    init_state,
+    run,
+    run_chunk,
+)
+
+TEL = T.TelemetryConfig(counters=True, ring_depth=16, hist_bins=8)
+
+
+def _digest(state) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        h.update(jax.device_get(leaf).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _xla_final(cfg, n_ticks=32):
+    return run_chunk(
+        init_state(cfg), base_key(cfg), init_plan(cfg), cfg.fault, n_ticks,
+        get_step_fn(cfg.protocol),
+    )
+
+
+def _ctr_final(cfg, n_ticks=32):
+    from paxos_tpu.kernels.fused_tick import fused_fns, reference_chunk
+
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    return reference_chunk(
+        init_state(cfg), cfg.seed, init_plan(cfg), cfg.fault, n_ticks,
+        apply_fn=apply_fn, mask_fn=mask_fn, blk_id=0,
+    )
+
+
+# The PR-1 goldens (tests/test_gray.py, n_inst=256, seed=7, 32 ticks, CPU):
+# recorder-off must reproduce them, and recorder-ON minus the telemetry
+# leaf must reproduce them too (schedule unperturbed).
+_GOLDEN_XLA = {
+    "config2": (lambda: C.config2_dueling_drop(256, 7), "83347bc41b16a2aa"),
+    "config3": (lambda: C.config3_multipaxos(256, 7), "93a2dd9d7b8d66e4"),
+    "fastpaxos": (lambda: C.config5_sweep(256, 7)[1], "c43658973b29e73e"),
+    "raftcore": (lambda: C.config5_sweep(256, 7)[2], "4662db6b2c5a39d3"),
+}
+_GOLDEN_CTR = {
+    "config2": (lambda: C.config2_dueling_drop(256, 7), "db6db6f40f16eb7b"),
+    "config3": (lambda: C.config3_multipaxos(256, 7), "4b6525460815d9c5"),
+    "fastpaxos": (lambda: C.config5_sweep(256, 7)[1], "72beea3ccdacab94"),
+    "raftcore": (lambda: C.config5_sweep(256, 7)[2], "eb285905571b709f"),
+}
+
+
+# One representative per state-shape family stays in the fast lane; the
+# remaining protocols are exhaustive coverage (-m slow, full-suite lane).
+_FAST_XLA = ("config2", "config3")
+_FAST_CTR = ("config2",)
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        n if n in _FAST_XLA else pytest.param(n, marks=pytest.mark.slow)
+        for n in sorted(_GOLDEN_XLA)
+    ],
+)
+def test_recorder_on_schedule_identical_xla(name):
+    mk, want = _GOLDEN_XLA[name]
+    assert _digest(_xla_final(mk())) == want  # off == pre-telemetry golden
+    fin = _xla_final(dataclasses.replace(mk(), telemetry=TEL))
+    assert fin.telemetry is not None
+    assert _digest(fin.replace(telemetry=None)) == want  # on == same schedule
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        n if n in _FAST_CTR else pytest.param(n, marks=pytest.mark.slow)
+        for n in sorted(_GOLDEN_CTR)
+    ],
+)
+def test_recorder_on_schedule_identical_counter_stream(name):
+    mk, want = _GOLDEN_CTR[name]
+    assert _digest(_ctr_final(mk())) == want
+    fin = _ctr_final(dataclasses.replace(mk(), telemetry=TEL))
+    assert _digest(fin.replace(telemetry=None)) == want
+
+
+def test_default_off_prunes_to_none():
+    """Disabled telemetry leaves NO trace in the pytree (structure parity)."""
+    for mk in (C.config1_no_faults, C.config3_multipaxos):
+        cfg = mk(64, 0)
+        state = init_state(cfg)
+        assert state.telemetry is None
+        on = init_state(dataclasses.replace(cfg, telemetry=TEL))
+        off_n = len(jax.tree_util.tree_leaves(state))
+        on_n = len(jax.tree_util.tree_leaves(on))
+        # counters + ring + cursor + seq + hist
+        assert on_n == off_n + 5
+        # All recorder leaves are non-scalar int32 — the fused engine's
+        # generic flattening rides them through with no kernel changes.
+        for leaf in jax.tree_util.tree_leaves(on.telemetry):
+            assert leaf.dtype == jnp.int32 and leaf.ndim >= 1
+
+
+def test_fingerprint_unchanged_by_default_telemetry():
+    """Pre-telemetry artifacts must keep matching: with the default (off)
+    telemetry the fingerprint is computed WITHOUT the telemetry key — the
+    exact pre-PR config shape; non-default telemetry IS fingerprinted."""
+    import hashlib
+
+    cfg = C.config2_dueling_drop(1 << 20)
+    d = dataclasses.asdict(cfg)
+    del d["telemetry"]  # the pre-telemetry asdict shape
+    pre = hashlib.sha256(
+        json.dumps(d, sort_keys=True).encode()
+    ).hexdigest()[:16]
+    assert cfg.fingerprint() == pre
+    assert (
+        dataclasses.replace(cfg, telemetry=TEL).fingerprint()
+        != cfg.fingerprint()
+    )
+
+
+@pytest.mark.parametrize(
+    "protocol",
+    [
+        "paxos",
+        pytest.param("multipaxos", marks=pytest.mark.slow),
+        pytest.param("fastpaxos", marks=pytest.mark.slow),
+        pytest.param("raftcore", marks=pytest.mark.slow),
+    ],
+)
+def test_fused_kernel_carries_recorder_bitexact(protocol):
+    """fused_chunk(interpret) == reference_chunk with the recorder ON."""
+    from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS, fused_fns, reference_chunk
+    from paxos_tpu.utils.trees import tree_mismatches
+
+    base = {
+        "paxos": C.config2_dueling_drop,
+        "multipaxos": C.config3_multipaxos,
+        "fastpaxos": lambda n, s: C.config5_sweep(n, s)[1],
+        "raftcore": lambda n, s: C.config5_sweep(n, s)[2],
+    }[protocol](64, 7)
+    cfg = dataclasses.replace(base, telemetry=TEL)
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    plan = init_plan(cfg)
+    sr = reference_chunk(
+        init_state(cfg), jnp.int32(cfg.seed), plan, cfg.fault, 24,
+        apply_fn=apply_fn, mask_fn=mask_fn,
+    )
+    sp = FUSED_CHUNKS[cfg.protocol](
+        init_state(cfg), jnp.int32(cfg.seed), plan, cfg.fault, 24,
+        block=64, interpret=True,
+    )
+    assert tree_mismatches(sp, sr) == []
+    assert int(sp.telemetry.seq.max()) > 0  # the recorder really recorded
+
+
+def test_counters_match_independent_reductions():
+    """decide count == chosen lanes; histogram total == decide total."""
+    cfg = dataclasses.replace(
+        C.config2_dueling_drop(256, 7), telemetry=TEL
+    )
+    fin = _xla_final(cfg, n_ticks=48)
+    rep = T.telemetry_report(fin.telemetry)
+    chosen = int(jax.device_get(fin.learner.chosen).sum())
+    assert rep["counters"]["decide"] == chosen
+    assert sum(rep["hist"]) == chosen
+    assert rep["counters"]["conflict"] == int(
+        jax.device_get(fin.learner.violations).sum()
+    )
+    # No partitions/corruption/dup configured -> those counters stay zero.
+    for ev in ("corrupt", "dup", "part_cut", "part_heal", "recover"):
+        assert rep["counters"][ev] == 0
+    # Ring words: at most one per (lane, tick), at least one per decide.
+    assert chosen <= rep["events_recorded"] <= 256 * 48
+
+
+def test_ring_decode_wrap_order():
+    """Per-lane decode is tick-ordered and keeps only the last D events."""
+    cfg = dataclasses.replace(
+        C.config2_dueling_drop(64, 7),
+        telemetry=T.TelemetryConfig(counters=True, ring_depth=4),
+    )
+    fin = _xla_final(cfg, n_ticks=32)
+    for lane in (0, 13, 63):
+        tl = T.decode_lane(fin.telemetry, lane)
+        assert len(tl) <= 4
+        ticks = [e["tick"] for e in tl]
+        assert ticks == sorted(ticks)
+        assert all(e["events"] for e in tl)
+        seq = int(jax.device_get(fin.telemetry.seq)[lane])
+        if seq > 4:  # wrapped: decoded window is the LAST writes
+            assert len(tl) == 4
+
+
+def test_decode_word_layout():
+    word = (1 << (T.EVENT_SHIFT + T.EVENTS.index("decide"))) | 37
+    rec = T.decode_word(word)
+    assert rec == {"tick": 37, "events": ["decide"]}
+
+
+def test_part_cut_heal_recover_recorded():
+    """Partition windows and crash recoveries land in the counters."""
+    cfg = dataclasses.replace(C.config_partition(256, 3), telemetry=TEL)
+    rep = run(cfg, total_ticks=96, chunk=32)
+    tel = rep["telemetry"]["counters"]
+    assert tel["part_cut"] > 0
+    assert tel["part_heal"] > 0
+    cfg3 = dataclasses.replace(C.config3_multipaxos(256, 7), telemetry=TEL)
+    rep3 = run(cfg3, total_ticks=64, chunk=32)
+    assert rep3["telemetry"]["counters"]["recover"] > 0
+
+
+def test_run_report_embeds_telemetry():
+    cfg = dataclasses.replace(C.config1_no_faults(64, 0), telemetry=TEL)
+    rep = run(cfg, total_ticks=16, chunk=8)
+    assert rep["telemetry"]["counters"]["decide"] == 64
+    assert rep["telemetry"]["hist_ticks_per_bin"] == T.HIST_TICKS_PER_BIN
+    # And with the default config the report has NO telemetry block.
+    rep_off = run(C.config1_no_faults(64, 0), total_ticks=16, chunk=8)
+    assert "telemetry" not in rep_off
+
+
+def test_corrupt_shrink_timeline_names_corruption_tick():
+    """Acceptance: a corrupt-config repro ships a decoded event timeline
+    whose victim lane names the injected corruption ticks."""
+    from paxos_tpu.harness.shrink import shrink
+
+    res = shrink(C.config_corrupt(256, 0), max_ticks=64, chunk=32)
+    assert res is not None
+    assert res.timeline, "repro must carry a decoded timeline"
+    corrupt_ticks = [
+        e["tick"] for e in res.timeline if "corrupt" in e["events"]
+    ]
+    assert corrupt_ticks, "timeline must name the injected corruption"
+    assert res.to_json()["timeline"] == res.timeline
+    # The timeline rides the repro JSON end-to-end.
+    json.dumps(res.to_json())
+
+
+def test_checkpoint_roundtrip_with_recorder(tmp_path):
+    """A telemetry-enabled campaign checkpoints and resumes losslessly."""
+    from paxos_tpu.harness import checkpoint as ckpt
+
+    cfg = dataclasses.replace(C.config2_dueling_drop(64, 5), telemetry=TEL)
+    state = _xla_final(cfg, n_ticks=16)
+    plan = init_plan(cfg)
+    ckpt.save(tmp_path / "snap", state, plan, cfg, engine="xla")
+    state2, plan2, cfg2 = ckpt.restore(tmp_path / "snap", engine="xla")
+    assert cfg2.telemetry == cfg.telemetry
+    from paxos_tpu.utils.trees import tree_mismatches
+
+    assert tree_mismatches(jax.device_get(state), state2) == []
