@@ -27,7 +27,7 @@ NORTH_STAR = 10_000_000.0  # BASELINE.md north-star target
 
 
 def _configs(platform: str):
-    """The sweep table: (name, SimConfig, engine, chunk) per case.
+    """The sweep table: (name, SimConfig, engine, chunk, depth) per case.
 
     TPU sizes match BASELINE.md's measured rows (1M instances).  The CPU
     rig shrinks instances and skips the fused engine (the Pallas TPU
@@ -41,6 +41,13 @@ def _configs(platform: str):
     config3long, where chunk IS the compaction cadence (schedule-relevant:
     a bigger chunk leaves lanes idle at a full window, padding the metric
     with non-work ticks) — it stays at the run/soak operating default 64.
+
+    Per-case depth (dispatch pipeline, harness.pipeline): the *-pipelined
+    rows group 4 chunk-64 bodies per dispatch — the schedule of the chunk-64
+    serial row (identical fingerprint AND identical stream) at a quarter of
+    the dispatch count, which is how the chunk-boundary tax is recovered
+    where the chunk size itself is schedule-relevant.  The chunk-64 serial
+    config2 row sits alongside as the pipelined-vs-serial comparison pair.
     """
     import dataclasses
 
@@ -65,14 +72,19 @@ def _configs(platform: str):
         telemetry=TelemetryConfig(counters=True, ring_depth=64, hist_bins=16),
     )
     cases = [
-        ("config2-paxos", config2_dueling_drop(n_inst=n), 1024),
-        ("config2-paxos-telemetry", tel_cfg, 1024),
-        ("config5-fastpaxos", sweep["fastpaxos"], 256),
-        ("config5-raftcore", sweep["raftcore"], 256),
-        ("config3-multipaxos", config3_multipaxos(n_inst=n), 256),
+        ("config2-paxos", config2_dueling_drop(n_inst=n), 1024, 1),
+        ("config2-paxos-telemetry", tel_cfg, 1024, 1),
+        ("config5-fastpaxos", sweep["fastpaxos"], 256, 1),
+        ("config5-raftcore", sweep["raftcore"], 256, 1),
+        ("config3-multipaxos", config3_multipaxos(n_inst=n), 256, 1),
         # Long-log mode: 16-slot window sliding over a 256-slot log with
         # decided-prefix compaction at every chunk boundary (cost included).
-        ("config3long-multipaxos", config3_long(n_inst=n), 64),
+        ("config3long-multipaxos", config3_long(n_inst=n), 64, 1),
+        # Pipelined-vs-serial pair at the schedule-relevant operating chunk.
+        ("config2-paxos-chunk64", config2_dueling_drop(n_inst=n), 64, 1),
+        ("config2-paxos-chunk64-pipelined",
+         config2_dueling_drop(n_inst=n), 64, 4),
+        ("config3long-multipaxos-pipelined", config3_long(n_inst=n), 64, 4),
     ]
     engines = ("fused", "xla") if on_tpu else ("xla",)
     # The big-chunk win is the fused path's (dispatch amortization over a
@@ -83,14 +95,15 @@ def _configs(platform: str):
         return chunk if (on_tpu and eng == "fused") else min(chunk, 64)
 
     return [
-        (name, cfg, eng, case_chunk(eng, chunk))
-        for name, cfg, chunk in cases
+        (name, cfg, eng, case_chunk(eng, chunk), depth)
+        for name, cfg, chunk, depth in cases
         for eng in engines
     ]
 
 
 def bench_case(
-    cfg, engine: str, chunk: int = 64, timed_chunks: int = 4, repeats: int = 3
+    cfg, engine: str, chunk: int = 64, timed_chunks: int = 4,
+    repeats: int = 3, pipeline_depth: int = 1,
 ) -> dict:
     """Measure one (config, engine) case; returns the result dict.
 
@@ -99,28 +112,43 @@ def bench_case(
     standard min-time discipline — noise on a shared tunnel only ever
     slows a run down) and ``throughput_runs`` records every group so a
     reader can judge the spread.
+
+    ``pipeline_depth`` groups that many chunk bodies per device dispatch
+    (harness.pipeline) — same ticks, same schedule, 1/depth the dispatch
+    count — and must divide ``timed_chunks`` so every timed group is a
+    whole number of dispatches.
     """
     import jax
 
     from paxos_tpu.harness.checkpoint import stream_id
+    from paxos_tpu.harness.config import validate_pipeline_depth
     from paxos_tpu.harness.run import (
         init_plan,
         init_state,
-        make_advance,
+        make_advance_grouped,
         make_longlog,
         summarize,
     )
 
+    depth = validate_pipeline_depth(pipeline_depth)
+    if timed_chunks % depth:
+        raise ValueError(
+            f"timed_chunks={timed_chunks} must be a multiple of "
+            f"pipeline_depth={depth} (whole dispatches per timed group)"
+        )
     platform = jax.devices()[0].platform
     state = init_state(cfg)
     plan = init_plan(cfg)
     # Long-log: compaction rides in the timed loop (traced into each chunk).
-    advance = make_advance(cfg, plan, engine, compact=bool(make_longlog(cfg)))
+    advance = make_advance_grouped(
+        cfg, plan, engine, compact=bool(make_longlog(cfg))
+    )
 
-    # Warmup: compile + one chunk.  NOTE: timing must end with a device->host
-    # readback, not block_until_ready — on the axon tunnel backend
-    # block_until_ready can return before execution finishes.
-    state = advance(state, chunk)
+    # Warmup: compile + one dispatch of the grouped program.  NOTE: timing
+    # must end with a device->host readback, not block_until_ready — on the
+    # axon tunnel backend block_until_ready can return before execution
+    # finishes.
+    state = advance(state, chunk, depth)
     int(state.tick)
 
     ticks = timed_chunks * chunk
@@ -128,8 +156,8 @@ def bench_case(
     violations = 0
     for _ in range(max(repeats, 1)):
         t0 = time.perf_counter()
-        for _ in range(timed_chunks):
-            state = advance(state, chunk)
+        for _ in range(timed_chunks // depth):
+            state = advance(state, chunk, depth)
         violations = int(state.learner.violations.sum())  # forces completion
         runs.append(cfg.n_inst * ticks / (time.perf_counter() - t0))
 
@@ -146,6 +174,7 @@ def bench_case(
         "vs_baseline": round(value / NORTH_STAR, 3),
         "n_instances": cfg.n_inst,
         "chunk": chunk,
+        "pipeline_depth": depth,
         "ticks": ticks,
         "seconds": round(cfg.n_inst * ticks / value, 4),
         "throughput_runs": [round(r, 1) for r in runs],
@@ -166,6 +195,12 @@ def main(argv=None) -> None:
                     help="bench all protocols x engines (one JSON line each)")
     ap.add_argument("--record", metavar="PATH",
                     help="with --sweep: also write the case list to PATH")
+    ap.add_argument(
+        "--pipeline-depth", type=int, default=None, metavar="K",
+        help="flagship case only: chunks grouped per device dispatch "
+        "(harness.pipeline; default 16 on TPU — 64-tick chunks in "
+        "1024-tick dispatches, the measured-best dispatch size — else 4)",
+    )
     args = ap.parse_args(argv)
     if args.record and not args.sweep:
         ap.error("--record requires --sweep")
@@ -179,8 +214,8 @@ def main(argv=None) -> None:
 
     if args.sweep:
         results = []
-        for name, cfg, engine, chunk in _configs(platform):
-            out = bench_case(cfg, engine, chunk=chunk)
+        for name, cfg, engine, chunk, depth in _configs(platform):
+            out = bench_case(cfg, engine, chunk=chunk, pipeline_depth=depth)
             out["case"] = name
             results.append(out)
             print(json.dumps(out), flush=True)
@@ -195,11 +230,19 @@ def main(argv=None) -> None:
     cfg = config2_dueling_drop(n_inst=n_inst, seed=0)
     # Engine: the fused Pallas path (whole chunk resident in VMEM) on TPU;
     # the scanned XLA path on CPU (Mosaic doesn't target host CPUs).
-    # Chunk 1024 on TPU: protocol work per tick is chunk-invariant and the
-    # per-dispatch tunnel overhead costs ~17% at chunk 64 (see _configs).
+    # Flagship dispatch shape: the OPERATING chunk of 64 (the run/soak and
+    # long-log compaction cadence), pipelined --pipeline-depth chunks per
+    # dispatch.  At the TPU default of 16 the dispatched program is
+    # structurally the old chunk-1024 program — the dispatch-boundary tax
+    # (~10-17% at serial chunk 64, see _configs) is recovered without
+    # giving up the chunk-64 cadence.
     engine = "fused" if platform == "tpu" else "xla"
-    chunk = 1024 if platform == "tpu" else 64
-    print(json.dumps(bench_case(cfg, engine, chunk=chunk)))
+    depth = args.pipeline_depth
+    if depth is None:
+        depth = 16 if platform == "tpu" else 4
+    print(json.dumps(bench_case(
+        cfg, engine, chunk=64, timed_chunks=4 * depth, pipeline_depth=depth
+    )))
 
 
 if __name__ == "__main__":

@@ -70,6 +70,67 @@ def stuck_mask(learner: LearnerState, budget_ticks: int, now, valid=None):
     return stuck if valid is None else stuck & valid
 
 
+def liveness_device(
+    learner: LearnerState,
+    now,
+    n_points: int = 8,
+    n_bins: int = 16,
+    base=None,
+    log_total: int = 0,
+) -> dict:
+    """Device half of :func:`liveness_report`: all statistics as a pytree of
+    small device arrays, no host transfer.
+
+    ``now`` may be a device scalar (e.g. ``state.tick``) — the curve's tick
+    points and the histogram bin width are computed ON DEVICE with the same
+    integer arithmetic the host formulas used (``jnp`` floor division
+    rounds toward -inf exactly like Python's), so building the report needs
+    no host round-trip at all.  Pair with :func:`liveness_host`, or embed
+    in ``harness.run.summarize_device``'s composite pytree.
+    """
+    now = jnp.maximum(jnp.asarray(now, jnp.int32), 1)
+    idx = jnp.arange(1, n_points + 1, dtype=jnp.int32)
+    ticks = jnp.maximum(1, (now * idx) // n_points)
+    # Width chosen so every decided tick (<= now-1) lands in bins
+    # 0..n_bins-2: the last bin holds ONLY undecided lanes, so hist[-1] is
+    # exactly the livelock count, never late deciders.
+    bin_width = jnp.maximum(1, -((-now) // (n_bins - 1)))
+    valid = None
+    if log_total > 0 and base is not None:
+        valid = window_valid_mask(learner.chosen.shape, base, log_total)
+    # One decided_by reduction per point (same accumulation as the serial
+    # path — a batched reduce could reassociate float sums at huge sizes).
+    curve = jnp.stack([decided_by(learner, ticks[i], valid)
+                       for i in range(n_points)])
+    dev = {
+        "ticks": ticks,
+        "curve": curve,
+        "hist": chosen_tick_histogram(learner, n_bins, bin_width, valid),
+        "bin_width": bin_width,
+        "stuck": stuck_mask(learner, now, now, valid).sum(),
+    }
+    if valid is not None:
+        dev["slots_compacted"] = base.sum()
+    return dev
+
+
+def liveness_host(host: dict) -> dict:
+    """Format a ``device_get``'d :func:`liveness_device` pytree."""
+    out = {
+        "decided_by_curve": [
+            (int(k), round(float(f), 6))
+            for k, f in zip(host["ticks"], host["curve"])
+        ],
+        "chosen_tick_hist": [int(c) for c in host["hist"]],
+        "hist_bin_width": int(host["bin_width"]),
+        "stuck_lanes": int(host["stuck"]),
+    }
+    if "slots_compacted" in host:
+        out["liveness_window_relative"] = True
+        out["slots_compacted"] = int(host["slots_compacted"])
+    return out
+
+
 def liveness_report(
     learner: LearnerState,
     now: int,
@@ -99,28 +160,5 @@ def liveness_report(
     """
     import jax
 
-    now = max(int(now), 1)
-    ticks = [max(1, (now * (i + 1)) // n_points) for i in range(n_points)]
-    # Width chosen so every decided tick (<= now-1) lands in bins
-    # 0..n_bins-2: the last bin holds ONLY undecided lanes, so
-    # hist[-1] is exactly the livelock count, never late deciders.
-    bin_width = max(1, -(-now // (n_bins - 1)))
-    valid = None
-    if log_total > 0 and base is not None:
-        valid = window_valid_mask(learner.chosen.shape, base, log_total)
-    curve = [decided_by(learner, k, valid) for k in ticks]
-    hist = chosen_tick_histogram(learner, n_bins, bin_width, valid)
-    stuck = stuck_mask(learner, now, now, valid).sum()
-    curve, hist, stuck = jax.device_get((curve, hist, stuck))
-    out = {
-        "decided_by_curve": [
-            (k, round(float(f), 6)) for k, f in zip(ticks, curve)
-        ],
-        "chosen_tick_hist": [int(c) for c in hist],
-        "hist_bin_width": bin_width,
-        "stuck_lanes": int(stuck),
-    }
-    if valid is not None:
-        out["liveness_window_relative"] = True
-        out["slots_compacted"] = int(jax.device_get(base.sum()))
-    return out
+    dev = liveness_device(learner, now, n_points, n_bins, base, log_total)
+    return liveness_host(jax.device_get(dev))

@@ -259,12 +259,34 @@ def hist_totals(tel: TelemetryState) -> list:
     return [int(v) for v in jax.device_get(tel.hist.sum(axis=-1))]
 
 
+def telemetry_device(tel: TelemetryState) -> dict:
+    """Device half of :func:`telemetry_report`: reductions only, no transfer.
+
+    Returns a dict of small device arrays suitable for embedding in a
+    composite report pytree (``harness.run.summarize_device``) so one
+    ``jax.device_get`` — or one async transfer — covers the whole report.
+    """
+    dev = {"counters": tel.counters.sum(axis=-1)}
+    if tel.hist is not None:
+        dev["hist"] = tel.hist.sum(axis=-1)
+    if tel.seq is not None:
+        dev["seq"] = tel.seq.sum()
+    return dev
+
+
+def telemetry_host(host: dict) -> dict:
+    """Format a ``device_get``'d :func:`telemetry_device` pytree."""
+    report = {
+        "counters": {name: int(v) for name, v in zip(EVENTS, host["counters"])}
+    }
+    if "hist" in host:
+        report["hist"] = [int(v) for v in host["hist"]]
+        report["hist_ticks_per_bin"] = HIST_TICKS_PER_BIN
+    if "seq" in host:
+        report["events_recorded"] = int(host["seq"])
+    return report
+
+
 def telemetry_report(tel: TelemetryState) -> dict:
     """Host-readable per-chunk telemetry summary (for MetricsLog / stats)."""
-    report = {"counters": counter_totals(tel)}
-    if tel.hist is not None:
-        report["hist"] = hist_totals(tel)
-        report["hist_ticks_per_bin"] = HIST_TICKS_PER_BIN
-    if tel.seq is not None:
-        report["events_recorded"] = int(jax.device_get(tel.seq.sum()))
-    return report
+    return telemetry_host(jax.device_get(telemetry_device(tel)))

@@ -89,6 +89,15 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--seed", type=int, default=0)
     r.add_argument("--ticks", type=int, default=256, help="total scheduler ticks")
     r.add_argument("--chunk", type=int, default=64, help="ticks per device dispatch")
+    r.add_argument(
+        "--pipeline-depth", type=int, default=None, metavar="K",
+        help="dispatch pipeline (harness.pipeline): group up to K chunks "
+        "per device dispatch, with termination probed via an async "
+        "on-device done-flag (default 4; 1 = the serial per-chunk loop). "
+        "Schedules are bit-identical at any depth.  Auto-degrades to 1 "
+        "under --shard/--events/--checkpoint-every (they need per-chunk "
+        "host work); incompatible with --resume (same rule as --record)",
+    )
     r.add_argument("--until-all-chosen", action="store_true")
     r.add_argument("--shard", action="store_true", help="shard over all devices")
     r.add_argument("--log", default=None, help="JSONL metrics path")
@@ -155,6 +164,13 @@ def build_parser() -> argparse.ArgumentParser:
     so.add_argument("--target-rounds", type=float, default=1e9)
     so.add_argument("--ticks-per-seed", type=int, default=256)
     so.add_argument("--chunk", type=int, default=64)
+    so.add_argument(
+        "--pipeline-depth", type=int, default=4, metavar="K",
+        help="campaign overlap (harness.pipeline): dispatch seed N+1's "
+        "campaign while seed N executes on-device and read reports from "
+        "async transfers, with K chunks grouped per dispatch (default 4; "
+        "1 = the serial campaign loop; the tally is identical either way)",
+    )
     so.add_argument("--log", default=None, help="JSONL metrics path")
     so.add_argument(
         "--min-replication", type=float, default=None,
@@ -336,6 +352,40 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
     )
     from paxos_tpu.parallel.mesh import make_mesh, shard_pytree
 
+    # Dispatch-pipeline depth (harness.pipeline).  An explicit
+    # --pipeline-depth is refused with --resume (same rule as --record: a
+    # resumed campaign keeps the serial per-chunk cadence its checkpoint
+    # lineage was recorded under); otherwise the depth defaults to 4 and
+    # auto-degrades to 1 for consumers that need per-chunk host work
+    # (--shard, --events, --checkpoint-every) or a resumed campaign.
+    if args.pipeline_depth is not None and args.resume:
+        print("error: --pipeline-depth cannot be combined with --resume "
+              "(resumed campaigns keep the serial per-chunk loop their "
+              "checkpoint cadence was recorded under; same rule as "
+              "--record)", file=sys.stderr)
+        return 1
+    try:
+        depth = config_mod.validate_pipeline_depth(
+            4 if args.pipeline_depth is None else args.pipeline_depth
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    serial_needs = [
+        flag for flag, on in (
+            ("--resume", bool(args.resume)),
+            ("--shard", args.shard),
+            ("--events", args.events),
+            ("--checkpoint-every", bool(args.checkpoint_every)),
+        ) if on
+    ]
+    if depth > 1 and serial_needs:
+        if args.pipeline_depth is not None:
+            print(f"note: {', '.join(serial_needs)} needs per-chunk host "
+                  "work; running serially (pipeline depth 1)",
+                  file=sys.stderr)
+        depth = 1
+
     tel_cfg = _telemetry_from_args(args)
     registry = MetricsRegistry()
     if args.resume:
@@ -408,37 +458,62 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
             raise SystemExit(1)
 
     done, since_ckpt = 0, 0
-    with trace_mod.profile(args.trace):
-        while done < args.ticks:
-            n = min(args.chunk, args.ticks - done)
-            state = advance(state, n)
-            done += n
-            since_ckpt += n
-            rep = observe()
-            log.emit("chunk", **rep)
-            if "telemetry" in rep:
-                registry.ingest(rep["telemetry"])
-            if args.events:
-                # Registry-routed (and into the JSONL stream), with the
-                # historical stderr line kept for eyeball debugging.
-                rec = trace_mod.event_dump(
-                    state, stream=sys.stderr, registry=registry
-                )
-                log.emit("events", **rec)
-            if args.checkpoint_every and since_ckpt >= args.checkpoint_every:
-                ckpt.save(args.checkpoint_dir, state, plan, cfg,
-                          engine=args.engine, block=args.block)
-                log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
-                since_ckpt = 0
-            # Exact check (a float32 mean can round to != 1.0 at huge scales).
-            if args.until_all_chosen:
-                if (ll.done(state) if ll else bool(state.learner.chosen.all())):
-                    break
+    if depth > 1:
+        # Pipelined loop: grouped dispatches, async done-flag probe, and
+        # light per-dispatch chunk records (the full report — including
+        # telemetry totals, which accumulate on-device — lands in `final`).
+        from paxos_tpu.harness.pipeline import pipelined_run
+        from paxos_tpu.harness.run import all_chosen_flag, make_advance_grouped
+
+        advance_g = make_advance_grouped(
+            cfg, plan, args.engine, block=args.block, compact=bool(ll)
+        )
+        done_fn = None
+        if args.until_all_chosen:
+            done_fn = ll.done_flag if ll else all_chosen_flag
+        with trace_mod.profile(args.trace):
+            state, done, _ = pipelined_run(
+                state, advance_g, budget=args.ticks, chunk=args.chunk,
+                depth=depth, done_fn=done_fn,
+                on_dispatch=lambda t: log.emit(
+                    "chunk", ticks=t, pipelined=True
+                ),
+            )
+    else:
+        with trace_mod.profile(args.trace):
+            while done < args.ticks:
+                n = min(args.chunk, args.ticks - done)
+                state = advance(state, n)
+                done += n
+                since_ckpt += n
+                rep = observe()
+                log.emit("chunk", **rep)
+                if "telemetry" in rep:
+                    registry.ingest(rep["telemetry"])
+                if args.events:
+                    # Registry-routed (and into the JSONL stream), with the
+                    # historical stderr line kept for eyeball debugging.
+                    rec = trace_mod.event_dump(
+                        state, stream=sys.stderr, registry=registry
+                    )
+                    log.emit("events", **rec)
+                if args.checkpoint_every and since_ckpt >= args.checkpoint_every:
+                    ckpt.save(args.checkpoint_dir, state, plan, cfg,
+                              engine=args.engine, block=args.block)
+                    log.emit("checkpoint", path=args.checkpoint_dir,
+                             tick=int(state.tick))
+                    since_ckpt = 0
+                # Exact check (a float32 mean can round to != 1.0 at huge
+                # scales).
+                if args.until_all_chosen:
+                    if (ll.done(state) if ll
+                            else bool(state.learner.chosen.all())):
+                        break
 
     report = observe(liveness=args.liveness)
     report["config_fingerprint"] = cfg.fingerprint()
-    if ll:
-        report.update(ll.report_fields(state))
+    if depth > 1:
+        report["pipeline_depth"] = depth
     if args.checkpoint_dir:
         ckpt.save(args.checkpoint_dir, state, plan, cfg,
                   engine=args.engine, block=args.block)
@@ -505,6 +580,11 @@ def cmd_soak(args: argparse.Namespace) -> int:
               "far too slow for soak campaigns); use --engine xla",
               file=sys.stderr)
         return 1
+    try:
+        depth = config_mod.validate_pipeline_depth(args.pipeline_depth)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     kw = {"seed": args.seed}
     if args.n_inst:
         kw["n_inst"] = args.n_inst
@@ -556,6 +636,7 @@ def cmd_soak(args: argparse.Namespace) -> int:
             engine=args.engine,
             log=lambda s: print(f"# {s}", file=sys.stderr),
             min_slots_per_lane_tick=band or None,
+            pipeline_depth=depth,
         )
         report["config"] = args.config
         if report["violations"]:

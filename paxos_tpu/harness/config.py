@@ -48,6 +48,25 @@ class SimConfig:
         return hashlib.sha256(blob).hexdigest()[:16]
 
 
+def validate_pipeline_depth(depth) -> int:
+    """Validate a dispatch-pipeline depth (``harness.pipeline``) up front.
+
+    Depth is a HOST-LOOP knob, deliberately not a ``SimConfig`` field: it
+    regroups the same chunk sequence into fewer device dispatches without
+    changing a single tick, so it must never enter fingerprints, stream
+    ids, or checkpoints.  Validated here (the config layer) so every
+    entry point — ``run()``, ``soak()``, the CLI, bench — rejects a bad
+    depth before any device work.
+    """
+    if isinstance(depth, bool) or not isinstance(depth, int):
+        raise ValueError(
+            f"pipeline depth must be an integer >= 1, got {depth!r}"
+        )
+    if depth < 1:
+        raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+    return depth
+
+
 # --- BASELINE.json evaluation configs (BASELINE.md "Evaluation configs") ---
 
 

@@ -1,0 +1,129 @@
+"""Asynchronous pipelined dispatch — keep the device ahead of the host.
+
+bench.py records a 10-17% dispatch-boundary tax at the operating chunk of
+64 (config2 fused: 321.8M rounds/s @ chunk 64 vs 378.1M @ chunk 1024), and
+ROOFLINE.json puts the fused kernel at ~0.69 VPU utilization — the
+remaining headroom is host-side coordination, not compute.  Chunk 64 is
+schedule-relevant for long-log Multi-Paxos (the decided-prefix compaction
+cadence), so the chunk size cannot simply be raised.  This module closes
+the gap from the host side instead:
+
+- :func:`pipelined_run` groups up to ``depth`` chunk bodies into ONE device
+  dispatch (``advance(state, n_ticks, groups)`` — see
+  ``run.make_advance_grouped``), so the per-dispatch tunnel cost is paid
+  once per ``depth`` chunks instead of once per chunk, and consecutive
+  dispatches enqueue back-to-back via JAX async dispatch with nothing
+  blocking between them.  Grouping only regroups the chunk sequence — tick
+  PRNG streams derive from ``state.tick``, never from dispatch boundaries —
+  so schedules stay bit-identical at any depth (tests/test_pipeline.py
+  pins this against the serial loop on both engines).
+- Termination probes (``until_all_chosen``, long-log ``done``) fetch a
+  tiny on-device done-flag scalar (``copy_to_host_async`` started first),
+  so the big state pytree never round-trips mid-run.  The probe runs per
+  *dispatch*, not per chunk: an early exit overshoots the serial exit tick
+  by strictly less than ``depth * chunk`` ticks.
+- :class:`AsyncSummary` starts the report readback (one composite pytree —
+  ``run.summarize_device``) without blocking, so a soak can dispatch seed
+  N+1's campaign while seed N's report is still in flight.
+
+Depth-vs-latency tradeoff: depth 1 is the exact serial loop (probe every
+chunk boundary); higher depths amortize dispatch cost ~1/depth but coarsen
+probe granularity and per-chunk observability (the CLI's per-chunk metrics
+loop and checkpoint cadence need depth 1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+
+def start_transfer(tree) -> None:
+    """Start device->host transfer of every array leaf without blocking.
+
+    Best-effort: backends whose arrays lack ``copy_to_host_async`` just
+    skip the hint and the later ``device_get`` does a blocking fetch.
+    """
+    for leaf in jax.tree_util.tree_leaves(tree):
+        start = getattr(leaf, "copy_to_host_async", None)
+        if start is not None:
+            start()
+
+
+def pipelined_run(
+    state,
+    advance: Callable,
+    *,
+    budget: int,
+    chunk: int,
+    depth: int,
+    done_fn: Optional[Callable] = None,
+    on_dispatch: Optional[Callable[[int], None]] = None,
+):
+    """Drive ``advance(state, n_ticks, groups)`` for ``budget`` ticks.
+
+    Each device dispatch covers up to ``depth`` full chunks of ``chunk``
+    ticks (a trailing remainder shorter than one chunk dispatches alone),
+    preserving the serial loop's exact chunk boundaries — and therefore the
+    long-log compaction cadence — inside fewer dispatches.
+
+    ``done_fn(state) -> 0-d bool array`` enables early exit: the scalar
+    flag's transfer is started asynchronously and drained before the next
+    dispatch is enqueued, so an exit lands on the first dispatch boundary
+    at or past the serial exit tick — overshoot < ``depth * chunk`` ticks,
+    and at depth 1 the semantics are exactly the serial per-chunk probe.
+    Without ``done_fn`` nothing blocks until the caller reads the state.
+
+    ``on_dispatch(ticks_done)`` is called after each dispatch is enqueued
+    (host-side bookkeeping such as per-dispatch log records).
+
+    Returns ``(state, ticks_dispatched, exit_tick)`` — ``exit_tick`` is the
+    dispatch boundary where the done flag first read true, or None.
+    """
+    done = 0
+    exit_tick = None
+    while done < budget:
+        left = budget - done
+        if left < chunk:
+            n, g = left, 1
+        else:
+            n, g = chunk, min(depth, left // chunk)
+        state = advance(state, n, g)
+        done += n * g
+        if on_dispatch is not None:
+            on_dispatch(done)
+        if done_fn is not None:
+            flag = done_fn(state)
+            start_transfer(flag)
+            if bool(jax.device_get(flag)):
+                exit_tick = done
+                break
+    return state, done, exit_tick
+
+
+class AsyncSummary:
+    """A :func:`run.summarize` split in two across time.
+
+    Construction runs the on-device reductions and *starts* the host
+    transfer of the one composite report pytree — nothing blocks, and the
+    campaign's big state pytree never crosses.  ``get()`` drains the
+    transfer and formats the host report (including the Multi-Paxos
+    ballot-overflow guard, which raises ``MeasurementCorrupted`` exactly as
+    the synchronous path does).  A soak overlaps seed N+1's dispatch with
+    seed N's report transfer by constructing N+1's campaign between the
+    two halves.
+    """
+
+    def __init__(self, state, liveness: bool = False, log_total: int = 0):
+        from paxos_tpu.harness.run import summarize_device
+
+        self._dev, self._meta = summarize_device(
+            state, liveness=liveness, log_total=log_total
+        )
+        start_transfer(self._dev)
+
+    def get(self) -> dict[str, Any]:
+        from paxos_tpu.harness.run import summarize_host
+
+        return summarize_host(jax.device_get(self._dev), self._meta)

@@ -4,7 +4,12 @@ Reference parity (SURVEY.md §4.1): the reference's bootstrap (CLI → backend
 init → node creation → spawn roles → run proposer → print decision) becomes:
 build config → init state pytree → sample fault plan → `lax.scan` the
 protocol step over chunks of ticks → read back reduced metrics.  The only
-host↔device crossings are at chunk boundaries (SURVEY.md §8.4.5).
+host↔device crossings are at *dispatch* boundaries (SURVEY.md §8.4.5): the
+dispatch pipeline (``harness.pipeline``) groups up to ``pipeline_depth``
+chunks per dispatch and termination probes fetch a tiny on-device done-flag
+scalar asynchronously, so the big state pytree never round-trips mid-run
+and a full report costs exactly one ``jax.device_get`` of one composite
+pytree (:func:`summarize_device` / :func:`summarize_host`).
 """
 
 from __future__ import annotations
@@ -167,6 +172,58 @@ def fused_chunk_compact(state, seed, plan, fault, n_ticks, protocol, block, inte
     return compact_mp_body(state)[0]
 
 
+# Grouped variants (dispatch pipeline, harness.pipeline): ``groups`` chunk
+# bodies — each with its decided-prefix compaction — trace into ONE jitted
+# dispatch via an outer scan, so the per-dispatch host/tunnel cost is paid
+# once per group while the compaction cadence stays the chunk cadence.
+# Streams are bit-identical to the serial loop by construction: per-tick
+# PRNG derives from state.tick (xla: fold_in(key, tick); fused: counter-PRNG
+# keyed per (seed, tick, block)), never from dispatch boundaries
+# (tests/test_pipeline.py pins this on both engines).
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fault", "n_ticks", "step_fn", "groups"),
+    donate_argnums=(0,),
+)
+def run_chunk_compact_grouped(state, key, plan, fault, n_ticks, step_fn, groups):
+    from paxos_tpu.protocols.multipaxos import compact_mp_body
+
+    def outer(s, _):
+        def body(si, __):
+            return step_fn(si, key, plan, fault), None
+
+        s, _ = jax.lax.scan(body, s, None, length=n_ticks)
+        return compact_mp_body(s)[0], None
+
+    state, _ = jax.lax.scan(outer, state, None, length=groups)
+    return state
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "fault", "n_ticks", "protocol", "block", "interpret", "groups"
+    ),
+    donate_argnums=(0,),
+)
+def fused_chunk_compact_grouped(
+    state, seed, plan, fault, n_ticks, protocol, block, interpret, groups
+):
+    from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+    from paxos_tpu.protocols.multipaxos import compact_mp_body
+
+    fused = FUSED_CHUNKS[protocol]
+
+    def outer(s, _):
+        s = fused(s, seed, plan, fault, n_ticks, block=block, interpret=interpret)
+        return compact_mp_body(s)[0], None
+
+    state, _ = jax.lax.scan(outer, state, None, length=groups)
+    return state
+
+
 def make_advance(
     cfg: SimConfig,
     plan: FaultPlan,
@@ -258,6 +315,101 @@ def make_advance(
     raise ValueError(f"unknown engine: {engine!r}")
 
 
+def make_advance_grouped(
+    cfg: SimConfig,
+    plan: FaultPlan,
+    engine: str = "xla",
+    block: "int | None" = None,
+    interpret: "bool | None" = None,
+    compact: bool = False,
+) -> Callable:
+    """Build ``advance(state, n_ticks, groups)`` — the pipelined dispatch.
+
+    ``groups`` chunk bodies execute in ONE device dispatch
+    (``harness.pipeline.pipelined_run`` drives the grouping).  Non-compact
+    engines group by simply scanning ``n_ticks * groups`` ticks — ticks are
+    chunk-invariant, so at groups=16 x chunk 64 the dispatched program IS
+    the chunk-1024 program.  Compact (long-log) engines use the grouped
+    jits above so the compaction cadence stays ``n_ticks`` inside the
+    dispatch.  ``groups=1`` routes to the exact same module-level jit cache
+    as :func:`make_advance` — the serial and pipelined loops share
+    compilations and produce bit-identical streams.
+
+    The sharded (mesh) path stays ungrouped: sharded compaction composes
+    between dispatches on the host (:func:`make_advance`), so the CLI caps
+    the pipeline depth at 1 under ``--shard``.
+    """
+    if engine == "fused":
+        from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
+
+        if interpret is None:
+            interpret = jax.devices()[0].platform != "tpu"
+
+        if compact:
+            def advance(state, n, g=1):
+                if g == 1:
+                    return fused_chunk_compact(
+                        state, jnp.int32(cfg.seed), plan, cfg.fault, n,
+                        cfg.protocol, block, interpret,
+                    )
+                return fused_chunk_compact_grouped(
+                    state, jnp.int32(cfg.seed), plan, cfg.fault, n,
+                    cfg.protocol, block, interpret, g,
+                )
+
+            return advance
+        fused = FUSED_CHUNKS[cfg.protocol]
+
+        def advance(state, n, g=1):
+            return fused(
+                state, jnp.int32(cfg.seed), plan, cfg.fault, n * g,
+                block=block, interpret=interpret,
+            )
+
+        return advance
+    if engine == "xla":
+        step_fn = get_step_fn(cfg.protocol)
+        key = base_key(cfg)
+
+        if compact:
+            def advance(state, n, g=1):
+                if g == 1:
+                    return run_chunk_compact(
+                        state, key, plan, cfg.fault, n, step_fn
+                    )
+                return run_chunk_compact_grouped(
+                    state, key, plan, cfg.fault, n, step_fn, g
+                )
+
+            return advance
+
+        def advance(state, n, g=1):
+            return run_chunk(state, key, plan, cfg.fault, n * g, step_fn)
+
+        return advance
+    raise ValueError(f"unknown engine: {engine!r}")
+
+
+# On-device termination probes (dispatch pipeline): each returns a 0-d bool
+# array — the ONLY thing that crosses to the host mid-run.  Jitted so the
+# reduction fuses into one tiny program instead of eager per-op dispatches.
+
+
+@jax.jit
+def _all_true(x):
+    return x.all()
+
+
+def all_chosen_flag(state) -> jax.Array:
+    """0-d bool device scalar: every lane's learner chose a value."""
+    return _all_true(state.learner.chosen)
+
+
+@functools.partial(jax.jit, static_argnames=("log_total",))
+def _base_done(base, log_total):
+    return (base >= log_total).all()
+
+
 class LongLog:
     """Chunk-boundary orchestration for long-log Multi-Paxos (SURVEY §6.7).
 
@@ -267,25 +419,20 @@ class LongLog:
     traced into the chunk's own jitted computation so the module-level
     compile caches cover every probe and seed), a run is done when every
     instance's ``base`` reached ``log_total``, and reports carry the
-    replicated-log fields.  ``make_longlog`` returns None for non-long-log
-    configs so callers can write ``if ll:`` guards.
+    replicated-log fields (:func:`summarize` folds them in).
+    ``make_longlog`` returns None for non-long-log configs so callers can
+    write ``if ll:`` guards.
     """
 
     def __init__(self, cfg: SimConfig):
         self.log_total = cfg.fault.log_total
 
+    def done_flag(self, state) -> jax.Array:
+        """0-d bool device scalar: every instance replicated the whole log."""
+        return _base_done(state.base, self.log_total)
+
     def done(self, state) -> bool:
-        return bool((state.base >= self.log_total).all())
-
-    def report_fields(self, state) -> dict[str, Any]:
-        import numpy as np
-
-        base = np.asarray(jax.device_get(state.base))
-        return {
-            "log_total": self.log_total,
-            "slots_replicated": int(base.sum()),  # compacted = decided
-            "replicated_frac": float((base >= self.log_total).mean()),
-        }
+        return bool(jax.device_get(self.done_flag(state)))
 
 
 def make_longlog(cfg: SimConfig) -> "LongLog | None":
@@ -294,25 +441,24 @@ def make_longlog(cfg: SimConfig) -> "LongLog | None":
     return None
 
 
-def summarize(
+def summarize_device(
     state: PaxosState, liveness: bool = False, log_total: int = 0
-) -> dict[str, Any]:
-    """Reduce on-device state to a host-side scalar report.
+) -> tuple[dict, dict]:
+    """Device half of :func:`summarize`: one composite pytree, no transfer.
 
-    Reductions run on-device (sharded states psum automatically under jit);
-    only scalars come back to the host.  ``liveness`` appends the
-    decided-by curve / latency histogram / stuck-lane count block
-    (:func:`paxos_tpu.check.liveness.liveness_report`).  ``log_total > 0``
-    (long-log Multi-Paxos) makes that block window-relative: compacted
-    slots report as ``slots_compacted`` and never-decidable tail rows are
-    masked out of the stuck count instead of misreported as livelocked.
+    Every block of the report — headline scalars, telemetry totals, the
+    liveness curve/histogram/stuck block, and long-log replication progress
+    — reduces on-device into ONE pytree of small arrays, so the whole
+    report crosses the host boundary in a single ``jax.device_get`` (or a
+    single async transfer — ``harness.pipeline.AsyncSummary``).  Returns
+    ``(device_pytree, meta)``; hand the fetched pytree plus ``meta`` to
+    :func:`summarize_host`.
     """
     lrn, prop = state.learner, state.proposer
     chosen = lrn.chosen  # (I,) single-decree, (L, I) multipaxos
 
     # Shared, shape-polymorphic fields.
-    out = {
-        "n_inst": chosen.shape[-1],
+    dev = {
         "ticks": state.tick,
         "chosen_frac": chosen.mean(dtype=jnp.float32),
         "violations": lrn.violations.sum(),
@@ -324,6 +470,7 @@ def summarize(
             -1.0,
         ),
     }
+    meta = {"n_inst": chosen.shape[-1], "log_total": log_total}
 
     if chosen.ndim == 2:  # Multi-Paxos: chosen_frac is slot-level
         # Packed-pair bit budget, ballot side (core.mp_state: bal < 2^15
@@ -332,7 +479,7 @@ def summarize(
         # init_state; ballots grow with elections, so the bound is enforced
         # on every report: an election-heavy campaign that overflowed would
         # otherwise corrupt recovery/learner compares SILENTLY.
-        out["max_ballot"] = prop.bal.max()
+        dev["max_ballot"] = prop.bal.max()
         if log_total > 0:
             # Long-log: the window is a moving residual, so "fraction of
             # instances with a full window" reads ~0 on a HEALTHY run
@@ -343,51 +490,102 @@ def summarize(
             from paxos_tpu.check.liveness import window_valid_mask
 
             valid = window_valid_mask(chosen.shape, state.base, log_total)
-            out["decided_frac"] = (
+            dev["decided_frac"] = (
                 state.base.sum(dtype=jnp.float32)
                 + (chosen & valid).sum(dtype=jnp.float32)
             ) / (chosen.shape[-1] * log_total)
         else:
-            out["decided_frac"] = chosen.all(axis=0).mean(dtype=jnp.float32)
-        out["proposer_disagree"] = jnp.zeros((), jnp.int32)  # n/a: leaders adopt
+            dev["decided_frac"] = chosen.all(axis=0).mean(dtype=jnp.float32)
+        dev["proposer_disagree"] = jnp.zeros((), jnp.int32)  # n/a: leaders adopt
     else:
-        out["decided_frac"] = (prop.phase == DONE).any(axis=0).mean(dtype=jnp.float32)
+        dev["decided_frac"] = (prop.phase == DONE).any(axis=0).mean(dtype=jnp.float32)
         # A proposer that believes it decided v while the learner chose v' != v
         # is a cross-role disagreement — counted as a safety signal.
-        out["proposer_disagree"] = (
+        dev["proposer_disagree"] = (
             (prop.phase == DONE)
             & chosen[None]
             & (prop.decided_val != lrn.chosen_val[None])
         ).any(axis=0).sum()
 
-    out = {
-        k: (v.item() if hasattr(v, "item") else v)
-        for k, v in jax.device_get(out).items()
-    }
-    if "max_ballot" in out:
+    base = getattr(state, "base", None)
+    if log_total > 0 and base is not None:
+        # Long-log replication progress (previously LongLog.report_fields,
+        # a separate blocking device_get of the whole base array).
+        dev["longlog"] = {
+            "slots_replicated": base.sum(),  # compacted = decided
+            "replicated_frac": (base >= log_total).mean(dtype=jnp.float32),
+        }
+    if state.telemetry is not None:
+        from paxos_tpu.core.telemetry import telemetry_device
+
+        dev["telemetry"] = telemetry_device(state.telemetry)
+    if liveness:
+        from paxos_tpu.check.liveness import liveness_device
+
+        dev["liveness"] = liveness_device(
+            lrn, state.tick, base=base, log_total=log_total
+        )
+    return dev, meta
+
+
+def summarize_host(host: dict, meta: dict) -> dict[str, Any]:
+    """Format a ``device_get``'d :func:`summarize_device` pytree.
+
+    Runs the Multi-Paxos ballot-overflow guard (raises
+    :class:`MeasurementCorrupted`) exactly as the synchronous path always
+    did — the guard is host-side policy, so async readers
+    (``AsyncSummary``) inherit it for free.
+    """
+    out = {"n_inst": meta["n_inst"]}
+    for k in ("ticks", "chosen_frac", "violations", "evictions",
+              "mean_choose_tick", "decided_frac", "proposer_disagree"):
+        v = host[k]
+        out[k] = v.item() if hasattr(v, "item") else v
+    if "max_ballot" in host:
         from paxos_tpu.core.mp_state import BV_SHIFT
 
         bal_bits = 31 - BV_SHIFT  # sign bit must stay clear after bal << 16
-        if out.pop("max_ballot") >= (1 << bal_bits):
+        if int(host["max_ballot"]) >= (1 << bal_bits):
             raise MeasurementCorrupted(
                 "Multi-Paxos ballot overflowed the packed (ballot, value) "
                 f"layout (bal >= 2^{bal_bits}): recovery/learner compares "
                 "are no longer trustworthy for this campaign; shorten "
                 "ticks_per_seed or raise lease_len (ADVICE r4)"
             )
-    if state.telemetry is not None:
-        from paxos_tpu.core.telemetry import telemetry_report
+    if "longlog" in host:
+        out["log_total"] = meta["log_total"]
+        out["slots_replicated"] = int(host["longlog"]["slots_replicated"])
+        out["replicated_frac"] = float(host["longlog"]["replicated_frac"])
+    if "telemetry" in host:
+        from paxos_tpu.core.telemetry import telemetry_host
 
-        # One readback per report (chunk cadence), host-side dict of totals.
-        out["telemetry"] = telemetry_report(state.telemetry)
-    if liveness:
-        from paxos_tpu.check.liveness import liveness_report
+        out["telemetry"] = telemetry_host(host["telemetry"])
+    if "liveness" in host:
+        from paxos_tpu.check.liveness import liveness_host
 
-        out.update(liveness_report(
-            lrn, out["ticks"],
-            base=getattr(state, "base", None), log_total=log_total,
-        ))
+        out.update(liveness_host(host["liveness"]))
     return out
+
+
+def summarize(
+    state: PaxosState, liveness: bool = False, log_total: int = 0
+) -> dict[str, Any]:
+    """Reduce on-device state to a host-side scalar report.
+
+    Reductions run on-device (sharded states psum automatically under jit)
+    and the whole report — scalars, telemetry, liveness, long-log
+    replication — comes back in ONE ``jax.device_get`` of one composite
+    pytree (:func:`summarize_device`).  ``liveness`` appends the decided-by
+    curve / latency histogram / stuck-lane count block
+    (:func:`paxos_tpu.check.liveness.liveness_device`).  ``log_total > 0``
+    (long-log Multi-Paxos) makes that block window-relative — compacted
+    slots report as ``slots_compacted`` and never-decidable tail rows are
+    masked out of the stuck count instead of misreported as livelocked —
+    and adds the replication-progress fields (``slots_replicated``,
+    ``replicated_frac``).
+    """
+    dev, meta = summarize_device(state, liveness=liveness, log_total=log_total)
+    return summarize_host(jax.device_get(dev), meta)
 
 
 def run(
@@ -399,44 +597,53 @@ def run(
     return_state: bool = False,
     engine: str = "xla",
     liveness: bool = False,
+    pipeline_depth: int = 1,
 ):
     """Host loop: init, scan chunks, return the final report.
 
     With ``until_all_chosen`` the loop keeps scanning chunks until every
     instance's learner chose a value (or ``max_ticks``), the batch analog of
-    the reference master's "wait for the decision, then print it".
+    the reference master's "wait for the decision, then print it".  The
+    probe is an on-device done-flag scalar fetched per dispatch
+    (``harness.pipeline``) — the state pytree never round-trips mid-run.
 
-    ``engine`` selects the execution path via :func:`make_advance`: ``"xla"``
-    scans the step function (any protocol, any platform); ``"fused"`` runs
-    the whole chunk inside one Pallas kernel with state resident in VMEM
-    (any protocol; ~3-4x faster on TPU, interpreted — slowly, bit-
-    identically — elsewhere; see ``kernels/fused_tick``).
+    ``engine`` selects the execution path via :func:`make_advance_grouped`:
+    ``"xla"`` scans the step function (any protocol, any platform);
+    ``"fused"`` runs the whole chunk inside one Pallas kernel with state
+    resident in VMEM (any protocol; ~3-4x faster on TPU, interpreted —
+    slowly, bit-identically — elsewhere; see ``kernels/fused_tick``).
+
+    ``pipeline_depth`` groups up to that many chunks per device dispatch
+    (default 1 = the serial per-chunk loop).  Grouping only regroups
+    dispatches — the schedule stream is bit-identical at any depth — but an
+    ``until_all_chosen`` exit is probed per dispatch, so the reported
+    ``ticks`` may exceed the serial exit tick by < ``depth * chunk``.
     """
+    from paxos_tpu.harness.config import validate_pipeline_depth
+    from paxos_tpu.harness.pipeline import pipelined_run
+
+    depth = validate_pipeline_depth(pipeline_depth)
     state = init_state(cfg)
     plan = init_plan(cfg)
     # Long-log Multi-Paxos (SURVEY.md §6.7): decided prefixes compact out of
     # the window at every chunk boundary (traced into the chunk's dispatch),
     # so HBM stays O(window) while the log grows to cfg.fault.log_total.
     ll = make_longlog(cfg)
-    advance = make_advance(cfg, plan, engine, compact=bool(ll))
+    advance = make_advance_grouped(cfg, plan, engine, compact=bool(ll))
 
+    done_fn = None
+    if until_all_chosen:
+        done_fn = ll.done_flag if ll else all_chosen_flag
     budget = max_ticks if until_all_chosen else total_ticks
-    done = 0
-    while done < budget:
-        n = min(chunk, budget - done)
-        state = advance(state, n)
-        done += n
-        if until_all_chosen:
-            if ll:
-                if ll.done(state):
-                    break
-            elif state.learner.chosen.all().item():
-                break
+    state, _, exit_tick = pipelined_run(
+        state, advance, budget=budget, chunk=chunk, depth=depth,
+        done_fn=done_fn,
+    )
     report = summarize(state, liveness=liveness, log_total=cfg.fault.log_total)
     report["config_fingerprint"] = cfg.fingerprint()
     report["engine"] = engine
-    if ll:
-        report.update(ll.report_fields(state))
+    if depth > 1:
+        report["pipeline_depth"] = depth
     if return_state:
         return report, state
     return report

@@ -86,6 +86,7 @@ def soak(
     transient_retries: int = 2,
     retry_backoff_s: float = 5.0,
     min_slots_per_lane_tick: Optional[float] = None,
+    pipeline_depth: int = 1,
 ) -> dict[str, Any]:
     """Run campaigns over rotating seeds until ``target_rounds`` accumulate.
 
@@ -93,6 +94,18 @@ def soak(
     place engine dispatch lives).  Returns a report with total
     instance-rounds, violations, evictions, seeds exhausted, and throughput.
     ``cfg.seed`` is the first seed; campaign ``i`` uses ``seed + i``.
+
+    **Dispatch pipelining (``pipeline_depth > 1``):** campaigns overlap by
+    one — seed N+1's fault plan is sampled, its state initialized, and all
+    its chunk dispatches enqueued while seed N's campaign is still
+    executing on-device, and seed N's tally comes from an asynchronously
+    transferred composite report pytree (``harness.pipeline.AsyncSummary``)
+    instead of a blocking full ``summarize`` between campaigns.  Each
+    campaign's chunks are also grouped ``pipeline_depth`` per dispatch.
+    The schedule streams, seed set, and tally are identical to the serial
+    loop (campaigns are deterministic in (config, seed)); a transiently
+    failed async campaign is replayed serially under the usual retry
+    budget.  Depth 1 (the default) is the exact serial campaign loop.
 
     **Liveness accounting (VERDICT r2 missing#6):** every campaign runs
     with the liveness block on, and the report aggregates
@@ -139,7 +152,10 @@ def soak(
     still evicts at the largest table (``evictions_first_pass`` keeps the
     raw pre-escalation count).
     """
+    from paxos_tpu.harness.config import validate_pipeline_depth
+
     say = log or (lambda s: None)
+    depth = validate_pipeline_depth(pipeline_depth)
     if min_slots_per_lane_tick is not None and not (
         cfg.protocol == "multipaxos" and cfg.fault.log_total
     ):
@@ -169,46 +185,111 @@ def soak(
     retries_used = 0
     t0 = time.perf_counter()
     corrupted_seed: Optional[int] = None
-    while rounds < target_rounds:
-        scfg = dataclasses.replace(cfg, seed=cfg.seed + seeds)
+
+    def serial_campaign(rcfg):
+        # Module-global `run` on purpose: tests monkeypatch soak.run to
+        # model transient backend failures, and retries must hit the patch.
+        return run(
+            rcfg, total_ticks=ticks_per_seed, chunk=chunk,
+            engine=engine, liveness=True, pipeline_depth=depth,
+        )
+
+    def dispatch_campaign(scfg):
+        """Enqueue one whole campaign without blocking; returns the async
+        report handle (or None if dispatch itself failed — the finalizer
+        then replays serially under the retry budget)."""
+        import jax
+
+        from paxos_tpu.harness.pipeline import AsyncSummary, pipelined_run
+        from paxos_tpu.harness.run import (
+            init_plan,
+            init_state,
+            make_advance_grouped,
+            make_longlog,
+        )
+
         try:
-            report, used = _run_with_retries(
-                lambda: run(
-                    scfg, total_ticks=ticks_per_seed, chunk=chunk,
-                    engine=engine, liveness=True,
-                ),
-                say, transient_retries, retry_backoff_s,
+            state = init_state(scfg)
+            plan = init_plan(scfg)
+            adv = make_advance_grouped(
+                scfg, plan, engine, compact=bool(make_longlog(scfg))
             )
+            state, _, _ = pipelined_run(
+                state, adv, budget=ticks_per_seed, chunk=chunk, depth=depth
+            )
+            return AsyncSummary(
+                state, liveness=True, log_total=scfg.fault.log_total
+            )
+        except jax.errors.JaxRuntimeError as e:
+            first_line = (str(e).splitlines() or [""])[0][:120]
+            say(f"seed {scfg.seed}: async dispatch failed ({first_line}); "
+                "replaying serially")
+            return None
+
+    def finalize(scfg, handle):
+        """Block on an async campaign's report.  A transient failure while
+        draining it falls back to a serial replay — exact, campaigns being
+        deterministic in (config, seed) — under the normal retry budget."""
+        attempt = {"n": 0}
+
+        def run_fn():
+            attempt["n"] += 1
+            if attempt["n"] == 1 and handle is not None:
+                return handle.get()
+            return serial_campaign(scfg)
+
+        return _run_with_retries(
+            run_fn, say, transient_retries, retry_backoff_s
+        )
+
+    # Overlap-by-one campaign loop: `planned` counts dispatched campaigns
+    # (runs one ahead of `seeds` when pipelined), `pending` is the campaign
+    # currently executing on-device.  Serial mode (depth 1) dispatches and
+    # finalizes in the same iteration — the exact pre-pipeline loop.
+    overlap = depth > 1
+    campaign_rounds = cfg.n_inst * ticks_per_seed
+    planned = 0
+    pending: "Optional[tuple]" = None
+    while rounds < target_rounds or pending is not None:
+        nxt = None
+        if planned * campaign_rounds < target_rounds:
+            scfg = dataclasses.replace(cfg, seed=cfg.seed + planned)
+            planned += 1
+            nxt = (scfg, dispatch_campaign(scfg) if overlap else None)
+        fin, pending = (pending, nxt) if overlap else (nxt, None)
+        if fin is None:
+            continue
+        fscfg, handle = fin
+        try:
+            report, used = finalize(fscfg, handle)
         except MeasurementCorrupted as e:
             # One seed's measurements went untrustworthy (e.g. packed-MP
             # ballot overflow): stop the campaign loop but KEEP the tally
             # from completed seeds — the report records the corrupted seed
-            # and the CLI fails loudly on it.
-            say(f"seed {scfg.seed}: measurement corrupted — {e}")
-            corrupted_seed = scfg.seed
+            # and the CLI fails loudly on it.  An in-flight next campaign
+            # is discarded unfinalized.
+            say(f"seed {fscfg.seed}: measurement corrupted — {e}")
+            corrupted_seed = fscfg.seed
             break
         retries_used += used
         evictions_first_pass += report["evictions"]
         if report["evictions"]:
-            k = scfg.k_slots
+            k = fscfg.k_slots
             for _ in range(recheck_doublings):
                 if not report["evictions"]:
                     break
                 k *= 2
-                say(f"seed {scfg.seed}: {report['evictions']} evictions, "
+                say(f"seed {fscfg.seed}: {report['evictions']} evictions, "
                     f"rechecking at k_slots={k}")
-                rcfg = dataclasses.replace(scfg, k_slots=k)
+                rcfg = dataclasses.replace(fscfg, k_slots=k)
                 report, used = _run_with_retries(
-                    lambda: run(
-                        rcfg, total_ticks=ticks_per_seed, chunk=chunk,
-                        engine=engine, liveness=True,
-                    ),
+                    lambda: serial_campaign(rcfg),
                     say, transient_retries, retry_backoff_s,
                 )
                 retries_used += used
-                recheck_rounds += scfg.n_inst * ticks_per_seed
+                recheck_rounds += fscfg.n_inst * ticks_per_seed
             rechecked_seeds.append({
-                "seed": scfg.seed,
+                "seed": fscfg.seed,
                 "k_slots": k,
                 "evictions": report["evictions"],
             })
@@ -216,7 +297,7 @@ def soak(
         evictions += report["evictions"]
         if report["violations"]:
             # Reproducibility: these seeds feed straight into `shrink`.
-            violating_seeds.append(scfg.seed)
+            violating_seeds.append(fscfg.seed)
         stuck_total += report["stuck_lanes"]
         stuck_max = max(stuck_max, report["stuck_lanes"])
         lanes_total += sum(report["chosen_tick_hist"])  # valid slot-lanes
@@ -224,11 +305,11 @@ def soak(
         if "slots_replicated" in report:  # long-log configs only
             slots_total += report["slots_replicated"]
             rep_rates.append(
-                report["slots_replicated"] / (scfg.n_inst * ticks_per_seed)
+                report["slots_replicated"] / (fscfg.n_inst * ticks_per_seed)
             )
-        rounds += scfg.n_inst * ticks_per_seed
+        rounds += fscfg.n_inst * ticks_per_seed
         seeds += 1
-        say(f"seed {scfg.seed}: {rounds:.3e} rounds, {violations} violations, "
+        say(f"seed {fscfg.seed}: {rounds:.3e} rounds, {violations} violations, "
             f"{report['stuck_lanes']} stuck")
     dt = time.perf_counter() - t0
     replication: dict[str, Any] = {}
@@ -247,6 +328,8 @@ def soak(
             )
     if corrupted_seed is not None:
         replication["measurement_corrupted"] = corrupted_seed
+    if depth > 1:
+        replication["pipeline_depth"] = depth
     return replication | {
         "metric": "soak",
         "rounds": rounds,

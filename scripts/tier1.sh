@@ -19,4 +19,17 @@ if [ "$rc" -eq 0 ]; then
        | grep -q '^paxos_tpu_events_total' \
   && echo STATS_SMOKE=ok || { echo STATS_SMOKE=FAILED; rc=1; }
 fi
+# Dispatch-pipeline smoke: a pipelined run (grouped dispatches + async
+# done-flag probe) and a pipelined soak (overlap-by-one campaigns) must
+# both complete clean — the depth knob is load-bearing in CI, not just in
+# the unit suite.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 180 env JAX_PLATFORMS=cpu python -m paxos_tpu run \
+    --config config1 --n-inst 256 --ticks 64 --chunk 16 \
+    --pipeline-depth 2 --until-all-chosen >/dev/null 2>&1 \
+  && timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxos_tpu soak \
+       --config config1 --engine xla --n-inst 4096 --target-rounds 1e6 \
+       --ticks-per-seed 64 --chunk 32 --pipeline-depth 2 >/dev/null 2>&1 \
+  && echo PIPELINE_SMOKE=ok || { echo PIPELINE_SMOKE=FAILED; rc=1; }
+fi
 exit $rc

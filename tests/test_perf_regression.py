@@ -54,10 +54,10 @@ def test_perf_band(case):
     from bench import _configs, bench_case
 
     table = {
-        (name, eng): (cfg, chunk)
-        for name, cfg, eng, chunk in _configs("tpu")
+        (name, eng): (cfg, chunk, depth)
+        for name, cfg, eng, chunk, depth in _configs("tpu")
     }
-    cfg, chunk = table[(case["case"], case["engine"])]
+    cfg, chunk, depth = table[(case["case"], case["engine"])]
     # The recorded number must refer to this exact config, else the band
     # compares apples to oranges (a config change requires re-recording).
     assert cfg.fingerprint() == case["config_fingerprint"], (
@@ -73,7 +73,14 @@ def test_perf_band(case):
         f"{case['case']}: bench chunk {chunk} != recorded "
         f"{case.get('chunk', case['ticks'] // 4)}; re-record BENCH_SWEEP.json"
     )
-    out = bench_case(cfg, case["engine"], chunk=chunk)
+    # Same exactness for the dispatch-pipeline depth: grouping moves the
+    # measured value by the very dispatch tax this PR exists to recover
+    # (pre-pipeline artifact rows carry no key — those ran serial, depth 1).
+    assert case.get("pipeline_depth", 1) == depth, (
+        f"{case['case']}: bench pipeline_depth {depth} != recorded "
+        f"{case.get('pipeline_depth', 1)}; re-record BENCH_SWEEP.json"
+    )
+    out = bench_case(cfg, case["engine"], chunk=chunk, pipeline_depth=depth)
     assert out["violations"] == 0
     assert out["value"] >= BAND * case["value"], (
         f"{case['case']} ({case['engine']}): {out['value']:.3e} < "

@@ -1,0 +1,251 @@
+"""Dispatch pipeline (PR 3): bit-identity, early exit, depth rules.
+
+The contract of ``harness.pipeline`` is that grouping chunk dispatches is
+*invisible to the schedule*: per-tick PRNG streams derive from
+``state.tick`` (xla) or the (seed, tick, block) counter (fused), never from
+dispatch boundaries, so a pipelined loop at ANY depth must reproduce the
+serial loop's final state bit-for-bit.  A digest drift here means the
+fuzzing schedules silently changed — the same severity as a gray-knob
+default-on drift (tests/test_gray.py).
+
+Three contracts guard the layer:
+
+1. **Bit-identity**: full-state sha256 digests for pipelined (depth 2, 4)
+   loops equal the serial loop's on both engines across all four
+   protocols, including long-log compaction (where the chunk cadence is
+   schedule-relevant and grouping must preserve it *inside* the dispatch).
+2. **Early exit**: an ``until_all_chosen`` pipelined run exits within
+   ``depth * chunk`` ticks of the serial exit tick and reports identical
+   chosen values — the async done-flag probe may only coarsen granularity,
+   never change outcomes.
+3. **Depth rules**: depth is a host-loop knob (never in fingerprints or
+   reports at depth 1), validated at config time, and refused by the CLI
+   together with ``--resume`` (checkpoint cadence was recorded serially).
+"""
+
+import dataclasses
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paxos_tpu.harness import config as C
+from paxos_tpu.harness.pipeline import AsyncSummary, pipelined_run
+from paxos_tpu.harness.run import (
+    init_plan,
+    init_state,
+    make_advance,
+    make_advance_grouped,
+    make_longlog,
+    run,
+    summarize,
+)
+
+TICKS, CHUNK = 48, 16  # depth 4 exercises a partial group (3 chunks left)
+
+
+def _digest(state) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        h.update(jax.device_get(leaf).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _cfg(protocol: str) -> C.SimConfig:
+    if protocol == "paxos":
+        return C.config2_dueling_drop(n_inst=64, seed=7)
+    if protocol == "multipaxos":
+        return C.config3_multipaxos(n_inst=64, seed=7)
+    sweep = {c.protocol: c for c in C.config5_sweep(n_inst=64, seed=7)}
+    return sweep[protocol]
+
+
+# Serial references are shared across the depth parametrization — the
+# serial chunk loop is the fixed point every depth is measured against.
+_serial_cache: dict = {}
+
+
+def _serial_digest(protocol: str, engine: str) -> str:
+    key = (protocol, engine)
+    if key not in _serial_cache:
+        cfg = _cfg(protocol)
+        plan = init_plan(cfg)
+        advance = make_advance(cfg, plan, engine)
+        state = init_state(cfg)
+        for _ in range(TICKS // CHUNK):
+            state = advance(state, CHUNK)
+        _serial_cache[key] = _digest(state)
+    return _serial_cache[key]
+
+
+@pytest.mark.parametrize("engine", ["xla", "fused"])
+@pytest.mark.parametrize(
+    "protocol", ["paxos", "multipaxos", "fastpaxos", "raftcore"]
+)
+@pytest.mark.parametrize("depth", [2, 4])
+def test_pipelined_digest_matches_serial(protocol, engine, depth):
+    cfg = _cfg(protocol)
+    plan = init_plan(cfg)
+    advance = make_advance_grouped(cfg, plan, engine)
+    state, done, exit_tick = pipelined_run(
+        init_state(cfg), advance, budget=TICKS, chunk=CHUNK, depth=depth
+    )
+    assert done == TICKS and exit_tick is None
+    assert _digest(state) == _serial_digest(protocol, engine), (
+        f"{protocol}/{engine}: depth-{depth} stream diverged from serial — "
+        "dispatch grouping leaked into the schedule"
+    )
+
+
+@pytest.mark.parametrize("engine", ["xla", "fused"])
+def test_pipelined_longlog_compaction_cadence(engine):
+    """Grouped compact dispatches must compact at every inner chunk
+    boundary, exactly like the serial loop — the cadence is
+    schedule-relevant (SURVEY.md §6.7), not just a perf knob."""
+    cfg = C.config3_long(n_inst=64, seed=2, log_total=24, window=8)
+    plan = init_plan(cfg)
+    serial = init_state(cfg)
+    adv1 = make_advance(cfg, plan, engine, compact=True)
+    for _ in range(TICKS // CHUNK):
+        serial = adv1(serial, CHUNK)
+
+    advg = make_advance_grouped(cfg, plan, engine, compact=True)
+    piped, done, _ = pipelined_run(
+        init_state(cfg), advg, budget=TICKS, chunk=CHUNK, depth=4
+    )
+    assert done == TICKS
+    assert _digest(piped) == _digest(serial)
+    # The one-device_get composite report agrees with the serial state's.
+    r1 = summarize(serial, log_total=cfg.fault.log_total)
+    r4 = AsyncSummary(piped, log_total=cfg.fault.log_total).get()
+    assert r1 == r4
+
+
+def test_until_all_chosen_exit_bound():
+    """The async done-flag probe runs per dispatch: the pipelined exit may
+    overshoot the serial exit tick, but by strictly less than
+    depth * chunk, and the chosen values must be identical."""
+    cfg = C.config1_no_faults(n_inst=256, seed=3)
+    depth, chunk = 4, 8
+    r1, s1 = run(cfg, until_all_chosen=True, chunk=chunk, max_ticks=4096,
+                 return_state=True)
+    r4, s4 = run(cfg, until_all_chosen=True, chunk=chunk, max_ticks=4096,
+                 return_state=True, pipeline_depth=depth)
+    assert r1["chosen_frac"] == 1.0 and r4["chosen_frac"] == 1.0
+    assert r1["ticks"] <= r4["ticks"] < r1["ticks"] + depth * chunk
+    assert bool(s1.learner.chosen.all()) and bool(s4.learner.chosen.all())
+    assert jnp.array_equal(s1.learner.chosen_val, s4.learner.chosen_val), (
+        "overshoot ticks changed chosen values — chosen lanes must be stable"
+    )
+
+
+def test_depth1_report_is_byte_identical():
+    """Depth 1 routes through the same module-level jit caches as the
+    serial loop and must not even *label* the report — resumed/recorded
+    artifacts diff clean against pre-pipeline runs."""
+    cfg = C.config2_dueling_drop(n_inst=128, seed=5)
+    r_serial = run(cfg, total_ticks=32, chunk=16)
+    r_d1 = run(cfg, total_ticks=32, chunk=16, pipeline_depth=1)
+    assert r_d1 == r_serial
+    assert "pipeline_depth" not in r_d1
+
+    r_d4 = run(cfg, total_ticks=32, chunk=16, pipeline_depth=4)
+    assert r_d4.pop("pipeline_depth") == 4
+    assert r_d4 == r_serial  # same stream, same report body
+
+
+def test_depth_is_not_schedule_relevant():
+    """pipeline_depth is a host-loop knob: it must never enter the config
+    fingerprint (checkpoints, stream ids, and perf-gate lineage all key on
+    the fingerprint, and any depth replays any recording)."""
+    cfg = C.config2_dueling_drop(n_inst=128, seed=5)
+    assert "pipeline_depth" not in [f.name for f in dataclasses.fields(cfg)]
+    r_d2 = run(cfg, total_ticks=32, chunk=16, pipeline_depth=2)
+    assert r_d2["config_fingerprint"] == cfg.fingerprint()
+
+
+def test_pipeline_depth_validation():
+    for bad in (0, -1, 2.5, "4", True):
+        with pytest.raises(ValueError):
+            C.validate_pipeline_depth(bad)
+    assert C.validate_pipeline_depth(1) == 1
+    assert C.validate_pipeline_depth(16) == 16
+    with pytest.raises(ValueError):
+        run(C.config1_no_faults(n_inst=64), total_ticks=8, chunk=8,
+            pipeline_depth=0)
+
+
+def test_soak_pipelined_tally_matches_serial():
+    """The overlap-by-one soak loop (dispatch seed N+1 while seed N
+    executes, tally from AsyncSummary) must produce the same tally as the
+    serial campaign loop — campaigns are deterministic in (config, seed)."""
+    from paxos_tpu.harness.soak import soak
+
+    cfg = C.config2_dueling_drop(n_inst=256, seed=7)
+    rounds = 2 * 256 * 32
+    r1 = soak(cfg, target_rounds=rounds, ticks_per_seed=32, chunk=16)
+    r4 = soak(cfg, target_rounds=rounds, ticks_per_seed=32, chunk=16,
+              pipeline_depth=4)
+    assert r4.pop("pipeline_depth") == 4
+    assert "pipeline_depth" not in r1
+    for key in ("seeds", "rounds", "violations", "evictions",
+                "evictions_first_pass", "rechecked_seeds", "stuck_lanes",
+                "stuck_frac", "decided_frac_mean", "decided_frac_min"):
+        assert r1[key] == r4[key], f"soak tally field {key!r} diverged"
+
+
+def test_soak_pipelined_longlog_tally():
+    from paxos_tpu.harness.soak import soak
+
+    cfg = C.config3_long(n_inst=64, seed=2, log_total=24, window=8)
+    rounds = 2 * 64 * 64
+    kw = dict(target_rounds=rounds, ticks_per_seed=64, chunk=16,
+              min_slots_per_lane_tick=1e-4)
+    r1 = soak(cfg, **kw)
+    r4 = soak(cfg, pipeline_depth=4, **kw)
+    assert r4.pop("pipeline_depth") == 4
+    for key in ("seeds", "rounds", "violations", "slots_replicated",
+                "replication_ok", "slots_per_lane_tick_min"):
+        assert r1[key] == r4[key], f"longlog soak field {key!r} diverged"
+
+
+def test_cli_pipelined_run_and_rules(tmp_path, capsys):
+    from paxos_tpu.harness.cli import main
+
+    # A pipelined run completes, labels its report, and logs per dispatch.
+    log = tmp_path / "m.jsonl"
+    rc = main([
+        "run", "--config", "config1", "--n-inst", "256", "--ticks", "32",
+        "--chunk", "8", "--pipeline-depth", "4", "--log", str(log),
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["pipeline_depth"] == 4
+    assert report["ticks"] == 32
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [e["event"] for e in events][0] == "start"
+    assert any(e.get("pipelined") for e in events if e["event"] == "chunk")
+
+    # Depth must be a positive integer — rejected at arg-validation time.
+    assert main([
+        "run", "--config", "config1", "--n-inst", "64", "--ticks", "8",
+        "--chunk", "8", "--pipeline-depth", "0",
+    ]) == 1
+    capsys.readouterr()
+
+    # --resume refuses an explicit depth (same rule as --record): the
+    # checkpoint cadence was recorded under the serial per-chunk loop.
+    ck = tmp_path / "ck"
+    assert main([
+        "run", "--config", "config1", "--n-inst", "64", "--ticks", "16",
+        "--chunk", "8", "--checkpoint-dir", str(ck),
+    ]) == 0
+    capsys.readouterr()
+    assert main([
+        "run", "--resume", str(ck), "--ticks", "16", "--chunk", "8",
+        "--pipeline-depth", "2",
+    ]) == 1
+    err = capsys.readouterr().err
+    assert "--pipeline-depth" in err and "--resume" in err
