@@ -1,0 +1,12 @@
+"""Static determinism auditor: jaxpr-level PRNG/purity/structure checks.
+
+Everything here runs at TRACE time — no campaign is executed.  The three
+audit layers (``prng_audit``, ``purity``, ``structure``) consume closed
+jaxprs produced by ``trace`` and report :class:`~paxos_tpu.analysis.audit.Finding`
+records; ``audit.run_audit`` orchestrates the full matrix and backs the
+``paxos_tpu audit`` CLI subcommand.
+"""
+
+from paxos_tpu.analysis.audit import AuditReport, Finding, run_audit
+
+__all__ = ["AuditReport", "Finding", "run_audit"]

@@ -1,0 +1,134 @@
+"""Audit orchestrator: trace the matrix, run every check, report findings.
+
+``run_audit`` is the single entry point behind the ``paxos_tpu audit``
+CLI subcommand, scripts/audit.sh, the tier-1 smoke, and tests/test_audit.
+Exit discipline: a clean audit returns a report with zero findings; the
+CLI maps findings to exit code 2 (distinct from crashes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One audit violation — ``message`` must name the offending stream /
+    primitive / leaf / file so the fix needs no re-tracing to locate."""
+
+    check: str  # e.g. "stream-collision", "purity", "structure-golden"
+    where: str  # "protocol/config trace" or "file:line"
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.check}] {self.message}"
+
+
+@dataclasses.dataclass
+class AuditReport:
+    findings: list
+    checks_run: int
+    protocols: tuple
+    configs: tuple
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "ok": self.ok,
+                "checks_run": self.checks_run,
+                "protocols": list(self.protocols),
+                "configs": list(self.configs),
+                "findings": [dataclasses.asdict(f) for f in self.findings],
+            },
+            indent=2,
+        )
+
+    def summary(self) -> str:
+        lines = [
+            f"audit: {self.checks_run} checks over "
+            f"{len(self.protocols)} protocols x {len(self.configs)} configs"
+        ]
+        if self.ok:
+            lines.append("audit: OK (no findings)")
+        else:
+            lines.append(f"audit: {len(self.findings)} finding(s)")
+            lines += [f"  {f}" for f in self.findings]
+        return "\n".join(lines)
+
+
+def run_audit(
+    protocols: Optional[Iterable[str]] = None,
+    configs: Optional[Iterable[str]] = None,
+    structure: bool = False,
+    lint: bool = True,
+) -> AuditReport:
+    """Trace every (protocol, config) cell and run the audit layers.
+
+    ``structure`` additionally runs the default-off leaf checks and the
+    golden treedef/config-fingerprint diffs (default OFF — see
+    :mod:`paxos_tpu.analysis.structure`).  ``lint`` runs the AST pass
+    over the traced packages (once, not per cell).
+    """
+    from paxos_tpu.analysis import prng_audit, purity, structure as struct_mod
+    from paxos_tpu.analysis import trace as trace_mod
+
+    protos = tuple(protocols) if protocols else trace_mod.PROTOCOLS
+    confs = tuple(configs) if configs else tuple(trace_mod.CONFIG_MATRIX)
+    for p in protos:
+        if p not in trace_mod.PROTOCOLS:
+            raise ValueError(f"unknown protocol {p!r}")
+    for c in confs:
+        if c not in trace_mod.CONFIG_MATRIX:
+            raise ValueError(f"unknown audit config {c!r}")
+
+    findings: list = []
+    checks = 0
+    for protocol in protos:
+        traces = {}
+        for config_name in confs:
+            cfg = trace_mod.build_config(protocol, config_name)
+            xla = trace_mod.trace_xla_step(protocol, cfg)
+            ctr = trace_mod.trace_counter_tick(protocol, cfg)
+            plan = trace_mod.trace_plan_sample(cfg)
+            traces[config_name] = (xla, ctr)
+            f = cfg.fault
+            findings += prng_audit.audit_xla_folds(protocol, config_name, xla, f)
+            findings += prng_audit.audit_counter_streams(
+                protocol, config_name, ctr, f
+            )
+            findings += prng_audit.audit_dead_draws(protocol, config_name, xla)
+            findings += prng_audit.audit_plan_folds(
+                protocol, config_name, plan, f
+            )
+            findings += purity.audit_jaxpr_purity(
+                f"{protocol}/{config_name} xla step", xla
+            )
+            findings += purity.audit_jaxpr_purity(
+                f"{protocol}/{config_name} fused tick", ctr
+            )
+            checks += 6
+            if structure:
+                findings += struct_mod.audit_default_off_leaves(
+                    protocol, config_name, cfg
+                )
+                findings += struct_mod.audit_goldens(protocol, config_name, cfg)
+                checks += 2
+        if "default" in traces and "telemetry" in traces:
+            findings += prng_audit.audit_telemetry_parity(
+                protocol,
+                traces["default"][0], traces["telemetry"][0],
+                traces["default"][1], traces["telemetry"][1],
+            )
+            checks += 1
+    if lint:
+        findings += purity.audit_traced_sources()
+        checks += 1
+    return AuditReport(
+        findings=findings, checks_run=checks, protocols=protos, configs=confs
+    )

@@ -1,0 +1,59 @@
+"""Recorded structural goldens for the audit matrix (see structure.py).
+
+Re-record deliberately after an INTENTIONAL state-layout or
+config-default change (``structure.record_goldens`` prints a fresh
+table; ``python -m paxos_tpu audit --structure`` diffs against it) and
+call out the checkpoint/schedule break in the PR description.
+
+Reading the table is itself documentation: gray/corrupt configs share
+the default treedef (gray faults live in the *plan*, not the state),
+while stale and telemetry each add their own leaves.
+"""
+
+# (protocol, config_name) -> sha256[:16] of str(tree_structure(init_state))
+TREEDEF_GOLDENS: dict = {
+    ("paxos", "default"): "9ca86b00e7246200",
+    ("paxos", "gray-chaos"): "9ca86b00e7246200",
+    ("paxos", "corrupt"): "9ca86b00e7246200",
+    ("paxos", "stale"): "2bfb7ddd9a9f5d8f",
+    ("paxos", "telemetry"): "9d5b41ec09f7eab4",
+    ("multipaxos", "default"): "e04bc854b35b2523",
+    ("multipaxos", "gray-chaos"): "e04bc854b35b2523",
+    ("multipaxos", "corrupt"): "e04bc854b35b2523",
+    ("multipaxos", "stale"): "7718aed26d17215b",
+    ("multipaxos", "telemetry"): "c566b8202d265ce7",
+    ("fastpaxos", "default"): "fb315f08a32a08bf",
+    ("fastpaxos", "gray-chaos"): "fb315f08a32a08bf",
+    ("fastpaxos", "corrupt"): "fb315f08a32a08bf",
+    ("fastpaxos", "stale"): "b95ad0ab7eb44998",
+    ("fastpaxos", "telemetry"): "d3013fac26dae0b3",
+    ("raftcore", "default"): "0620776d1e658d16",
+    ("raftcore", "gray-chaos"): "0620776d1e658d16",
+    ("raftcore", "corrupt"): "0620776d1e658d16",
+    ("raftcore", "stale"): "8cb260a60823125a",
+    ("raftcore", "telemetry"): "195f5cdf656377b4",
+}
+
+# (protocol, config_name) -> SimConfig.fingerprint() of the audit config
+CONFIG_GOLDENS: dict = {
+    ("paxos", "default"): "c66870e38738f078",
+    ("paxos", "gray-chaos"): "c5d88efa1593e109",
+    ("paxos", "corrupt"): "5610069aa64745b5",
+    ("paxos", "stale"): "c1d24005bcc4cdd8",
+    ("paxos", "telemetry"): "1e8ea8111735cffe",
+    ("multipaxos", "default"): "1b934c22f736e9bc",
+    ("multipaxos", "gray-chaos"): "3a0d10f31d095527",
+    ("multipaxos", "corrupt"): "3f275ddad81a8896",
+    ("multipaxos", "stale"): "2e64fd633a49c9eb",
+    ("multipaxos", "telemetry"): "bf30a9aa158d482b",
+    ("fastpaxos", "default"): "f0a2ff5f1f64c308",
+    ("fastpaxos", "gray-chaos"): "9c2fe26d8b088798",
+    ("fastpaxos", "corrupt"): "1b4a7bbe877196e5",
+    ("fastpaxos", "stale"): "fa0b8b6c5cc2fd6f",
+    ("fastpaxos", "telemetry"): "f172a2995af2be65",
+    ("raftcore", "default"): "e278086e1936256a",
+    ("raftcore", "gray-chaos"): "68c1f0b05b7f58d2",
+    ("raftcore", "corrupt"): "1a7251d43bd82aa3",
+    ("raftcore", "stale"): "5baa20380323d476",
+    ("raftcore", "telemetry"): "c6fbcef2b33dd732",
+}

@@ -1,0 +1,146 @@
+"""Jaxpr walking utilities shared by the audit layers.
+
+All checks work on *closed* jaxprs from ``jax.make_jaxpr``.  Higher-order
+primitives (pjit, scan, cond, while, ...) carry their bodies as
+``ClosedJaxpr``/``Jaxpr`` values inside ``eqn.params`` — every walker here
+recurses into those, so a draw buried three pjit levels deep is seen
+exactly like a top-level one.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Iterator
+
+import jax
+from jax.interpreters import partial_eval as pe
+
+from paxos_tpu.kernels.counter_prng import stream_salt
+
+Jaxpr = jax.core.Jaxpr
+ClosedJaxpr = jax.core.ClosedJaxpr
+Literal = jax.core.Literal
+
+# Primitives that consume or produce PRNG state.  Matched by prefix so new
+# key-array primitives (random_clone, ...) are conservatively included.
+_PRNG_PREFIXES = ("random_", "threefry")
+
+
+def is_prng_eqn(eqn: Any) -> bool:
+    return eqn.primitive.name.startswith(_PRNG_PREFIXES)
+
+
+def _inner_jaxprs(value: Any) -> Iterator[Jaxpr]:
+    """Yield any jaxprs nested in a single eqn.params value."""
+    if isinstance(value, ClosedJaxpr):
+        yield value.jaxpr
+    elif isinstance(value, Jaxpr):
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _inner_jaxprs(v)
+
+
+def iter_eqns(jaxpr: Jaxpr) -> Iterator[Any]:
+    """Depth-first over every equation, recursing into sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for param in eqn.params.values():
+            for inner in _inner_jaxprs(param):
+                yield from iter_eqns(inner)
+
+
+def literal_ints(eqn: Any) -> list[int]:
+    """Integer values of the eqn's Literal invars (traced invars skipped)."""
+    out = []
+    for v in eqn.invars:
+        if isinstance(v, Literal):
+            try:
+                out.append(int(v.val))
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+def fold_in_constants(jaxpr: Jaxpr) -> Counter:
+    """Multiset of literal fold_in constants reachable from ``jaxpr``.
+
+    Only *literal* fold data counts — ``fold_in(key, tick)`` with a traced
+    tick has no literal invar and is invisible here (by design: the stream
+    registry governs the compile-time constants, not runtime tick values).
+    """
+    consts: Counter = Counter()
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name == "random_fold_in":
+            for c in literal_ints(eqn):
+                consts[c] += 1
+    return consts
+
+
+def split_widths(jaxpr: Jaxpr) -> Counter:
+    """Multiset of ``random_split`` fan-out widths in the trace."""
+    widths: Counter = Counter()
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name == "random_split":
+            shape = eqn.params.get("shape")
+            if shape:
+                widths[int(shape[0])] += 1
+    return widths
+
+
+def counter_salt_streams(jaxpr: Jaxpr, max_stream: int = 64) -> Counter:
+    """Recover counter-PRNG stream ids from a fused-engine trace.
+
+    ``counter_bits(seed, stream, shape)`` emits exactly one ``add`` whose
+    literal operand is ``stream_salt(stream)`` — a 32-bit golden-ratio
+    multiple, far outside the range of shape/index constants, so scanning
+    add-literals against the salt table recovers each draw exactly once
+    with no false positives for stream ids < ``max_stream``.
+    """
+    salt_to_stream = {stream_salt(s): s for s in range(max_stream)}
+    streams: Counter = Counter()
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "add":
+            continue
+        for c in literal_ints(eqn):
+            if c in salt_to_stream:
+                streams[salt_to_stream[c]] += 1
+    return streams
+
+
+def prng_signature(jaxpr: Jaxpr) -> Counter:
+    """Multiset of (primitive, literal fold const or None) PRNG eqns.
+
+    Two traces with equal signatures draw the same streams the same number
+    of times — the comparison behind the telemetry-parity check.
+    """
+    sig: Counter = Counter()
+    for eqn in iter_eqns(jaxpr):
+        if not is_prng_eqn(eqn):
+            continue
+        lits = literal_ints(eqn)
+        sig[(eqn.primitive.name, lits[0] if lits else None)] += 1
+    return sig
+
+
+def dead_prng_draws(closed: ClosedJaxpr) -> list[tuple[str, int | None]]:
+    """PRNG eqns that dead-code elimination removes from ``closed``.
+
+    A draw whose output never reaches an outvar is a schedule landmine:
+    it costs trace/compile time today and silently shifts sibling streams
+    the day someone starts consuming it.  Returns (primitive, fold const)
+    pairs present in the original trace but absent after DCE.
+    """
+    live_jaxpr, _ = pe.dce_jaxpr(
+        closed.jaxpr, [True] * len(closed.jaxpr.outvars)
+    )
+    before = prng_signature(closed.jaxpr)
+    after = prng_signature(live_jaxpr)
+    dead = before - after
+    return sorted(dead.elements(), key=lambda t: (t[0], t[1] is None, t[1]))
+
+
+def has_prng_eqns(jaxpr: Jaxpr) -> list[str]:
+    """Names of any jax.random machinery primitives present (fused-engine
+    traces must return [] — counter streams never touch key arrays)."""
+    return sorted({e.primitive.name for e in iter_eqns(jaxpr) if is_prng_eqn(e)})

@@ -1,0 +1,277 @@
+"""PRNG stream auditor: traced draws vs the core.streams registry.
+
+Checks (per protocol x config trace):
+
+- every counter-PRNG stream id recovered from a fused-tick trace is
+  registered for the protocol's family, and drawn at most once per tick
+  (a second draw = stream reuse = correlated masks);
+- every literal ``fold_in`` constant in an XLA-step trace is a registered
+  tick fold, and in a plan trace a registered plan fold;
+- gray streams/folds appear ONLY when their knob is on, and never in a
+  default-config trace (the default-off-is-free contract, stream half);
+- exactly one family-width ``random_split`` per step, and nothing splits
+  wider (a wider split would silently renumber every pre-gray stream);
+- DCE removes no PRNG eqn (a dead draw shifts sibling streams the day it
+  gains a consumer — the bug class this auditor was built after);
+- fused-engine traces contain zero ``jax.random`` machinery;
+- telemetry-on traces draw the exact same streams as default (telemetry
+  consumes no randomness).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from paxos_tpu.analysis import jaxpr_tools as jt
+from paxos_tpu.analysis.audit import Finding
+from paxos_tpu.core import streams as streams_mod
+from paxos_tpu.faults.injector import FaultConfig, links_dup
+
+
+def _allowed_gray_tick_names(cfg: FaultConfig) -> set:
+    """Tick-domain gray draws whose knobs are ON for this config."""
+    names = set()
+    if cfg.p_flaky > 0.0:
+        names.add("LINK_BITS")
+    if links_dup(cfg):
+        names.add("DUP_BITS")
+    if cfg.p_corrupt > 0.0:
+        names.add("CORRUPT")
+    return names
+
+
+def expected_plan_folds(cfg: FaultConfig) -> set:
+    """Exact PLAN_FOLDS constants a plan trace must contain for ``cfg``."""
+    names = set()
+    if cfg.p_asym > 0.0:
+        names |= {"PART_DIR", "CUT_REQ"}
+    if cfg.p_flaky > 0.0:
+        names |= {"FLAKY", "FLAKY_DROP"}
+        if links_dup(cfg):
+            names.add("FLAKY_DUP")
+    if cfg.timeout_skew > 0:
+        names.add("PTIMEOUT")
+    if cfg.backoff_skew > 1:
+        names.add("PBOFF")
+    return {streams_mod.PLAN_FOLDS[n] for n in names}
+
+
+def audit_counter_streams(
+    protocol: str, config_name: str, closed, cfg: FaultConfig
+) -> list:
+    """Audit a fused-tick trace's counter-PRNG stream ids."""
+    findings = []
+    where = f"{protocol}/{config_name} fused tick"
+    family = streams_mod.family_of(protocol)
+    registered = set(family.streams.values())
+    streams = jt.counter_salt_streams(closed.jaxpr)
+    allowed_gray = {
+        family.streams[n]
+        for n in _allowed_gray_tick_names(cfg)
+        if n in family.streams
+    }
+    for sid, count in sorted(streams.items()):
+        if sid not in registered:
+            findings.append(Finding(
+                check="stream-registry", where=where,
+                message=(
+                    f"unregistered counter stream {sid} drawn in {where}: "
+                    f"not in core.streams.{family.name} "
+                    f"(registered: {sorted(registered)})"
+                ),
+            ))
+            continue
+        name = family.by_id()[sid]
+        if count > 1:
+            findings.append(Finding(
+                check="stream-collision", where=where,
+                message=(
+                    f"counter stream {sid} ({family.name}.{name}) drawn "
+                    f"{count}x in one tick in {where}: stream reuse makes "
+                    f"the draws bit-identical (correlated masks)"
+                ),
+            ))
+        if sid in family.gray_ids() and sid not in allowed_gray:
+            findings.append(Finding(
+                check="gray-gating", where=where,
+                message=(
+                    f"gray stream {sid} ({family.name}.{name}) drawn in "
+                    f"{where} although its fault knob is off: gray draws "
+                    f"must trace away when disabled (default-off-is-free)"
+                ),
+            ))
+    # The fused engine must never touch jax.random machinery: key-array
+    # primitives have no Mosaic lowering and would fork the schedule from
+    # the reference replay.
+    rnd = jt.has_prng_eqns(closed.jaxpr)
+    if rnd:
+        findings.append(Finding(
+            check="counter-engine-purity", where=where,
+            message=(
+                f"jax.random primitives {rnd} inside {where}: the fused "
+                f"engine draws only from kernels.counter_prng"
+            ),
+        ))
+    return findings
+
+
+def audit_xla_folds(
+    protocol: str, config_name: str, closed, cfg: FaultConfig
+) -> list:
+    """Audit an XLA-step trace's fold_in constants and split widths."""
+    findings = []
+    where = f"{protocol}/{config_name} xla step"
+    family = streams_mod.family_of(protocol)
+    tick_by_const = {v: k for k, v in streams_mod.TICK_FOLDS.items()}
+    allowed = {
+        streams_mod.TICK_FOLDS[n] for n in _allowed_gray_tick_names(cfg)
+    }
+    for const, count in sorted(jt.fold_in_constants(closed.jaxpr).items()):
+        if const not in tick_by_const:
+            findings.append(Finding(
+                check="fold-registry", where=where,
+                message=(
+                    f"unregistered fold_in constant {const} in {where}: "
+                    f"tick-domain folds must come from "
+                    f"core.streams.TICK_FOLDS "
+                    f"({sorted(streams_mod.TICK_FOLDS.values())})"
+                ),
+            ))
+            continue
+        name = tick_by_const[const]
+        if count > 1:
+            findings.append(Finding(
+                check="fold-collision", where=where,
+                message=(
+                    f"fold_in({const}) (TICK_FOLDS.{name}) appears {count}x "
+                    f"in {where}: duplicate folds yield identical keys"
+                ),
+            ))
+        if const not in allowed:
+            findings.append(Finding(
+                check="gray-gating", where=where,
+                message=(
+                    f"gray fold_in({const}) (TICK_FOLDS.{name}) traced in "
+                    f"{where} although its fault knob is off"
+                ),
+            ))
+    widths = jt.split_widths(closed.jaxpr)
+    fam_width = family.gray_base
+    if widths.get(fam_width, 0) != 1:
+        findings.append(Finding(
+            check="split-width", where=where,
+            message=(
+                f"expected exactly one {fam_width}-way random_split "
+                f"(the {family.name} protocol-stream split) in {where}, "
+                f"saw widths {dict(sorted(widths.items()))}"
+            ),
+        ))
+    for w in widths:
+        if w > fam_width:
+            findings.append(Finding(
+                check="split-width", where=where,
+                message=(
+                    f"{w}-way random_split in {where} exceeds the "
+                    f"{family.name} family width {fam_width}: widening the "
+                    f"split renumbers every pre-gray stream"
+                ),
+            ))
+    return findings
+
+
+def audit_dead_draws(protocol: str, config_name: str, closed) -> list:
+    """Flag PRNG eqns that dead-code elimination removes."""
+    findings = []
+    where = f"{protocol}/{config_name} xla step"
+    for prim, const in jt.dead_prng_draws(closed):
+        detail = f"{prim}({const})" if const is not None else prim
+        findings.append(Finding(
+            check="dead-draw", where=where,
+            message=(
+                f"dead PRNG eqn {detail} in {where}: its output is unused, "
+                f"so it can be deleted today but silently shifts sibling "
+                f"streams the day someone consumes it — gate it on its "
+                f"knob instead"
+            ),
+        ))
+    return findings
+
+
+def audit_plan_folds(protocol: str, config_name: str, closed, cfg) -> list:
+    """Audit a plan-sample trace: exact registered fold set for the knobs."""
+    findings = []
+    where = f"{protocol}/{config_name} plan sample"
+    plan_by_const = {v: k for k, v in streams_mod.PLAN_FOLDS.items()}
+    seen = jt.fold_in_constants(closed.jaxpr)
+    expected = expected_plan_folds(cfg)
+    for const, count in sorted(seen.items()):
+        if const not in plan_by_const:
+            findings.append(Finding(
+                check="fold-registry", where=where,
+                message=(
+                    f"unregistered fold_in constant {const} in {where}: "
+                    f"plan-domain folds must come from "
+                    f"core.streams.PLAN_FOLDS "
+                    f"({sorted(streams_mod.PLAN_FOLDS.values())})"
+                ),
+            ))
+        elif count > 1:
+            findings.append(Finding(
+                check="fold-collision", where=where,
+                message=(
+                    f"fold_in({const}) (PLAN_FOLDS.{plan_by_const[const]}) "
+                    f"appears {count}x in {where}"
+                ),
+            ))
+    missing = expected - set(seen)
+    extra = {c for c in seen if c in plan_by_const} - expected
+    if missing:
+        names = sorted(plan_by_const[c] for c in missing)
+        findings.append(Finding(
+            check="plan-folds", where=where,
+            message=(
+                f"plan trace in {where} is missing expected gray folds "
+                f"{names} for the enabled knobs"
+            ),
+        ))
+    if extra:
+        names = sorted(plan_by_const[c] for c in extra)
+        findings.append(Finding(
+            check="gray-gating", where=where,
+            message=(
+                f"plan trace in {where} draws gray folds {names} although "
+                f"their knobs are off (default-off-is-free)"
+            ),
+        ))
+    return findings
+
+
+def audit_telemetry_parity(
+    protocol: str, default_xla, telem_xla, default_ctr, telem_ctr
+) -> list:
+    """Telemetry must consume no randomness: identical PRNG signatures."""
+    findings = []
+    sig_d = jt.prng_signature(default_xla.jaxpr)
+    sig_t = jt.prng_signature(telem_xla.jaxpr)
+    if sig_d != sig_t:
+        delta = (sig_t - sig_d) + (sig_d - sig_t)
+        findings.append(Finding(
+            check="telemetry-parity", where=f"{protocol} xla step",
+            message=(
+                f"telemetry-on xla trace for {protocol} changes the PRNG "
+                f"eqn multiset (diff: {dict(delta)}): telemetry must draw "
+                f"no randomness"
+            ),
+        ))
+    str_d = jt.counter_salt_streams(default_ctr.jaxpr)
+    str_t = jt.counter_salt_streams(telem_ctr.jaxpr)
+    if str_d != str_t:
+        delta = (str_t - str_d) + (str_d - str_t)
+        findings.append(Finding(
+            check="telemetry-parity", where=f"{protocol} fused tick",
+            message=(
+                f"telemetry-on fused trace for {protocol} changes the "
+                f"counter-stream multiset (diff: {dict(delta)})"
+            ),
+        ))
+    return findings

@@ -1,0 +1,172 @@
+"""Purity / determinism lint: jaxpr layer + AST layer.
+
+Jaxpr layer: a traced protocol program must be a pure array function —
+no host callbacks (results depend on host scheduling), no effects, no
+XLA-nondeterministic primitives, no data-dependent output shapes.
+
+AST layer: the traced packages must not even *import* host entropy or
+wall-clock facilities (``np.random``, ``random``, ``secrets``, ``time``,
+``os.urandom``).  Tracing would catch a call on the traced path, but the
+AST pass also catches module-level and conditional uses that a single
+trace misses.  Host-side packages (harness, cpu_ref) are exempt: they
+legitimately time campaigns and talk to the OS.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from paxos_tpu.analysis import jaxpr_tools as jt
+from paxos_tpu.analysis.audit import Finding
+
+# Primitives whose results depend on the host or are documented as
+# nondeterministic on XLA.  ``rng_uniform`` is XLA's stateful RNG op —
+# explicitly not reproducible across backends.
+DISALLOWED_PRIMITIVES = frozenset({
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+    "outside_call",
+    "infeed",
+    "outfeed",
+    "rng_uniform",
+})
+
+# Packages whose modules end up inside traced programs.  harness/ and
+# cpu_ref/ are host-side by design and excluded.
+TRACED_PACKAGES = (
+    "protocols", "core", "faults", "kernels", "transport", "check",
+    "utils", "parallel",
+)
+
+_BANNED_MODULES = {
+    "random": "stdlib random (host entropy)",
+    "secrets": "secrets (host entropy)",
+    "time": "wall clock",
+}
+# numpy aliases resolved per-module; `<alias>.random` attribute is banned.
+_NUMPY_NAMES = {"numpy"}
+
+
+def audit_jaxpr_purity(where: str, closed) -> list:
+    """Lint one closed jaxpr for host traffic / nondeterminism."""
+    findings = []
+    for eqn in jt.iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        if name in DISALLOWED_PRIMITIVES:
+            findings.append(Finding(
+                check="purity", where=where,
+                message=(
+                    f"disallowed primitive '{name}' in {where}: traced "
+                    f"protocol programs must not call back to the host or "
+                    f"use nondeterministic XLA ops"
+                ),
+            ))
+    effects = closed.jaxpr.effects
+    if effects:
+        findings.append(Finding(
+            check="purity", where=where,
+            message=(
+                f"traced program in {where} carries JAX effects "
+                f"{sorted(str(e) for e in effects)}: step functions must "
+                f"be effect-free"
+            ),
+        ))
+    for i, var in enumerate(closed.jaxpr.outvars):
+        shape = getattr(var.aval, "shape", ())
+        if not all(isinstance(d, int) for d in shape):
+            findings.append(Finding(
+                check="purity", where=where,
+                message=(
+                    f"output {i} of {where} has data-dependent shape "
+                    f"{shape}: dynamic shapes break the fixed-layout "
+                    f"scan/checkpoint contract"
+                ),
+            ))
+    return findings
+
+
+class _HostEntropyVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list = []
+        self._numpy_aliases: set = set()
+        self._os_aliases: set = set()
+
+    def _flag(self, node: ast.AST, what: str):
+        self.findings.append(Finding(
+            check="ast-lint", where=f"{self.path}:{node.lineno}",
+            message=(
+                f"{what} at {self.path}:{node.lineno}: traced modules "
+                f"must draw randomness only from jax.random or "
+                f"kernels.counter_prng, and never read the host clock"
+            ),
+        ))
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            root = alias.name.split(".")[0]
+            if root in _BANNED_MODULES:
+                self._flag(node, f"import of {alias.name} "
+                                 f"({_BANNED_MODULES[root]})")
+            if alias.name in _NUMPY_NAMES:
+                self._numpy_aliases.add(alias.asname or alias.name)
+            if alias.name == "numpy.random":
+                self._flag(node, "import of numpy.random (host-seeded RNG)")
+            if alias.name == "os":
+                self._os_aliases.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        mod = (node.module or "").split(".")[0]
+        if mod in _BANNED_MODULES:
+            self._flag(node, f"import from {node.module} "
+                             f"({_BANNED_MODULES[mod]})")
+        if node.module == "numpy" and any(
+            a.name == "random" for a in node.names
+        ):
+            self._flag(node, "import of numpy.random (host-seeded RNG)")
+        if node.module == "os" and any(
+            a.name == "urandom" for a in node.names
+        ):
+            self._flag(node, "import of os.urandom (host entropy)")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        base = node.value
+        if isinstance(base, ast.Name):
+            if base.id in self._numpy_aliases and node.attr == "random":
+                self._flag(node, f"use of {base.id}.random (host-seeded RNG)")
+            if base.id in self._os_aliases and node.attr == "urandom":
+                self._flag(node, f"use of {base.id}.urandom (host entropy)")
+        self.generic_visit(node)
+
+
+def lint_file(path: Path, repo_relative: str | None = None) -> list:
+    """AST-lint one python file; returns findings (empty = clean)."""
+    rel = repo_relative or str(path)
+    try:
+        tree = ast.parse(path.read_text(), filename=rel)
+    except SyntaxError as e:
+        return [Finding(
+            check="ast-lint", where=f"{rel}:{e.lineno}",
+            message=f"syntax error while linting {rel}:{e.lineno}: {e.msg}",
+        )]
+    visitor = _HostEntropyVisitor(rel)
+    visitor.visit(tree)
+    return visitor.findings
+
+
+def audit_traced_sources(package_root: Path | None = None) -> list:
+    """AST-lint every module of every traced package."""
+    root = package_root or Path(__file__).resolve().parent.parent
+    findings = []
+    for pkg in TRACED_PACKAGES:
+        pkg_dir = root / pkg
+        if not pkg_dir.is_dir():
+            continue
+        for path in sorted(pkg_dir.rglob("*.py")):
+            rel = str(path.relative_to(root.parent))
+            findings.extend(lint_file(path, rel))
+    return findings

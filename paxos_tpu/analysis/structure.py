@@ -1,0 +1,193 @@
+"""Structural verifier: default-off leaves prune, treedefs match goldens.
+
+The default-off-is-free contract has a structural half the PRNG audit
+can't see: a disabled knob must leave its state/plan leaves as ``None``
+(pruned from the pytree, zero bytes on device), and the *shape of the
+pytree itself* for the default config must not drift between sessions —
+a new always-on leaf is a silent per-lane memory tax and invalidates
+checkpoints.  Goldens for treedef fingerprints and config fingerprints
+live in :mod:`paxos_tpu.analysis.goldens`.
+
+Default OFF in the audit CLI (``--structure`` enables): golden diffs are
+a release gate, not an every-trace invariant, and intentionally fail
+when a PR deliberately adds a state leaf (then: re-record via
+``python -m paxos_tpu audit --structure --record-goldens``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Callable
+
+import jax
+
+from paxos_tpu.analysis import goldens
+from paxos_tpu.analysis.audit import Finding
+from paxos_tpu.harness.config import SimConfig
+from paxos_tpu.harness.run import init_plan, init_state
+
+# Leaves that exist only when their knob is on; field-name prefix match,
+# applied recursively over the state dataclass tree.
+_KNOB_LEAVES = (
+    # (field predicate, knob predicate, knob description)
+    (
+        lambda name: name == "telemetry",
+        lambda cfg: cfg.telemetry.enabled(),
+        "telemetry disabled",
+    ),
+    (
+        lambda name: name.startswith("snap_"),
+        lambda cfg: cfg.fault.stale_k > 0,
+        "stale_k == 0",
+    ),
+)
+
+_PLAN_GRAY_FIELDS = ("part_dir", "link_drop", "link_dup", "ptimeout", "pboff")
+
+
+def treedef_fingerprint(tree) -> str:
+    """Shape-independent pytree-structure digest (leaf *placement*, not
+    leaf values: ``None`` vs array is visible, 64 vs 1M lanes is not)."""
+    s = str(jax.tree_util.tree_structure(tree))
+    return hashlib.sha256(s.encode()).hexdigest()[:16]
+
+
+def _walk_dataclass_fields(obj, prefix: str = ""):
+    """Yield (dotted_name, value) for every dataclass field, recursively."""
+    if not dataclasses.is_dataclass(obj):
+        return
+    for f in dataclasses.fields(obj):
+        value = getattr(obj, f.name)
+        name = f"{prefix}{f.name}"
+        yield name, value
+        if dataclasses.is_dataclass(value):
+            yield from _walk_dataclass_fields(value, prefix=f"{name}.")
+
+
+def audit_default_off_leaves(
+    protocol: str,
+    config_name: str,
+    cfg: SimConfig,
+    state_builder: Callable = init_state,
+    plan_builder: Callable = init_plan,
+) -> list:
+    """Knob-off leaves must be None; knob-on leaves must be populated."""
+    findings = []
+    where = f"{protocol}/{config_name}"
+    state = state_builder(cfg)
+    for name, value in _walk_dataclass_fields(state):
+        for field_pred, knob_pred, off_reason in _KNOB_LEAVES:
+            leaf = name.rsplit(".", 1)[-1]
+            if not field_pred(leaf):
+                continue
+            if knob_pred(cfg) and value is None:
+                findings.append(Finding(
+                    check="structure", where=where,
+                    message=(
+                        f"state leaf '{name}' is None in {where} although "
+                        f"its knob is ON: the feature silently no-ops"
+                    ),
+                ))
+            elif not knob_pred(cfg) and value is not None:
+                findings.append(Finding(
+                    check="structure", where=where,
+                    message=(
+                        f"state leaf '{name}' is allocated in {where} "
+                        f"although {off_reason}: default-off leaves must "
+                        f"prune to None (zero bytes, unchanged treedef)"
+                    ),
+                ))
+    plan = plan_builder(cfg)
+    fault = cfg.fault
+    expect_on = {
+        "part_dir": fault.p_asym > 0.0,
+        "link_drop": fault.p_flaky > 0.0,
+        "link_dup": fault.p_flaky > 0.0
+        and (fault.p_dup > 0.0 or fault.flaky_dup > 0.0),
+        "ptimeout": fault.timeout_skew > 0,
+        "pboff": fault.backoff_skew > 1,
+    }
+    for field in _PLAN_GRAY_FIELDS:
+        value = getattr(plan, field)
+        if expect_on[field] and value is None:
+            findings.append(Finding(
+                check="structure", where=where,
+                message=(
+                    f"FaultPlan.{field} is None in {where} although its "
+                    f"gray knob is ON"
+                ),
+            ))
+        elif not expect_on[field] and value is not None:
+            findings.append(Finding(
+                check="structure", where=where,
+                message=(
+                    f"FaultPlan.{field} is allocated in {where} although "
+                    f"its gray knob is off: plan gray fields must prune "
+                    f"to None"
+                ),
+            ))
+    return findings
+
+
+def audit_goldens(
+    protocol: str,
+    config_name: str,
+    cfg: SimConfig,
+    state_builder: Callable = init_state,
+) -> list:
+    """Diff treedef + config fingerprints against the recorded goldens."""
+    findings = []
+    where = f"{protocol}/{config_name}"
+    key = (protocol, config_name)
+    got_tree = treedef_fingerprint(state_builder(cfg))
+    want_tree = goldens.TREEDEF_GOLDENS.get(key)
+    if want_tree is None:
+        findings.append(Finding(
+            check="structure-golden", where=where,
+            message=(
+                f"no treedef golden recorded for {where}: run "
+                f"`python -m paxos_tpu audit --structure --record-goldens`"
+            ),
+        ))
+    elif got_tree != want_tree:
+        findings.append(Finding(
+            check="structure-golden", where=where,
+            message=(
+                f"state treedef for {where} drifted: {got_tree} != golden "
+                f"{want_tree} — a leaf was added/removed/reordered; if "
+                f"intentional, re-record goldens and call out the "
+                f"checkpoint break in the PR"
+            ),
+        ))
+    got_cfg = cfg.fingerprint()
+    want_cfg = goldens.CONFIG_GOLDENS.get(key)
+    if want_cfg is None:
+        findings.append(Finding(
+            check="structure-golden", where=where,
+            message=f"no config-fingerprint golden recorded for {where}",
+        ))
+    elif got_cfg != want_cfg:
+        findings.append(Finding(
+            check="structure-golden", where=where,
+            message=(
+                f"config fingerprint for {where} drifted: {got_cfg} != "
+                f"golden {want_cfg} — a SimConfig/FaultConfig default "
+                f"changed, which re-seeds every recorded campaign"
+            ),
+        ))
+    return findings
+
+
+def record_goldens(matrix) -> dict:
+    """Compute fresh goldens for ``matrix`` = [(protocol, config_name, cfg)].
+
+    Returns ``{"treedef": {...}, "config": {...}}`` with stringified keys,
+    ready to paste into :mod:`paxos_tpu.analysis.goldens`.
+    """
+    tree, conf = {}, {}
+    for protocol, config_name, cfg in matrix:
+        key = (protocol, config_name)
+        tree[key] = treedef_fingerprint(init_state(cfg))
+        conf[key] = cfg.fingerprint()
+    return {"treedef": tree, "config": conf}

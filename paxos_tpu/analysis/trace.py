@@ -1,0 +1,117 @@
+"""Trace protocol programs to closed jaxprs across the audit config matrix.
+
+Sizes are deliberately tiny (tracing cost only — nothing executes) but the
+*knob* combinations mirror the real evaluation configs: stream topology
+depends on fault/telemetry knobs, never on lane count, so a 64-lane trace
+proves the same stream discipline as a 1M-lane campaign.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.core.telemetry import TelemetryConfig
+from paxos_tpu.faults.injector import FaultPlan
+from paxos_tpu.harness.config import (
+    SimConfig,
+    config_corrupt,
+    config_gray_chaos,
+    config_stale,
+)
+from paxos_tpu.harness.run import base_key, get_step_fn, init_plan, init_state
+from paxos_tpu.kernels.counter_prng import mix
+from paxos_tpu.kernels.fused_tick import fused_fns
+
+PROTOCOLS = ("paxos", "multipaxos", "fastpaxos", "raftcore")
+
+_AUDIT_N_INST = 64
+_AUDIT_SEED = 3
+
+
+def _small(cfg: SimConfig, protocol: str) -> SimConfig:
+    return dataclasses.replace(
+        cfg, protocol=protocol, n_inst=_AUDIT_N_INST, seed=_AUDIT_SEED
+    )
+
+
+def _default(protocol: str) -> SimConfig:
+    return _small(SimConfig(), protocol)
+
+
+def _gray(protocol: str) -> SimConfig:
+    return _small(config_gray_chaos(), protocol)
+
+
+def _corrupt(protocol: str) -> SimConfig:
+    return _small(config_corrupt(), protocol)
+
+
+def _stale(protocol: str) -> SimConfig:
+    return _small(config_stale(), protocol)
+
+
+def _telemetry(protocol: str) -> SimConfig:
+    return dataclasses.replace(
+        _default(protocol),
+        telemetry=TelemetryConfig(counters=True, ring_depth=4, hist_bins=8),
+    )
+
+
+CONFIG_MATRIX: dict[str, Callable[[str], SimConfig]] = {
+    "default": _default,
+    "gray-chaos": _gray,
+    "corrupt": _corrupt,
+    "stale": _stale,
+    "telemetry": _telemetry,
+}
+
+
+def build_config(protocol: str, config_name: str) -> SimConfig:
+    return CONFIG_MATRIX[config_name](protocol)
+
+
+def trace_xla_step(protocol: str, cfg: SimConfig):
+    """Closed jaxpr of one XLA-engine protocol step (state, key, plan free)."""
+    step = get_step_fn(protocol)
+    state = init_state(cfg)
+    plan = init_plan(cfg)
+
+    def body(st, key, pl):
+        return step(st, key, pl, cfg.fault)
+
+    return jax.make_jaxpr(body)(state, base_key(cfg), plan)
+
+
+def trace_counter_tick(protocol: str, cfg: SimConfig):
+    """Closed jaxpr of one fused-engine tick body (reference schedule).
+
+    Mirrors ``kernels.fused_tick.reference_chunk``'s loop body exactly:
+    per-tick seed from ``mix(seed, tick, block)``, then the protocol's
+    counter-PRNG mask sampler + transition.  This is the same program the
+    Pallas kernel lowers, so the stream ids recovered here are the fused
+    engine's stream ids.
+    """
+    apply_fn, mask_fn, _ = fused_fns(protocol)
+    state = init_state(cfg)
+    plan = init_plan(cfg)
+
+    def body(st, seed, pl):
+        tick_seed = mix(seed, st.tick, jnp.int32(0))
+        return apply_fn(st, mask_fn(cfg.fault, tick_seed, st), pl, cfg.fault)
+
+    return jax.make_jaxpr(body)(state, jnp.int32(cfg.seed), plan)
+
+
+def trace_plan_sample(cfg: SimConfig):
+    """Closed jaxpr of the fault-plan sampler (the harness's plan domain)."""
+
+    def body(key):
+        return FaultPlan.sample(
+            key, cfg.fault, cfg.n_inst, cfg.n_acc, cfg.n_prop
+        )
+
+    return jax.make_jaxpr(body)(jax.random.PRNGKey(0))

@@ -1,0 +1,261 @@
+"""Central PRNG stream registry — every stream id and fold_in constant.
+
+Determinism in this codebase rests on stream *discipline*: the XLA engine
+derives every tick's randomness from a fixed-width ``jax.random.split`` plus
+gray-only ``fold_in`` constants, and the fused engines index the counter
+PRNG (``kernels/counter_prng``) by small integer stream ids.  PR 1's
+contract — gray-failure draws live on streams disjoint from the pre-gray
+protocol draws, so default-config schedules stay bit-identical — was
+enforced only by comments and golden digests.  This module makes the
+allocation itself a checked artifact:
+
+- **Counter stream families** (:class:`StreamFamily`): the single-decree
+  family (paxos / fastpaxos / raftcore share one mask sampler) and the
+  multipaxos family each map mask names to counter-PRNG stream ids, with a
+  ``gray_base`` splitting protocol streams (below) from gray streams (at or
+  above).  ``validate()`` rejects collisions and range breaches at import.
+- **fold_in domains**: the root domain (``PRNGKey(seed)`` → step/plan
+  keys), the tick domain (gray draws inside ``sample_masks``), and the plan
+  domain (gray fields of ``FaultPlan.sample``).  Constants in different
+  domains fold different keys, so equal values across domains are fine;
+  within a domain each constant is unique and gray constants sit at or
+  above :data:`GRAY_FOLD_BASE`.
+
+The jaxpr-level auditor (``paxos_tpu/analysis``) recovers every
+``fold_in``/``random_bits``/counter-stream draw from traced step functions
+and checks them against THIS registry — an unregistered constant, a
+collision, or a gray draw in a default-config trace fails the audit
+(``paxos_tpu audit``; tests/test_audit.py).
+
+Numbering is historical and frozen: the multipaxos family's ``BACKOFF``
+stream is 10 (it predates the gray layer), so that family's gray streams
+start at 11 while the single-decree family's start at 10.  Renumbering
+would silently change every recorded schedule digest — the registry
+records reality; the auditor keeps reality consistent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping
+
+import jax
+
+__all__ = [
+    "StreamFamily",
+    "SINGLE_DECREE",
+    "MULTI_PAXOS",
+    "FAMILIES",
+    "family_of",
+    "ROOT_STEP",
+    "ROOT_PLAN",
+    "GRAY_FOLD_BASE",
+    "TICK_FOLDS",
+    "PLAN_FOLDS",
+    "tick_key",
+    "root_step_key",
+    "root_plan_key",
+    "tick_fold",
+    "plan_fold",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamFamily:
+    """One counter-PRNG stream allocation (one mask-sampler lineage).
+
+    ``streams`` maps mask names to ``kernels/counter_prng`` stream ids;
+    ``gray`` names the streams drawn only when a gray-failure knob is on.
+    Invariant (checked by :meth:`validate`): protocol streams are all
+    ``< gray_base`` and gray streams all ``>= gray_base``, so a
+    default-config trace containing any stream ``>= gray_base`` is a
+    determinism bug by construction.
+    """
+
+    name: str
+    streams: Mapping[str, int]
+    gray: frozenset
+    gray_base: int
+
+    def validate(self) -> None:
+        ids = list(self.streams.values())
+        if len(ids) != len(set(ids)):
+            dup = {
+                i: sorted(n for n, v in self.streams.items() if v == i)
+                for i in set(ids)
+                if ids.count(i) > 1
+            }
+            raise ValueError(
+                f"stream family {self.name!r}: duplicate stream ids {dup}"
+            )
+        unknown = self.gray - set(self.streams)
+        if unknown:
+            raise ValueError(
+                f"stream family {self.name!r}: gray names {sorted(unknown)} "
+                "not in the stream table"
+            )
+        for mask, sid in self.streams.items():
+            if sid < 0:
+                raise ValueError(
+                    f"stream family {self.name!r}: negative id {mask}={sid}"
+                )
+            if mask in self.gray and sid < self.gray_base:
+                raise ValueError(
+                    f"stream family {self.name!r}: gray stream {mask}={sid} "
+                    f"below gray_base={self.gray_base}"
+                )
+            if mask not in self.gray and sid >= self.gray_base:
+                raise ValueError(
+                    f"stream family {self.name!r}: protocol stream "
+                    f"{mask}={sid} at or above gray_base={self.gray_base}"
+                )
+
+    def by_id(self) -> dict:
+        """id -> mask name (validated: injective)."""
+        return {sid: mask for mask, sid in self.streams.items()}
+
+    def gray_ids(self) -> frozenset:
+        return frozenset(self.streams[m] for m in self.gray)
+
+
+# The single-decree family: paxos, fastpaxos and raftcore all draw their
+# masks through protocols.paxos.sample_masks / counter_masks (identical
+# shapes), so they share one allocation.
+SINGLE_DECREE = StreamFamily(
+    name="single-decree",
+    streams=dict(
+        SEL=0,  # request-selection entropy
+        BUSY=1,  # acceptor idling (p_idle)
+        DELIVER=2,  # reply holding (p_hold)
+        DUP_REQ=3,  # request duplication (p_dup, uniform)
+        DUP_REP=4,  # reply duplication (p_dup, uniform)
+        KEEP_PROM=5,  # PROMISE-class drop (p_drop, uniform)
+        KEEP_ACCD=6,  # ACCEPTED-class drop
+        KEEP_P1=7,  # PREPARE-class drop
+        KEEP_P2=8,  # ACCEPT-class drop
+        BACKOFF=9,  # proposer retry backoff
+        LINK_BITS=10,  # per-link loss raw bits (p_flaky)
+        DUP_BITS=11,  # per-link duplication raw bits (p_flaky + dup)
+        CORRUPT=12,  # in-flight corruption mask (p_corrupt)
+    ),
+    gray=frozenset({"LINK_BITS", "DUP_BITS", "CORRUPT"}),
+    gray_base=10,
+)
+
+# The multipaxos family: BACKOFF landed on 10 before the gray layer
+# existed, so gray streams start at 11 (frozen by the PR 1/PR 3 golden
+# digests — see the module docstring).
+MULTI_PAXOS = StreamFamily(
+    name="multipaxos",
+    streams=dict(
+        SEL=0,
+        BUSY=1,
+        DUP_REQ=2,
+        PROM_DELIVER=3,  # promise holding (p_hold)
+        ACCD_DELIVER=4,  # accepted holding (p_hold)
+        KEEP_PROM=5,
+        KEEP_ACCD=6,
+        KEEP_PREP=7,
+        KEEP_ACC=8,
+        JITTER=9,  # election-threshold jitter
+        BACKOFF=10,  # post-failure retreat
+        LINK_BITS=11,
+        DUP_BITS=12,
+        CORRUPT=13,
+    ),
+    gray=frozenset({"LINK_BITS", "DUP_BITS", "CORRUPT"}),
+    gray_base=11,
+)
+
+FAMILIES = {f.name: f for f in (SINGLE_DECREE, MULTI_PAXOS)}
+
+_FAMILY_OF_PROTOCOL = {
+    "paxos": SINGLE_DECREE,
+    "fastpaxos": SINGLE_DECREE,
+    "raftcore": SINGLE_DECREE,
+    "multipaxos": MULTI_PAXOS,
+}
+
+
+def family_of(protocol: str) -> StreamFamily:
+    """The counter-stream family a protocol's mask sampler draws from."""
+    try:
+        return _FAMILY_OF_PROTOCOL[protocol]
+    except KeyError:
+        raise ValueError(f"unknown protocol: {protocol!r}") from None
+
+
+# --- fold_in domains (XLA engine, jax.random keys) ---
+
+# Root domain: fold_in(PRNGKey(seed), c) — the two top-level lineages.
+ROOT_STEP = 0  # per-tick mask stream (harness.run.base_key)
+ROOT_PLAN = 1  # fault-plan sampling (harness.run.init_plan)
+
+# Gray fold_in constants sit at or above this in the tick and plan domains,
+# keeping them visibly disjoint from the split-derived pre-gray draws.
+GRAY_FOLD_BASE = 100
+
+# Tick domain: fold_in(tick_key, c) inside sample_masks — gray draws only
+# (the pre-gray draws come from the fixed-width split, never fold_in).
+TICK_FOLDS = dict(
+    LINK_BITS=100,  # per-link loss raw bits (p_flaky)
+    DUP_BITS=101,  # per-link duplication raw bits
+    CORRUPT=102,  # in-flight corruption mask (p_corrupt)
+)
+
+# Plan domain: fold_in(plan_key, c) inside FaultPlan.sample — gray fields
+# only (pre-gray plan draws come from the 5-way split).
+PLAN_FOLDS = dict(
+    PART_DIR=101,  # one-way cut? (p_asym)
+    CUT_REQ=102,  # which direction a one-way cut blocks
+    FLAKY=103,  # which links are flaky (p_flaky)
+    FLAKY_DROP=104,  # per-flaky-link drop rate
+    FLAKY_DUP=105,  # per-flaky-link dup rate
+    PTIMEOUT=106,  # per-proposer timeout skew (timeout_skew)
+    PBOFF=107,  # per-proposer backoff multiplier (backoff_skew)
+)
+
+
+def _validate_folds(domain_name: str, folds: Mapping[str, int]) -> None:
+    vals = list(folds.values())
+    if len(vals) != len(set(vals)):
+        dup = sorted(v for v in set(vals) if vals.count(v) > 1)
+        raise ValueError(f"{domain_name} fold domain: duplicate consts {dup}")
+    low = [f"{k}={v}" for k, v in folds.items() if v < GRAY_FOLD_BASE]
+    if low:
+        raise ValueError(
+            f"{domain_name} fold domain: gray consts below "
+            f"GRAY_FOLD_BASE={GRAY_FOLD_BASE}: {low}"
+        )
+
+
+SINGLE_DECREE.validate()
+MULTI_PAXOS.validate()
+_validate_folds("tick", TICK_FOLDS)
+_validate_folds("plan", PLAN_FOLDS)
+
+
+def tick_key(base_key: jax.Array, tick) -> jax.Array:
+    """The per-tick mask key: depends only on (seed, tick), so
+    checkpoint/resume and pipelined dispatch replay bit-exactly."""
+    return jax.random.fold_in(base_key, tick)
+
+
+def root_step_key(seed: int) -> jax.Array:
+    """The step-key lineage root (fold const :data:`ROOT_STEP`)."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), ROOT_STEP)
+
+
+def root_plan_key(seed: int) -> jax.Array:
+    """The plan-sampling lineage root (fold const :data:`ROOT_PLAN`)."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), ROOT_PLAN)
+
+
+def tick_fold(key: jax.Array, name: str) -> jax.Array:
+    """A registered gray fold of the tick key (``sample_masks``)."""
+    return jax.random.fold_in(key, TICK_FOLDS[name])
+
+
+def plan_fold(key: jax.Array, name: str) -> jax.Array:
+    """A registered gray fold of the plan key (``FaultPlan.sample``)."""
+    return jax.random.fold_in(key, PLAN_FOLDS[name])

@@ -25,6 +25,12 @@ Default-off is free: ``SimConfig.telemetry`` defaults to the disabled
 then ``None`` (pruned from the pytree), and schedule streams are
 bit-identical to a build without this module (tests/test_telemetry.py
 reuses the tests/test_gray.py golden digests).
+
+By design this module draws NO randomness — it owns no stream id and no
+fold constant in ``core.streams``, and the static auditor
+(``paxos_tpu/analysis``) holds it to that: a telemetry-on trace must have
+the exact same PRNG-equation multiset as a default trace
+(``prng_audit.audit_telemetry_parity``).
 """
 
 from __future__ import annotations

@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from paxos_tpu.core import streams as streams_mod
+
 NEVER = jnp.iinfo(jnp.int32).max
 
 # Per-link Bernoulli rates are stored as uint32 thresholds in int32 bit
@@ -271,15 +273,19 @@ class FaultPlan:
         pside = jax.random.uniform(kpr, (n_prop, n_inst)) < 0.5
 
         # Gray fields draw from fold_in-derived keys (NOT extra splits of
-        # ``key``) so the pre-gray streams above stay bit-identical.
+        # ``key``) so the pre-gray streams above stay bit-identical; the
+        # fold constants are registered in core.streams.PLAN_FOLDS and
+        # checked against traced plans by the jaxpr auditor.
         part_dir = None
         if cfg.p_asym > 0.0:
             one_way = (
-                jax.random.uniform(jax.random.fold_in(key, 101), (n_inst,))
+                jax.random.uniform(
+                    streams_mod.plan_fold(key, "PART_DIR"), (n_inst,)
+                )
                 < cfg.p_asym
             )
             cut_req = jax.random.bernoulli(
-                jax.random.fold_in(key, 102), 0.5, (n_inst,)
+                streams_mod.plan_fold(key, "CUT_REQ"), 0.5, (n_inst,)
             )
             part_dir = jnp.where(
                 one_way, jnp.where(cut_req, 1, 2), 0
@@ -289,12 +295,14 @@ class FaultPlan:
         if cfg.p_flaky > 0.0:
             edge = (n_prop, n_acc, n_inst)
             flaky = (
-                jax.random.uniform(jax.random.fold_in(key, 103), edge)
+                jax.random.uniform(streams_mod.plan_fold(key, "FLAKY"), edge)
                 < cfg.p_flaky
             )
             drop_rate = jnp.where(
                 flaky,
-                jax.random.uniform(jax.random.fold_in(key, 104), edge)
+                jax.random.uniform(
+                    streams_mod.plan_fold(key, "FLAKY_DROP"), edge
+                )
                 * cfg.flaky_drop,
                 cfg.p_drop,
             )
@@ -302,7 +310,9 @@ class FaultPlan:
             if links_dup(cfg):
                 dup_rate = jnp.where(
                     flaky,
-                    jax.random.uniform(jax.random.fold_in(key, 105), edge)
+                    jax.random.uniform(
+                        streams_mod.plan_fold(key, "FLAKY_DUP"), edge
+                    )
                     * cfg.flaky_dup,
                     cfg.p_dup,
                 )
@@ -311,7 +321,7 @@ class FaultPlan:
         ptimeout = None
         if cfg.timeout_skew > 0:
             ptimeout = jax.random.randint(
-                jax.random.fold_in(key, 106),
+                streams_mod.plan_fold(key, "PTIMEOUT"),
                 (n_prop, n_inst),
                 0,
                 cfg.timeout_skew + 1,
@@ -319,7 +329,7 @@ class FaultPlan:
         pboff = None
         if cfg.backoff_skew > 1:
             pboff = jax.random.randint(
-                jax.random.fold_in(key, 107),
+                streams_mod.plan_fold(key, "PBOFF"),
                 (n_prop, n_inst),
                 1,
                 cfg.backoff_skew + 1,

@@ -305,6 +305,41 @@ def build_parser() -> argparse.ArgumentParser:
         "fastpaxos: retry the fast round instead of classic recovery) — "
         "--liveness-bound must then find a lasso counterexample",
     )
+
+    a = sub.add_parser(
+        "audit",
+        help="static determinism audit: trace every protocol x config cell "
+        "and check PRNG streams, purity, and (optionally) pytree structure "
+        "against the core.streams registry — nothing executes",
+    )
+    a.add_argument(
+        "--protocol", action="append", dest="protocols", metavar="NAME",
+        choices=["paxos", "multipaxos", "fastpaxos", "raftcore"],
+        help="restrict to one protocol (repeatable; default: all four)",
+    )
+    a.add_argument(
+        "--config", action="append", dest="configs", metavar="NAME",
+        choices=["default", "gray-chaos", "corrupt", "stale", "telemetry"],
+        help="restrict to one audit config (repeatable; default: all five)",
+    )
+    a.add_argument(
+        "--structure", action="store_true",
+        help="also run the default-off leaf checks and the golden "
+        "treedef/config-fingerprint diffs (release gate; default off)",
+    )
+    a.add_argument(
+        "--no-lint", action="store_true",
+        help="skip the AST host-entropy lint over the traced packages",
+    )
+    a.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the full report as JSON instead of the text summary",
+    )
+    a.add_argument(
+        "--record-goldens", action="store_true",
+        help="print a fresh goldens table (paste into analysis/goldens.py "
+        "after an intentional structure change) instead of auditing",
+    )
     return p
 
 
@@ -660,6 +695,35 @@ def cmd_soak(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_audit(args: argparse.Namespace) -> int:
+    """Static determinism audit: exit 0 clean, 2 on findings."""
+    from paxos_tpu.analysis import run_audit
+    from paxos_tpu.analysis import trace as trace_mod
+    from paxos_tpu.analysis.structure import record_goldens
+
+    if args.record_goldens:
+        matrix = [
+            (p, c, trace_mod.build_config(p, c))
+            for p in (args.protocols or trace_mod.PROTOCOLS)
+            for c in (args.configs or trace_mod.CONFIG_MATRIX)
+        ]
+        g = record_goldens(matrix)
+        for kind in ("treedef", "config"):
+            print(f"{kind.upper()}_GOLDENS = {{")
+            for (p, c), v in g[kind].items():
+                print(f'    ("{p}", "{c}"): "{v}",')
+            print("}")
+        return 0
+    report = run_audit(
+        protocols=args.protocols,
+        configs=args.configs,
+        structure=args.structure,
+        lint=not args.no_lint,
+    )
+    print(report.to_json() if args.as_json else report.summary())
+    return 0 if report.ok else 2
+
+
 def cmd_stats(args: argparse.Namespace) -> int:
     """Summarize a JSONL metrics stream; optionally as Prometheus text."""
     import pathlib
@@ -948,6 +1012,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_check(args)
     if args.cmd == "stats":
         return cmd_stats(args)
+    if args.cmd == "audit":
+        return cmd_audit(args)
     return 1
 
 

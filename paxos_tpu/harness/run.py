@@ -20,6 +20,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from paxos_tpu.core import streams as streams_mod
 from paxos_tpu.core.state import DONE, PaxosState
 from paxos_tpu.faults.injector import FaultConfig, FaultPlan
 from paxos_tpu.harness.config import SimConfig
@@ -110,12 +111,12 @@ def _init_protocol_state(cfg: SimConfig):
 
 
 def init_plan(cfg: SimConfig) -> FaultPlan:
-    key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 1)
+    key = streams_mod.root_plan_key(cfg.seed)
     return FaultPlan.sample(key, cfg.fault, cfg.n_inst, cfg.n_acc, cfg.n_prop)
 
 
 def base_key(cfg: SimConfig) -> jax.Array:
-    return jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 0)
+    return streams_mod.root_step_key(cfg.seed)
 
 
 @functools.partial(

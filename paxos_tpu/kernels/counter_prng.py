@@ -45,6 +45,21 @@ def mix(seed: jnp.ndarray, tick: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray
     return h
 
 
+STREAM_SALT_MULT = 0x9E3779B9
+"""Multiplier that turns a stream id into the per-stream salt literal.
+
+The jaxpr auditor (``paxos_tpu.analysis``) recovers counter-stream ids from
+traced programs by matching add-equation literals against
+``stream_salt(s)`` — keep this in sync with :func:`counter_bits`.
+"""
+
+
+def stream_salt(stream: int) -> int:
+    """The int32 bit pattern ``counter_bits`` salts stream ``stream`` with."""
+    v = (STREAM_SALT_MULT * (stream + 1)) & 0xFFFFFFFF
+    return v - (1 << 32) if v >= (1 << 31) else v
+
+
 def _linear_index(shape) -> jnp.ndarray:
     """int32 linear position of every element (broadcasted_iota — TPU-safe)."""
     idx = jnp.zeros(shape, jnp.int32)
@@ -57,7 +72,7 @@ def _linear_index(shape) -> jnp.ndarray:
 
 def counter_bits(seed: jnp.ndarray, stream: int, shape) -> jnp.ndarray:
     """Stateless uniform int32 bits = hash of (seed, stream, position)."""
-    x = _linear_index(shape) + i32(0x9E3779B9 * (stream + 1))
+    x = _linear_index(shape) + i32(STREAM_SALT_MULT * (stream + 1))
     x = x ^ (seed.astype(jnp.int32) * i32(0x85EBCA6B))
     x = x ^ shr(x, 16)
     x = x * i32(0x7FEB352D)

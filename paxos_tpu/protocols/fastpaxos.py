@@ -388,12 +388,14 @@ def fastpaxos_step(
     """Advance every instance by one scheduler tick (XLA engine).
 
     Fast Paxos shares single-decree paxos' mask shapes, so it reuses its
-    samplers (`protocols.paxos.sample_masks` / `counter_masks`).
+    samplers (`protocols.paxos.sample_masks` / `counter_masks`) and draws
+    from the same stream family (`core.streams.SINGLE_DECREE`).
     """
+    from paxos_tpu.core import streams as streams_mod
     from paxos_tpu.protocols.paxos import sample_masks
 
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
-    key = jax.random.fold_in(base_key, state.tick)
+    key = streams_mod.tick_key(base_key, state.tick)
     masks = sample_masks(key, cfg, n_prop, n_acc, n_inst)
     return apply_tick_fast(state, masks, plan, cfg)

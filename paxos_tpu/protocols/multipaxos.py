@@ -31,6 +31,7 @@ from flax import struct
 
 from paxos_tpu.check.mp_safety import mp_learner_observe
 from paxos_tpu.core import ballot as bal_mod
+from paxos_tpu.core import streams as streams_mod
 from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.core.messages import ACCEPT, PREPARE
 from paxos_tpu.core.mp_state import (
@@ -83,12 +84,15 @@ def sample_mp_masks(
     slot = (2, n_prop, n_acc, n_inst)
     edge = (n_prop, n_acc, n_inst)
     # Per-link loss replaces the uniform keep/dup masks with raw bits the
-    # tick compares against plan thresholds (fold_in, never extra splits:
-    # the pre-gray stream stays bit-identical when the knobs are off).
+    # tick compares against plan thresholds (fold_in via the registered
+    # core.streams.TICK_FOLDS consts, never extra splits: the pre-gray
+    # stream stays bit-identical when the knobs are off).  Gray folds are
+    # gated on their knob so off knobs leave zero PRNG eqns in the trace
+    # (audited at the jaxpr level by paxos_tpu/analysis).
     flaky = cfg.p_flaky > 0.0
 
-    def raw_bits(const: int, shape):
-        k = jax.random.fold_in(key, const)
+    def raw_bits(name: str, shape):
+        k = streams_mod.tick_fold(key, name)
         return jax.random.bits(k, shape, jnp.uint32).astype(jnp.int32)
 
     return MPTickMasks(
@@ -107,10 +111,16 @@ def sample_mp_masks(
         backoff=jax.random.randint(
             k_back, (n_prop, n_inst), 0, 2 * max(cfg.backoff_max, 1), jnp.int32
         ),
-        link_bits=raw_bits(100, (4,) + edge) if flaky else None,
-        dup_bits=raw_bits(101, slot) if links_dup(cfg) else None,
-        corrupt=net.stay_mask(
-            jax.random.fold_in(key, 102), (n_acc, n_inst), cfg.p_corrupt
+        link_bits=raw_bits("LINK_BITS", (4,) + edge) if flaky else None,
+        dup_bits=raw_bits("DUP_BITS", slot) if links_dup(cfg) else None,
+        corrupt=(
+            net.stay_mask(
+                streams_mod.tick_fold(key, "CORRUPT"),
+                (n_acc, n_inst),
+                cfg.p_corrupt,
+            )
+            if cfg.p_corrupt > 0.0
+            else None
         ),
     )
 
@@ -137,26 +147,59 @@ def mp_counter_masks(
             jitter=jnp.zeros((n_prop, n_inst), jnp.int32),
             backoff=jnp.zeros((n_prop, n_inst), jnp.int32),
         )
+    # Stream ids from the registry (core.streams.MULTI_PAXOS; gray_base=11
+    # — BACKOFF landed on 10 before the gray layer and is frozen there).
+    s = streams_mod.MULTI_PAXOS.streams
     flaky = cfg.p_flaky > 0.0
     return MPTickMasks(
-        sel_score=cp.counter_bits(tick_seed, 0, slot),
-        busy=cp.bern_not(tick_seed, 1, (1, 1, n_acc, n_inst), cfg.p_idle),
-        dup_req=None if flaky else cp.bern(tick_seed, 2, slot, cfg.p_dup),
-        prom_deliver=cp.bern_not(tick_seed, 3, edge, cfg.p_hold),
-        accd_deliver=cp.bern_not(tick_seed, 4, edge, cfg.p_hold),
-        keep_prom=None if flaky else cp.bern_not(tick_seed, 5, edge, cfg.p_drop),
-        keep_accd=None if flaky else cp.bern_not(tick_seed, 6, edge, cfg.p_drop),
-        keep_prep=None if flaky else cp.bern_not(tick_seed, 7, edge, cfg.p_drop),
-        keep_acc=None if flaky else cp.bern_not(tick_seed, 8, edge, cfg.p_drop),
-        jitter=cp.randint(tick_seed, 9, (n_prop, n_inst), max(cfg.backoff_max, 1)),
+        sel_score=cp.counter_bits(tick_seed, s["SEL"], slot),
+        busy=cp.bern_not(
+            tick_seed, s["BUSY"], (1, 1, n_acc, n_inst), cfg.p_idle
+        ),
+        dup_req=(
+            None if flaky else cp.bern(tick_seed, s["DUP_REQ"], slot, cfg.p_dup)
+        ),
+        prom_deliver=cp.bern_not(tick_seed, s["PROM_DELIVER"], edge, cfg.p_hold),
+        accd_deliver=cp.bern_not(tick_seed, s["ACCD_DELIVER"], edge, cfg.p_hold),
+        keep_prom=(
+            None
+            if flaky
+            else cp.bern_not(tick_seed, s["KEEP_PROM"], edge, cfg.p_drop)
+        ),
+        keep_accd=(
+            None
+            if flaky
+            else cp.bern_not(tick_seed, s["KEEP_ACCD"], edge, cfg.p_drop)
+        ),
+        keep_prep=(
+            None
+            if flaky
+            else cp.bern_not(tick_seed, s["KEEP_PREP"], edge, cfg.p_drop)
+        ),
+        keep_acc=(
+            None
+            if flaky
+            else cp.bern_not(tick_seed, s["KEEP_ACC"], edge, cfg.p_drop)
+        ),
+        jitter=cp.randint(
+            tick_seed, s["JITTER"], (n_prop, n_inst), max(cfg.backoff_max, 1)
+        ),
         backoff=cp.randint(
-            tick_seed, 10, (n_prop, n_inst), 2 * max(cfg.backoff_max, 1)
+            tick_seed, s["BACKOFF"], (n_prop, n_inst), 2 * max(cfg.backoff_max, 1)
         ),
         link_bits=(
-            cp.counter_bits(tick_seed, 11, (4,) + edge) if flaky else None
+            cp.counter_bits(tick_seed, s["LINK_BITS"], (4,) + edge)
+            if flaky
+            else None
         ),
-        dup_bits=cp.counter_bits(tick_seed, 12, slot) if links_dup(cfg) else None,
-        corrupt=cp.bern(tick_seed, 13, (n_acc, n_inst), cfg.p_corrupt),
+        dup_bits=(
+            cp.counter_bits(tick_seed, s["DUP_BITS"], slot)
+            if links_dup(cfg)
+            else None
+        ),
+        corrupt=cp.bern(
+            tick_seed, s["CORRUPT"], (n_acc, n_inst), cfg.p_corrupt
+        ),
     )
 
 
@@ -552,7 +595,7 @@ def multipaxos_step(
     """Advance every instance by one scheduler tick (XLA engine)."""
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
-    key = jax.random.fold_in(base_key, state.tick)
+    key = streams_mod.tick_key(base_key, state.tick)
     masks = sample_mp_masks(key, cfg, n_prop, n_acc, n_inst)
     return apply_tick_mp(state, masks, plan, cfg)
 

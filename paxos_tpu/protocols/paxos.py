@@ -45,6 +45,7 @@ from flax import struct
 
 from paxos_tpu.check.safety import acceptor_invariants, learner_observe
 from paxos_tpu.core import ballot as bal_mod
+from paxos_tpu.core import streams as streams_mod
 from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.core.messages import ACCEPT, ACCEPTED, PREPARE, PROMISE
 from paxos_tpu.core.state import DONE, P1, P2, PaxosState
@@ -97,13 +98,15 @@ def sample_masks(
     slot = (2, n_prop, n_acc, n_inst)
     edge = (n_prop, n_acc, n_inst)
 
-    # Gray draws use fold_in-derived keys, NOT extra splits: the 10-way
-    # split above must keep producing the exact pre-gray streams when every
-    # gray knob is off.
+    # Gray draws use fold_in-derived keys (core.streams.TICK_FOLDS), NOT
+    # extra splits: the 10-way split above must keep producing the exact
+    # pre-gray streams when every gray knob is off.  Gray folds are also
+    # GATED on their knob — an off knob must leave zero PRNG eqns in the
+    # traced tick, which the jaxpr auditor (paxos_tpu/analysis) enforces.
     flaky = cfg.p_flaky > 0.0
 
-    def raw_bits(const: int, shape):
-        k = jax.random.fold_in(key, const)
+    def raw_bits(name: str, shape):
+        k = streams_mod.tick_fold(key, name)
         return jax.random.bits(k, shape, jnp.uint32).astype(jnp.int32)
 
     return TickMasks(
@@ -125,10 +128,16 @@ def sample_masks(
         backoff=jax.random.randint(
             k_backoff, (n_prop, n_inst), 0, max(cfg.backoff_max, 1), jnp.int32
         ),
-        link_bits=raw_bits(100, (4,) + edge) if flaky else None,
-        dup_bits=raw_bits(101, (2,) + slot) if links_dup(cfg) else None,
-        corrupt=net.stay_mask(
-            jax.random.fold_in(key, 102), (n_acc, n_inst), cfg.p_corrupt
+        link_bits=raw_bits("LINK_BITS", (4,) + edge) if flaky else None,
+        dup_bits=raw_bits("DUP_BITS", (2,) + slot) if links_dup(cfg) else None,
+        corrupt=(
+            net.stay_mask(
+                streams_mod.tick_fold(key, "CORRUPT"),
+                (n_acc, n_inst),
+                cfg.p_corrupt,
+            )
+            if cfg.p_corrupt > 0.0
+            else None
         ),
     )
 
@@ -163,27 +172,59 @@ def counter_masks(
             keep_prom=None, keep_accd=None, keep_p1=None, keep_p2=None,
             backoff=jnp.zeros((n_prop, n_inst), jnp.int32),
         )
-    # Gray draws live on streams >= 10 so streams 0-9 stay the exact
+    # Stream ids come from the registry (core.streams.SINGLE_DECREE): gray
+    # draws live on streams >= gray_base (10) so streams 0-9 stay the exact
     # pre-gray schedule when every gray knob is off.
+    s = streams_mod.SINGLE_DECREE.streams
     flaky = cfg.p_flaky > 0.0
     return TickMasks(
-        sel_score=cp.counter_bits(tick_seed, 0, slot),
-        busy=cp.bern_not(tick_seed, 1, (1, 1, n_acc, n_inst), cfg.p_idle),
-        deliver=cp.bern_not(tick_seed, 2, slot, cfg.p_hold),
-        dup_req=None if flaky else cp.bern(tick_seed, 3, slot, cfg.p_dup),
-        dup_rep=None if flaky else cp.bern(tick_seed, 4, slot, cfg.p_dup),
-        keep_prom=None if flaky else cp.bern_not(tick_seed, 5, edge, cfg.p_drop),
-        keep_accd=None if flaky else cp.bern_not(tick_seed, 6, edge, cfg.p_drop),
-        keep_p1=None if flaky else cp.bern_not(tick_seed, 7, edge, cfg.p_drop),
-        keep_p2=None if flaky else cp.bern_not(tick_seed, 8, edge, cfg.p_drop),
-        backoff=cp.randint(tick_seed, 9, (n_prop, n_inst), max(cfg.backoff_max, 1)),
-        link_bits=cp.counter_bits(tick_seed, 10, (4,) + edge) if flaky else None,
+        sel_score=cp.counter_bits(tick_seed, s["SEL"], slot),
+        busy=cp.bern_not(
+            tick_seed, s["BUSY"], (1, 1, n_acc, n_inst), cfg.p_idle
+        ),
+        deliver=cp.bern_not(tick_seed, s["DELIVER"], slot, cfg.p_hold),
+        dup_req=(
+            None if flaky else cp.bern(tick_seed, s["DUP_REQ"], slot, cfg.p_dup)
+        ),
+        dup_rep=(
+            None if flaky else cp.bern(tick_seed, s["DUP_REP"], slot, cfg.p_dup)
+        ),
+        keep_prom=(
+            None
+            if flaky
+            else cp.bern_not(tick_seed, s["KEEP_PROM"], edge, cfg.p_drop)
+        ),
+        keep_accd=(
+            None
+            if flaky
+            else cp.bern_not(tick_seed, s["KEEP_ACCD"], edge, cfg.p_drop)
+        ),
+        keep_p1=(
+            None
+            if flaky
+            else cp.bern_not(tick_seed, s["KEEP_P1"], edge, cfg.p_drop)
+        ),
+        keep_p2=(
+            None
+            if flaky
+            else cp.bern_not(tick_seed, s["KEEP_P2"], edge, cfg.p_drop)
+        ),
+        backoff=cp.randint(
+            tick_seed, s["BACKOFF"], (n_prop, n_inst), max(cfg.backoff_max, 1)
+        ),
+        link_bits=(
+            cp.counter_bits(tick_seed, s["LINK_BITS"], (4,) + edge)
+            if flaky
+            else None
+        ),
         dup_bits=(
-            cp.counter_bits(tick_seed, 11, (2,) + slot)
+            cp.counter_bits(tick_seed, s["DUP_BITS"], (2,) + slot)
             if links_dup(cfg)
             else None
         ),
-        corrupt=cp.bern(tick_seed, 12, (n_acc, n_inst), cfg.p_corrupt),
+        corrupt=cp.bern(
+            tick_seed, s["CORRUPT"], (n_acc, n_inst), cfg.p_corrupt
+        ),
     )
 
 
@@ -544,6 +585,6 @@ def paxos_step(
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     # Keys depend only on (seed, tick): checkpoint/resume replays bit-exactly.
-    key = jax.random.fold_in(base_key, state.tick)
+    key = streams_mod.tick_key(base_key, state.tick)
     masks = sample_masks(key, cfg, n_prop, n_acc, n_inst)
     return apply_tick(state, masks, plan, cfg)

@@ -342,11 +342,16 @@ def apply_tick_raft(
 def raftcore_step(
     state: RaftState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
 ) -> RaftState:
-    """Advance every instance by one scheduler tick (XLA engine)."""
+    """Advance every instance by one scheduler tick (XLA engine).
+
+    Raft-core reuses single-decree paxos' mask samplers, so it draws from
+    the same stream family (`core.streams.SINGLE_DECREE`).
+    """
+    from paxos_tpu.core import streams as streams_mod
     from paxos_tpu.protocols.paxos import sample_masks
 
     n_acc, n_inst = state.acceptor.voted.shape
     n_prop = state.proposer.bal.shape[0]
-    key = jax.random.fold_in(base_key, state.tick)
+    key = streams_mod.tick_key(base_key, state.tick)
     masks = sample_masks(key, cfg, n_prop, n_acc, n_inst)
     return apply_tick_raft(state, masks, plan, cfg)

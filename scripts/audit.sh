@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Full static determinism audit: every protocol x config cell through the
+# jaxpr auditor (PRNG stream registry, purity lint, AST host-entropy pass)
+# plus the default-off structural verifier and golden diffs.  Trace-time
+# only — no campaign executes; a clean tree exits 0, findings exit 2.
+#
+# Usage: scripts/audit.sh [extra `paxos_tpu audit` flags...]
+#   scripts/audit.sh --json            # machine-readable report
+#   scripts/audit.sh --protocol paxos  # one protocol only
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m paxos_tpu audit --structure "$@"

@@ -32,4 +32,14 @@ if [ "$rc" -eq 0 ]; then
        --ticks-per-seed 64 --chunk 32 --pipeline-depth 2 >/dev/null 2>&1 \
   && echo PIPELINE_SMOKE=ok || { echo PIPELINE_SMOKE=FAILED; rc=1; }
 fi
+# Static-audit smoke: one protocol x two configs through the full jaxpr
+# auditor (PRNG registry + purity + structure goldens) — trace-time only,
+# so seconds, but it catches stream/structure drift the runtime suite
+# can't see until a schedule silently forks.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxos_tpu audit \
+    --protocol paxos --config default --config gray-chaos --structure \
+    >/dev/null 2>&1 \
+  && echo AUDIT_SMOKE=ok || { echo AUDIT_SMOKE=FAILED; rc=1; }
+fi
 exit $rc

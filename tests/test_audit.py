@@ -1,0 +1,319 @@
+"""Static determinism auditor (paxos_tpu.analysis): clean + mutation tests.
+
+Two halves:
+
+1. **Clean**: the shipped tree audits clean for every protocol x config
+   cell — PRNG streams registered/collision-free/gated, traces pure, plan
+   folds exact, structure goldens matching.  These pin the auditor AND
+   the tree: either side regressing fails here first.
+2. **Mutations**: each detector is fed a planted violation (stream
+   collision, unregistered stream, host callback, unregistered fold,
+   non-pruning default-off leaf, host-entropy import) and must produce a
+   finding whose message NAMES the offender — an auditor that fires
+   without saying where is a worse debugging experience than no auditor.
+
+Everything here is trace-time only (no campaign executes), so the whole
+module rides the fast ``-m 'not slow'`` tier.
+"""
+
+import dataclasses
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paxos_tpu.analysis import jaxpr_tools as jt
+from paxos_tpu.analysis import prng_audit, purity, structure
+from paxos_tpu.analysis import trace as trace_mod
+from paxos_tpu.analysis.audit import run_audit
+from paxos_tpu.core import streams as streams_mod
+from paxos_tpu.harness.run import init_plan, init_state
+from paxos_tpu.kernels import counter_prng as cp
+
+PROTOCOLS = trace_mod.PROTOCOLS
+CONFIGS = tuple(trace_mod.CONFIG_MATRIX)
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_registry_validates():
+    """The registry's own invariants hold at import (collisions, ranges)."""
+    for fam in streams_mod.FAMILIES.values():
+        fam.validate()
+    assert streams_mod.family_of("paxos") is streams_mod.SINGLE_DECREE
+    assert streams_mod.family_of("multipaxos") is streams_mod.MULTI_PAXOS
+
+
+def test_registry_rejects_collision():
+    fam = dataclasses.replace(
+        streams_mod.SINGLE_DECREE,
+        streams={**streams_mod.SINGLE_DECREE.streams, "EVIL": 0},
+    )
+    with pytest.raises(ValueError, match="EVIL|SEL"):
+        fam.validate()
+
+
+def test_salt_helper_matches_counter_bits():
+    """stream_salt is the literal counter_bits embeds (recovery anchor)."""
+    for s in (0, 5, 13, 63):
+        closed = jax.make_jaxpr(
+            lambda seed: cp.counter_bits(seed, s, (4,))
+        )(jnp.int32(7))
+        assert jt.counter_salt_streams(closed.jaxpr) == {s: 1}
+
+
+# ------------------------------------------------------------------- clean
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_clean_audit_default_config(protocol):
+    """Fast lane: the default cell of each protocol audits clean."""
+    report = run_audit(
+        protocols=[protocol], configs=["default"], structure=True, lint=False
+    )
+    assert report.ok, report.summary()
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_clean_audit_full_matrix(protocol):
+    """Every config cell (incl. telemetry parity) audits clean."""
+    report = run_audit(protocols=[protocol], structure=True, lint=False)
+    assert report.ok, report.summary()
+
+
+def test_ast_lint_clean_on_tree():
+    assert purity.audit_traced_sources() == []
+
+
+def test_default_trace_has_no_gray_draws():
+    """The stream half of default-off-is-free, asserted directly."""
+    for protocol in PROTOCOLS:
+        cfg = trace_mod.build_config(protocol, "default")
+        xla = trace_mod.trace_xla_step(protocol, cfg)
+        assert not jt.fold_in_constants(xla.jaxpr), protocol
+        ctr = trace_mod.trace_counter_tick(protocol, cfg)
+        fam = streams_mod.family_of(protocol)
+        gray = jt.counter_salt_streams(ctr.jaxpr).keys() & fam.gray_ids()
+        assert not gray, (protocol, sorted(gray))
+
+
+# --------------------------------------------------------------- mutations
+
+
+def _ctr_audit(fn, protocol="paxos", config="default"):
+    cfg = trace_mod.build_config(protocol, config)
+    closed = jax.make_jaxpr(fn)(jnp.int32(3))
+    return prng_audit.audit_counter_streams(protocol, config, closed, cfg.fault)
+
+
+def test_mutation_stream_collision_detected():
+    sel = streams_mod.SINGLE_DECREE.streams["SEL"]
+
+    def twice(seed):
+        return cp.counter_bits(seed, sel, (8,)) ^ cp.counter_bits(
+            seed, sel, (8,)
+        )
+
+    findings = _ctr_audit(twice)
+    assert any(
+        f.check == "stream-collision" and f"stream {sel}" in f.message
+        and "SEL" in f.message
+        for f in findings
+    ), findings
+
+
+def test_mutation_unregistered_stream_detected():
+    def rogue(seed):
+        return cp.counter_bits(seed, 42, (8,))
+
+    findings = _ctr_audit(rogue)
+    assert any(
+        f.check == "stream-registry" and "42" in f.message for f in findings
+    ), findings
+
+
+def test_mutation_gray_stream_when_knob_off_detected():
+    link = streams_mod.SINGLE_DECREE.streams["LINK_BITS"]
+
+    def gray(seed):
+        return cp.counter_bits(seed, link, (8,))
+
+    findings = _ctr_audit(gray)  # default config: p_flaky == 0
+    assert any(
+        f.check == "gray-gating" and "LINK_BITS" in f.message
+        for f in findings
+    ), findings
+
+
+def test_mutation_jax_random_in_fused_path_detected():
+    def leaky(seed):
+        key = jax.random.PRNGKey(seed)
+        return jax.random.bits(key, (8,), jnp.uint32)
+
+    findings = _ctr_audit(leaky)
+    assert any(f.check == "counter-engine-purity" for f in findings), findings
+
+
+def test_mutation_unregistered_fold_detected():
+    cfg = trace_mod.build_config("paxos", "default")
+
+    def step_like(key):
+        return jax.random.bits(jax.random.fold_in(key, 55), (4,), jnp.uint32)
+
+    closed = jax.make_jaxpr(step_like)(jax.random.PRNGKey(0))
+    findings = prng_audit.audit_xla_folds("paxos", "default", closed, cfg.fault)
+    assert any(
+        f.check == "fold-registry" and "55" in f.message for f in findings
+    ), findings
+
+
+def test_mutation_dead_draw_detected():
+    def wasteful(key):
+        dead = jax.random.bits(jax.random.fold_in(key, 102), (4,), jnp.uint32)
+        del dead
+        return jax.random.bits(key, (4,), jnp.uint32)
+
+    closed = jax.make_jaxpr(wasteful)(jax.random.PRNGKey(0))
+    findings = prng_audit.audit_dead_draws("paxos", "default", closed)
+    assert any(
+        f.check == "dead-draw" and "102" in f.message for f in findings
+    ), findings
+
+
+def test_mutation_host_callback_detected():
+    import numpy as np
+
+    def chatty(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v) + 1,
+            jax.ShapeDtypeStruct((4,), jnp.int32),
+            x,
+        )
+
+    closed = jax.make_jaxpr(chatty)(jnp.zeros(4, jnp.int32))
+    findings = purity.audit_jaxpr_purity("mutant xla step", closed)
+    assert any("pure_callback" in f.message for f in findings), findings
+
+
+def test_mutation_nonpruning_default_off_leaf_detected():
+    from paxos_tpu.core.telemetry import TelemetryConfig, TelemetryState
+
+    cfg = trace_mod.build_config("paxos", "default")
+
+    def leaky_builder(c):
+        state = init_state(c)
+        return state.replace(
+            telemetry=TelemetryState.init(
+                c.n_inst, TelemetryConfig(counters=True)
+            )
+        )
+
+    findings = structure.audit_default_off_leaves(
+        "paxos", "default", cfg, state_builder=leaky_builder
+    )
+    assert any(
+        f.check == "structure" and "telemetry" in f.message
+        and "prune" in f.message
+        for f in findings
+    ), findings
+
+
+def test_mutation_treedef_drift_detected():
+    from paxos_tpu.core.telemetry import TelemetryConfig, TelemetryState
+
+    cfg = trace_mod.build_config("paxos", "default")
+
+    def drifted_builder(c):
+        state = init_state(c)
+        return state.replace(
+            telemetry=TelemetryState.init(
+                c.n_inst, TelemetryConfig(counters=True)
+            )
+        )
+
+    findings = structure.audit_goldens(
+        "paxos", "default", cfg, state_builder=drifted_builder
+    )
+    assert any(
+        f.check == "structure-golden" and "treedef" in f.message
+        for f in findings
+    ), findings
+
+
+def test_mutation_host_entropy_import_detected(tmp_path):
+    bad = tmp_path / "mutant_module.py"
+    bad.write_text(textwrap.dedent("""\
+        import numpy as np
+
+        def seedy():
+            return np.random.rand(4)
+    """))
+    findings = purity.lint_file(bad, "mutant_module.py")
+    assert any(
+        f.check == "ast-lint" and "np.random" in f.message
+        and "mutant_module.py" in f.where
+        for f in findings
+    ), findings
+
+
+def test_mutation_wall_clock_import_detected(tmp_path):
+    bad = tmp_path / "timed.py"
+    bad.write_text("import time\n\ndef now():\n    return time.time()\n")
+    findings = purity.lint_file(bad, "timed.py")
+    assert any("wall clock" in f.message for f in findings), findings
+
+
+# --------------------------------------------------------------- plan audit
+
+
+def test_plan_folds_exact_for_gray_chaos():
+    cfg = trace_mod.build_config("paxos", "gray-chaos")
+    closed = trace_mod.trace_plan_sample(cfg)
+    seen = set(jt.fold_in_constants(closed.jaxpr))
+    assert seen == prng_audit.expected_plan_folds(cfg.fault) == set(
+        streams_mod.PLAN_FOLDS.values()
+    )
+
+
+def test_plan_missing_fold_detected():
+    """A plan trace that skips an expected gray fold is flagged."""
+    cfg = trace_mod.build_config("paxos", "gray-chaos")
+
+    def partial_plan(key):
+        # Draws PART_DIR but not CUT_REQ/FLAKY/... for a config where all
+        # knobs are on.
+        return jax.random.uniform(
+            streams_mod.plan_fold(key, "PART_DIR"), (4,)
+        )
+
+    closed = jax.make_jaxpr(partial_plan)(jax.random.PRNGKey(0))
+    findings = prng_audit.audit_plan_folds(
+        "paxos", "gray-chaos", closed, cfg.fault
+    )
+    assert any(
+        f.check == "plan-folds" and "CUT_REQ" in f.message for f in findings
+    ), findings
+
+
+# -------------------------------------------------------------- structural
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_default_off_leaves_prune(protocol):
+    """Direct (golden-free) check: off-knob leaves are None on the tree."""
+    cfg = trace_mod.build_config(protocol, "default")
+    state = init_state(cfg)
+    plan = init_plan(cfg)
+    assert state.telemetry is None
+    for field in ("part_dir", "link_drop", "link_dup", "ptimeout", "pboff"):
+        assert getattr(plan, field) is None, field
+
+
+def test_treedef_fingerprint_is_shape_independent():
+    cfg64 = trace_mod.build_config("paxos", "default")
+    cfg128 = dataclasses.replace(cfg64, n_inst=128)
+    assert structure.treedef_fingerprint(
+        init_state(cfg64)
+    ) == structure.treedef_fingerprint(init_state(cfg128))
