@@ -34,10 +34,13 @@ DISALLOWED_PRIMITIVES = frozenset({
 })
 
 # Packages whose modules end up inside traced programs.  harness/ and
-# cpu_ref/ are host-side by design and excluded.
+# cpu_ref/ are host-side by design and excluded.  obs/ is host-side decode
+# but held to the same no-entropy/no-clock bar on purpose: span
+# reconstruction must be a pure function of the decoded ring, and its
+# wall clock is INJECTED by the harness (obs.host_spans), never imported.
 TRACED_PACKAGES = (
     "protocols", "core", "faults", "kernels", "transport", "check",
-    "utils", "parallel",
+    "utils", "parallel", "obs",
 )
 
 _BANNED_MODULES = {

@@ -258,11 +258,38 @@ def counter_totals(tel: TelemetryState) -> dict:
     return {name: int(v) for name, v in zip(EVENTS, totals)}
 
 
-def hist_totals(tel: TelemetryState) -> list:
-    """Decide-latency histogram summed over lanes (len = hist_bins)."""
-    if tel.hist is None:
-        return []
-    return [int(v) for v in jax.device_get(tel.hist.sum(axis=-1))]
+def hist_saturation(counts: list) -> dict:
+    """Overflow accounting for a decoded decide-latency histogram.
+
+    The device update clamps ``decide_tick // HIST_TICKS_PER_BIN`` into the
+    last bin, so that bin is a catch-all: any count there means latencies
+    at or past ``(bins - 1) * HIST_TICKS_PER_BIN`` ticks were folded
+    together and the in-range bins under-describe the tail.  Returns
+    ``{"overflow": <last-bin count>, "saturated": <bool>}`` (zeros/False
+    for an empty or single-bin histogram, where no in-range bins exist to
+    be misread).
+    """
+    if len(counts) < 2:
+        return {"overflow": 0, "saturated": False}
+    overflow = int(counts[-1])
+    return {"overflow": overflow, "saturated": overflow > 0}
+
+
+def hist_totals(tel: TelemetryState, with_saturation: bool = False):
+    """Decide-latency histogram summed over lanes (len = hist_bins).
+
+    With ``with_saturation`` returns ``(counts, hist_saturation(counts))``
+    so callers surfacing the histogram can flag a clipped tail instead of
+    silently reporting the overflow bucket as a real latency bin.
+    """
+    counts = (
+        []
+        if tel.hist is None
+        else [int(v) for v in jax.device_get(tel.hist.sum(axis=-1))]
+    )
+    if with_saturation:
+        return counts, hist_saturation(counts)
+    return counts
 
 
 def telemetry_device(tel: TelemetryState) -> dict:
@@ -288,6 +315,9 @@ def telemetry_host(host: dict) -> dict:
     if "hist" in host:
         report["hist"] = [int(v) for v in host["hist"]]
         report["hist_ticks_per_bin"] = HIST_TICKS_PER_BIN
+        sat = hist_saturation(report["hist"])
+        report["hist_overflow"] = sat["overflow"]
+        report["hist_saturated"] = sat["saturated"]
     if "seq" in host:
         report["events_recorded"] = int(host["seq"])
     return report

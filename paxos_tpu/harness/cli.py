@@ -138,6 +138,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="on-device ticks-to-decide histogram with N fixed-width bins "
         "(implies --telemetry)",
     )
+    r.add_argument(
+        "--span-trace", default=None, metavar="PATH",
+        help="write the host loop's wall-clock spans (dispatches, probes, "
+        "checkpoint writes) as a Chrome/Perfetto trace to PATH; for the "
+        "unified device+host view use the `trace` subcommand",
+    )
 
     s = sub.add_parser(
         "sweep",
@@ -179,6 +185,11 @@ def build_parser() -> argparse.ArgumentParser:
         "rate for known long-log configs (config.REPLICATION_RATES), 'off' "
         "for ad-hoc ones; pass 0 to disable",
     )
+    so.add_argument(
+        "--span-trace", default=None, metavar="PATH",
+        help="write the campaign loop's wall-clock spans (per-seed dispatch "
+        "and finalize, retry backoffs) as a Chrome/Perfetto trace to PATH",
+    )
 
     k = sub.add_parser(
         "shrink",
@@ -213,6 +224,50 @@ def build_parser() -> argparse.ArgumentParser:
         "chunk boundaries, so a mismatched chunk explores a different "
         "schedule and can miss the violation)",
     )
+    k.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="also write the victim lane's reconstructed round spans as a "
+        "Chrome/Perfetto trace to PATH (the repro JSON carries the same "
+        "spans either way)",
+    )
+
+    tr = sub.add_parser(
+        "trace",
+        help="run a campaign with the flight recorder on and export a "
+        "Perfetto/Chrome trace: per-lane ballot-round spans, fault "
+        "instants, and the host dispatch loop on its own track",
+    )
+    tr.add_argument("--config", choices=sorted(CONFIGS), default="corrupt")
+    tr.add_argument("--engine", choices=["xla", "fused"], default="xla")
+    tr.add_argument("--n-inst", type=int, default=None)
+    tr.add_argument(
+        "--fault", action="append", default=[], metavar="KEY=VALUE",
+        help="override any FaultConfig knob by name (repeatable)",
+    )
+    tr.add_argument("--seed", type=int, default=0)
+    tr.add_argument("--ticks", type=int, default=256)
+    tr.add_argument("--chunk", type=int, default=64)
+    tr.add_argument(
+        "--pipeline-depth", type=int, default=4, metavar="K",
+        help="dispatch grouping for the traced loop (the host track shows "
+        "the grouped dispatches; 1 = serial per-chunk loop)",
+    )
+    tr.add_argument(
+        "--lanes", type=int, default=8, metavar="N",
+        help="how many lanes to decode into round spans (violating lanes "
+        "are picked first, then lane 0 upward)",
+    )
+    tr.add_argument(
+        "--out", default="trace.json",
+        help="Chrome trace-event JSON output path (load in ui.perfetto.dev "
+        "or chrome://tracing)",
+    )
+    tr.add_argument(
+        "--spans-out", default=None, metavar="PATH",
+        help="also write the reconstructed spans as compact JSONL "
+        "(one span per line; the programmatic-diff format)",
+    )
+    tr.add_argument("--log", default=None, help="JSONL metrics path")
 
     st = sub.add_parser(
         "stats",
@@ -415,14 +470,27 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
         ) if on
     ]
     if depth > 1 and serial_needs:
-        if args.pipeline_depth is not None:
-            print(f"note: {', '.join(serial_needs)} needs per-chunk host "
-                  "work; running serially (pipeline depth 1)",
-                  file=sys.stderr)
+        # Always say so (satellite of the silent-degrade bug): an operator
+        # reading throughput off a run that quietly fell back to depth 1
+        # would compare serial numbers against pipelined expectations.
+        print(f"warning: {', '.join(serial_needs)} needs per-chunk host "
+              f"work; pipeline depth {depth} degraded to 1 "
+              f"({'explicit' if args.pipeline_depth is not None else 'default'}"
+              " --pipeline-depth overridden)", file=sys.stderr)
         depth = 1
 
     tel_cfg = _telemetry_from_args(args)
     registry = MetricsRegistry()
+    registry.gauge("pipeline_depth_effective", depth)
+    # Host span recorder (--span-trace): the CLI owns the wall clock and
+    # injects it — the obs package itself stays clock-free (purity audit).
+    recorder = None
+    if args.span_trace:
+        import time
+
+        from paxos_tpu.obs.host_spans import HostSpanRecorder
+
+        recorder = HostSpanRecorder(time.perf_counter)
     if args.resume:
         if args.fault:
             print("error: --fault cannot be combined with --resume (the "
@@ -509,19 +577,24 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
         with trace_mod.profile(args.trace):
             state, done, _ = pipelined_run(
                 state, advance_g, budget=args.ticks, chunk=args.chunk,
-                depth=depth, done_fn=done_fn,
+                depth=depth, done_fn=done_fn, spans=recorder,
                 on_dispatch=lambda t: log.emit(
                     "chunk", ticks=t, pipelined=True
                 ),
             )
     else:
+        from paxos_tpu.obs.host_spans import ensure_recorder
+
+        sp = ensure_recorder(recorder)
         with trace_mod.profile(args.trace):
             while done < args.ticks:
                 n = min(args.chunk, args.ticks - done)
-                state = advance(state, n)
+                with sp.span("dispatch", tick_start=done, ticks=n, groups=1):
+                    state = advance(state, n)
                 done += n
                 since_ckpt += n
-                rep = observe()
+                with sp.span("report", tick=done):
+                    rep = observe()
                 log.emit("chunk", **rep)
                 if "telemetry" in rep:
                     registry.ingest(rep["telemetry"])
@@ -533,8 +606,9 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                     )
                     log.emit("events", **rec)
                 if args.checkpoint_every and since_ckpt >= args.checkpoint_every:
-                    ckpt.save(args.checkpoint_dir, state, plan, cfg,
-                              engine=args.engine, block=args.block)
+                    with sp.span("checkpoint", tick=done):
+                        ckpt.save(args.checkpoint_dir, state, plan, cfg,
+                                  engine=args.engine, block=args.block)
                     log.emit("checkpoint", path=args.checkpoint_dir,
                              tick=int(state.tick))
                     since_ckpt = 0
@@ -547,16 +621,26 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
 
     report = observe(liveness=args.liveness)
     report["config_fingerprint"] = cfg.fingerprint()
-    if depth > 1:
-        report["pipeline_depth"] = depth
+    # EFFECTIVE depth, always: the requested depth may have been degraded
+    # above, and a silent fallback must not be invisible in the report.
+    report["pipeline_depth"] = depth
     if args.checkpoint_dir:
         ckpt.save(args.checkpoint_dir, state, plan, cfg,
                   engine=args.engine, block=args.block)
         log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
     if "telemetry" in report:
         registry.ingest(report["telemetry"])
+    if recorder is not None:
+        from paxos_tpu.obs.export import write_chrome_trace
+
+        write_chrome_trace(
+            args.span_trace, {}, host=recorder,
+            meta={"config": args.config, "engine": args.engine},
+        )
+        log.emit("span_trace", path=args.span_trace,
+                 host_spans=len(recorder.spans))
     snap = registry.snapshot()
-    if snap["counters"] or snap["histograms"]:
+    if snap["counters"] or snap["histograms"] or snap.get("gauges"):
         log.emit("metrics", **snap)
     log.emit("final", **report)
     print(json.dumps(report))
@@ -660,6 +744,13 @@ def cmd_soak(args: argparse.Namespace) -> int:
         return 1
     from paxos_tpu.harness.metrics import MetricsLog
 
+    recorder = None
+    if args.span_trace:
+        import time
+
+        from paxos_tpu.obs.host_spans import HostSpanRecorder
+
+        recorder = HostSpanRecorder(time.perf_counter)
     with MetricsLog(args.log) as mlog:
         mlog.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
                   n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)
@@ -672,8 +763,18 @@ def cmd_soak(args: argparse.Namespace) -> int:
             log=lambda s: print(f"# {s}", file=sys.stderr),
             min_slots_per_lane_tick=band or None,
             pipeline_depth=depth,
+            spans=recorder,
         )
         report["config"] = args.config
+        if recorder is not None:
+            from paxos_tpu.obs.export import write_chrome_trace
+
+            write_chrome_trace(
+                args.span_trace, {}, host=recorder,
+                meta={"config": args.config, "engine": args.engine},
+            )
+            mlog.emit("span_trace", path=args.span_trace,
+                      host_spans=len(recorder.spans))
         if report["violations"]:
             # emit() flushes per record, so the violation tally is durable
             # in the JSONL stream even if the process dies right after.
@@ -751,6 +852,7 @@ def cmd_stats(args: argparse.Namespace) -> int:
     kinds: dict[str, int] = {}
     final = None
     last_tel = None
+    last_agg = None
     for rec in records:
         kind = rec.get("event", "?")
         kinds[kind] = kinds.get(kind, 0) + 1
@@ -759,10 +861,16 @@ def cmd_stats(args: argparse.Namespace) -> int:
         # total, whether it rode a chunk record or the final one.
         if isinstance(rec.get("telemetry"), dict):
             last_tel = rec["telemetry"]
+        # Span-trace aggregates (`trace` subcommand) are whole-campaign
+        # summaries; the last record wins for the same reason.
+        if kind == "spans" and isinstance(rec.get("aggregates"), dict):
+            last_agg = rec["aggregates"]
         if kind == "final":
             final = rec
     if last_tel is not None:
         registry.ingest(last_tel)
+    if last_agg is not None:
+        registry.ingest_span_aggregates(last_agg)
 
     if args.prometheus:
         print(registry.to_prometheus(), end="")
@@ -790,6 +898,14 @@ def cmd_stats(args: argparse.Namespace) -> int:
         }
     if last_tel is not None:
         out["telemetry"] = last_tel
+        if last_tel.get("hist"):
+            from paxos_tpu.core.telemetry import hist_saturation
+
+            # Recompute (rather than trust the record) so logs written
+            # before the overflow flag existed still get the verdict.
+            out["hist_saturation"] = hist_saturation(last_tel["hist"])
+    if last_agg is not None:
+        out["span_aggregates"] = last_agg
     print(json.dumps(out))
     return 0
 
@@ -988,8 +1104,93 @@ def cmd_shrink(args: argparse.Namespace) -> int:
         "replays": replay(cfg, result),
         **result.to_json(),
     }
+    if args.trace_out and result.spans is not None:
+        from paxos_tpu.obs.export import write_chrome_trace
+
+        write_chrome_trace(
+            args.trace_out, {result.lane: result.spans},
+            meta={"config": args.config, "repro": "shrink",
+                  "lane": result.lane, "ticks": result.ticks},
+        )
+        print(f"# trace: {args.trace_out}", file=sys.stderr)
     print(json.dumps(out))
     return 2
+
+
+def cmd_trace(args: argparse.Namespace) -> int:
+    """Causal round tracing: run a recorded campaign, export the unified
+    device+host Perfetto timeline, and print the span summary as JSON."""
+    import time
+
+    import jax
+
+    from paxos_tpu.harness.metrics import MetricsLog, MetricsRegistry
+    from paxos_tpu.obs.capture import capture_round_trace
+    from paxos_tpu.obs.export import spans_jsonl, write_chrome_trace
+    from paxos_tpu.obs.host_spans import HostSpanRecorder
+
+    if args.engine == "fused" and jax.devices()[0].platform != "tpu":
+        print("error: --engine fused compiles Mosaic kernels (TPU only); "
+              "use --engine xla", file=sys.stderr)
+        return 1
+    try:
+        depth = config_mod.validate_pipeline_depth(args.pipeline_depth)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    kw = {"seed": args.seed}
+    if args.n_inst:
+        kw["n_inst"] = args.n_inst
+    cfg = CONFIGS[args.config](**kw)
+    try:
+        cfg = config_mod.apply_fault_overrides(cfg, args.fault)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    # The CLI owns the wall clock and injects it; the obs package itself
+    # never touches `time` (purity-audit scope).
+    recorder = HostSpanRecorder(time.perf_counter)
+    with MetricsLog(args.log) as log:
+        log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
+                 n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)
+        cap = capture_round_trace(
+            cfg, ticks=args.ticks, chunk=args.chunk, engine=args.engine,
+            depth=depth, max_lanes=args.lanes, recorder=recorder,
+        )
+        write_chrome_trace(
+            args.out, cap.spans, host=recorder,
+            meta={"config": args.config, "engine": args.engine,
+                  "seed": args.seed, "ticks": args.ticks,
+                  "fingerprint": cfg.fingerprint()},
+        )
+        if args.spans_out:
+            with open(args.spans_out, "w") as fh:
+                fh.write(spans_jsonl(
+                    s for lane in cap.lanes for s in cap.spans[lane]
+                ))
+        registry = MetricsRegistry()
+        log.emit("report", **cap.report)
+        if "telemetry" in cap.report:
+            registry.ingest(cap.report["telemetry"])
+        registry.ingest_span_aggregates(cap.aggregates)
+        log.emit("spans", lanes=cap.lanes, aggregates=cap.aggregates)
+        log.emit("metrics", **registry.snapshot())
+        summary = {
+            "trace": args.out,
+            "config": args.config,
+            "engine": args.engine,
+            "ticks": args.ticks,
+            "lanes": cap.lanes,
+            "violations": cap.report.get("violations"),
+            "host_spans": len(recorder.spans),
+            **cap.aggregates,
+        }
+        if args.spans_out:
+            summary["spans_jsonl"] = args.spans_out
+        log.emit("final", **summary)
+    print(json.dumps(summary))
+    return 0
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -1012,6 +1213,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_check(args)
     if args.cmd == "stats":
         return cmd_stats(args)
+    if args.cmd == "trace":
+        return cmd_trace(args)
     if args.cmd == "audit":
         return cmd_audit(args)
     return 1

@@ -79,11 +79,18 @@ class MetricsRegistry:
         self._counters: dict[str, dict[tuple, float]] = {}
         # name -> {"counts": list[int], "bin_width": int}
         self._hists: dict[str, dict[str, Any]] = {}
+        # name -> {labels-tuple -> value}; last-write-wins point-in-time values.
+        self._gauges: dict[str, dict[tuple, float]] = {}
 
     def inc(self, name: str, value: float = 1, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
         series = self._counters.setdefault(name, {})
         series[key] = series.get(key, 0) + value
+
+    def gauge(self, name: str, value: float, **labels: str) -> None:
+        """Set a point-in-time value (overwrite, not accumulate)."""
+        key = tuple(sorted(labels.items()))
+        self._gauges.setdefault(name, {})[key] = value
 
     def observe_hist(
         self, name: str, counts: "list[int]", bin_width: int
@@ -117,6 +124,29 @@ class MetricsRegistry:
                 "counts": list(hist),
                 "bin_width": report.get("hist_ticks_per_bin", 1),
             }
+        if "hist_overflow" in report:
+            self.gauge("hist_overflow_decides", report["hist_overflow"])
+
+    def ingest_span_aggregates(self, agg: dict[str, Any]) -> None:
+        """Fold ``obs.spans.span_aggregates`` output into gauges.
+
+        Span aggregates are whole-campaign summaries (not deltas), so they
+        land as gauges; quantiles become one ``round_latency_ticks`` series
+        labelled by quantile, matching Prometheus summary idiom.
+        """
+        for q in ("p50", "p95", "p99"):
+            v = agg.get(f"round_latency_{q}")
+            if v is not None and v >= 0:
+                self.gauge("round_latency_ticks", v, quantile=q)
+        for name in (
+            "rounds_total",
+            "rounds_decided",
+            "rounds_preempted",
+            "preemption_depth_max",
+            "faults_per_decided_round",
+        ):
+            if agg.get(name) is not None:
+                self.gauge(name, agg[name])
 
     def snapshot(self) -> dict[str, Any]:
         """One JSON-ready dict of everything in the registry."""
@@ -129,7 +159,15 @@ class MetricsRegistry:
             name: {"counts": h["counts"], "bin_width": h["bin_width"]}
             for name, h in sorted(self._hists.items())
         }
-        return {"counters": counters, "histograms": hists}
+        gauges: dict[str, Any] = {}
+        for name, series in sorted(self._gauges.items()):
+            for key, value in sorted(series.items()):
+                label = ",".join(f"{k}={v}" for k, v in key)
+                gauges[f"{name}{{{label}}}" if label else name] = value
+        snap: dict[str, Any] = {"counters": counters, "histograms": hists}
+        if gauges:
+            snap["gauges"] = gauges
+        return snap
 
     def emit(self, log: MetricsLog, event: str = "metrics") -> dict[str, Any]:
         """Write the current snapshot as one JSONL record to ``log``."""
@@ -145,6 +183,13 @@ class MetricsRegistry:
                 label = ",".join(f'{k}="{v}"' for k, v in key)
                 suffix = f"{{{label}}}" if label else ""
                 lines.append(f"{ns}_{name}{suffix} {int(value)}")
+        for name, series in sorted(self._gauges.items()):
+            lines.append(f"# TYPE {ns}_{name} gauge")
+            for key, value in sorted(series.items()):
+                label = ",".join(f'{k}="{v}"' for k, v in key)
+                suffix = f"{{{label}}}" if label else ""
+                val = int(value) if float(value).is_integer() else value
+                lines.append(f"{ns}_{name}{suffix} {val}")
         for name, h in sorted(self._hists.items()):
             lines.append(f"# TYPE {ns}_{name} histogram")
             cum = 0

@@ -60,6 +60,7 @@ def pipelined_run(
     depth: int,
     done_fn: Optional[Callable] = None,
     on_dispatch: Optional[Callable[[int], None]] = None,
+    spans=None,
 ):
     """Drive ``advance(state, n_ticks, groups)`` for ``budget`` ticks.
 
@@ -78,9 +79,18 @@ def pipelined_run(
     ``on_dispatch(ticks_done)`` is called after each dispatch is enqueued
     (host-side bookkeeping such as per-dispatch log records).
 
+    ``spans`` is an optional ``obs.host_spans.HostSpanRecorder``: each
+    grouped dispatch and each done-flag probe becomes a wall-clock span on
+    the host track of a merged Perfetto trace, with the dispatch's tick
+    window in its args (the causal device<->host correlation).  Purely
+    observational — ``None`` (the default) takes the identical code path.
+
     Returns ``(state, ticks_dispatched, exit_tick)`` — ``exit_tick`` is the
     dispatch boundary where the done flag first read true, or None.
     """
+    from paxos_tpu.obs.host_spans import ensure_recorder
+
+    sp = ensure_recorder(spans)
     done = 0
     exit_tick = None
     while done < budget:
@@ -89,14 +99,17 @@ def pipelined_run(
             n, g = left, 1
         else:
             n, g = chunk, min(depth, left // chunk)
-        state = advance(state, n, g)
+        with sp.span("dispatch", tick_start=done, ticks=n * g, groups=g):
+            state = advance(state, n, g)
         done += n * g
         if on_dispatch is not None:
             on_dispatch(done)
         if done_fn is not None:
-            flag = done_fn(state)
-            start_transfer(flag)
-            if bool(jax.device_get(flag)):
+            with sp.span("probe", tick=done):
+                flag = done_fn(state)
+                start_transfer(flag)
+                is_done = bool(jax.device_get(flag))
+            if is_done:
                 exit_tick = done
                 break
     return state, done, exit_tick
@@ -115,15 +128,22 @@ class AsyncSummary:
     two halves.
     """
 
-    def __init__(self, state, liveness: bool = False, log_total: int = 0):
+    def __init__(
+        self, state, liveness: bool = False, log_total: int = 0, spans=None
+    ):
         from paxos_tpu.harness.run import summarize_device
+        from paxos_tpu.obs.host_spans import ensure_recorder
 
-        self._dev, self._meta = summarize_device(
-            state, liveness=liveness, log_total=log_total
-        )
-        start_transfer(self._dev)
+        self._sp = ensure_recorder(spans)
+        with self._sp.span("report_transfer_start"):
+            self._dev, self._meta = summarize_device(
+                state, liveness=liveness, log_total=log_total
+            )
+            start_transfer(self._dev)
 
     def get(self) -> dict[str, Any]:
         from paxos_tpu.harness.run import summarize_host
 
-        return summarize_host(jax.device_get(self._dev), self._meta)
+        with self._sp.span("report_drain"):
+            host = jax.device_get(self._dev)
+        return summarize_host(host, self._meta)

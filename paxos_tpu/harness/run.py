@@ -599,6 +599,7 @@ def run(
     engine: str = "xla",
     liveness: bool = False,
     pipeline_depth: int = 1,
+    spans=None,
 ):
     """Host loop: init, scan chunks, return the final report.
 
@@ -619,6 +620,10 @@ def run(
     dispatches — the schedule stream is bit-identical at any depth — but an
     ``until_all_chosen`` exit is probed per dispatch, so the reported
     ``ticks`` may exceed the serial exit tick by < ``depth * chunk``.
+
+    ``spans`` (an ``obs.host_spans.HostSpanRecorder``) adds wall-clock
+    spans for every dispatch/probe to a merged Perfetto trace — purely
+    observational, never schedule-relevant.
     """
     from paxos_tpu.harness.config import validate_pipeline_depth
     from paxos_tpu.harness.pipeline import pipelined_run
@@ -638,7 +643,7 @@ def run(
     budget = max_ticks if until_all_chosen else total_ticks
     state, _, exit_tick = pipelined_run(
         state, advance, budget=budget, chunk=chunk, depth=depth,
-        done_fn=done_fn,
+        done_fn=done_fn, spans=spans,
     )
     report = summarize(state, liveness=liveness, log_total=cfg.fault.log_total)
     report["config_fingerprint"] = cfg.fingerprint()

@@ -61,6 +61,10 @@ class ShrinkResult:
     # [{"tick": 3, "events": ["corrupt", "accept"]}, ...] — so a shrunk
     # repro ships with a human-readable event history, not just atoms.
     timeline: Optional[list] = None
+    # Round spans reconstructed from the timeline (obs.spans): per ballot
+    # attempt, open/close ticks, outcome, and fault annotations — the
+    # causal reading of the raw timeline.
+    spans: Optional[list] = None
 
     def to_json(self) -> dict[str, Any]:
         out = {
@@ -74,6 +78,8 @@ class ShrinkResult:
         }
         if self.timeline is not None:
             out["timeline"] = self.timeline
+        if self.spans is not None:
+            out["spans"] = [s.to_json() for s in self.spans]
         return out
 
 
@@ -328,6 +334,10 @@ def shrink(
     )
     result.timeline = violation_timeline(cfg, result)
     say(f"timeline: {len(result.timeline)} recorded ticks in lane {lane}")
+    from paxos_tpu.obs.spans import build_spans
+
+    result.spans = build_spans(result.timeline, lane)
+    say(f"spans: {len(result.spans)} ballot rounds reconstructed")
     return result
 
 

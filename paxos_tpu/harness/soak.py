@@ -41,6 +41,7 @@ def _run_with_retries(
     say: Callable[[str], None],
     transient_retries: int,
     backoff_s: float = 5.0,
+    spans=None,
 ) -> tuple[dict, int]:
     """Call ``run_fn``, retrying transient runtime failures.
 
@@ -58,6 +59,9 @@ def _run_with_retries(
 
     import jax
 
+    from paxos_tpu.obs.host_spans import ensure_recorder
+
+    sp = ensure_recorder(spans)
     schedule = _retry_schedule(transient_retries, backoff_s)
     for attempt in range(transient_retries + 1):
         try:
@@ -71,7 +75,9 @@ def _run_with_retries(
             say(f"transient backend error (attempt {attempt + 1}/"
                 f"{transient_retries + 1}): {first_line}; "
                 f"retrying in {sleep:.1f}s")
-            time.sleep(sleep)
+            with sp.span("retry_backoff", attempt=attempt + 1,
+                         sleep_s=round(sleep, 3)):
+                time.sleep(sleep)
     raise AssertionError("unreachable")
 
 
@@ -87,6 +93,7 @@ def soak(
     retry_backoff_s: float = 5.0,
     min_slots_per_lane_tick: Optional[float] = None,
     pipeline_depth: int = 1,
+    spans=None,
 ) -> dict[str, Any]:
     """Run campaigns over rotating seeds until ``target_rounds`` accumulate.
 
@@ -151,10 +158,16 @@ def soak(
     ``evictions`` is the post-recheck residual — nonzero only if a campaign
     still evicts at the largest table (``evictions_first_pass`` keeps the
     raw pre-escalation count).
+
+    ``spans`` (an ``obs.host_spans.HostSpanRecorder``) records wall-clock
+    spans for each campaign's dispatch, report drain, recheck replays, and
+    retry backoffs — purely observational, never schedule-relevant.
     """
     from paxos_tpu.harness.config import validate_pipeline_depth
+    from paxos_tpu.obs.host_spans import ensure_recorder
 
     say = log or (lambda s: None)
+    sp = ensure_recorder(spans)
     depth = validate_pipeline_depth(pipeline_depth)
     if min_slots_per_lane_tick is not None and not (
         cfg.protocol == "multipaxos" and cfg.fault.log_total
@@ -192,6 +205,7 @@ def soak(
         return run(
             rcfg, total_ticks=ticks_per_seed, chunk=chunk,
             engine=engine, liveness=True, pipeline_depth=depth,
+            spans=spans,
         )
 
     def dispatch_campaign(scfg):
@@ -209,17 +223,20 @@ def soak(
         )
 
         try:
-            state = init_state(scfg)
-            plan = init_plan(scfg)
-            adv = make_advance_grouped(
-                scfg, plan, engine, compact=bool(make_longlog(scfg))
-            )
-            state, _, _ = pipelined_run(
-                state, adv, budget=ticks_per_seed, chunk=chunk, depth=depth
-            )
-            return AsyncSummary(
-                state, liveness=True, log_total=scfg.fault.log_total
-            )
+            with sp.span("campaign_dispatch", seed=scfg.seed):
+                state = init_state(scfg)
+                plan = init_plan(scfg)
+                adv = make_advance_grouped(
+                    scfg, plan, engine, compact=bool(make_longlog(scfg))
+                )
+                state, _, _ = pipelined_run(
+                    state, adv, budget=ticks_per_seed, chunk=chunk,
+                    depth=depth, spans=spans,
+                )
+                return AsyncSummary(
+                    state, liveness=True, log_total=scfg.fault.log_total,
+                    spans=spans,
+                )
         except jax.errors.JaxRuntimeError as e:
             first_line = (str(e).splitlines() or [""])[0][:120]
             say(f"seed {scfg.seed}: async dispatch failed ({first_line}); "
@@ -238,9 +255,10 @@ def soak(
                 return handle.get()
             return serial_campaign(scfg)
 
-        return _run_with_retries(
-            run_fn, say, transient_retries, retry_backoff_s
-        )
+        with sp.span("campaign_finalize", seed=scfg.seed):
+            return _run_with_retries(
+                run_fn, say, transient_retries, retry_backoff_s, spans=spans
+            )
 
     # Overlap-by-one campaign loop: `planned` counts dispatched campaigns
     # (runs one ahead of `seeds` when pipelined), `pending` is the campaign
@@ -284,7 +302,7 @@ def soak(
                 rcfg = dataclasses.replace(fscfg, k_slots=k)
                 report, used = _run_with_retries(
                     lambda: serial_campaign(rcfg),
-                    say, transient_retries, retry_backoff_s,
+                    say, transient_retries, retry_backoff_s, spans=spans,
                 )
                 retries_used += used
                 recheck_rounds += fscfg.n_inst * ticks_per_seed

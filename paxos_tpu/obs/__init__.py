@@ -1,0 +1,39 @@
+"""Causal round tracing — span reconstruction and standard-tooling export.
+
+Model-checking practice treats the counterexample *trace* as the product,
+not the verdict (PAPERS.md: Spin Paxos), and hardware-consensus designs
+keep event accounting on the fast path so rich observability is free when
+idle (NetPaxos).  ``core.telemetry`` (PR 2) is the raw material: packed
+per-lane event rings, counters, histograms.  This package is the layer
+above — it turns decoded rings into *causal, span-level* traces and emits
+them in formats standard tooling loads:
+
+- :mod:`spans` — replay a decoded flight-recorder timeline into per-lane,
+  per-ballot round spans (phase-1 open -> promise quorum -> phase-2 ->
+  decide/timeout/preemption), each annotated with the fault events that
+  landed inside it, plus span-derived aggregates (round-latency
+  percentiles, preemption depth, faults per decided round).
+- :mod:`host_spans` — wall-clock spans for the host dispatch loop
+  (dispatch groups, done-flag probes, device->host transfers, checkpoint
+  writes, retry backoffs).  The clock is INJECTED by the harness layer:
+  this package never reads the host clock or entropy itself, so it sits
+  inside the static auditor's purity scope (``analysis/purity``).
+- :mod:`export` — Chrome trace-event JSON (Perfetto-loadable: one track
+  per lane, async spans per ballot, instant events for faults, host spans
+  on a separate process track) and a compact JSONL span format for
+  programmatic diffing, plus a schema validator.
+- :mod:`capture` — drive a campaign with the recorder on and the host
+  span layer wrapping the pipelined dispatch loop; backs the
+  ``paxos_tpu trace`` CLI subcommand.
+
+Everything here is host-side decode: zero new device ops, zero PRNG
+draws, schedules bit-identical (the PR 4 auditor and the golden digests
+confirm the layer cannot perturb a campaign).
+"""
+
+from paxos_tpu.obs.spans import (  # noqa: F401
+    FAULT_EVENTS,
+    RoundSpan,
+    build_spans,
+    span_aggregates,
+)

@@ -1,0 +1,129 @@
+"""Trace capture — run a campaign with the recorder on; decode into spans.
+
+Backs the ``paxos_tpu trace`` CLI subcommand: enable the on-device flight
+recorder (ring sized to the tick budget, so the "last window" is the full
+history), drive the pipelined dispatch loop with the host span layer
+wrapping every dispatch and probe, then decode the interesting lanes and
+reconstruct round spans.
+
+Telemetry draws no randomness and the host span layer only *observes* the
+loop, so the captured schedule is bit-identical to an untraced run of the
+same (config, seed, engine) — the whole point of tracing a fuzzer: the
+trace IS the campaign, not a perturbed cousin.
+
+Clock doctrine: this module takes an already-built
+:class:`~paxos_tpu.obs.host_spans.HostSpanRecorder` (or ``None``) — the
+harness layer owns wall clocks; ``obs`` stays clock-free for the purity
+auditor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+
+from paxos_tpu.obs.host_spans import HostSpanRecorder, ensure_recorder
+from paxos_tpu.obs.spans import RoundSpan, build_spans, span_aggregates
+
+# Ring depth cap: (depth, n_inst) int32 per lane; 4096 x 64k lanes is 1 GiB,
+# so campaigns longer than this must raise --n-inst trade-offs explicitly.
+MAX_RING = 4096
+
+
+@dataclasses.dataclass
+class CaptureResult:
+    report: dict[str, Any]  # the campaign's summarize() report
+    lanes: list[int]  # decoded lanes (violating lanes first)
+    timelines: dict[int, list]  # lane -> decode_lane output
+    spans: dict[int, list[RoundSpan]]  # lane -> reconstructed rounds
+    aggregates: dict[str, Any]  # span_aggregates over every decoded lane
+    host: Optional[HostSpanRecorder]  # wall-clock dispatch spans
+
+
+def recorder_config(cfg, ticks: int):
+    """``cfg`` with the flight recorder sized for a full-history trace."""
+    from paxos_tpu.core.telemetry import HIST_TICKS_PER_BIN, TelemetryConfig
+
+    return dataclasses.replace(
+        cfg,
+        telemetry=TelemetryConfig(
+            counters=True,
+            ring_depth=min(ticks, MAX_RING),
+            # One bin per HIST_TICKS_PER_BIN ticks covers the whole budget,
+            # +1 catch-all so in-budget decides never saturate the tail.
+            hist_bins=min(-(-ticks // HIST_TICKS_PER_BIN) + 1, 128),
+        ),
+    )
+
+
+def pick_lanes(violations, n_inst: int, max_lanes: int) -> list[int]:
+    """Lanes to decode: violating lanes first, then lane 0 upward."""
+    chosen: list[int] = [int(i) for i in violations.nonzero()[0][:max_lanes]]
+    lane = 0
+    while len(chosen) < min(max_lanes, n_inst):
+        if lane not in chosen:
+            chosen.append(lane)
+        lane += 1
+    return chosen
+
+
+def capture_round_trace(
+    cfg,
+    *,
+    ticks: int,
+    chunk: int = 64,
+    engine: str = "xla",
+    depth: int = 4,
+    max_lanes: int = 8,
+    recorder: Optional[HostSpanRecorder] = None,
+) -> CaptureResult:
+    """Run ``cfg`` for ``ticks`` with full tracing; decode ``max_lanes`` lanes.
+
+    The loop is the pipelined dispatcher (``harness.pipeline``) so the
+    host track shows real grouped dispatches; ``depth=1`` degrades to the
+    serial per-chunk loop.  The returned spans are per-lane round
+    reconstructions (``obs.spans``); aggregates cover every decoded lane.
+    """
+    from paxos_tpu.core.telemetry import decode_lane
+    from paxos_tpu.harness.pipeline import pipelined_run
+    from paxos_tpu.harness.run import (
+        init_plan,
+        init_state,
+        make_advance_grouped,
+        make_longlog,
+        summarize,
+    )
+
+    sp = ensure_recorder(recorder)
+    tcfg = recorder_config(cfg, ticks)
+    with sp.span("init", n_inst=tcfg.n_inst, protocol=tcfg.protocol):
+        state = init_state(tcfg)
+        plan = init_plan(tcfg)
+        advance = make_advance_grouped(
+            tcfg, plan, engine, compact=bool(make_longlog(tcfg))
+        )
+    state, _, _ = pipelined_run(
+        state, advance, budget=ticks, chunk=chunk, depth=depth,
+        spans=recorder,
+    )
+    with sp.span("summarize"):
+        report = summarize(state, log_total=tcfg.fault.log_total)
+    with sp.span("violations_readback"):
+        viol = jax.device_get(state.learner.violations)
+    if viol.ndim > 1:  # multipaxos: (L, I) slot violations -> per-lane
+        viol = viol.sum(axis=0)
+    lanes = pick_lanes(viol, tcfg.n_inst, max_lanes)
+
+    timelines: dict[int, list] = {}
+    spans: dict[int, list[RoundSpan]] = {}
+    with sp.span("decode", lanes=len(lanes)):
+        for lane in lanes:
+            timelines[lane] = decode_lane(state.telemetry, lane)
+            spans[lane] = build_spans(timelines[lane], lane)
+    agg = span_aggregates(s for lane in lanes for s in spans[lane])
+    return CaptureResult(
+        report=report, lanes=lanes, timelines=timelines, spans=spans,
+        aggregates=agg, host=recorder,
+    )

@@ -1,0 +1,202 @@
+"""Trace export — Chrome trace-event JSON (Perfetto-loadable) and span JSONL.
+
+The Chrome trace-event format (loadable by Perfetto and chrome://tracing)
+is the lingua franca of timeline tooling, so the span layer exports to it
+directly:
+
+- **Device track** (pid ``DEVICE_PID``): one thread per lane; each
+  reconstructed round is an async begin/end pair (``ph: b/e``, one id per
+  ballot attempt) so overlapping re-decodes nest cleanly, and every fault
+  annotation is a thread-scoped instant event (``ph: i``).  Device time is
+  tick-time: ``ts = tick * tick_us`` (default 1 tick = 1000 us, so
+  Perfetto's ms ruler reads directly in ticks).
+- **Host track** (pid ``HOST_PID``): the dispatch loop's wall-clock spans
+  (``obs.host_spans``) as complete events (``ph: X``) plus instants —
+  dispatch groups, done-flag probes, transfers, checkpoint writes, retry
+  backoffs.  Host time is real microseconds from capture start.
+
+The two tracks share one file but not one clock — dispatch spans carry
+their tick window in ``args`` (``tick_start``/``ticks``), which is the
+honest causal correlation between device-tick time and host wall time.
+
+``validate_chrome_trace`` is the schema gate used by tests/test_obs.py and
+``scripts/trace.sh``: required keys per phase, non-decreasing ``ts``, and
+matched async begin/end pairs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable, Optional
+
+from paxos_tpu.obs.host_spans import HostSpanRecorder
+from paxos_tpu.obs.spans import RoundSpan
+
+DEVICE_PID = 0  # tick-time process track (one thread per lane)
+HOST_PID = 1  # wall-clock process track (the dispatch loop)
+TICK_US = 1000  # default device-time scale: 1 tick renders as 1 ms
+
+
+def _meta(name: str, pid: int, tid: Optional[int] = None, label: str = "") -> dict:
+    ev: dict[str, Any] = {
+        "ph": "M", "name": name, "pid": pid, "ts": 0, "args": {"name": label},
+    }
+    if tid is not None:
+        ev["tid"] = tid
+    return ev
+
+
+def chrome_trace_events(
+    lane_spans: "dict[int, list[RoundSpan]]",
+    host: Optional[HostSpanRecorder] = None,
+    tick_us: int = TICK_US,
+) -> list[dict]:
+    """Flatten spans + host recorder into a sorted trace-event list."""
+    events: list[dict] = []
+    if lane_spans:
+        events.append(_meta(
+            "process_name", DEVICE_PID,
+            label=f"device (ticks; 1 tick = {tick_us}us)",
+        ))
+    for lane in sorted(lane_spans):
+        events.append(_meta("thread_name", DEVICE_PID, lane, f"lane {lane}"))
+        for s in lane_spans[lane]:
+            sid = f"L{lane}R{s.round}"
+            args = {
+                "outcome": s.outcome,
+                "events": dict(sorted(s.events.items())),
+                "faults": len(s.faults),
+            }
+            for k in ("p1_tick", "p2_tick", "leader_tick", "conflict_tick"):
+                v = getattr(s, k)
+                if v is not None:
+                    args[k] = v
+            common = {
+                "cat": "round", "id": sid, "pid": DEVICE_PID, "tid": lane,
+                "name": f"round {s.round}",
+            }
+            events.append({
+                "ph": "b", "ts": s.start * tick_us, "args": args, **common,
+            })
+            # Exclusive end tick: a round closed the tick it opened still
+            # renders one tick wide instead of vanishing at zero width.
+            events.append({"ph": "e", "ts": (s.end + 1) * tick_us, **common})
+            for f in s.faults:
+                events.append({
+                    "ph": "i", "s": "t", "cat": "fault", "name": f["kind"],
+                    "pid": DEVICE_PID, "tid": lane, "ts": f["tick"] * tick_us,
+                    "args": {"round": s.round},
+                })
+    if host is not None:
+        events.append(_meta("process_name", HOST_PID, label="host (wall clock)"))
+        events.append(_meta("thread_name", HOST_PID, 0, "dispatch loop"))
+        for sp in host.spans:
+            events.append({
+                "ph": "X", "cat": "host", "name": sp["name"], "pid": HOST_PID,
+                "tid": 0, "ts": sp["ts"], "dur": sp["dur"],
+                "args": dict(sp["args"]),
+            })
+        for ins in host.instants:
+            events.append({
+                "ph": "i", "s": "t", "cat": "host", "name": ins["name"],
+                "pid": HOST_PID, "tid": 0, "ts": ins["ts"],
+                "args": dict(ins["args"]),
+            })
+    # Perfetto tolerates any order, but sorted-ts output makes the schema
+    # check ("monotonic ts") and diffs deterministic.  Stable sort keeps
+    # b-before-e for zero-length pairs and metadata first at ts 0.
+    events.sort(key=lambda e: e["ts"])
+    return events
+
+
+def chrome_trace(
+    lane_spans: "dict[int, list[RoundSpan]]",
+    host: Optional[HostSpanRecorder] = None,
+    tick_us: int = TICK_US,
+    meta: Optional[dict] = None,
+) -> dict:
+    """The full Chrome trace JSON object (``traceEvents`` container)."""
+    return {
+        "traceEvents": chrome_trace_events(lane_spans, host, tick_us),
+        "displayTimeUnit": "ms",
+        "otherData": dict(meta or {}),
+    }
+
+
+def write_chrome_trace(
+    path: str,
+    lane_spans: "dict[int, list[RoundSpan]]",
+    host: Optional[HostSpanRecorder] = None,
+    tick_us: int = TICK_US,
+    meta: Optional[dict] = None,
+) -> dict:
+    """Write the trace to ``path``; returns the object written."""
+    obj = chrome_trace(lane_spans, host, tick_us, meta)
+    with open(path, "w") as fh:
+        json.dump(obj, fh)
+    return obj
+
+
+def spans_jsonl(spans: Iterable[RoundSpan]) -> str:
+    """Compact one-span-per-line JSONL — the programmatic-diff format."""
+    return "".join(
+        json.dumps(s.to_json(), sort_keys=True) + "\n" for s in spans
+    )
+
+
+# Keys every event must carry, plus per-phase extras.
+_REQUIRED_COMMON = ("ph", "name", "pid", "ts")
+_REQUIRED_BY_PH = {
+    "b": ("cat", "id", "tid"),
+    "e": ("cat", "id", "tid"),
+    "X": ("dur", "tid"),
+    "i": ("s",),
+    "M": ("args",),
+}
+
+
+def validate_chrome_trace(obj: Any) -> list[str]:
+    """Schema-check a Chrome trace object; returns error strings (empty = ok).
+
+    Checks: container shape, required keys per phase, non-decreasing
+    ``ts`` across the event list, and async begin/end discipline (every
+    ``e`` follows a matching ``b`` of the same (cat, id, pid); none left
+    open at the end).
+    """
+    errors: list[str] = []
+    if not isinstance(obj, dict) or not isinstance(obj.get("traceEvents"), list):
+        return ["top level must be a dict with a 'traceEvents' list"]
+    last_ts = None
+    open_async: dict[tuple, int] = {}
+    for i, ev in enumerate(obj["traceEvents"]):
+        if not isinstance(ev, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        missing = [k for k in _REQUIRED_COMMON if k not in ev]
+        missing += [k for k in _REQUIRED_BY_PH.get(ph, ()) if k not in ev]
+        if missing:
+            errors.append(f"event {i} (ph={ph!r}): missing keys {missing}")
+            continue
+        ts = ev["ts"]
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if last_ts is not None and ts < last_ts:
+            errors.append(f"event {i}: ts {ts} < previous {last_ts}")
+        last_ts = ts
+        if ph == "b":
+            key = (ev["cat"], ev["id"], ev["pid"])
+            open_async[key] = open_async.get(key, 0) + 1
+        elif ph == "e":
+            key = (ev["cat"], ev["id"], ev["pid"])
+            if open_async.get(key, 0) <= 0:
+                errors.append(f"event {i}: async end without begin for {key}")
+            else:
+                open_async[key] -= 1
+        elif ph == "X" and ev["dur"] < 0:
+            errors.append(f"event {i}: negative dur {ev['dur']}")
+    for key, n in sorted(open_async.items()):
+        if n:
+            errors.append(f"async begin without end for {key} (x{n})")
+    return errors

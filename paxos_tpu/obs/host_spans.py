@@ -1,0 +1,72 @@
+"""Host-side wall-clock spans for the dispatch loop.
+
+The device timeline (``obs.spans``) is tick-time; the host loop — dispatch
+groups, done-flag probes, device->host transfers, checkpoint writes, retry
+backoffs — is wall-clock time.  :class:`HostSpanRecorder` captures the
+host side so the exporter (``obs.export``) can merge both onto one
+Perfetto view, each on its own process track.
+
+The clock is INJECTED: this module never imports ``time`` — the harness
+layer (which legitimately owns wall clocks) passes a monotonic clock
+callable in, keeping the whole ``obs`` package inside the static
+auditor's no-entropy/no-clock purity scope (``analysis/purity``).  Span
+records are plain dicts with microsecond offsets from the recorder's
+birth, ready for the Chrome trace-event ``X``/``i`` phases.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Iterator, Optional
+
+
+class HostSpanRecorder:
+    """Collect wall-clock spans and instants from the host loop.
+
+    ``clock`` is a monotonic seconds-returning callable (the harness
+    passes ``time.perf_counter``).  ``span`` is a context manager — spans
+    may nest (rendered stacked on the host track); ``instant`` marks a
+    point event.  All timestamps are integer microseconds since the
+    recorder was constructed.
+    """
+
+    def __init__(self, clock: Callable[[], float]):
+        self._clock = clock
+        self._t0 = clock()
+        self.spans: list[dict[str, Any]] = []  # {"name","ts","dur","args"}
+        self.instants: list[dict[str, Any]] = []  # {"name","ts","args"}
+
+    def now_us(self) -> int:
+        return int(round((self._clock() - self._t0) * 1e6))
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args: Any) -> Iterator[None]:
+        ts = self.now_us()
+        try:
+            yield
+        finally:
+            self.spans.append({
+                "name": name, "ts": ts,
+                "dur": max(self.now_us() - ts, 0), "args": args,
+            })
+
+    def instant(self, name: str, **args: Any) -> None:
+        self.instants.append({"name": name, "ts": self.now_us(), "args": args})
+
+
+class NullSpanRecorder:
+    """No-op stand-in so hot loops can write ``spans.span(...)`` unguarded."""
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args: Any) -> Iterator[None]:
+        yield
+
+    def instant(self, name: str, **args: Any) -> None:
+        pass
+
+
+def ensure_recorder(
+    spans: "Optional[HostSpanRecorder]",
+) -> "HostSpanRecorder | NullSpanRecorder":
+    """The harness-facing guard: ``None`` becomes the no-op recorder."""
+    return spans if spans is not None else NullSpanRecorder()

@@ -1,0 +1,198 @@
+"""Span builder — replay a decoded flight-recorder ring into round spans.
+
+The recorder (``core.telemetry``) emits at most one packed word per
+(lane, tick): an event bitmask over ``promise / accept / decide / conflict
+/ leader / timeout / drop / dup / corrupt / part_cut / part_heal /
+recover``.  A consensus round is not one event but an *interval*: a ballot
+opens, gathers a promise quorum, moves to phase 2, and ends in a decide, a
+proposer timeout (retry at a higher ballot), or a preemption (another
+leader/ballot takes over).  This module reconstructs those intervals from
+the flat per-lane timeline that ``core.telemetry.decode_lane`` produces.
+
+Reconstruction rules (shared by all four protocols; Raft rounds map to
+elections/terms):
+
+- ``decide`` closes the current span with outcome ``decided``.
+- ``timeout`` closes it with outcome ``timeout`` and opens the successor
+  at the same tick — the proposer retries with a higher ballot, so the
+  ordinal ``round`` index is the lane's ballot-attempt counter.
+- the FIRST ``leader`` event inside a span marks leadership established
+  (phase-1 won / election won); a SECOND one without an intervening decide
+  is a leadership change mid-round — the span closes ``preempted`` and the
+  successor opens at that tick.
+- fault events (``drop/dup/corrupt/part_cut/part_heal/recover``) never
+  open or close spans; they annotate the span they land inside.
+- a span still open when the timeline ends gets outcome ``open``.
+
+The ring stores ballot *events*, not ballot numbers, so ``round`` is the
+per-lane attempt ordinal — exactly the quantity preemption depth and
+retry-storm analyses need.  Reconstruction is a pure function of the
+decoded timeline: same ring, same spans, bit for bit (tests/test_obs.py
+pins determinism across decodes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Optional
+
+# Event kinds that annotate spans rather than delimit them (the fault
+# layer's footprints inside a round).
+FAULT_EVENTS = ("drop", "dup", "corrupt", "part_cut", "part_heal", "recover")
+
+# Span outcomes, in the order a round can end.
+OUTCOMES = ("decided", "timeout", "preempted", "open")
+
+
+@dataclasses.dataclass
+class RoundSpan:
+    """One reconstructed consensus round (ballot attempt) in one lane."""
+
+    lane: int
+    round: int  # per-lane ballot-attempt ordinal, 0-based
+    start: int  # tick the round opened
+    end: int  # tick of the closing event (== start for 1-tick rounds)
+    outcome: str  # one of OUTCOMES
+    p1_tick: Optional[int] = None  # first promise recorded (phase-1 progress)
+    p2_tick: Optional[int] = None  # first accept recorded (phase-2 progress)
+    leader_tick: Optional[int] = None  # leadership established in this span
+    conflict_tick: Optional[int] = None  # safety checker fired in this span
+    events: dict = dataclasses.field(default_factory=dict)  # kind -> count
+    faults: list = dataclasses.field(default_factory=list)  # {"tick","kind"}
+
+    def to_json(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "lane": self.lane,
+            "round": self.round,
+            "start": self.start,
+            "end": self.end,
+            "outcome": self.outcome,
+            "events": dict(sorted(self.events.items())),
+            "faults": list(self.faults),
+        }
+        for k in ("p1_tick", "p2_tick", "leader_tick", "conflict_tick"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        return out
+
+
+def build_spans(timeline: Iterable[dict], lane: int) -> list[RoundSpan]:
+    """Reconstruct ``RoundSpan``s from one lane's decoded timeline.
+
+    ``timeline`` is ``core.telemetry.decode_lane`` output: an ordered list
+    of ``{"tick": int, "events": [names]}`` records, at most one per tick.
+    Pure and deterministic — no clock, no randomness, no device traffic.
+    """
+    spans: list[RoundSpan] = []
+    cur: Optional[RoundSpan] = None
+    next_start: Optional[int] = None  # successor opens here (timeout tick)
+
+    def close(span: RoundSpan, tick: int, outcome: str) -> None:
+        span.end = tick
+        span.outcome = outcome
+        spans.append(span)
+
+    for rec in timeline:
+        tick = int(rec["tick"])
+        evs = rec["events"]
+        if cur is None:
+            start = next_start if next_start is not None else tick
+            cur = RoundSpan(
+                lane=lane, round=len(spans), start=start, end=start,
+                outcome="open",
+            )
+            next_start = None
+
+        for kind in evs:
+            cur.events[kind] = cur.events.get(kind, 0) + 1
+            if kind in FAULT_EVENTS:
+                cur.faults.append({"tick": tick, "kind": kind})
+        if "promise" in evs and cur.p1_tick is None:
+            cur.p1_tick = tick
+        if "accept" in evs and cur.p2_tick is None:
+            cur.p2_tick = tick
+        if "conflict" in evs and cur.conflict_tick is None:
+            cur.conflict_tick = tick
+
+        # Closing transitions, strongest first: a decide completes the
+        # round even if a timeout or leader change shares its tick.
+        if "decide" in evs:
+            close(cur, tick, "decided")
+            cur = None
+        elif "timeout" in evs:
+            close(cur, tick, "timeout")
+            cur, next_start = None, tick
+        elif "leader" in evs:
+            if cur.leader_tick is None:
+                cur.leader_tick = tick  # phase-1 won / election won
+            else:
+                close(cur, tick, "preempted")
+                cur, next_start = None, tick
+        if cur is not None:
+            cur.end = tick
+
+    if cur is not None:
+        close(cur, cur.end, "open")
+    return spans
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (deterministic)."""
+    if not sorted_vals:
+        return -1.0
+    rank = max(1, -(-int(q * len(sorted_vals) * 100) // 100))  # ceil(q*n)
+    rank = min(rank, len(sorted_vals))
+    return float(sorted_vals[rank - 1])
+
+
+def span_aggregates(spans: Iterable[RoundSpan]) -> dict[str, Any]:
+    """Campaign-level aggregates over reconstructed spans (any lane mix).
+
+    - ``round_latency_p50/p95/p99``: ticks from round open to decide,
+      nearest-rank percentiles over decided rounds (-1.0 when none decided).
+    - ``preemption_depth_max/mean``: ballot attempts burned before a
+      decide — the length of each maximal run of non-decided spans that
+      precedes a decided span, per lane.
+    - ``faults_per_decided_round``: fault annotations across ALL spans per
+      decided round.
+    """
+    by_lane: dict[int, list[RoundSpan]] = {}
+    for s in spans:
+        by_lane.setdefault(s.lane, []).append(s)
+
+    latencies: list[int] = []
+    depths: list[int] = []
+    counts = {o: 0 for o in OUTCOMES}
+    faults_total = 0
+    for lane_spans in by_lane.values():
+        depth = 0
+        for s in sorted(lane_spans, key=lambda s: s.round):
+            counts[s.outcome] = counts.get(s.outcome, 0) + 1
+            faults_total += len(s.faults)
+            if s.outcome == "decided":
+                latencies.append(s.end - s.start)
+                depths.append(depth)
+                depth = 0
+            else:
+                depth += 1
+    latencies.sort()
+    decided = counts["decided"]
+    return {
+        "rounds_total": sum(counts.values()),
+        "rounds_decided": decided,
+        "rounds_timeout": counts["timeout"],
+        "rounds_preempted": counts["preempted"],
+        "rounds_open": counts["open"],
+        "round_latency_p50": _percentile(latencies, 0.50),
+        "round_latency_p95": _percentile(latencies, 0.95),
+        "round_latency_p99": _percentile(latencies, 0.99),
+        "preemption_depth_max": max(depths, default=0),
+        "preemption_depth_mean": (
+            round(sum(depths) / len(depths), 6) if depths else 0.0
+        ),
+        "faults_total": faults_total,
+        "faults_per_decided_round": (
+            round(faults_total / decided, 6) if decided else float(faults_total)
+        ),
+    }

@@ -3,7 +3,9 @@
 # always-available fallbacks for the hermetic CI image, which ships
 # NEITHER tool and forbids installs:
 #   - python -m compileall  (syntax over the whole package)
-#   - the analysis AST pass (host-entropy/wall-clock ban in traced modules)
+#   - the analysis AST pass (host-entropy/wall-clock ban in traced modules,
+#     including obs/ — span reconstruction is held to the same purity bar;
+#     its wall clock is injected by the harness, never imported)
 # Missing tools are reported as SKIPPED, not failures — the fallbacks are
 # the floor, the real linters are the ceiling.
 #

@@ -32,6 +32,29 @@ if [ "$rc" -eq 0 ]; then
        --ticks-per-seed 64 --chunk 32 --pipeline-depth 2 >/dev/null 2>&1 \
   && echo PIPELINE_SMOKE=ok || { echo PIPELINE_SMOKE=FAILED; rc=1; }
 fi
+# Trace-export smoke: a short corrupt campaign through the `trace`
+# subcommand must yield a schema-valid Perfetto trace (per-lane round
+# spans + fault instants on the device track, dispatch spans on the host
+# track) — the causal-tracing acceptance path, kept cheap.
+if [ "$rc" -eq 0 ]; then
+  t=/tmp/_t1_trace.json; rm -f "$t"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxos_tpu trace \
+    --config corrupt --n-inst 128 --ticks 64 --chunk 16 --lanes 4 \
+    --out "$t" >/dev/null 2>&1 \
+  && timeout -k 10 30 env JAX_PLATFORMS=cpu python - "$t" <<'EOF' \
+  && echo TRACE_SMOKE=ok || { echo TRACE_SMOKE=FAILED; rc=1; }
+import json, sys
+from paxos_tpu.obs.export import validate_chrome_trace
+obj = json.load(open(sys.argv[1]))
+errs = validate_chrome_trace(obj)
+pids = {e["pid"] for e in obj["traceEvents"]}
+assert not errs, errs
+assert pids == {0, 1}, f"expected device+host tracks, got pids {pids}"
+assert any(e["ph"] == "b" for e in obj["traceEvents"]), "no round spans"
+assert any(e["ph"] == "i" and e.get("cat") == "fault"
+           for e in obj["traceEvents"]), "no fault instants"
+EOF
+fi
 # Static-audit smoke: one protocol x two configs through the full jaxpr
 # auditor (PRNG registry + purity + structure goldens) — trace-time only,
 # so seconds, but it catches stream/structure drift the runtime suite

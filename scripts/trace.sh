@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Causal round trace: run a short corrupt campaign with the flight
+# recorder on, export the unified device+host Perfetto timeline, and
+# schema-check the result.  The output loads directly in ui.perfetto.dev
+# or chrome://tracing: one thread per decoded lane with async ballot-round
+# spans and fault instants (device track, tick-time), plus the dispatch
+# loop's wall-clock spans (host track).
+#
+# Usage: scripts/trace.sh [out.json] [extra `paxos_tpu trace` flags...]
+#   scripts/trace.sh                            # trace.json, corrupt config
+#   scripts/trace.sh /tmp/t.json --config gray-chaos --ticks 512
+cd "$(dirname "$0")/.." || exit 1
+out="${1:-trace.json}"; [ $# -gt 0 ] && shift
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m paxos_tpu trace \
+  --config corrupt --ticks 256 --out "$out" "$@" || exit $?
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python - "$out" <<'EOF' || exit 1
+import json, sys
+from paxos_tpu.obs.export import validate_chrome_trace
+obj = json.load(open(sys.argv[1]))
+errs = validate_chrome_trace(obj)
+for e in errs:
+    print(f"schema: {e}", file=sys.stderr)
+raise SystemExit(1 if errs else 0)
+EOF
+echo "TRACE=$out (schema ok; load in ui.perfetto.dev)"

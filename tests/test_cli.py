@@ -262,3 +262,53 @@ def test_cli_check_native(capsys):
     assert main([
         "--platform", "cpu", "check", "--native", "--liveness-bound", "20",
     ]) == 1
+
+
+def test_cli_pipeline_degrade_is_loud_and_recorded(tmp_path, capsys):
+    """[bugfix] --events (and friends) force the serial loop: the degrade
+    must name the forcing flag on stderr and record the EFFECTIVE depth in
+    the report and metrics gauges — never a silent fallback an operator
+    could mistake for a pipelined run."""
+    log = tmp_path / "m.jsonl"
+    rc = main([
+        "run", "--config", "config1", "--n-inst", "64", "--ticks", "16",
+        "--chunk", "8", "--pipeline-depth", "4", "--events",
+        "--log", str(log),
+    ])
+    assert rc == 0
+    captured = capsys.readouterr()
+    warnings = [
+        l for l in captured.err.splitlines() if l.startswith("warning")
+    ]
+    assert warnings and "--events" in warnings[0]
+    assert "explicit" in warnings[0]  # the user asked for depth 4
+    report = json.loads(captured.out.strip().splitlines()[-1])
+    assert report["pipeline_depth"] == 1  # effective, not requested
+    records = [json.loads(l) for l in log.read_text().splitlines()]
+    metrics = [r for r in records if r["event"] == "metrics"]
+    assert metrics[-1]["gauges"]["pipeline_depth_effective"] == 1
+
+    # The default depth (4) degrades too — still loud, labelled as such.
+    rc = main([
+        "run", "--config", "config1", "--n-inst", "64", "--ticks", "16",
+        "--chunk", "8", "--events",
+    ])
+    assert rc == 0
+    captured = capsys.readouterr()
+    warnings = [
+        l for l in captured.err.splitlines() if l.startswith("warning")
+    ]
+    assert warnings and "default" in warnings[0]
+
+    # An undegraded run records its real depth.
+    rc = main([
+        "run", "--config", "config1", "--n-inst", "64", "--ticks", "16",
+        "--chunk", "8", "--pipeline-depth", "2",
+    ])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert not [
+        l for l in captured.err.splitlines() if l.startswith("warning")
+    ]
+    report = json.loads(captured.out.strip().splitlines()[-1])
+    assert report["pipeline_depth"] == 2

@@ -1,0 +1,341 @@
+"""Causal round tracing (obs/): span reconstruction, export, host spans.
+
+Contracts:
+
+1. **Reconstruction is exact and pure**: a synthetic timeline maps to the
+   documented span semantics (decide/timeout/preemption closes, fault
+   annotations, trailing-open), and decoding the SAME campaign twice
+   yields byte-identical spans — the builder is a pure function of the
+   ring, never of wall time or entropy.
+2. **The exporter is schema-honest**: ``validate_chrome_trace`` passes on
+   everything we emit (both process tracks, matched async begin/end,
+   monotonic ts) and actually rejects broken traces.
+3. **End-to-end**: ``paxos_tpu trace`` on a corrupt campaign produces a
+   Perfetto-loadable file whose device track names the corruption and
+   whose host track shows the dispatch loop; ``stats`` folds the span
+   aggregates into gauges.
+"""
+
+import json
+
+import pytest
+
+from paxos_tpu.harness import config as C
+from paxos_tpu.obs.export import (
+    DEVICE_PID,
+    HOST_PID,
+    chrome_trace,
+    spans_jsonl,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+from paxos_tpu.obs.host_spans import (
+    HostSpanRecorder,
+    NullSpanRecorder,
+    ensure_recorder,
+)
+from paxos_tpu.obs.spans import RoundSpan, build_spans, span_aggregates
+
+# A synthetic lane history exercising every close rule:
+#   round 0: leader at 0, promise, accept+drop, decide at 5   -> decided
+#   round 1: timeout at 7 (opens round 2 at the same tick)    -> timeout
+#   round 2: leader at 9, second leader at 11                 -> preempted
+#   round 3: opens at 11, trailing                            -> open
+TIMELINE = [
+    {"tick": 0, "events": ["leader"]},
+    {"tick": 2, "events": ["promise"]},
+    {"tick": 3, "events": ["accept", "drop"]},
+    {"tick": 5, "events": ["decide"]},
+    {"tick": 7, "events": ["timeout"]},
+    {"tick": 9, "events": ["leader", "corrupt"]},
+    {"tick": 11, "events": ["leader"]},
+    {"tick": 12, "events": ["promise"]},
+]
+
+
+def test_build_spans_semantics():
+    spans = build_spans(TIMELINE, lane=3)
+    assert [s.outcome for s in spans] == [
+        "decided", "timeout", "preempted", "open",
+    ]
+    assert [s.round for s in spans] == [0, 1, 2, 3]
+    assert all(s.lane == 3 for s in spans)
+
+    decided = spans[0]
+    assert (decided.start, decided.end) == (0, 5)
+    assert decided.leader_tick == 0
+    assert decided.p1_tick == 2 and decided.p2_tick == 3
+    assert decided.faults == [{"tick": 3, "kind": "drop"}]
+    assert decided.events["promise"] == 1
+
+    # Timeout closes AND re-opens at the same tick (ballot retry).
+    assert (spans[1].start, spans[1].end) == (7, 7)
+    assert spans[2].start == 7
+
+    # Second leader without a decide = preemption; the corrupt fault
+    # annotates the span it landed in.
+    assert spans[2].leader_tick == 9
+    assert {"tick": 9, "kind": "corrupt"} in spans[2].faults
+    assert spans[2].events["leader"] == 2
+
+    # Trailing span stays open and ends at the last seen tick.
+    assert (spans[3].start, spans[3].end) == (11, 12)
+
+    # to_json is JSON-serializable and round-trips the key fields.
+    j = [s.to_json() for s in spans]
+    json.dumps(j)
+    assert j[0]["outcome"] == "decided" and j[0]["p2_tick"] == 3
+
+
+def test_decide_beats_timeout_and_leader_on_shared_tick():
+    spans = build_spans(
+        [{"tick": 4, "events": ["decide", "timeout", "leader"]}], lane=0
+    )
+    assert [s.outcome for s in spans] == ["decided"]
+
+
+def test_span_aggregates_exact():
+    agg = span_aggregates(build_spans(TIMELINE, lane=3))
+    assert agg["rounds_total"] == 4
+    assert agg["rounds_decided"] == 1
+    assert agg["rounds_timeout"] == 1
+    assert agg["rounds_preempted"] == 1
+    assert agg["rounds_open"] == 1
+    # One decided round of latency 5; nearest-rank puts every quantile there.
+    assert agg["round_latency_p50"] == 5.0
+    assert agg["round_latency_p99"] == 5.0
+    assert agg["preemption_depth_max"] == 0  # the decide came first
+    assert agg["faults_total"] == 2
+    assert agg["faults_per_decided_round"] == 2.0
+
+    # No decided rounds: latency sentinel, faults counted raw.
+    agg0 = span_aggregates(build_spans(
+        [{"tick": 1, "events": ["timeout"]}, {"tick": 2, "events": ["drop"]}],
+        lane=0,
+    ))
+    assert agg0["round_latency_p50"] == -1.0
+    assert agg0["rounds_decided"] == 0 and agg0["faults_total"] == 1
+
+
+def test_preemption_depth_counts_burned_attempts():
+    tl = [
+        {"tick": 1, "events": ["timeout"]},
+        {"tick": 3, "events": ["timeout"]},
+        {"tick": 6, "events": ["decide"]},
+        {"tick": 8, "events": ["decide"]},
+    ]
+    agg = span_aggregates(build_spans(tl, lane=0))
+    # Two timed-out attempts before the first decide, none before the next.
+    assert agg["preemption_depth_max"] == 2
+    assert agg["preemption_depth_mean"] == 1.0
+
+
+def test_chrome_trace_schema_and_tracks():
+    spans = build_spans(TIMELINE, lane=3)
+    host = HostSpanRecorder(_FakeClock().now)
+    with host.span("dispatch", tick_start=0, ticks=64, groups=4):
+        pass
+    host.instant("probe_done")
+    obj = chrome_trace({3: spans}, host=host, meta={"config": "test"})
+    assert validate_chrome_trace(obj) == []
+    evs = obj["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert pids == {DEVICE_PID, HOST_PID}
+    # One async b/e pair per span, on the lane's thread.
+    bs = [e for e in evs if e["ph"] == "b"]
+    assert len(bs) == len(spans) and all(e["tid"] == 3 for e in bs)
+    assert len([e for e in evs if e["ph"] == "e"]) == len(spans)
+    # Faults render as instants on the device track.
+    faults = [e for e in evs if e["ph"] == "i" and e.get("cat") == "fault"]
+    assert {e["name"] for e in faults} == {"drop", "corrupt"}
+    # Host spans render as complete events with wall-us timestamps.
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 1 and xs[0]["args"]["groups"] == 4
+    assert obj["otherData"]["config"] == "test"
+
+
+def test_validator_rejects_broken_traces():
+    good = chrome_trace({0: build_spans(TIMELINE, lane=0)})
+    assert validate_chrome_trace(good) == []
+    assert validate_chrome_trace({"nope": 1})
+    assert validate_chrome_trace({"traceEvents": "not-a-list"})
+
+    # Unmatched async end.
+    bad_e = {"traceEvents": [
+        {"ph": "e", "name": "r", "pid": 0, "tid": 0, "ts": 1,
+         "cat": "round", "id": "L0R0"},
+    ]}
+    assert any("end without begin" in e for e in validate_chrome_trace(bad_e))
+
+    # Dangling async begin.
+    bad_b = {"traceEvents": [
+        {"ph": "b", "name": "r", "pid": 0, "tid": 0, "ts": 1,
+         "cat": "round", "id": "L0R0"},
+    ]}
+    assert any("begin without end" in e for e in validate_chrome_trace(bad_b))
+
+    # Non-monotonic ts.
+    bad_ts = {"traceEvents": [
+        {"ph": "i", "name": "a", "pid": 0, "ts": 5, "s": "t"},
+        {"ph": "i", "name": "b", "pid": 0, "ts": 2, "s": "t"},
+    ]}
+    assert any("ts" in e for e in validate_chrome_trace(bad_ts))
+
+    # Missing required keys per phase.
+    bad_keys = {"traceEvents": [{"ph": "X", "name": "x", "pid": 0, "ts": 0}]}
+    assert any("missing keys" in e for e in validate_chrome_trace(bad_keys))
+
+
+class _FakeClock:
+    """Deterministic injected clock: advances 1 ms per reading."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self) -> float:
+        self.t += 0.001
+        return self.t
+
+
+def test_host_span_recorder_with_injected_clock():
+    clk = _FakeClock()
+    rec = HostSpanRecorder(clk.now)
+    with rec.span("outer", k=1):
+        with rec.span("inner"):
+            pass
+        rec.instant("mark")
+    # Inner closes before outer; each clock read adds exactly 1000 us.
+    assert [s["name"] for s in rec.spans] == ["inner", "outer"]
+    inner, outer = rec.spans
+    assert inner["dur"] == 1000 and outer["args"] == {"k": 1}
+    assert outer["ts"] < inner["ts"] and outer["dur"] > inner["dur"]
+    assert rec.instants[0]["name"] == "mark"
+
+    # The None guard returns the no-op recorder; real recorders pass through.
+    assert isinstance(ensure_recorder(None), NullSpanRecorder)
+    assert ensure_recorder(rec) is rec
+    with ensure_recorder(None).span("ignored"):
+        pass
+
+
+def test_spans_jsonl_roundtrip():
+    spans = build_spans(TIMELINE, lane=1)
+    text = spans_jsonl(spans)
+    parsed = [json.loads(line) for line in text.splitlines()]
+    assert parsed == [s.to_json() for s in spans]
+
+
+def test_reconstruction_deterministic_across_decodes():
+    """Same campaign, decoded twice: identical spans, bit for bit — and
+    enabling the host span layer never perturbs the schedule."""
+    from paxos_tpu.obs.capture import capture_round_trace
+
+    cfg = C.config_corrupt(128, 0)
+    kw = dict(ticks=48, chunk=16, max_lanes=3)
+    a = capture_round_trace(cfg, **kw)
+    b = capture_round_trace(cfg, recorder=HostSpanRecorder(_FakeClock().now),
+                            **kw)
+    assert a.lanes == b.lanes
+    for lane in a.lanes:
+        assert [s.to_json() for s in a.spans[lane]] == [
+            s.to_json() for s in b.spans[lane]
+        ]
+    assert a.aggregates == b.aggregates
+    assert a.report["violations"] == b.report["violations"]
+
+
+def test_corrupt_campaign_spans_name_corruption():
+    """Acceptance: the corrupt config's reconstructed spans carry the
+    injected corruption as fault annotations with their ticks."""
+    from paxos_tpu.obs.capture import capture_round_trace
+
+    cap = capture_round_trace(C.config_corrupt(128, 0), ticks=48, chunk=16,
+                              max_lanes=4)
+    all_spans = [s for lane in cap.lanes for s in cap.spans[lane]]
+    corrupt = [
+        f for s in all_spans for f in s.faults if f["kind"] == "corrupt"
+    ]
+    assert corrupt, "corrupt campaign must annotate spans with corruption"
+    assert all(isinstance(f["tick"], int) for f in corrupt)
+    assert cap.aggregates["faults_total"] >= len(corrupt)
+    # Violating lanes decode first (the corrupt config trips the checker).
+    assert cap.report["violations"] > 0
+
+
+def test_registry_span_gauges_and_prometheus():
+    from paxos_tpu.harness.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.ingest_span_aggregates(span_aggregates(build_spans(TIMELINE, 0)))
+    snap = reg.snapshot()
+    assert snap["gauges"]["round_latency_ticks{quantile=p50}"] == 5.0
+    assert snap["gauges"]["rounds_total"] == 4
+    text = reg.to_prometheus()
+    assert "# TYPE paxos_tpu_round_latency_ticks gauge" in text
+    assert 'paxos_tpu_round_latency_ticks{quantile="p99"} 5' in text
+    assert "paxos_tpu_faults_per_decided_round 2" in text
+
+    # Undecided aggregates: the -1.0 sentinel must NOT leak into gauges.
+    reg2 = MetricsRegistry()
+    reg2.ingest_span_aggregates(span_aggregates([]))
+    assert "round_latency_ticks{quantile=p50}" not in (
+        reg2.snapshot().get("gauges", {})
+    )
+
+
+def test_cli_trace_end_to_end(tmp_path, capsys):
+    """`paxos_tpu trace` exports a valid Perfetto file (device + host
+    tracks), a parseable span JSONL, and a stats-consumable log."""
+    from paxos_tpu.harness.cli import main
+
+    out = tmp_path / "trace.json"
+    sj = tmp_path / "spans.jsonl"
+    log = tmp_path / "m.jsonl"
+    rc = main([
+        "trace", "--config", "corrupt", "--n-inst", "128", "--ticks", "48",
+        "--chunk", "16", "--lanes", "3", "--out", str(out),
+        "--spans-out", str(sj), "--log", str(log),
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["rounds_total"] > 0 and summary["host_spans"] > 0
+
+    obj = json.loads(out.read_text())
+    assert validate_chrome_trace(obj) == []
+    pids = {e["pid"] for e in obj["traceEvents"]}
+    assert pids == {DEVICE_PID, HOST_PID}
+    assert any(
+        e["ph"] == "i" and e.get("cat") == "fault" and e["name"] == "corrupt"
+        for e in obj["traceEvents"]
+    )
+    dispatch = [
+        e for e in obj["traceEvents"]
+        if e["ph"] == "X" and e["name"] == "dispatch"
+    ]
+    assert dispatch and all("tick_start" in e["args"] for e in dispatch)
+
+    for line in sj.read_text().splitlines():
+        assert json.loads(line)["outcome"] in (
+            "decided", "timeout", "preempted", "open",
+        )
+    records = [json.loads(l) for l in log.read_text().splitlines()]
+    kinds = [r["event"] for r in records]
+    assert "spans" in kinds and kinds[-1] == "final"
+
+    # stats folds the span aggregates into the summary and the registry.
+    capsys.readouterr()
+    assert main(["stats", str(log)]) == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["span_aggregates"]["rounds_total"] == (
+        summary["rounds_total"]
+    )
+
+
+def test_write_chrome_trace_host_only(tmp_path):
+    """--span-trace's host-only export: no device track, still valid."""
+    rec = HostSpanRecorder(_FakeClock().now)
+    with rec.span("dispatch", tick_start=0, ticks=8, groups=1):
+        pass
+    obj = write_chrome_trace(str(tmp_path / "h.json"), {}, host=rec)
+    assert validate_chrome_trace(obj) == []
+    assert {e["pid"] for e in obj["traceEvents"]} == {HOST_PID}

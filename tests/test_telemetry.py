@@ -267,6 +267,40 @@ def test_corrupt_shrink_timeline_names_corruption_tick():
     assert res.to_json()["timeline"] == res.timeline
     # The timeline rides the repro JSON end-to-end.
     json.dumps(res.to_json())
+    # The causal reading rides too: reconstructed round spans whose fault
+    # annotations name the same corruption ticks the raw timeline does.
+    assert res.spans, "repro must carry reconstructed round spans"
+    span_corrupt_ticks = sorted(
+        f["tick"] for s in res.spans for f in s.faults
+        if f["kind"] == "corrupt"
+    )
+    assert span_corrupt_ticks == sorted(corrupt_ticks)
+    assert res.to_json()["spans"] == [s.to_json() for s in res.spans]
+
+
+def test_hist_saturation_flags_overflow():
+    """The histogram's last bin is a catch-all; decoding must SAY when it
+    caught anything instead of letting the tail read as a real bin."""
+    # Flag semantics: <2 bins have no in-range bins to misread.
+    assert T.hist_saturation([]) == {"overflow": 0, "saturated": False}
+    assert T.hist_saturation([7]) == {"overflow": 0, "saturated": False}
+    assert T.hist_saturation([3, 0]) == {"overflow": 0, "saturated": False}
+    assert T.hist_saturation([3, 2]) == {"overflow": 2, "saturated": True}
+
+    # A 2-bin histogram under dueling proposers (decides routinely past
+    # tick 8) must report a clipped tail end-to-end.
+    cfg = dataclasses.replace(
+        C.config2_dueling_drop(64, 3),
+        telemetry=T.TelemetryConfig(counters=True, hist_bins=2),
+    )
+    state = _xla_final(cfg, n_ticks=32)
+    counts, sat = T.hist_totals(state.telemetry, with_saturation=True)
+    assert T.hist_totals(state.telemetry) == counts  # default unchanged
+    assert sat == T.hist_saturation(counts)
+    rep = T.telemetry_report(state.telemetry)
+    assert rep["hist"] == counts
+    assert rep["hist_overflow"] == counts[-1]
+    assert rep["hist_saturated"] is (counts[-1] > 0)
 
 
 def test_checkpoint_roundtrip_with_recorder(tmp_path):
