@@ -139,6 +139,19 @@ def bench_case(
     platform = jax.devices()[0].platform
     state = init_state(cfg)
     plan = init_plan(cfg)
+    # Packed on-device footprint + the effective fused block, recorded in
+    # every row so a packing regression (bytes creeping back up, block
+    # degrading) shows in BENCH_* without re-running the roofline.
+    # eval_shape-based: free, computed before the state is donated away.
+    from paxos_tpu.kernels.fused_tick import fit_block
+    from paxos_tpu.utils import bitops
+
+    state_bytes = bitops.codec_for(cfg.protocol, state).bytes_per_lane(state)
+    sid = stream_id(cfg, engine)
+    eff_block = (
+        fit_block(sid["block"], cfg.n_inst, warn=False)
+        if engine == "fused" else None
+    )
     # Long-log: compaction rides in the timed loop (traced into each chunk).
     advance = make_advance_grouped(
         cfg, plan, engine, compact=bool(make_longlog(cfg))
@@ -182,9 +195,11 @@ def bench_case(
         "engine": engine,
         "protocol": cfg.protocol,
         "violations": violations,
+        "state_bytes_per_lane": state_bytes,
+        "block": eff_block,
         # Stream lineage (VERDICT r4 weak#3): the fused block this case ran
         # under — replays must match it or the schedule differs.
-        "stream": stream_id(cfg, engine),
+        "stream": sid,
         "config_fingerprint": cfg.fingerprint(),
     }
 

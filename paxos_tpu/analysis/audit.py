@@ -90,6 +90,11 @@ def run_audit(
     findings: list = []
     checks = 0
     for protocol in protos:
+        # Packed-layout version guard is ALWAYS on (not gated behind
+        # ``structure``): a layout edit without a version bump corrupts
+        # live checkpoints, which is never a release-gate-only concern.
+        findings += struct_mod.audit_layout(protocol)
+        checks += 1
         traces = {}
         for config_name in confs:
             cfg = trace_mod.build_config(protocol, config_name)

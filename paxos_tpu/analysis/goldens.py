@@ -35,25 +35,264 @@ TREEDEF_GOLDENS: dict = {
 }
 
 # (protocol, config_name) -> SimConfig.fingerprint() of the audit config
+# Re-recorded once for the packed-layout release: fingerprint() now folds
+# the per-protocol layout version (paxos-packed-v1 / multipaxos-packed-v1 /
+# fastpaxos-packed-v1 / raftcore-packed-v1), re-keying every cell.
 CONFIG_GOLDENS: dict = {
-    ("paxos", "default"): "c66870e38738f078",
-    ("paxos", "gray-chaos"): "c5d88efa1593e109",
-    ("paxos", "corrupt"): "5610069aa64745b5",
-    ("paxos", "stale"): "c1d24005bcc4cdd8",
-    ("paxos", "telemetry"): "1e8ea8111735cffe",
-    ("multipaxos", "default"): "1b934c22f736e9bc",
-    ("multipaxos", "gray-chaos"): "3a0d10f31d095527",
-    ("multipaxos", "corrupt"): "3f275ddad81a8896",
-    ("multipaxos", "stale"): "2e64fd633a49c9eb",
-    ("multipaxos", "telemetry"): "bf30a9aa158d482b",
-    ("fastpaxos", "default"): "f0a2ff5f1f64c308",
-    ("fastpaxos", "gray-chaos"): "9c2fe26d8b088798",
-    ("fastpaxos", "corrupt"): "1b4a7bbe877196e5",
-    ("fastpaxos", "stale"): "fa0b8b6c5cc2fd6f",
-    ("fastpaxos", "telemetry"): "f172a2995af2be65",
-    ("raftcore", "default"): "e278086e1936256a",
-    ("raftcore", "gray-chaos"): "68c1f0b05b7f58d2",
-    ("raftcore", "corrupt"): "1a7251d43bd82aa3",
-    ("raftcore", "stale"): "5baa20380323d476",
-    ("raftcore", "telemetry"): "c6fbcef2b33dd732",
+    ("paxos", "default"): "f50cfbfdf74b11c0",
+    ("paxos", "gray-chaos"): "a68d36156e155a29",
+    ("paxos", "corrupt"): "1b476cdd907b5933",
+    ("paxos", "stale"): "dd2e59a672568867",
+    ("paxos", "telemetry"): "45769fa2f93945e0",
+    ("multipaxos", "default"): "c43e601ef68a237f",
+    ("multipaxos", "gray-chaos"): "ef22269046287409",
+    ("multipaxos", "corrupt"): "8175e48831a73e89",
+    ("multipaxos", "stale"): "f68540b11905991c",
+    ("multipaxos", "telemetry"): "4ea3f797b32bc566",
+    ("fastpaxos", "default"): "cb51e3867a43b91b",
+    ("fastpaxos", "gray-chaos"): "d311d7e3d86192e7",
+    ("fastpaxos", "corrupt"): "72485f432fb7393a",
+    ("fastpaxos", "stale"): "0bc8e8e18a940735",
+    ("fastpaxos", "telemetry"): "298edfbc20970277",
+    ("raftcore", "default"): "ff49ab17defc9057",
+    ("raftcore", "gray-chaos"): "1755349e01c9d063",
+    ("raftcore", "corrupt"): "040a2cdb1838612f",
+    ("raftcore", "stale"): "291ba0bd46e6cd30",
+    ("raftcore", "telemetry"): "d0b50c940de6b66a",
+}
+
+# protocol -> {"version": layout version string, "fields": canonical per-field
+# descriptors from bitops.layout_fields}.  The audit's layout-version guard
+# (structure.audit_layout, always ON in `paxos_tpu audit`) diffs the live
+# tables against this: an edited field with an UNCHANGED version is the
+# failure mode this exists to catch — silently re-binning live campaign
+# state.  Bump the *_LAYOUT_VERSION in core/*_state.py, re-record here, and
+# name the version in the commit.
+LAYOUT_GOLDENS: dict = {
+    "paxos": {
+        "version": "paxos-packed-v1",
+        "fields": {
+            "__dims__":
+                "[('n_acc', ('acceptor.promised', 0))]",
+            "acceptor.acc_bal":
+                "word=acc slot=1 bits=15 signed=0 bool=0 bv=None",
+            "acceptor.promised":
+                "word=acc slot=0 bits=15 signed=0 bool=0 bv=None",
+            "acceptor.snap_bal":
+                "word=snap_acc slot=1 bits=15 signed=0 bool=0 bv=None optional",
+            "acceptor.snap_promised":
+                "word=snap_acc slot=0 bits=15 signed=0 bool=0 bv=None optional",
+            "learner.chosen":
+                "word=chosen slot=0 bits=1 signed=0 bool=1 bv=None",
+            "learner.chosen_tick":
+                "word=chosen slot=2 bits=19 signed=1 bool=0 bv=None",
+            "learner.chosen_val":
+                "word=chosen slot=1 bits=12 signed=0 bool=0 bv=None",
+            "learner.lt_bal":
+                "word=lt slot=0 bits=15 signed=0 bool=0 bv=None",
+            "learner.lt_mask":
+                "word=lt slot=2 bits=n_acc signed=0 bool=0 bv=None",
+            "learner.lt_val":
+                "word=lt slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.bal":
+                "word=prop0 slot=0 bits=15 signed=0 bool=0 bv=None",
+            "proposer.best_bal":
+                "word=prop2 slot=1 bits=15 signed=0 bool=0 bv=None",
+            "proposer.best_val":
+                "word=prop3 slot=0 bits=12 signed=0 bool=0 bv=None",
+            "proposer.decided_val":
+                "word=prop3 slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.heard":
+                "word=prop2 slot=0 bits=16 signed=0 bool=0 bv=None",
+            "proposer.own_val":
+                "word=prop1 slot=0 bits=12 signed=0 bool=0 bv=None",
+            "proposer.phase":
+                "word=prop0 slot=1 bits=2 signed=0 bool=0 bv=None",
+            "proposer.prop_val":
+                "word=prop1 slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.timer":
+                "word=prop0 slot=2 bits=13 signed=1 bool=0 bv=None",
+            "replies.bal":
+                "word=rep slot=0 bits=15 signed=0 bool=0 bv=None",
+            "replies.present":
+                "word=rep slot=2 bits=1 signed=0 bool=1 bv=None",
+            "replies.v2":
+                "word=rep slot=1 bits=12 signed=0 bool=0 bv=None",
+            "requests.bal":
+                "word=req slot=0 bits=15 signed=0 bool=0 bv=None",
+            "requests.present":
+                "word=req slot=2 bits=1 signed=0 bool=1 bv=None",
+            "requests.v1":
+                "word=req slot=1 bits=12 signed=0 bool=0 bv=None",
+            "requests.v2":
+                "zero like=req",
+        },
+    },
+    "multipaxos": {
+        "version": "multipaxos-packed-v1",
+        "fields": {
+            "__dims__":
+                "[('n_acc', ('acceptor.promised', 0))]",
+            "accepted.bal":
+                "word=accd slot=0 bits=12 signed=0 bool=0 bv=None",
+            "accepted.present":
+                "word=accd slot=2 bits=1 signed=0 bool=1 bv=None",
+            "accepted.val":
+                "word=accd slot=1 bits=13 signed=0 bool=0 bv=None",
+            "acceptor.log":
+                "stream=acc_log bal=11 val=13",
+            "acceptor.snap_log":
+                "stream=snap_log bal=11 val=13 optional",
+            "learner.chosen":
+                "word=chosen slot=0 bits=1 signed=0 bool=1 bv=None",
+            "learner.chosen_tick":
+                "word=chosen slot=2 bits=18 signed=1 bool=0 bv=None",
+            "learner.chosen_val":
+                "word=chosen slot=1 bits=13 signed=0 bool=0 bv=None",
+            "learner.lt_bv":
+                "word=lt slot=0 bits=24 signed=0 bool=0 bv=(11, 13)",
+            "learner.lt_mask":
+                "word=lt slot=1 bits=n_acc signed=0 bool=0 bv=None",
+            "promises.bal":
+                "word=prom slot=0 bits=12 signed=0 bool=0 bv=None",
+            "promises.p_bv":
+                "stream=prom_bv bal=11 val=13",
+            "promises.present":
+                "word=prom slot=1 bits=1 signed=0 bool=1 bv=None",
+            "proposer.bal":
+                "word=prop0 slot=0 bits=11 signed=0 bool=0 bv=None",
+            "proposer.candidate_timer":
+                "word=prop0 slot=3 bits=12 signed=0 bool=0 bv=None",
+            "proposer.commit_idx":
+                "word=prop0 slot=2 bits=6 signed=0 bool=0 bv=None",
+            "proposer.heard":
+                "word=prop1 slot=0 bits=16 signed=0 bool=0 bv=None",
+            "proposer.last_chosen_count":
+                "word=prop1 slot=1 bits=16 signed=0 bool=0 bv=None",
+            "proposer.phase":
+                "word=prop0 slot=1 bits=2 signed=0 bool=0 bv=None",
+            "proposer.recov_bv":
+                "stream=recov bal=11 val=13",
+            "requests.bal":
+                "word=req slot=0 bits=12 signed=0 bool=0 bv=None",
+            "requests.present":
+                "word=req slot=2 bits=1 signed=0 bool=1 bv=None",
+            "requests.v1":
+                "word=req slot=1 bits=13 signed=0 bool=0 bv=None",
+        },
+    },
+    "fastpaxos": {
+        "version": "fastpaxos-packed-v1",
+        "fields": {
+            "__dims__":
+                "[('n_acc', ('acceptor.promised', 0))]",
+            "acceptor.acc_bal":
+                "word=acc slot=1 bits=15 signed=0 bool=0 bv=None",
+            "acceptor.promised":
+                "word=acc slot=0 bits=15 signed=0 bool=0 bv=None",
+            "acceptor.snap_bal":
+                "word=snap_acc slot=1 bits=15 signed=0 bool=0 bv=None optional",
+            "acceptor.snap_promised":
+                "word=snap_acc slot=0 bits=15 signed=0 bool=0 bv=None optional",
+            "learner.chosen":
+                "word=chosen slot=0 bits=1 signed=0 bool=1 bv=None",
+            "learner.chosen_tick":
+                "word=chosen slot=2 bits=19 signed=1 bool=0 bv=None",
+            "learner.chosen_val":
+                "word=chosen slot=1 bits=12 signed=0 bool=0 bv=None",
+            "learner.lt_bal":
+                "word=lt slot=0 bits=15 signed=0 bool=0 bv=None",
+            "learner.lt_mask":
+                "word=lt slot=2 bits=n_acc signed=0 bool=0 bv=None",
+            "learner.lt_val":
+                "word=lt slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.bal":
+                "word=prop0 slot=0 bits=15 signed=0 bool=0 bv=None",
+            "proposer.best_bal":
+                "word=prop2 slot=1 bits=15 signed=0 bool=0 bv=None",
+            "proposer.heard":
+                "word=prop2 slot=0 bits=16 signed=0 bool=0 bv=None",
+            "proposer.own_val":
+                "word=prop1 slot=0 bits=12 signed=0 bool=0 bv=None",
+            "proposer.phase":
+                "word=prop0 slot=1 bits=2 signed=0 bool=0 bv=None",
+            "proposer.prop_val":
+                "word=prop1 slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.timer":
+                "word=prop0 slot=2 bits=13 signed=1 bool=0 bv=None",
+            "replies.bal":
+                "word=rep slot=0 bits=15 signed=0 bool=0 bv=None",
+            "replies.present":
+                "word=rep slot=2 bits=1 signed=0 bool=1 bv=None",
+            "replies.v2":
+                "word=rep slot=1 bits=12 signed=0 bool=0 bv=None",
+            "requests.bal":
+                "word=req slot=0 bits=15 signed=0 bool=0 bv=None",
+            "requests.present":
+                "word=req slot=2 bits=1 signed=0 bool=1 bv=None",
+            "requests.v1":
+                "word=req slot=1 bits=12 signed=0 bool=0 bv=None",
+            "requests.v2":
+                "zero like=req",
+        },
+    },
+    "raftcore": {
+        "version": "raftcore-packed-v1",
+        "fields": {
+            "__dims__":
+                "[('n_acc', ('acceptor.voted', 0))]",
+            "acceptor.ent_term":
+                "word=acc slot=1 bits=15 signed=0 bool=0 bv=None",
+            "acceptor.snap_term":
+                "word=snap_acc slot=1 bits=15 signed=0 bool=0 bv=None optional",
+            "acceptor.snap_voted":
+                "word=snap_acc slot=0 bits=15 signed=0 bool=0 bv=None optional",
+            "acceptor.voted":
+                "word=acc slot=0 bits=15 signed=0 bool=0 bv=None",
+            "learner.chosen":
+                "word=chosen slot=0 bits=1 signed=0 bool=1 bv=None",
+            "learner.chosen_tick":
+                "word=chosen slot=2 bits=19 signed=1 bool=0 bv=None",
+            "learner.chosen_val":
+                "word=chosen slot=1 bits=12 signed=0 bool=0 bv=None",
+            "learner.lt_bal":
+                "word=lt slot=0 bits=15 signed=0 bool=0 bv=None",
+            "learner.lt_mask":
+                "word=lt slot=2 bits=n_acc signed=0 bool=0 bv=None",
+            "learner.lt_val":
+                "word=lt slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.bal":
+                "word=prop0 slot=0 bits=15 signed=0 bool=0 bv=None",
+            "proposer.decided_val":
+                "word=prop3 slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.ent_term":
+                "word=prop2 slot=1 bits=15 signed=0 bool=0 bv=None",
+            "proposer.ent_val":
+                "word=prop3 slot=0 bits=12 signed=0 bool=0 bv=None",
+            "proposer.heard":
+                "word=prop2 slot=0 bits=16 signed=0 bool=0 bv=None",
+            "proposer.own_val":
+                "word=prop1 slot=0 bits=12 signed=0 bool=0 bv=None",
+            "proposer.phase":
+                "word=prop0 slot=1 bits=2 signed=0 bool=0 bv=None",
+            "proposer.prop_val":
+                "word=prop1 slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.timer":
+                "word=prop0 slot=2 bits=13 signed=1 bool=0 bv=None",
+            "replies.bal":
+                "word=rep slot=0 bits=15 signed=0 bool=0 bv=None",
+            "replies.present":
+                "word=rep slot=2 bits=1 signed=0 bool=1 bv=None",
+            "replies.v2":
+                "word=rep slot=1 bits=12 signed=0 bool=0 bv=None",
+            "requests.bal":
+                "word=req slot=0 bits=15 signed=0 bool=0 bv=None",
+            "requests.present":
+                "word=req slot=2 bits=1 signed=0 bool=1 bv=None",
+            "requests.v1":
+                "word=req slot=1 bits=15 signed=0 bool=0 bv=None",
+            "requests.v2":
+                "zero like=req",
+        },
+    },
 }

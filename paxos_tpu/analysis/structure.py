@@ -179,15 +179,95 @@ def audit_goldens(
     return findings
 
 
+def audit_layout(protocol: str) -> list:
+    """Packed-layout guard: a changed layout table must bump its version.
+
+    Always ON in ``run_audit`` (unlike the ``--structure`` goldens): the
+    packed layout is the on-device representation of every lane, so an
+    edited field with an unchanged ``*_LAYOUT_VERSION`` silently re-bins
+    live campaign state — checkpoints decode garbage and the config
+    fingerprint (which folds the version) claims continuity it no longer
+    has.  Diffs :func:`paxos_tpu.utils.bitops.layout_fields` against
+    ``goldens.LAYOUT_GOLDENS`` and names the exact fields that moved.
+    """
+    from paxos_tpu.utils import bitops
+
+    findings = []
+    where = f"{protocol}/layout"
+    got_version = bitops.layout_version(protocol)
+    got_fields = bitops.layout_fields(protocol)
+    golden = goldens.LAYOUT_GOLDENS.get(protocol)
+    if golden is None:
+        findings.append(Finding(
+            check="layout-version", where=where,
+            message=(
+                f"no packed-layout golden recorded for {protocol}: run "
+                f"`python -m paxos_tpu audit --record-goldens`"
+            ),
+        ))
+        return findings
+    want_version, want_fields = golden["version"], golden["fields"]
+    if got_fields != want_fields:
+        changed = sorted(
+            path
+            for path in set(got_fields) | set(want_fields)
+            if got_fields.get(path) != want_fields.get(path)
+        )
+        detail = "; ".join(
+            f"{p}: {want_fields.get(p, '<absent>')} -> "
+            f"{got_fields.get(p, '<absent>')}"
+            for p in changed
+        )
+        if got_version == want_version:
+            findings.append(Finding(
+                check="layout-version", where=where,
+                message=(
+                    f"packed layout for {protocol} changed WITHOUT a "
+                    f"version bump (still {got_version!r}): field(s) "
+                    f"[{', '.join(changed)}] moved ({detail}) — bump "
+                    f"*_LAYOUT_VERSION in core/*_state.py, then re-record "
+                    f"goldens"
+                ),
+            ))
+        else:
+            findings.append(Finding(
+                check="layout-version", where=where,
+                message=(
+                    f"packed layout for {protocol} changed and the version "
+                    f"was bumped ({want_version!r} -> {got_version!r}) but "
+                    f"the goldens are stale: re-record via `python -m "
+                    f"paxos_tpu audit --record-goldens` (changed field(s): "
+                    f"[{', '.join(changed)}])"
+                ),
+            ))
+    elif got_version != want_version:
+        findings.append(Finding(
+            check="layout-version", where=where,
+            message=(
+                f"layout version for {protocol} bumped "
+                f"({want_version!r} -> {got_version!r}) with an unchanged "
+                f"table: re-record goldens (the config fingerprint folds "
+                f"the version, so every recorded campaign re-seeds)"
+            ),
+        ))
+    return findings
+
+
 def record_goldens(matrix) -> dict:
     """Compute fresh goldens for ``matrix`` = [(protocol, config_name, cfg)].
 
-    Returns ``{"treedef": {...}, "config": {...}}`` with stringified keys,
-    ready to paste into :mod:`paxos_tpu.analysis.goldens`.
+    Returns ``{"treedef": {...}, "config": {...}, "layout": {...}}`` with
+    stringified keys, ready to paste into :mod:`paxos_tpu.analysis.goldens`.
     """
-    tree, conf = {}, {}
+    from paxos_tpu.utils import bitops
+
+    tree, conf, layout = {}, {}, {}
     for protocol, config_name, cfg in matrix:
         key = (protocol, config_name)
         tree[key] = treedef_fingerprint(init_state(cfg))
         conf[key] = cfg.fingerprint()
-    return {"treedef": tree, "config": conf}
+        layout[protocol] = {
+            "version": bitops.layout_version(protocol),
+            "fields": bitops.layout_fields(protocol),
+        }
+    return {"treedef": tree, "config": conf, "layout": layout}

@@ -136,3 +136,36 @@ class FastPaxosState:
             replies=MsgBuf.empty(n_inst, n_prop, n_acc),
             tick=jnp.zeros((), jnp.int32),
         )
+
+
+# ---------------------------------------------------------------------------
+# Packed lane-state layout (utils/bitops) — see core/state.py for the width
+# rationale; Fast Paxos shares the classic widths.  phase needs 2 bits for
+# FAST=3.  decided_val has no 12-bit partner leaf (best_val is replaced by
+# rep_mask here), so it passes through — the layout rule bans single-field
+# words.  rep_mask is a (P, V, I) vote bitmask and passes through.  Bump the
+# version with ANY table edit.
+
+from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
+
+FP_LAYOUT_VERSION = "fastpaxos-packed-v1"
+FP_LAYOUT = (
+    Word("req", F("requests.bal", 15), F("requests.v1", 12),
+         F("requests.present", 1, bool_=True)),
+    Zero("requests.v2", like="req"),
+    Word("rep", F("replies.bal", 15), F("replies.v2", 12),
+         F("replies.present", 1, bool_=True)),
+    Word("acc", F("acceptor.promised", 15), F("acceptor.acc_bal", 15)),
+    Word("snap_acc", F("acceptor.snap_promised", 15),
+         F("acceptor.snap_bal", 15), optional=True),
+    Word("prop0", F("proposer.bal", 15), F("proposer.phase", 2),
+         F("proposer.timer", 13, signed=True)),
+    Word("prop1", F("proposer.own_val", 12), F("proposer.prop_val", 12)),
+    Word("prop2", F("proposer.heard", 16), F("proposer.best_bal", 15)),
+    Word("lt", F("learner.lt_bal", 15), F("learner.lt_val", 12),
+         F("learner.lt_mask", "n_acc")),
+    Word("chosen", F("learner.chosen", 1, bool_=True),
+         F("learner.chosen_val", 12),
+         F("learner.chosen_tick", 19, signed=True)),
+)
+FP_LAYOUT_DIMS = {"n_acc": ("acceptor.promised", 0)}

@@ -254,3 +254,54 @@ class MultiPaxosState:
     @property
     def log_len(self) -> int:
         return self.acceptor.log.shape[1]
+
+
+# ---------------------------------------------------------------------------
+# Packed lane-state layout (utils/bitops).  Multi-Paxos width rationale:
+#
+# - Proposer ballots stay < 2^11 (report-time max_ballot guard in
+#   harness/run.py — tighter than the 2^15 pack_bv budget); message-buffer
+#   ballot fields get 12 bits because PREPARE corruption bumps msg_bal by 1,
+#   which can land exactly on 2^11.
+# - Values are own_slot_value(pid, slot) < 2^13 (config-time guard in
+#   init_state; corrupt flips ^64 stay in range).
+# - (bal << 16 | val) log pairs transcode to dense 11+13 = 24-bit entries and
+#   pack 4 entries -> 3 words along the slot axis (Stream): acceptor.log,
+#   promises.p_bv, proposer.recov_bv, snap_log.  Log ballots are ACCEPT
+#   ballots (never corrupt-bumped), so 11 bits suffice.
+# - commit_idx <= n_slots < 64 (config-time log_len guard); candidate_timer
+#   resets on election success/failure so it stays <= timeout+1 < 2^12.
+# - lease_timer passes through: once the log is full nothing resets it, so
+#   it grows without bound.  requests.v2 and accepted.slot pass through:
+#   compact_mp_body shifts them unconditionally (present or not), so
+#   non-present slots drift negative without bound.  acceptor.promised /
+#   snap_promised pass through (no same-shape partner when stale is off).
+#
+# Bump the version with ANY table edit — the audit's layout goldens fail
+# otherwise (analysis/structure.py).
+
+from paxos_tpu.utils.bitops import F, Stream, Word  # noqa: E402
+
+MP_LAYOUT_VERSION = "multipaxos-packed-v1"
+MP_LAYOUT = (
+    Word("req", F("requests.bal", 12), F("requests.v1", 13),
+         F("requests.present", 1, bool_=True)),
+    Word("prom", F("promises.bal", 12), F("promises.present", 1, bool_=True)),
+    Stream("prom_bv", "promises.p_bv", bal_bits=11, val_bits=13),
+    Word("accd", F("accepted.bal", 12), F("accepted.val", 13),
+         F("accepted.present", 1, bool_=True)),
+    Stream("acc_log", "acceptor.log", bal_bits=11, val_bits=13),
+    Stream("snap_log", "acceptor.snap_log", bal_bits=11, val_bits=13,
+           optional=True),
+    Word("prop0", F("proposer.bal", 11), F("proposer.phase", 2),
+         F("proposer.commit_idx", 6), F("proposer.candidate_timer", 12)),
+    Word("prop1", F("proposer.heard", 16),
+         F("proposer.last_chosen_count", 16)),
+    Stream("recov", "proposer.recov_bv", bal_bits=11, val_bits=13),
+    Word("lt", F("learner.lt_bv", 24, bv=(11, 13)),
+         F("learner.lt_mask", "n_acc")),
+    Word("chosen", F("learner.chosen", 1, bool_=True),
+         F("learner.chosen_val", 13),
+         F("learner.chosen_tick", 18, signed=True)),
+)
+MP_LAYOUT_DIMS = {"n_acc": ("acceptor.promised", 0)}

@@ -161,3 +161,39 @@ class RaftState:
             replies=MsgBuf.empty(n_inst, n_prop, n_acc),
             tick=jnp.zeros((), jnp.int32),
         )
+
+
+# ---------------------------------------------------------------------------
+# Packed lane-state layout (utils/bitops) — see core/state.py for the width
+# rationale.  Raft-specific widths: requests.v1 carries 15-bit terms
+# (REQVOTE ships ent_term_c) as well as 12-bit values (APPEND ships
+# prop_val), so it gets 15 bits; replies.v1 carries VOTE's term*2+grant
+# (16 bits) and ACK's value, so it passes through.  ent_term is a ballot
+# (elected leaders adopt cand.bal), hence 15 bits.  requests.v2 is
+# identically 0 (APPEND and REQVOTE both send v2=0).  Bump the version with
+# ANY table edit.
+
+from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
+
+RAFT_LAYOUT_VERSION = "raftcore-packed-v1"
+RAFT_LAYOUT = (
+    Word("req", F("requests.bal", 15), F("requests.v1", 15),
+         F("requests.present", 1, bool_=True)),
+    Zero("requests.v2", like="req"),
+    Word("rep", F("replies.bal", 15), F("replies.v2", 12),
+         F("replies.present", 1, bool_=True)),
+    Word("acc", F("acceptor.voted", 15), F("acceptor.ent_term", 15)),
+    Word("snap_acc", F("acceptor.snap_voted", 15),
+         F("acceptor.snap_term", 15), optional=True),
+    Word("prop0", F("proposer.bal", 15), F("proposer.phase", 2),
+         F("proposer.timer", 13, signed=True)),
+    Word("prop1", F("proposer.own_val", 12), F("proposer.prop_val", 12)),
+    Word("prop2", F("proposer.heard", 16), F("proposer.ent_term", 15)),
+    Word("prop3", F("proposer.ent_val", 12), F("proposer.decided_val", 12)),
+    Word("lt", F("learner.lt_bal", 15), F("learner.lt_val", 12),
+         F("learner.lt_mask", "n_acc")),
+    Word("chosen", F("learner.chosen", 1, bool_=True),
+         F("learner.chosen_val", 12),
+         F("learner.chosen_tick", 19, signed=True)),
+)
+RAFT_LAYOUT_DIMS = {"n_acc": ("acceptor.voted", 0)}

@@ -204,3 +204,45 @@ class PaxosState:
     @property
     def n_prop(self) -> int:
         return self.proposer.bal.shape[0]
+
+
+# ---------------------------------------------------------------------------
+# Packed lane-state layout (utils/bitops): how the fused engine fuses these
+# leaves into dense 32-bit VMEM words.  Field widths come from protocol
+# invariants — ballots are make_ballot(rnd, pid) = rnd*8+pid+1 < 2^15
+# (report-time max_ballot guard in harness/run.py), values are
+# pid+VALUE_BASE or adopted values < 2^12 (corrupt flips ^64 stay in range),
+# timers stay within ±(timeout+1 / backoff_max*backoff_skew) < 2^12
+# (config-time guard), chosen_tick < 2^18 ticks per campaign.  requests.v2
+# is identically 0 (ACCEPT/PREPARE both send v2=0; the transport only ever
+# overwrites payloads with sends), so it stores nothing.  Unlisted leaves
+# (acc_val / snap_val / replies.v1 / violations / evictions / telemetry)
+# pass through as full int32 lanes: replies.v1 carries 15-bit promise
+# ballots AND 12-bit accepted values depending on kind, so packing it would
+# save nothing safe.  Bump the version with ANY table edit — the audit's
+# layout goldens fail otherwise (analysis/structure.py).
+
+from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
+
+PAXOS_LAYOUT_VERSION = "paxos-packed-v1"
+PAXOS_LAYOUT = (
+    Word("req", F("requests.bal", 15), F("requests.v1", 12),
+         F("requests.present", 1, bool_=True)),
+    Zero("requests.v2", like="req"),
+    Word("rep", F("replies.bal", 15), F("replies.v2", 12),
+         F("replies.present", 1, bool_=True)),
+    Word("acc", F("acceptor.promised", 15), F("acceptor.acc_bal", 15)),
+    Word("snap_acc", F("acceptor.snap_promised", 15),
+         F("acceptor.snap_bal", 15), optional=True),
+    Word("prop0", F("proposer.bal", 15), F("proposer.phase", 2),
+         F("proposer.timer", 13, signed=True)),
+    Word("prop1", F("proposer.own_val", 12), F("proposer.prop_val", 12)),
+    Word("prop2", F("proposer.heard", 16), F("proposer.best_bal", 15)),
+    Word("prop3", F("proposer.best_val", 12), F("proposer.decided_val", 12)),
+    Word("lt", F("learner.lt_bal", 15), F("learner.lt_val", 12),
+         F("learner.lt_mask", "n_acc")),
+    Word("chosen", F("learner.chosen", 1, bool_=True),
+         F("learner.chosen_val", 12),
+         F("learner.chosen_tick", 19, signed=True)),
+)
+PAXOS_LAYOUT_DIMS = {"n_acc": ("acceptor.promised", 0)}

@@ -814,6 +814,17 @@ def cmd_audit(args: argparse.Namespace) -> int:
             for (p, c), v in g[kind].items():
                 print(f'    ("{p}", "{c}"): "{v}",')
             print("}")
+        print("LAYOUT_GOLDENS = {")
+        for p, rec in g["layout"].items():
+            print(f'    "{p}": {{')
+            print(f'        "version": "{rec["version"]}",')
+            print('        "fields": {')
+            for path, desc in sorted(rec["fields"].items()):
+                print(f'            "{path}":')
+                print(f'                "{desc}",')
+            print("        },")
+            print("    },")
+        print("}")
         return 0
     report = run_audit(
         protocols=args.protocols,

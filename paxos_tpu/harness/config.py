@@ -44,6 +44,15 @@ class SimConfig:
         # checkpoints) from pre-telemetry builds keep matching.
         if d["telemetry"] == dataclasses.asdict(TelemetryConfig()):
             del d["telemetry"]
+        # The packed lane-state layout version (core/*_state.py) is part of
+        # the on-device representation: a layout change invalidates every
+        # checkpoint recorded under the old bit positions, so it must
+        # re-key fingerprint-addressed artifacts.  The audit's
+        # layout-version guard ensures the version actually moves when the
+        # table does.  Lazy import: bitops pulls in jax.numpy.
+        from paxos_tpu.utils.bitops import layout_version
+
+        d["layout_version"] = layout_version(self.protocol)
         blob = json.dumps(d, sort_keys=True).encode()
         return hashlib.sha256(blob).hexdigest()[:16]
 

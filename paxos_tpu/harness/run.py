@@ -65,8 +65,40 @@ def init_state(cfg: SimConfig):
     return state
 
 
+def _check_packed_layout_bounds(cfg: SimConfig) -> None:
+    """Config-time guards for the packed lane-state field widths.
+
+    The fused engine stores lane state in the bit-packed layout tables
+    (core/*_state.py, utils/bitops): values in 12/13-bit fields, retry
+    timers in 13-bit signed (single-decree) / 12-bit unsigned (Multi-Paxos
+    candidate) fields, and Multi-Paxos ``commit_idx`` in 6 bits.  A config
+    that can exceed those bounds must fail HERE, not via silent wraparound
+    inside a kernel (ballots are guarded at report time via ``max_ballot``
+    — they grow with the schedule, not the config).
+    """
+    f = cfg.fault
+    if f.timeout + max(f.timeout_skew, 0) >= 4095:
+        raise ValueError(
+            f"timeout={f.timeout} + timeout_skew={f.timeout_skew} overflows "
+            "the packed 13-bit proposer timer (core/*_state layout tables); "
+            "keep timeout + skew < 4095"
+        )
+    if f.backoff_max * max(f.backoff_skew, 1) > 2048:
+        raise ValueError(
+            f"backoff_max={f.backoff_max} * backoff_skew={f.backoff_skew} "
+            "overflows the packed 13-bit signed proposer timer "
+            "(core/*_state layout tables); keep the product <= 2048"
+        )
+    if cfg.protocol == "multipaxos" and cfg.log_len >= 64:
+        raise ValueError(
+            f"log_len={cfg.log_len} overflows the packed 6-bit commit_idx "
+            "field (core/mp_state.MP_LAYOUT); keep the window < 64 slots"
+        )
+
+
 def _init_protocol_state(cfg: SimConfig):
     stale = cfg.fault.stale_k > 0  # allocate stale-snapshot shadow arrays
+    _check_packed_layout_bounds(cfg)
     if cfg.protocol == "multipaxos":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
         from paxos_tpu.core.mp_state import BV_SHIFT, MultiPaxosState
@@ -83,6 +115,18 @@ def _init_protocol_state(cfg: SimConfig):
                 f"(ballot, value) layout: own_slot_value can reach "
                 f"{max_val} >= 2^{BV_SHIFT}; keep log_total <= "
                 f"{(1 << BV_SHIFT) - MAX_PROPOSERS * 1000 - 1}"
+            )
+        # Tighter, lane-packed budget (core.mp_state.MP_LAYOUT): values ride
+        # 13-bit fields in the fused engine's packed words.  Keyed to the
+        # CONFIGURED proposer count — 8-proposer long logs genuinely overflow
+        # 13 bits and must be rejected; the default 2-proposer configs don't.
+        max_val = cfg.n_prop * 1000 + max(cfg.fault.log_total, cfg.log_len)
+        if max_val >= (1 << 13):
+            raise ValueError(
+                f"n_prop={cfg.n_prop} with log_total={cfg.fault.log_total} "
+                f"overflows the packed 13-bit value field "
+                f"(core/mp_state.MP_LAYOUT): own_slot_value can reach "
+                f"{max_val} >= 2^13; shrink the log or the proposer count"
             )
         return MultiPaxosState.init(
             cfg.n_inst,
@@ -259,22 +303,31 @@ def make_advance(
     XLA engine needs no mesh plumbing — sharded inputs alone drive pjit.
     """
     if engine == "fused":
-        from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS, fused_fns
+        from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS
 
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
 
         if mesh is not None:
-            from paxos_tpu.kernels.fused_tick import fused_chunk_sharded
+            from paxos_tpu.kernels.fused_tick import (
+                fused_chunk_sharded, packed_fns,
+            )
+            from paxos_tpu.utils import bitops
 
-            apply_fn, mask_fn, dblk = fused_fns(cfg.protocol)
+            apply_fn, mask_fn, dblk = packed_fns(cfg.protocol)
 
             def advance_sharded(state, n):
-                return fused_chunk_sharded(
-                    state, jnp.int32(cfg.seed), plan, cfg.fault, n,
+                # Pack/unpack at the chunk boundary, like FUSED_CHUNKS:
+                # both are elementwise or non-I-axis ops, so the instance
+                # sharding propagates through them under pjit unchanged.
+                codec = bitops.codec_for(cfg.protocol, state)
+                pst = bitops.pack_state(codec, state)
+                pst = fused_chunk_sharded(
+                    pst, jnp.int32(cfg.seed), plan, cfg.fault, n,
                     apply_fn, mask_fn, mesh, block=block,
                     interpret=interpret, default=dblk,
                 )
+                return bitops.unpack_state(codec, pst)
 
             if compact:
                 from paxos_tpu.protocols.multipaxos import compact_mp
@@ -473,14 +526,19 @@ def summarize_device(
     }
     meta = {"n_inst": chosen.shape[-1], "log_total": log_total}
 
+    # Ballot bit budget: ballots grow with the schedule (elections/retries),
+    # so the bound is enforced on every report — a campaign that overflowed
+    # would otherwise corrupt compares SILENTLY.  Multi-Paxos: 11-bit packed
+    # proposer ballots (core/mp_state.MP_LAYOUT; tighter than the 2^15
+    # pack_bv budget that keeps bal << 16 | val sign-clear).  Single-decree:
+    # 15-bit packed ballot fields (core/state.py PAXOS_LAYOUT and kin),
+    # minus 1 for the corrupt fault's msg_bal+1 headroom.
+    dev["max_ballot"] = prop.bal.max()
+    meta["ballot_limit"] = (
+        (1 << 11) if chosen.ndim == 2 else (1 << 15) - 1
+    )
+
     if chosen.ndim == 2:  # Multi-Paxos: chosen_frac is slot-level
-        # Packed-pair bit budget, ballot side (core.mp_state: bal < 2^15
-        # keeps bal << 16 | val non-negative so int32 compares stay
-        # lexicographic).  The value side is guarded at config time in
-        # init_state; ballots grow with elections, so the bound is enforced
-        # on every report: an election-heavy campaign that overflowed would
-        # otherwise corrupt recovery/learner compares SILENTLY.
-        dev["max_ballot"] = prop.bal.max()
         if log_total > 0:
             # Long-log: the window is a moving residual, so "fraction of
             # instances with a full window" reads ~0 on a HEALTHY run
@@ -543,14 +601,12 @@ def summarize_host(host: dict, meta: dict) -> dict[str, Any]:
         v = host[k]
         out[k] = v.item() if hasattr(v, "item") else v
     if "max_ballot" in host:
-        from paxos_tpu.core.mp_state import BV_SHIFT
-
-        bal_bits = 31 - BV_SHIFT  # sign bit must stay clear after bal << 16
-        if int(host["max_ballot"]) >= (1 << bal_bits):
+        limit = meta.get("ballot_limit", (1 << 15) - 1)
+        if int(host["max_ballot"]) >= limit:
             raise MeasurementCorrupted(
-                "Multi-Paxos ballot overflowed the packed (ballot, value) "
-                f"layout (bal >= 2^{bal_bits}): recovery/learner compares "
-                "are no longer trustworthy for this campaign; shorten "
+                f"ballot overflowed the packed lane-state layout (bal >= "
+                f"{limit}; core/*_state layout tables): ballot compares are "
+                "no longer trustworthy for this campaign; shorten "
                 "ticks_per_seed or raise lease_len (ADVICE r4)"
             )
     if "longlog" in host:

@@ -150,6 +150,41 @@ def _warn_degraded(requested: int, got: int, n: int) -> None:
         )
 
 
+# VMEM budget for the blocked state carry: ~24 MB usable VMEM per core,
+# minus double-buffered plan blocks, loop temporaries, and the unpacked
+# field values live inside the tick body.  384 KiB of PACKED state per
+# block leaves comfortable headroom at every measured configuration while
+# letting the estimator pick the largest useful block.
+VMEM_STATE_BUDGET = 384 * 1024
+
+
+def block_for_bytes(
+    bytes_per_lane: float, default: int = DEFAULT_BLOCK, floor: int = 128
+) -> int:
+    """Largest power-of-two block <= ``default`` whose packed state fits
+    :data:`VMEM_STATE_BUDGET` (never below ``floor``, the lane-tiling
+    minimum).  This is the layout-table-driven half of the VMEM estimate:
+    ``fit_block`` then reconciles the result with ``n_inst`` divisibility."""
+    b = default
+    while b > floor and b * bytes_per_lane > VMEM_STATE_BUDGET:
+        b //= 2
+    return b
+
+
+def estimate_block(protocol: str, state, default: int = DEFAULT_BLOCK) -> int:
+    """VMEM-estimated fused block for a concrete (unpacked) state: computes
+    packed bytes/lane from the protocol's layout table (utils/bitops) and
+    sizes the block against :data:`VMEM_STATE_BUDGET`.  The static
+    per-protocol defaults in :func:`fused_fns` are pinned to this
+    estimator's output for the library configs (asserted in
+    tests/test_bitops.py) — they stay static because block is
+    stream-relevant and must not drift with state shape details."""
+    from paxos_tpu.utils import bitops
+
+    codec = bitops.codec_for(protocol, state)
+    return block_for_bytes(codec.bytes_per_lane(state), default=default)
+
+
 def _split_tick(state: Any):
     """Flatten the state with the scalar ``tick`` leaf separated out.
 
@@ -630,13 +665,14 @@ def fused_fns(protocol: str, ablate: frozenset = frozenset()):
     if protocol == "multipaxos":
         from paxos_tpu.protocols.multipaxos import apply_tick_mp, mp_counter_masks
 
-        # 128 (the lane-tiling floor): measured best for the packed MP state
-        # (214M vs 202M r/s at 256, 181M at 1024 on config3 @ 1M lanes) —
-        # the wide (P, A, L, I)/(L, K, I) arrays make bigger blocks trade
-        # VMEM pressure for no reuse win.  Block is stream-relevant; the
-        # round-4 default change starts a fresh schedule lineage for MP
-        # (replays of pre-change campaigns must pass block=256 explicitly).
-        mp_block = 128
+        # 256: the bit-packed layout (core/mp_state.MP_LAYOUT) cuts MP state
+        # to 904 B/lane (config3; was 1400 unpacked), so the VMEM estimator
+        # (block_for_bytes: 256 * 904 B <= 384 KiB budget, 512 overflows)
+        # doubles the block the old unpacked footprint forced down to 128.
+        # Kept static (not per-shape) because block is stream-relevant: this
+        # default change starts a fresh schedule lineage for MP — replays of
+        # pre-packing campaigns must pass block=128 explicitly.
+        mp_block = 256
         if ablate:
             return (
                 functools.partial(apply_tick_mp, ablate=ablate),
@@ -647,18 +683,53 @@ def fused_fns(protocol: str, ablate: frozenset = frozenset()):
     raise ValueError(f"unknown protocol: {protocol!r}")
 
 
+@functools.lru_cache(maxsize=None)
+def packed_fns(protocol: str, ablate: frozenset = frozenset()):
+    """(apply_fn, mask_fn, default_block) lifted to the packed state.
+
+    The raw :func:`fused_fns` pair operates on the unpacked pytree; these
+    wrappers carry a ``bitops.PackedState`` across the fused engine's
+    fori_loop instead — unpacking on use inside the tick body (shift+mask is
+    ALU work the VPU eats, not layout shuffles) and repacking the result, so
+    the VMEM-resident carry is the dense words.  The mask path's unpack is
+    dead-code-eliminated (mask samplers read only shapes).  PRNG streams are
+    untouched: same mask fns, same (seed, tick, block) keying, and the
+    unpack/apply/pack composition is value-identical to the raw pair, so
+    fused(packed) == reference(unpacked) bit-exactly (tier1 PACKED_SMOKE).
+    """
+    apply_fn, mask_fn, default_block = fused_fns(protocol, ablate)
+
+    def packed_apply(pst, masks, plan, cfg):
+        codec = pst.codec
+        return codec.pack(apply_fn(codec.unpack(pst), masks, plan, cfg))
+
+    def packed_mask(cfg, tick_seed, pst):
+        return mask_fn(cfg, tick_seed, pst.codec.unpack(pst))
+
+    packed_apply.__name__ = f"packed_{protocol}_apply"
+    packed_mask.__name__ = f"packed_{protocol}_masks"
+    return packed_apply, packed_mask, default_block
+
+
 def _make_chunk(protocol: str) -> Callable:
     def chunk(state, seed, plan, cfg, n_ticks, block=None, interpret=False):
-        apply_fn, mask_fn, default_block = fused_fns(protocol)
-        return fused_chunk_auto(
-            state, seed, plan, cfg, n_ticks, apply_fn, mask_fn,
+        from paxos_tpu.utils import bitops
+
+        apply_fn, mask_fn, default_block = packed_fns(protocol)
+        codec = bitops.codec_for(protocol, state)
+        pst = bitops.pack_state(codec, state)
+        pst = fused_chunk_auto(
+            pst, seed, plan, cfg, n_ticks, apply_fn, mask_fn,
             block=block, interpret=interpret, default=default_block,
         )
+        return bitops.unpack_state(codec, pst)
 
     chunk.__name__ = f"fused_{protocol}_chunk"
     chunk.__doc__ = (
-        f"{protocol} on the fused engine (binding: fused_fns); batches over "
-        f"MAX_LANES_PER_CALL auto-segment (fused_chunk_auto)."
+        f"{protocol} on the fused engine (binding: packed_fns over "
+        f"fused_fns): state packs to dense words (utils/bitops) at the "
+        f"chunk boundary, rides VMEM packed, and unpacks on return; "
+        f"batches over MAX_LANES_PER_CALL auto-segment (fused_chunk_auto)."
     )
     return chunk
 

@@ -1,15 +1,40 @@
-"""Bit-level helpers for quorum bookkeeping.
+"""Bit-field layout library: quorum masks + packed lane-state codecs.
 
-"Which acceptors have I heard from this phase" is a set over at most
-``MAX_ACCEPTORS`` elements, so it lives in one int32 lane per (instance,
-proposer) — the struct-of-arrays analog of the reference proposer's list of
-collected Promise/Accepted replies (SURVEY.md §4.2 [P]).
+Two layers live here:
+
+1. The original bit-set helpers (``acceptor_bit``/``popcount``): "which
+   acceptors have I heard from" is a set over at most ``MAX_ACCEPTORS``
+   elements, so it lives in one int32 lane per (instance, proposer) — the
+   struct-of-arrays analog of the reference proposer's list of collected
+   Promise/Accepted replies (SURVEY.md §4.2 [P]).
+
+2. A declarative field-layout library (ROADMAP item 3): per-protocol layout
+   tables (``core/state.py`` etc.) declare how today's one-int32-per-field
+   state leaves fuse into dense 32-bit words — ``F`` bit-fields grouped into
+   ``Word``s, ``Stream``s of packed (ballot, value) log pairs, and ``Zero``
+   leaves that are always-zero by protocol invariant and need no storage at
+   all.  :func:`codec_for` resolves a table against a concrete state pytree
+   into a :class:`Codec` whose ``pack``/``unpack`` compile to shifts+masks
+   (ALU work, not layout shuffles), and :class:`PackedState` is the packed
+   pytree the fused Pallas engine keeps resident in VMEM across ticks
+   (``kernels/fused_tick.py``).  The XLA reference path and every golden
+   compare on the *unpacked* pytree — packing is an engine-internal
+   representation, not a semantic change.
+
+Field widths are chosen from protocol invariants (ballot/value/timer bounds
+enforced at config time in ``harness/run.py`` and at report time via the
+``max_ballot`` guard); pack masks to the declared width, so an out-of-range
+value wraps — the roundtrip property tests (tests/test_bitops.py) pin that
+behavior at the field boundaries.
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 MAX_ACCEPTORS = 16  # bitmask capacity; protocol configs use 3-7
 
@@ -22,3 +47,589 @@ def acceptor_bit(a):
 def popcount(mask):
     """Number of set bits, elementwise (int32 in, int32 out)."""
     return jax.lax.population_count(jnp.asarray(mask, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Low-level shift/mask helpers (Mosaic-safe: signed int32 arithmetic only).
+
+
+def shr_logical(x, k: int):
+    """Logical right shift of int32 by a static amount, without uint32.
+
+    Mosaic vectors are signed int32, so ``>>`` sign-extends; masking off the
+    ``k`` replicated sign bits recovers the logical shift.
+    """
+    if k == 0:
+        return x
+    return jnp.right_shift(x, k) & ((1 << (32 - k)) - 1)
+
+
+def unpack_field(word, off: int, bits: int, signed: bool = False):
+    """Extract a ``bits``-wide field at bit offset ``off`` from int32 words."""
+    if signed:
+        # Two's-complement sign extension: left-justify, arithmetic shift back.
+        return jnp.right_shift(jnp.left_shift(word, 32 - off - bits), 32 - bits)
+    return shr_logical(word, off) & ((1 << bits) - 1)
+
+
+def pack_field(value, off: int, bits: int):
+    """Mask ``value`` to ``bits`` and place it at ``off`` (OR into a word)."""
+    v = value & ((1 << bits) - 1)
+    return v if off == 0 else jnp.left_shift(v, off)
+
+
+def set_field(word, value, off: int, bits: int):
+    """Return ``word`` with the (off, bits) field replaced by ``value``."""
+    hole = word & ~(((1 << bits) - 1) << off)
+    return hole | pack_field(value, off, bits)
+
+
+def pack_word(values_offs_bits):
+    """OR a sequence of ``(value, off, bits)`` fields into one int32 word."""
+    acc = None
+    for value, off, bits in values_offs_bits:
+        v = pack_field(value, off, bits)
+        acc = v if acc is None else acc | v
+    return acc
+
+
+# (ballot, value) pair transcoding: core/mp_state.py packs pairs as
+# bal << 16 | val for lexicographic int32 compares.  With bal < 2^bal_bits
+# and val < 2^val_bits (config/report-time guards), the pair transcodes to a
+# dense (bal_bits + val_bits)-bit integer and back, bit-exactly.
+
+
+def bv_to_dense(bv, bal_bits: int, val_bits: int):
+    """16-bit-aligned (bal << 16 | val) pair -> dense bal_bits+val_bits int."""
+    bal = jnp.right_shift(bv, 16) & ((1 << bal_bits) - 1)  # bv >= 0
+    return jnp.left_shift(bal, val_bits) | (bv & ((1 << val_bits) - 1))
+
+
+def dense_to_bv(e, bal_bits: int, val_bits: int):
+    """Inverse of :func:`bv_to_dense`."""
+    bal = jnp.right_shift(e, val_bits) & ((1 << bal_bits) - 1)  # e >= 0
+    return jnp.left_shift(bal, 16) | (e & ((1 << val_bits) - 1))
+
+
+# ---------------------------------------------------------------------------
+# Layout spec types — what the per-protocol tables in core/*.py are made of.
+
+
+class F:
+    """One bit-field: ``path`` (dotted attribute path into the state pytree),
+    ``bits`` (int, or a str naming a layout dim resolved from state shapes),
+    ``signed`` (two's-complement storage), ``bool_`` (1-bit flag leaves),
+    ``bv`` ((bal_bits, val_bits): leaf holds bal<<16|val pairs, transcoded
+    dense — see :func:`bv_to_dense`)."""
+
+    __slots__ = ("path", "bits", "signed", "bool_", "bv")
+
+    def __init__(self, path, bits, signed=False, bool_=False, bv=None):
+        self.path, self.bits = path, bits
+        self.signed, self.bool_, self.bv = signed, bool_, bv
+
+
+class Word:
+    """Named group of fields fused into 32-bit words.  A resolved group whose
+    widths exceed 32 bits is split greedily (in declared order) into
+    ``name_0, name_1, ...``.  ``optional`` words vanish when their leaves are
+    pruned (e.g. snapshot shadows with ``stale_k=0``).  Layout rule: never
+    declare a single-field word — an int32 passthrough is the same bytes with
+    zero truncation risk, and unlisted leaves pass through automatically."""
+
+    __slots__ = ("name", "fields", "optional")
+
+    def __init__(self, name, *fields, optional=False):
+        self.name, self.fields, self.optional = name, tuple(fields), optional
+
+
+class Stream:
+    """A (bal << 16 | val) log leaf packed 4 pairs -> 3 words along its slot
+    axis (always axis -2: (..., L, I) -> (..., W, I)).  Each pair transcodes
+    to bal_bits + val_bits == 24 dense bits; W = 3*(L//4) + (L%4)."""
+
+    __slots__ = ("name", "path", "bal_bits", "val_bits", "optional")
+
+    def __init__(self, name, path, bal_bits=11, val_bits=13, optional=False):
+        if bal_bits + val_bits != 24:
+            raise ValueError("Stream packing is specialized to 24-bit pairs")
+        self.name, self.path = name, path
+        self.bal_bits, self.val_bits, self.optional = bal_bits, val_bits, optional
+
+
+class Zero:
+    """A leaf that is identically zero by protocol invariant (e.g. paxos
+    ``requests.v2``: every send writes 0 there).  Stores nothing; unpack
+    re-materializes zeros shaped like the ``like`` word (which must share the
+    leaf's shape)."""
+
+    __slots__ = ("path", "like")
+
+    def __init__(self, path, like):
+        self.path, self.like = path, like
+
+
+# ---------------------------------------------------------------------------
+# Resolved codec internals.
+
+
+class _Slot:
+    __slots__ = ("leaf", "off", "bits", "signed", "bool_", "bv")
+
+    def __init__(self, leaf, off, bits, signed, bool_, bv):
+        self.leaf, self.off, self.bits = leaf, off, bits
+        self.signed, self.bool_, self.bv = signed, bool_, bv
+
+
+class _PWord:
+    __slots__ = ("name", "slots")
+
+    def __init__(self, name, slots):
+        self.name, self.slots = name, tuple(slots)
+
+
+class _PStream:
+    __slots__ = ("name", "leaf", "bal_bits", "val_bits", "length")
+
+    def __init__(self, name, leaf, bal_bits, val_bits, length):
+        self.name, self.leaf = name, leaf
+        self.bal_bits, self.val_bits, self.length = bal_bits, val_bits, length
+
+
+def stream_words(length: int) -> int:
+    """Packed word count along the slot axis for an L-entry stream."""
+    return 3 * (length // 4) + (length % 4)
+
+
+def _stream_pack(x, bal_bits: int, val_bits: int):
+    e = bv_to_dense(x, bal_bits, val_bits)  # (..., L, I), 24 bits per entry
+    ax = x.ndim - 2
+    length = x.shape[ax]
+
+    def sl(i):
+        return lax.slice_in_dim(e, i, i + 1, axis=ax)
+
+    out = []
+    for g in range(length // 4):
+        e0, e1, e2, e3 = (sl(4 * g + j) for j in range(4))
+        out.append(e0 | jnp.left_shift(e1, 24))
+        out.append(shr_logical(e1, 8) | jnp.left_shift(e2, 16))
+        out.append(shr_logical(e2, 16) | jnp.left_shift(e3, 8))
+    r = length % 4
+    b = 4 * (length // 4)
+    if r >= 1:
+        e0 = sl(b)
+        if r == 1:
+            out.append(e0)
+        else:
+            e1 = sl(b + 1)
+            out.append(e0 | jnp.left_shift(e1, 24))
+            if r == 2:
+                out.append(shr_logical(e1, 8))
+            else:
+                e2 = sl(b + 2)
+                out.append(shr_logical(e1, 8) | jnp.left_shift(e2, 16))
+                out.append(shr_logical(e2, 16))
+    return jnp.concatenate(out, axis=ax)
+
+
+def _stream_unpack(w, bal_bits: int, val_bits: int, length: int):
+    ax = w.ndim - 2
+
+    def sl(i):
+        return lax.slice_in_dim(w, i, i + 1, axis=ax)
+
+    ents = []
+    for g in range(length // 4):
+        w0, w1, w2 = sl(3 * g), sl(3 * g + 1), sl(3 * g + 2)
+        ents.append(w0 & 0xFFFFFF)
+        ents.append(shr_logical(w0, 24) | jnp.left_shift(w1 & 0xFFFF, 8))
+        ents.append(shr_logical(w1, 16) | jnp.left_shift(w2 & 0xFF, 16))
+        ents.append(shr_logical(w2, 8))
+    r = length % 4
+    b = 3 * (length // 4)
+    if r >= 1:
+        w0 = sl(b)
+        ents.append(w0 & 0xFFFFFF)
+        if r >= 2:
+            w1 = sl(b + 1)
+            ents.append(shr_logical(w0, 24) | jnp.left_shift(w1 & 0xFFFF, 8))
+            if r == 3:
+                w2 = sl(b + 2)
+                ents.append(shr_logical(w1, 16) | jnp.left_shift(w2 & 0xFF, 16))
+    e = jnp.concatenate(ents, axis=ax)
+    return dense_to_bv(e, bal_bits, val_bits)
+
+
+# ---------------------------------------------------------------------------
+# PackedState: the packed pytree the fused engine carries across ticks.
+
+
+@jax.tree_util.register_pytree_node_class
+class PackedState:
+    """Dense word arrays + the tick scalar, as one pytree.
+
+    Children are the word arrays in sorted-name order followed by ``tick``
+    (so the fused engine's single-scalar-leaf invariant holds); aux data is
+    the name tuple plus the :class:`Codec` (identity-hashed — codecs are
+    cached per (protocol, structure), so treedefs stay jit-cache stable).
+    """
+
+    __slots__ = ("_names", "_values", "tick", "codec")
+
+    def __init__(self, words: dict, tick, codec):
+        self._names = tuple(sorted(words))
+        self._values = tuple(words[n] for n in self._names)
+        self.tick = tick
+        self.codec = codec
+
+    @property
+    def words(self) -> dict:
+        return dict(zip(self._names, self._values))
+
+    def word(self, name: str):
+        return self._values[self._names.index(name)]
+
+    def tree_flatten(self):
+        return self._values + (self.tick,), (self._names, self.codec)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = object.__new__(cls)
+        obj._names, obj.codec = aux
+        obj._values = tuple(children[:-1])
+        obj.tick = children[-1]
+        return obj
+
+
+class Codec:
+    """A layout table resolved against one concrete state structure.
+
+    Instances come from :func:`codec_for` only (cached), so identity
+    equality/hashing is correct and cheap — the codec rides as a jit-static
+    argument and inside ``PackedState`` treedefs.
+    """
+
+    def __init__(self, protocol, version, treedef, n_leaves, tick_leaf,
+                 words, streams, zeros, passthroughs, dims):
+        self.protocol, self.version = protocol, version
+        self.treedef, self.n_leaves = treedef, n_leaves
+        self.tick_leaf = tick_leaf
+        self.words = tuple(words)  # _PWord
+        self.streams = tuple(streams)  # _PStream
+        self.zeros = tuple(zeros)  # (leaf_idx, like_name, dtype)
+        self.passthroughs = tuple(passthroughs)  # (name, leaf_idx)
+        self.dims = dict(dims)
+
+    def __repr__(self):
+        return (f"Codec({self.protocol!r}, {self.version!r}, "
+                f"words={len(self.words)}, streams={len(self.streams)}, "
+                f"zeros={len(self.zeros)}, pt={len(self.passthroughs)})")
+
+    def pack(self, state) -> PackedState:
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        if treedef != self.treedef:
+            raise ValueError(
+                f"state structure does not match codec for {self.protocol!r}"
+            )
+        words = {}
+        for w in self.words:
+            acc = None
+            for s in w.slots:
+                x = leaves[s.leaf]
+                if s.bool_:
+                    x = x.astype(jnp.int32)
+                if s.bv is not None:
+                    x = bv_to_dense(x, *s.bv)
+                v = pack_field(x, s.off, s.bits)
+                acc = v if acc is None else acc | v
+            words[w.name] = acc
+        for st in self.streams:
+            words[st.name] = _stream_pack(leaves[st.leaf], st.bal_bits,
+                                          st.val_bits)
+        for name, leaf in self.passthroughs:
+            words[name] = leaves[leaf]
+        return PackedState(words, leaves[self.tick_leaf], self)
+
+    def unpack(self, pst: PackedState):
+        vals = pst.words
+        leaves: list = [None] * self.n_leaves
+        for w in self.words:
+            arr = vals[w.name]
+            for s in w.slots:
+                x = unpack_field(arr, s.off, s.bits, s.signed)
+                if s.bv is not None:
+                    x = dense_to_bv(x, *s.bv)
+                if s.bool_:
+                    x = x.astype(jnp.bool_)
+                leaves[s.leaf] = x
+        for st in self.streams:
+            leaves[st.leaf] = _stream_unpack(vals[st.name], st.bal_bits,
+                                             st.val_bits, st.length)
+        for leaf, like, dtype in self.zeros:
+            leaves[leaf] = jnp.zeros(vals[like].shape, dtype)
+        for name, leaf in self.passthroughs:
+            leaves[leaf] = vals[name]
+        leaves[self.tick_leaf] = pst.tick
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def bytes_per_lane(self, state) -> float:
+        """Packed VMEM bytes per instance lane (tick scalar excluded)."""
+        p = jax.eval_shape(self.pack, state)
+        arrs = p._values  # word arrays; last axis is always I
+        n_inst = arrs[0].shape[-1]
+        return sum(
+            _size(a.shape) * jnp.dtype(a.dtype).itemsize for a in arrs
+        ) / n_inst
+
+
+def _size(shape):
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+def unpacked_bytes_per_lane(state) -> float:
+    """Unpacked bytes per instance lane (tick scalar excluded) — the number
+    ROOFLINE.json historically reported as ``state_bytes_per_lane``."""
+    leaves = [l for l in jax.tree_util.tree_leaves(state) if l.ndim > 0]
+    n_inst = leaves[0].shape[-1]
+    return sum(
+        _size(l.shape) * jnp.dtype(l.dtype).itemsize for l in leaves
+    ) / n_inst
+
+
+# ---------------------------------------------------------------------------
+# Layout registry + codec builder.
+
+
+def protocol_layout(protocol: str):
+    """Resolve a protocol name to ``(version, entries, dims_spec)``.
+
+    ``dims_spec`` maps symbolic width names (the str ``bits`` values in the
+    table) to ``(leaf_path, axis)`` pairs resolved from state shapes.
+    """
+    if protocol == "paxos":
+        from paxos_tpu.core import state as m
+
+        return m.PAXOS_LAYOUT_VERSION, m.PAXOS_LAYOUT, m.PAXOS_LAYOUT_DIMS
+    if protocol == "multipaxos":
+        from paxos_tpu.core import mp_state as m
+
+        return m.MP_LAYOUT_VERSION, m.MP_LAYOUT, m.MP_LAYOUT_DIMS
+    if protocol == "fastpaxos":
+        from paxos_tpu.core import fp_state as m
+
+        return m.FP_LAYOUT_VERSION, m.FP_LAYOUT, m.FP_LAYOUT_DIMS
+    if protocol == "raftcore":
+        from paxos_tpu.core import raft_state as m
+
+        return m.RAFT_LAYOUT_VERSION, m.RAFT_LAYOUT, m.RAFT_LAYOUT_DIMS
+    raise ValueError(f"unknown protocol: {protocol!r}")
+
+
+def layout_version(protocol: str) -> str:
+    return protocol_layout(protocol)[0]
+
+
+def layout_fields(protocol: str) -> dict:
+    """Canonical per-field descriptors for the audit's layout goldens.
+
+    Symbolic widths stay symbolic, so the golden is dimension-independent:
+    resolving ``n_acc`` differently (auto-split) is not a layout change,
+    editing the table is.
+    """
+    _, entries, dims_spec = protocol_layout(protocol)
+    out = {}
+    for e in entries:
+        if isinstance(e, Word):
+            for j, f in enumerate(e.fields):
+                out[f.path] = (
+                    f"word={e.name} slot={j} bits={f.bits} "
+                    f"signed={int(f.signed)} bool={int(f.bool_)} bv={f.bv}"
+                    + (" optional" if e.optional else "")
+                )
+        elif isinstance(e, Stream):
+            out[e.path] = (
+                f"stream={e.name} bal={e.bal_bits} val={e.val_bits}"
+                + (" optional" if e.optional else "")
+            )
+        elif isinstance(e, Zero):
+            out[e.path] = f"zero like={e.like}"
+        else:  # pragma: no cover - spec bug
+            raise TypeError(f"unknown layout entry: {e!r}")
+    out["__dims__"] = repr(sorted(dims_spec.items()))
+    return out
+
+
+_CODEC_CACHE: dict = {}
+
+
+def codec_for(protocol: str, state) -> Codec:
+    """Resolve (and cache) the packed codec for a concrete state pytree.
+
+    The cache key is the full structural signature — treedef plus every
+    leaf's (shape, dtype) — so codecs are identity-stable across calls and
+    safe as jit-static arguments; tracers work as well as concrete arrays.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    sig = (
+        protocol,
+        treedef,
+        tuple((tuple(l.shape), jnp.dtype(l.dtype).name) for l in leaves),
+    )
+    codec = _CODEC_CACHE.get(sig)
+    if codec is None:
+        codec = _build_codec(protocol, leaves, treedef)
+        _CODEC_CACHE[sig] = codec
+    return codec
+
+
+def _build_codec(protocol, leaves, treedef) -> Codec:
+    version, entries, dims_spec = protocol_layout(protocol)
+    # Leaf-index lookup by dotted path: unflatten the treedef with integer
+    # tokens as leaves, then attribute-walk.  Robust to how containers
+    # register with the pytree machinery — no key-path API needed.
+    token_state = jax.tree_util.tree_unflatten(treedef, list(range(len(leaves))))
+
+    def leaf_index(path):
+        obj = token_state
+        for part in path.split("."):
+            if obj is None:
+                return None
+            obj = getattr(obj, part, None)
+        return obj if isinstance(obj, int) else None
+
+    dims = {}
+    for name, (path, axis) in dims_spec.items():
+        i = leaf_index(path)
+        if i is None:
+            raise ValueError(f"{protocol}: dim {name!r} path {path!r} missing")
+        dims[name] = int(leaves[i].shape[axis])
+
+    def width(bits):
+        w = dims[bits] if isinstance(bits, str) else bits
+        if not 1 <= w <= 31:
+            raise ValueError(f"{protocol}: field width {bits!r} -> {w} out of range")
+        return w
+
+    used: set = set()
+
+    def consume(i, path):
+        if i in used:
+            raise ValueError(f"{protocol}: leaf {path!r} consumed twice")
+        used.add(i)
+
+    words, streams, zeros = [], [], []
+    word_names: dict = {}  # logical name -> physical word count
+    for e in entries:
+        if isinstance(e, Word):
+            idxs = [leaf_index(f.path) for f in e.fields]
+            missing = [f.path for f, i in zip(e.fields, idxs) if i is None]
+            if missing:
+                if e.optional and len(missing) == len(idxs):
+                    continue
+                raise ValueError(
+                    f"{protocol}: word {e.name!r} fields missing: {missing}"
+                )
+            shape = tuple(leaves[idxs[0]].shape)
+            for f, i in zip(e.fields, idxs):
+                if tuple(leaves[i].shape) != shape:
+                    raise ValueError(
+                        f"{protocol}: word {e.name!r} field {f.path!r} shape "
+                        f"{tuple(leaves[i].shape)} != {shape}"
+                    )
+                consume(i, f.path)
+            # Greedy split into <= 32-bit physical words, declared order.
+            phys, slots, off = [], [], 0
+            for f, i in zip(e.fields, idxs):
+                b = width(f.bits)
+                if off + b > 32:
+                    phys.append(slots)
+                    slots, off = [], 0
+                slots.append(_Slot(i, off, b, f.signed, f.bool_, f.bv))
+                off += b
+            phys.append(slots)
+            names = (
+                [e.name] if len(phys) == 1
+                else [f"{e.name}_{j}" for j in range(len(phys))]
+            )
+            word_names[e.name] = names
+            for n, s in zip(names, phys):
+                words.append(_PWord(n, s))
+        elif isinstance(e, Stream):
+            i = leaf_index(e.path)
+            if i is None:
+                if e.optional:
+                    continue
+                raise ValueError(f"{protocol}: stream leaf {e.path!r} missing")
+            if len(leaves[i].shape) < 2:
+                raise ValueError(f"{protocol}: stream {e.path!r} needs a slot axis")
+            consume(i, e.path)
+            streams.append(
+                _PStream(e.name, i, e.bal_bits, e.val_bits,
+                         int(leaves[i].shape[-2]))
+            )
+        elif isinstance(e, Zero):
+            i = leaf_index(e.path)
+            if i is None:
+                raise ValueError(f"{protocol}: zero leaf {e.path!r} missing")
+            consume(i, e.path)
+            zeros.append((i, e.like, jnp.dtype(leaves[i].dtype)))
+        else:
+            raise TypeError(f"{protocol}: unknown layout entry {e!r}")
+
+    # Zero `like` targets must resolve to exactly one same-shaped physical word.
+    for leaf, like, _ in zeros:
+        names = word_names.get(like)
+        if not names or len(names) != 1:
+            raise ValueError(
+                f"{protocol}: Zero like={like!r} must name an unsplit word"
+            )
+        like_word = next(w for w in words if w.name == names[0])
+        if tuple(leaves[leaf].shape) != tuple(leaves[like_word.slots[0].leaf].shape):
+            raise ValueError(f"{protocol}: Zero like={like!r} shape mismatch")
+    zeros = [(leaf, word_names[like][0], dt) for leaf, like, dt in zeros]
+
+    # The tick scalar: the one 0-d leaf (the fused engine's invariant).
+    scalar = [i for i, l in enumerate(leaves) if len(l.shape) == 0]
+    if len(scalar) != 1:
+        raise ValueError(f"{protocol}: expected exactly 1 scalar leaf, got {scalar}")
+    tick_leaf = scalar[0]
+    consume(tick_leaf, "tick")
+
+    # Everything unlisted passes through unchanged (telemetry rings, bool
+    # masks, full-range values) under a deterministic index-derived name.
+    passthroughs = [
+        (f"pt{i:03d}", i) for i in range(len(leaves)) if i not in used
+    ]
+
+    seen: set = set()
+    for n in [w.name for w in words] + [s.name for s in streams] + [
+        n for n, _ in passthroughs
+    ]:
+        if n in seen:
+            raise ValueError(f"{protocol}: duplicate packed word name {n!r}")
+        seen.add(n)
+
+    return Codec(protocol, version, treedef, len(leaves), tick_leaf,
+                 words, streams, zeros, passthroughs, dims)
+
+
+# Jitted adapters (static codec, so each codec gets its own cache entry).
+# The XLA reference path and goldens stay on the unpacked pytree; these are
+# the boundary crossings the fused wrappers (kernels/fused_tick.FUSED_CHUNKS)
+# and benches use.
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def pack_state(codec: Codec, state) -> PackedState:
+    """Pack an unpacked state pytree (jitted; codec static)."""
+    return codec.pack(state)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def unpack_state(codec: Codec, pst: PackedState):
+    """Unpack a :class:`PackedState` (jitted; codec static)."""
+    return codec.unpack(pst)

@@ -92,23 +92,35 @@ def census_jaxpr(jaxpr, counts):
 
 
 def tick_census(cfg, block: int) -> dict:
-    """Per-instance-tick op counts for a config's fused tick at ``block``."""
+    """Per-instance-tick op counts for a config's fused tick at ``block``.
+
+    Censuses the PACKED tick — the program the kernel actually runs:
+    unpack-on-use (shifts+masks, counted as ALU), the protocol body, pack
+    at the end.  ``state_bytes_per_lane`` is the packed VMEM-resident
+    footprint; ``unpacked_bytes_per_lane`` keeps the one-int32-per-field
+    size alongside — it is what the XLA engine (which runs on the unpacked
+    pytree) still streams through HBM, and the packed/unpacked ratio is
+    the layout win itself.
+    """
     import dataclasses
 
     from paxos_tpu.harness.run import init_plan, init_state
-    from paxos_tpu.kernels.fused_tick import fused_fns
+    from paxos_tpu.kernels.fused_tick import packed_fns
+    from paxos_tpu.utils import bitops
 
-    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    apply_fn, mask_fn, _ = packed_fns(cfg.protocol)
     small = dataclasses.replace(cfg, n_inst=block)
     state, plan = init_state(small), init_plan(small)
+    codec = bitops.codec_for(cfg.protocol, state)
+    pst = bitops.pack_state(codec, state)
 
     def tick(st):
         masks = mask_fn(cfg.fault, jnp.int32(1), st)
         return apply_fn(st, masks, plan, cfg.fault)
 
-    closed = jax.make_jaxpr(tick)(state)
+    closed = jax.make_jaxpr(tick)(pst)
     counts = census_jaxpr(closed.jaxpr, {"alu": 0, "reduce": 0, "layout": 0})
-    state_bytes = sum(
+    unpacked_bytes = sum(
         np.prod(l.shape) * l.dtype.itemsize for l in jax.tree.leaves(state)
         if getattr(l, "ndim", 0)
     )
@@ -117,7 +129,8 @@ def tick_census(cfg, block: int) -> dict:
         "reduce_per_lane_tick": counts["reduce"] / block,
         "layout_per_lane_tick": counts["layout"] / block,
         "other": {k: v / block for k, v in counts.get("other", {}).items()},
-        "state_bytes_per_lane": float(state_bytes) / block,
+        "state_bytes_per_lane": float(codec.bytes_per_lane(state)),
+        "unpacked_bytes_per_lane": float(unpacked_bytes) / block,
     }
 
 
@@ -229,7 +242,7 @@ def hbm_ceiling(mb: int = 512, reps: int = 5) -> float:
 
 def build_table(census_only: bool, sweep_path: str) -> dict:
     from bench import _configs
-    from paxos_tpu.kernels.fused_tick import fused_fns
+    from paxos_tpu.kernels.fused_tick import packed_fns
 
     on_tpu = (not census_only) and jax.devices()[0].platform == "tpu"
     out: dict = {"platform": jax.devices()[0].platform if on_tpu else "census"}
@@ -245,11 +258,11 @@ def build_table(census_only: bool, sweep_path: str) -> dict:
                 recorded[(c["case"], c["engine"])] = c["value"]
 
     uniq: dict = {}
-    for name, cfg, _eng, _chunk in _configs("tpu"):
+    for name, cfg, _eng, _chunk, _depth in _configs("tpu"):
         uniq.setdefault(name, cfg)
     rows = []
     for name, cfg in uniq.items():
-        _, _, dblk = fused_fns(cfg.protocol)
+        _, _, dblk = packed_fns(cfg.protocol)
         cen = tick_census(cfg, dblk)
         row = {"case": name, "block": dblk, **cen}
         for engine in ("fused", "xla"):
@@ -265,8 +278,10 @@ def build_table(census_only: bool, sweep_path: str) -> dict:
             if engine == "xla" and "hbm_bytes_per_sec" in out:
                 # The XLA engine streams the full state through HBM twice a
                 # tick (scan carry in + out); masks/temporaries add more, so
-                # this is a LOWER bound on its achieved bandwidth.
-                by = val * 2 * cen["state_bytes_per_lane"]
+                # this is a LOWER bound on its achieved bandwidth.  It runs
+                # on the UNPACKED pytree (packing is fused-engine-only), so
+                # the unpacked footprint is the right byte count here.
+                by = val * 2 * cen["unpacked_bytes_per_lane"]
                 row["xla_hbm_bytes_per_sec"] = by
                 row["xla_hbm_utilization"] = by / out["hbm_bytes_per_sec"]
         rows.append(row)
@@ -289,7 +304,8 @@ def main() -> int:
               f"HBM ceiling: {out['hbm_bytes_per_sec'] / 1e9:.0f} GB/s")
     for r in out["cases"]:
         line = (f"{r['case']:22s} alu/lane-tick {r['alu_per_lane_tick']:8.1f} "
-                f"state {r['state_bytes_per_lane']:7.1f} B")
+                f"state {r['state_bytes_per_lane']:7.1f} B "
+                f"(unpacked {r['unpacked_bytes_per_lane']:.0f})")
         if "fused_vpu_utilization" in r:
             line += (f"  fused {r['fused_rps'] / 1e6:6.1f}M r/s = "
                      f"{r['fused_vpu_utilization'] * 100:5.1f}% VPU")

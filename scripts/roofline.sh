@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Roofline re-record: scripts/roofline.py against the current BENCH_SWEEP
+# recording, written to ROOFLINE.json.  On a TPU rig this measures the VPU
+# and HBM ceilings fresh; anywhere else pass --census-only to refresh only
+# the static op-census fields (per-lane-tick ALU/layout counts and the
+# packed/unpacked state bytes) while a later TPU run re-measures ceilings.
+#
+# Usage: scripts/roofline.sh [--census-only] [extra roofline.py flags...]
+cd "$(dirname "$0")/.." || exit 1
+exec env python scripts/roofline.py --record ROOFLINE.json "$@"

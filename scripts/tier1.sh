@@ -65,4 +65,49 @@ if [ "$rc" -eq 0 ]; then
     >/dev/null 2>&1 \
   && echo AUDIT_SMOKE=ok || { echo AUDIT_SMOKE=FAILED; rc=1; }
 fi
+# Packed-state smoke: the fused engine now carries lane state bit-packed
+# through VMEM (utils/bitops layout tables); this replays one config per
+# protocol through the packed fused kernel (interpret) AND the unpacked
+# reference_chunk oracle (same counter-PRNG stream, plain XLA) and
+# digests both end states — any packing drift (a field re-binned, a
+# width wrong, an overflow clipped) breaks bit-equality here on CPU CI.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF' >/dev/null 2>&1 \
+  && echo PACKED_SMOKE=ok || { echo PACKED_SMOKE=FAILED; rc=1; }
+import hashlib
+import jax
+import jax.numpy as jnp
+import numpy as np
+from paxos_tpu.harness.config import (
+    config2_dueling_drop, config3_multipaxos, config5_sweep)
+from paxos_tpu.harness.run import init_plan, init_state
+from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS, fused_fns, reference_chunk
+
+def digest(state):
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+sweep = {c.protocol: c for c in config5_sweep(n_inst=256)}
+cases = {
+    "paxos": config2_dueling_drop(n_inst=256),
+    "multipaxos": config3_multipaxos(n_inst=256),
+    "fastpaxos": sweep["fastpaxos"],
+    "raftcore": sweep["raftcore"],
+}
+for protocol, cfg in cases.items():
+    plan = init_plan(cfg)
+    seed = jnp.int32(cfg.seed)
+    fused = FUSED_CHUNKS[protocol](
+        init_state(cfg), seed, plan, cfg.fault, 16,
+        block=256, interpret=True,
+    )
+    apply_fn, mask_fn, _ = fused_fns(protocol)
+    ref = reference_chunk(
+        init_state(cfg), seed, plan, cfg.fault, 16, apply_fn, mask_fn,
+    )
+    assert digest(fused) == digest(ref), f"{protocol}: packed fused != XLA reference"
+EOF
+fi
 exit $rc

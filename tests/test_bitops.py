@@ -1,0 +1,420 @@
+"""Packed lane-state codecs: roundtrip exactness, boundaries, estimator.
+
+The packing contract (utils/bitops + the core/*_state.py layout tables) is
+``unpack(pack(s)) == s`` bit-exactly for every in-range state — in-range
+meaning the field-width invariants the config/report-time guards enforce
+(harness/run.py).  These tests pin that property for all four protocols
+with randomized states, pin the boundary behavior (0, max roundtrip; max+1
+WRAPS — pack masks to the declared width, which is why the runtime guards
+exist), and pin the VMEM estimator that sizes the fused block from packed
+bytes instead of unpacked leaf sums.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paxos_tpu.harness.config import (
+    SimConfig,
+    config2_dueling_drop,
+    config3_long,
+    config3_multipaxos,
+    config5_sweep,
+)
+from paxos_tpu.harness.run import init_state
+from paxos_tpu.utils import bitops
+
+PROTOCOLS = ("paxos", "multipaxos", "fastpaxos", "raftcore")
+
+
+def _cfg(protocol, n_inst=64, **kw):
+    if protocol == "paxos":
+        return config2_dueling_drop(n_inst=n_inst, **kw)
+    if protocol == "multipaxos":
+        return config3_multipaxos(n_inst=n_inst, **kw)
+    sweep = {c.protocol: c for c in config5_sweep(n_inst=n_inst, **kw)}
+    return sweep[protocol]
+
+
+def _leaf_kinds(codec):
+    """leaf index -> ("slot", _Slot) | ("stream", _PStream) | ("zero", dtype)
+    | ("pt", None) | ("tick", None), from the resolved codec."""
+    kinds = {}
+    for w in codec.words:
+        for s in w.slots:
+            kinds[s.leaf] = ("slot", s)
+    for st in codec.streams:
+        kinds[st.leaf] = ("stream", st)
+    for leaf, _like, dtype in codec.zeros:
+        kinds[leaf] = ("zero", dtype)
+    for _name, leaf in codec.passthroughs:
+        kinds[leaf] = ("pt", None)
+    kinds[codec.tick_leaf] = ("tick", None)
+    return kinds
+
+
+def _random_bv(rng, shape, bal_bits, val_bits):
+    bal = rng.integers(0, 1 << bal_bits, shape)
+    val = rng.integers(0, 1 << val_bits, shape)
+    return jnp.asarray((bal << 16) | val, jnp.int32)
+
+
+def _random_in_range_state(protocol, cfg, seed):
+    """A state whose every leaf is random but within its declared field
+    range — the domain the pack/unpack bijection is promised on."""
+    state = init_state(cfg)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    codec = bitops.codec_for(protocol, state)
+    kinds = _leaf_kinds(codec)
+    rng = np.random.default_rng(seed)
+    out = []
+    for i, leaf in enumerate(leaves):
+        kind, info = kinds[i]
+        shape = tuple(leaf.shape)
+        if kind == "slot":
+            if info.bool_:
+                out.append(jnp.asarray(rng.integers(0, 2, shape), jnp.bool_))
+            elif info.bv is not None:
+                out.append(_random_bv(rng, shape, *info.bv))
+            elif info.signed:
+                half = 1 << (info.bits - 1)
+                out.append(jnp.asarray(
+                    rng.integers(-half, half, shape), jnp.int32))
+            else:
+                out.append(jnp.asarray(
+                    rng.integers(0, 1 << info.bits, shape), jnp.int32))
+        elif kind == "stream":
+            out.append(_random_bv(rng, shape, info.bal_bits, info.val_bits))
+        elif kind == "zero":
+            out.append(jnp.zeros(shape, info))
+        elif kind == "tick":
+            out.append(jnp.int32(rng.integers(0, 1 << 30)))
+        else:  # passthrough: any value of the leaf's dtype roundtrips
+            if leaf.dtype == jnp.bool_:
+                out.append(jnp.asarray(rng.integers(0, 2, shape), jnp.bool_))
+            else:
+                out.append(jnp.asarray(
+                    rng.integers(-(1 << 31), 1 << 31, shape), jnp.int32))
+    return jax.tree_util.tree_unflatten(treedef, out), codec
+
+
+def _assert_trees_bitexact(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# Roundtrip property: all four protocols, randomized in-range states.
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_roundtrip_random_in_range(protocol, seed):
+    cfg = _cfg(protocol, n_inst=64, seed=seed)
+    state, codec = _random_in_range_state(protocol, cfg, seed)
+    _assert_trees_bitexact(codec.unpack(codec.pack(state)), state)
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_roundtrip_init_state_and_variants(protocol):
+    """The real initial states (default, stale snapshots on, telemetry on)
+    roundtrip too — optional words/streams and passthrough rings included."""
+    from paxos_tpu.core.telemetry import TelemetryConfig
+
+    base = _cfg(protocol, n_inst=64)
+    variants = [
+        base,
+        dataclasses.replace(
+            base, fault=dataclasses.replace(base.fault, stale_k=2)
+        ),
+        dataclasses.replace(
+            base,
+            telemetry=TelemetryConfig(counters=True, ring_depth=8, hist_bins=4),
+        ),
+    ]
+    for cfg in variants:
+        state = init_state(cfg)
+        codec = bitops.codec_for(protocol, state)
+        _assert_trees_bitexact(codec.unpack(codec.pack(state)), state)
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_roundtrip_field_boundaries(protocol):
+    """0 and max roundtrip exactly; max+1 WRAPS to the masked value (the
+    documented overflow behavior the runtime ballot/timer guards exist to
+    rule out)."""
+    cfg = _cfg(protocol, n_inst=8)
+    state = init_state(cfg)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    codec = bitops.codec_for(protocol, state)
+    kinds = _leaf_kinds(codec)
+
+    def fill(value_of):
+        out = []
+        for i, leaf in enumerate(leaves):
+            kind, info = kinds[i]
+            if kind == "slot" and not info.bool_ and info.bv is None:
+                out.append(jnp.full(leaf.shape, value_of(info), jnp.int32))
+            else:
+                out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def maxval(s):
+        return (1 << (s.bits - 1)) - 1 if s.signed else (1 << s.bits) - 1
+
+    zero = fill(lambda s: 0)
+    _assert_trees_bitexact(codec.unpack(codec.pack(zero)), zero)
+    top = fill(maxval)
+    _assert_trees_bitexact(codec.unpack(codec.pack(top)), top)
+    # max+1 wraps: unsigned fields drop to 0, signed fields to their minimum.
+    over = fill(lambda s: maxval(s) + 1)
+    got = jax.tree_util.tree_flatten(codec.unpack(codec.pack(over)))[0]
+    for i, leaf in enumerate(leaves):
+        kind, info = kinds[i]
+        if kind == "slot" and not info.bool_ and info.bv is None:
+            want = -(1 << (info.bits - 1)) if info.signed else 0
+            np.testing.assert_array_equal(
+                np.asarray(got[i]), np.full(leaf.shape, want, np.int32)
+            )
+
+
+def test_signed_negative_roundtrip():
+    """Signed fields (timers, chosen_tick sentinels) keep negatives exact."""
+    cfg = _cfg("paxos", n_inst=8)
+    state = init_state(cfg)
+    codec = bitops.codec_for("paxos", state)
+    timer = jnp.full(state.proposer.timer.shape, -1, jnp.int32)
+    st = dataclasses.replace(
+        state, proposer=dataclasses.replace(state.proposer, timer=timer)
+    )
+    rt = codec.unpack(codec.pack(st))
+    np.testing.assert_array_equal(np.asarray(rt.proposer.timer), -1)
+
+
+# ---------------------------------------------------------------------------
+# Primitive helpers.
+
+
+def test_shr_logical_matches_uint_semantics():
+    x = jnp.asarray([-1, -(1 << 31), 123, 0], jnp.int32)
+    for k in (0, 1, 7, 13, 31):
+        want = (np.asarray(x).astype(np.uint32) >> k).astype(np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(bitops.shr_logical(x, k)), want
+        )
+
+
+def test_pack_unpack_set_field():
+    w = bitops.pack_word([(jnp.int32(5), 0, 4), (jnp.int32(9), 4, 5)])
+    assert int(bitops.unpack_field(w, 0, 4)) == 5
+    assert int(bitops.unpack_field(w, 4, 5)) == 9
+    w2 = bitops.set_field(w, jnp.int32(3), 4, 5)
+    assert int(bitops.unpack_field(w2, 4, 5)) == 3
+    assert int(bitops.unpack_field(w2, 0, 4)) == 5  # neighbor untouched
+    # Overflow masks: a 4-bit field packed with 16+2 reads back as 2.
+    w3 = bitops.set_field(w, jnp.int32(18), 0, 4)
+    assert int(bitops.unpack_field(w3, 0, 4)) == 2
+
+
+def test_bv_dense_transcode_roundtrip():
+    rng = np.random.default_rng(0)
+    bal = rng.integers(0, 1 << 11, (4, 64))
+    val = rng.integers(0, 1 << 13, (4, 64))
+    bv = jnp.asarray((bal << 16) | val, jnp.int32)
+    dense = bitops.bv_to_dense(bv, 11, 13)
+    assert int(jnp.max(dense)) < (1 << 24)
+    np.testing.assert_array_equal(
+        np.asarray(bitops.dense_to_bv(dense, 11, 13)), np.asarray(bv)
+    )
+
+
+@pytest.mark.parametrize("length", [1, 2, 3, 4, 5, 7, 8, 9, 16])
+def test_stream_pack_partial_groups(length):
+    """4-entries->3-words stream codec, including every partial-group tail."""
+    rng = np.random.default_rng(length)
+    bv = np.asarray(_random_bv(rng, (2, length, 32), 11, 13))
+    packed = bitops._stream_pack(jnp.asarray(bv), 11, 13)
+    assert packed.shape == (2, bitops.stream_words(length), 32)
+    out = bitops._stream_unpack(packed, 11, 13, length)
+    np.testing.assert_array_equal(np.asarray(out), bv)
+
+
+# ---------------------------------------------------------------------------
+# Codec structure: auto-split, PackedState pytree, byte accounting.
+
+
+def test_word_autosplit_on_wide_lt_mask():
+    """paxos ``lt`` = lt_bal(15) + lt_val(12) + lt_mask(n_acc): n_acc=5 fits
+    one 32-bit word; n_acc=7 overflows and splits to lt_0/lt_1 — and both
+    resolutions roundtrip (the split is a codec detail, not a layout
+    change, so layout_fields is identical for both)."""
+    names = {}
+    for n_acc in (5, 7):
+        cfg = SimConfig(n_inst=8, n_prop=2, n_acc=n_acc, protocol="paxos")
+        state = init_state(cfg)
+        codec = bitops.codec_for("paxos", state)
+        names[n_acc] = {w.name for w in codec.words}
+        _assert_trees_bitexact(codec.unpack(codec.pack(state)), state)
+    assert "lt" in names[5] and "lt_0" not in names[5]
+    assert "lt_0" in names[7] and "lt_1" in names[7] and "lt" not in names[7]
+
+
+def test_packed_state_pytree_contract():
+    """Flatten order is word arrays then tick LAST (the fused engine's
+    single-scalar invariant), and treedef is stable across pack calls."""
+    cfg = _cfg("paxos", n_inst=8)
+    state = init_state(cfg)
+    codec = bitops.codec_for("paxos", state)
+    pst = codec.pack(state)
+    leaves, treedef = jax.tree_util.tree_flatten(pst)
+    assert leaves[-1].ndim == 0  # tick
+    assert all(l.ndim > 0 for l in leaves[:-1])
+    assert treedef == jax.tree_util.tree_flatten(codec.pack(state))[1]
+    assert pst.word("acc").shape == state.acceptor.promised.shape
+    assert int(pst.tick) == int(state.tick)
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_packed_bytes_reduced_at_least_30pct(protocol):
+    """The acceptance floor: packed VMEM bytes/lane down >= 30% vs the
+    one-int32-per-field representation, every protocol."""
+    cfg = _cfg(protocol, n_inst=64)
+    state = init_state(cfg)
+    codec = bitops.codec_for(protocol, state)
+    packed = codec.bytes_per_lane(state)
+    unpacked = bitops.unpacked_bytes_per_lane(state)
+    assert packed <= 0.7 * unpacked, (protocol, packed, unpacked)
+
+
+def test_codec_cache_identity():
+    cfg = _cfg("multipaxos", n_inst=64)
+    s1, s2 = init_state(cfg), init_state(cfg)
+    assert bitops.codec_for("multipaxos", s1) is bitops.codec_for(
+        "multipaxos", s2
+    )
+
+
+# ---------------------------------------------------------------------------
+# VMEM estimator: packed tables size the fused block.
+
+
+def test_estimator_raises_multipaxos_block():
+    """The headline win: multipaxos's packed footprint (904 B/lane at
+    config3) lets the estimated block rise from the pre-packing 128 to
+    >= 256 — and the static default in fused_fns is pinned to exactly the
+    estimator's output, so the two can't silently diverge."""
+    from paxos_tpu.kernels.fused_tick import (
+        estimate_block, fused_fns, packed_fns,
+    )
+
+    for cfg in (config3_multipaxos(n_inst=64), config3_long(n_inst=64)):
+        est = estimate_block("multipaxos", init_state(cfg))
+        assert est >= 256
+        assert est == fused_fns("multipaxos")[2] == packed_fns("multipaxos")[2]
+
+
+def test_estimator_keeps_paxos_at_default():
+    from paxos_tpu.kernels.fused_tick import (
+        DEFAULT_BLOCK, estimate_block, fused_fns,
+    )
+
+    for protocol in ("paxos", "fastpaxos", "raftcore"):
+        cfg = _cfg(protocol, n_inst=64)
+        est = estimate_block(protocol, init_state(cfg))
+        assert est == DEFAULT_BLOCK == fused_fns(protocol)[2]
+
+
+def test_block_for_bytes_budget_halving():
+    from paxos_tpu.kernels.fused_tick import (
+        VMEM_STATE_BUDGET, block_for_bytes,
+    )
+
+    assert block_for_bytes(904.0) == 256  # config3-multipaxos packed
+    assert 512 * 904.0 > VMEM_STATE_BUDGET  # 512 really would overflow
+    assert block_for_bytes(356.0) == 1024  # config2-paxos packed
+    assert block_for_bytes(1e9) == 128  # floor holds however heavy the lane
+
+
+def test_degrade_warning_still_names_constraint():
+    """`fit_block` reconciles the estimated block with n_inst divisibility
+    and must still say WHICH constraint degraded the request and to what."""
+    from paxos_tpu.kernels.fused_tick import fit_block
+
+    with pytest.warns(
+        UserWarning, match=r"block=256 does not tile n_inst=1920"
+    ):
+        assert fit_block(256, 1920) == 128
+
+
+# ---------------------------------------------------------------------------
+# Layout-version guard (audit satellite): goldens catch silent re-binning.
+
+
+def test_layout_goldens_match_live_tables():
+    from paxos_tpu.analysis import goldens
+    from paxos_tpu.analysis.structure import audit_layout
+
+    for protocol in PROTOCOLS:
+        assert goldens.LAYOUT_GOLDENS[protocol]["version"] == (
+            bitops.layout_version(protocol)
+        )
+        assert goldens.LAYOUT_GOLDENS[protocol]["fields"] == (
+            bitops.layout_fields(protocol)
+        )
+        assert audit_layout(protocol) == []
+
+
+def test_layout_mutation_without_version_bump_fails_audit(monkeypatch):
+    """Planted mutation: shrink paxos requests.bal 15->14 without touching
+    the version — the audit must fail and NAME the field."""
+    from paxos_tpu.analysis.structure import audit_layout
+    from paxos_tpu.core import state as state_mod
+
+    mutated = []
+    for e in state_mod.PAXOS_LAYOUT:
+        if isinstance(e, bitops.Word) and e.name == "req":
+            fields = [
+                bitops.F(f.path, 14, signed=f.signed, bool_=f.bool_, bv=f.bv)
+                if f.path == "requests.bal" else f
+                for f in e.fields
+            ]
+            mutated.append(bitops.Word("req", *fields))
+        else:
+            mutated.append(e)
+    monkeypatch.setattr(state_mod, "PAXOS_LAYOUT", tuple(mutated))
+
+    findings = audit_layout("paxos")
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "requests.bal" in msg
+    assert "WITHOUT a version bump" in msg
+
+    # Same mutation WITH a bump: still a finding (stale goldens need a
+    # re-record), but it instructs the re-record instead of failing the bump.
+    monkeypatch.setattr(
+        state_mod, "PAXOS_LAYOUT_VERSION", "paxos-packed-v2-test"
+    )
+    findings = audit_layout("paxos")
+    assert len(findings) == 1
+    assert "re-record" in findings[0].message
+    assert "requests.bal" in findings[0].message
+
+
+def test_layout_version_folds_into_fingerprint(monkeypatch):
+    """A version bump alone must re-key the config fingerprint — that is
+    how checkpoints recorded under an old layout stop matching."""
+    from paxos_tpu.core import state as state_mod
+
+    cfg = config2_dueling_drop(n_inst=64)
+    before = cfg.fingerprint()
+    monkeypatch.setattr(
+        state_mod, "PAXOS_LAYOUT_VERSION", "paxos-packed-v2-test"
+    )
+    assert cfg.fingerprint() != before

@@ -250,8 +250,8 @@ def test_reshard_resume_longlog_fused_with_base(tmp_path):
 
 def test_stream_lineage_guard(tmp_path):
     """VERDICT r4 weak#3: the fused block is stream-relevant (schedules key
-    on (seed, tick, block)), so a checkpoint written under block=256 (the
-    pre-round-4 MP default) must REFUSE to resume under the current 128
+    on (seed, tick, block)), so a checkpoint written under block=128 (the
+    pre-packing MP default) must REFUSE to resume under the current 256
     default — same seed, different schedule — unless the saved block is
     passed explicitly."""
     import warnings
@@ -263,15 +263,15 @@ def test_stream_lineage_guard(tmp_path):
     cfg = config3_multipaxos(n_inst=64, seed=3)
     state, plan = init_state(cfg), init_plan(cfg)
 
-    ckpt.save(tmp_path / "s", state, plan, cfg, engine="fused", block=256)
-    # Mismatched effective block (MP default is 128) -> refused.
+    ckpt.save(tmp_path / "s", state, plan, cfg, engine="fused", block=128)
+    # Mismatched effective block (MP default is 256) -> refused.
     with pytest.raises(ValueError, match="DIFFERENT schedule"):
         ckpt.restore(tmp_path / "s", engine="fused")
     # Mismatched engine -> refused (XLA streams are keyed differently).
     with pytest.raises(ValueError, match="DIFFERENT schedule"):
         ckpt.restore(tmp_path / "s", engine="xla")
     # Matching lineage -> restores.
-    s2, _, c2 = ckpt.restore(tmp_path / "s", engine="fused", block=256)
+    s2, _, c2 = ckpt.restore(tmp_path / "s", engine="fused", block=128)
     assert c2 == cfg
 
     # Saved under the protocol default (block=None resolves at SAVE time),

@@ -135,12 +135,17 @@ def test_default_off_prunes_to_none():
 def test_fingerprint_unchanged_by_default_telemetry():
     """Pre-telemetry artifacts must keep matching: with the default (off)
     telemetry the fingerprint is computed WITHOUT the telemetry key — the
-    exact pre-PR config shape; non-default telemetry IS fingerprinted."""
+    exact pre-PR config shape plus the packed-layout version key (which is
+    deliberately fingerprinted: a layout change re-keys every checkpoint);
+    non-default telemetry IS fingerprinted."""
     import hashlib
+
+    from paxos_tpu.utils.bitops import layout_version
 
     cfg = C.config2_dueling_drop(1 << 20)
     d = dataclasses.asdict(cfg)
     del d["telemetry"]  # the pre-telemetry asdict shape
+    d["layout_version"] = layout_version(cfg.protocol)
     pre = hashlib.sha256(
         json.dumps(d, sort_keys=True).encode()
     ).hexdigest()[:16]
