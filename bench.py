@@ -139,14 +139,20 @@ def bench_case(
     platform = jax.devices()[0].platform
     state = init_state(cfg)
     plan = init_plan(cfg)
-    # Packed on-device footprint + the effective fused block, recorded in
-    # every row so a packing regression (bytes creeping back up, block
-    # degrading) shows in BENCH_* without re-running the roofline.
-    # eval_shape-based: free, computed before the state is donated away.
+    # On-device footprint + the effective fused block, recorded in every
+    # row so a packing regression (bytes creeping back up, block degrading)
+    # shows in BENCH_* without re-running the roofline.  The bytes are what
+    # THIS engine carries: packed codec words for fused rows, the unpacked
+    # pytree for xla rows (which never packs).  eval_shape/leaf-shape based:
+    # free, computed before the state is donated away.
     from paxos_tpu.kernels.fused_tick import fit_block
     from paxos_tpu.utils import bitops
 
-    state_bytes = bitops.codec_for(cfg.protocol, state).bytes_per_lane(state)
+    state_bytes = (
+        bitops.codec_for(cfg.protocol, state).bytes_per_lane(state)
+        if engine == "fused"
+        else bitops.unpacked_bytes_per_lane(state)
+    )
     sid = stream_id(cfg, engine)
     eff_block = (
         fit_block(sid["block"], cfg.n_inst, warn=False)

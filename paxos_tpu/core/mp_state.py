@@ -259,10 +259,12 @@ class MultiPaxosState:
 # ---------------------------------------------------------------------------
 # Packed lane-state layout (utils/bitops).  Multi-Paxos width rationale:
 #
-# - Proposer ballots stay < 2^11 (report-time max_ballot guard in
-#   harness/run.py — tighter than the 2^15 pack_bv budget); message-buffer
-#   ballot fields get 12 bits because PREPARE corruption bumps msg_bal by 1,
-#   which can land exactly on 2^11.
+# - Proposer ballots stay <= 2^11 - 1, the 11-bit field capacity: the fused
+#   engine saturates there instead of wrapping (fused_tick._saturate_ballots)
+#   and the report-time max_ballot guard in harness/run.py condemns any
+#   campaign that reaches it (tighter than the 2^15 pack_bv budget);
+#   message-buffer ballot fields get 12 bits because PREPARE corruption
+#   bumps msg_bal by 1, which can land exactly on 2^11.
 # - Values are own_slot_value(pid, slot) < 2^13 (config-time guard in
 #   init_state; corrupt flips ^64 stay in range).
 # - (bal << 16 | val) log pairs transcode to dense 11+13 = 24-bit entries and

@@ -96,6 +96,30 @@ def _check_packed_layout_bounds(cfg: SimConfig) -> None:
         )
 
 
+def check_tick_budget(protocol: str, ticks: int) -> None:
+    """Ticks-per-campaign bound for the packed ``learner.chosen_tick`` field.
+
+    ``chosen_tick`` records the global tick of first choice, so it grows to
+    the campaign's tick budget — a run longer than the field's signed
+    capacity (18-bit Multi-Paxos: 131071; 19-bit single-decree: 262143)
+    would wrap it NEGATIVE on the fused engine, corrupting latency
+    histograms and ``mean_choose_tick`` silently.  Enforced where the tick
+    budget is accepted (:func:`run`, ``soak``) for both engines, like the
+    other packed-layout bounds: config acceptance must not depend on the
+    engine, or a campaign could pass on XLA and be unreplayable fused.
+    """
+    from paxos_tpu.utils.bitops import layout_field_width
+
+    bits, signed = layout_field_width(protocol, "learner.chosen_tick")
+    cap = (1 << (bits - 1)) - 1 if signed else (1 << bits) - 1
+    if ticks > cap:
+        raise ValueError(
+            f"tick budget {ticks} overflows the packed {bits}-bit "
+            f"learner.chosen_tick field for {protocol} (core layout tables); "
+            f"keep ticks per campaign <= {cap}"
+        )
+
+
 def _init_protocol_state(cfg: SimConfig):
     stale = cfg.fault.stale_k > 0  # allocate stale-snapshot shadow arrays
     _check_packed_layout_bounds(cfg)
@@ -528,14 +552,19 @@ def summarize_device(
 
     # Ballot bit budget: ballots grow with the schedule (elections/retries),
     # so the bound is enforced on every report — a campaign that overflowed
-    # would otherwise corrupt compares SILENTLY.  Multi-Paxos: 11-bit packed
-    # proposer ballots (core/mp_state.MP_LAYOUT; tighter than the 2^15
-    # pack_bv budget that keeps bal << 16 | val sign-clear).  Single-decree:
-    # 15-bit packed ballot fields (core/state.py PAXOS_LAYOUT and kin),
-    # minus 1 for the corrupt fault's msg_bal+1 headroom.
+    # would otherwise corrupt compares SILENTLY.  The limit is exactly the
+    # packed field CAPACITY of proposer.bal — Multi-Paxos 2^11 - 1
+    # (core/mp_state.MP_LAYOUT, tighter than the 2^15 pack_bv budget that
+    # keeps bal << 16 | val sign-clear), single-decree 2^15 - 1
+    # (core/state.py PAXOS_LAYOUT and kin, the last value with corrupt
+    # msg_bal+1 headroom in the 12/15-bit message fields) — because the
+    # fused engine SATURATES ballots there instead of letting the pack mask
+    # wrap them (kernels/fused_tick._saturate_ballots): an overflowed
+    # campaign reads max_ballot == capacity at the chunk boundary, so this
+    # guard fires on both engines at the same threshold.
     dev["max_ballot"] = prop.bal.max()
     meta["ballot_limit"] = (
-        (1 << 11) if chosen.ndim == 2 else (1 << 15) - 1
+        (1 << 11) - 1 if chosen.ndim == 2 else (1 << 15) - 1
     )
 
     if chosen.ndim == 2:  # Multi-Paxos: chosen_frac is slot-level
@@ -685,6 +714,7 @@ def run(
     from paxos_tpu.harness.pipeline import pipelined_run
 
     depth = validate_pipeline_depth(pipeline_depth)
+    check_tick_budget(cfg.protocol, max_ticks if until_all_chosen else total_ticks)
     state = init_state(cfg)
     plan = init_plan(cfg)
     # Long-log Multi-Paxos (SURVEY.md §6.7): decided prefixes compact out of

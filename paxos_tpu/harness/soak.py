@@ -19,7 +19,7 @@ from typing import Any, Callable, Optional
 
 from paxos_tpu.harness.checkpoint import stream_id
 from paxos_tpu.harness.config import SimConfig
-from paxos_tpu.harness.run import MeasurementCorrupted, run
+from paxos_tpu.harness.run import MeasurementCorrupted, check_tick_budget, run
 
 
 def _retry_schedule(
@@ -169,6 +169,10 @@ def soak(
     say = log or (lambda s: None)
     sp = ensure_recorder(spans)
     depth = validate_pipeline_depth(pipeline_depth)
+    # Fail before the campaign loop: a per-seed tick budget beyond the
+    # packed chosen_tick width would wrap latency measurements negative on
+    # the fused engine (the pipelined path below bypasses run()'s check).
+    check_tick_budget(cfg.protocol, ticks_per_seed)
     if min_slots_per_lane_tick is not None and not (
         cfg.protocol == "multipaxos" and cfg.fault.log_total
     ):

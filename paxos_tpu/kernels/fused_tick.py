@@ -683,6 +683,29 @@ def fused_fns(protocol: str, ablate: frozenset = frozenset()):
     raise ValueError(f"unknown protocol: {protocol!r}")
 
 
+def _saturate_ballots(codec, state):
+    """Pin ``proposer.bal`` at its packed field capacity before a pack.
+
+    ``Codec.pack`` masks every field to its declared width, so a ballot
+    that outgrew its field would WRAP to a small value and the report-time
+    ``max_ballot >= limit`` guard (harness/run.summarize_host) could never
+    observe the overflow — the exact silent corruption it exists to catch.
+    Ballots are monotone, so clamping at the capacity is sticky: once any
+    proposer's ballot tries to exceed the field, the unpacked state reads
+    exactly the capacity at every subsequent chunk boundary and the guard
+    (whose limit IS this capacity) raises ``MeasurementCorrupted`` at the
+    next report — same threshold the XLA engine trips by growing through
+    it unmasked.  Below the capacity the clamp is the identity, so the
+    fused(packed) == reference(unpacked) bit-exactness contract holds for
+    every uncorrupted campaign.
+    """
+    cap = codec.field_capacity("proposer.bal")
+    if cap is None:
+        return state
+    prop = state.proposer
+    return state.replace(proposer=prop.replace(bal=jnp.minimum(prop.bal, cap)))
+
+
 @functools.lru_cache(maxsize=None)
 def packed_fns(protocol: str, ablate: frozenset = frozenset()):
     """(apply_fn, mask_fn, default_block) lifted to the packed state.
@@ -694,14 +717,17 @@ def packed_fns(protocol: str, ablate: frozenset = frozenset()):
     the VMEM-resident carry is the dense words.  The mask path's unpack is
     dead-code-eliminated (mask samplers read only shapes).  PRNG streams are
     untouched: same mask fns, same (seed, tick, block) keying, and the
-    unpack/apply/pack composition is value-identical to the raw pair, so
-    fused(packed) == reference(unpacked) bit-exactly (tier1 PACKED_SMOKE).
+    unpack/apply/pack composition is value-identical to the raw pair below
+    the ballot capacity (overflow saturates instead of wrapping —
+    :func:`_saturate_ballots` — so the report-time guard stays satisfiable),
+    so fused(packed) == reference(unpacked) bit-exactly (tier1 PACKED_SMOKE).
     """
     apply_fn, mask_fn, default_block = fused_fns(protocol, ablate)
 
     def packed_apply(pst, masks, plan, cfg):
         codec = pst.codec
-        return codec.pack(apply_fn(codec.unpack(pst), masks, plan, cfg))
+        new = apply_fn(codec.unpack(pst), masks, plan, cfg)
+        return codec.pack(_saturate_ballots(codec, new))
 
     def packed_mask(cfg, tick_seed, pst):
         return mask_fn(cfg, tick_seed, pst.codec.unpack(pst))
@@ -717,7 +743,10 @@ def _make_chunk(protocol: str) -> Callable:
 
         apply_fn, mask_fn, default_block = packed_fns(protocol)
         codec = bitops.codec_for(protocol, state)
-        pst = bitops.pack_state(codec, state)
+        # The entry pack saturates too: a resumed/handed-in state whose
+        # ballots already overflowed must read as at-capacity (guard fires),
+        # not wrap to a small value (guard blind).
+        pst = bitops.pack_state(codec, _saturate_ballots(codec, state))
         pst = fused_chunk_auto(
             pst, seed, plan, cfg, n_ticks, apply_fn, mask_fn,
             block=block, interpret=interpret, default=default_block,

@@ -174,11 +174,12 @@ class Zero:
 
 
 class _Slot:
-    __slots__ = ("leaf", "off", "bits", "signed", "bool_", "bv")
+    __slots__ = ("leaf", "off", "bits", "signed", "bool_", "bv", "path")
 
-    def __init__(self, leaf, off, bits, signed, bool_, bv):
+    def __init__(self, leaf, off, bits, signed, bool_, bv, path):
         self.leaf, self.off, self.bits = leaf, off, bits
         self.signed, self.bool_, self.bv = signed, bool_, bv
+        self.path = path
 
 
 class _PWord:
@@ -373,6 +374,21 @@ class Codec:
         leaves[self.tick_leaf] = pst.tick
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
+    def field_capacity(self, path: str) -> "int | None":
+        """Largest value the packed field at ``path`` can hold, or None when
+        the leaf is not a plain unsigned word field (passthrough / signed /
+        bv-pair / stream).  The overflow-saturation policy in
+        ``kernels/fused_tick.packed_fns`` keys off this: a monotone leaf
+        clamped to its capacity survives pack/unpack as the capacity value,
+        so report-time ``>= capacity`` guards stay satisfiable."""
+        for w in self.words:
+            for s in w.slots:
+                if s.path == path:
+                    if s.signed or s.bool_ or s.bv is not None:
+                        return None
+                    return (1 << s.bits) - 1
+        return None
+
     def bytes_per_lane(self, state) -> float:
         """Packed VMEM bytes per instance lane (tick scalar excluded)."""
         p = jax.eval_shape(self.pack, state)
@@ -431,6 +447,25 @@ def protocol_layout(protocol: str):
 
 def layout_version(protocol: str) -> str:
     return protocol_layout(protocol)[0]
+
+
+def layout_field_width(protocol: str, path: str) -> "tuple[int, bool]":
+    """(bits, signed) for a fixed-width word field in a protocol's layout
+    table — state-free, so config/argument-time bound checks (e.g. the
+    ticks-per-campaign guard against ``learner.chosen_tick`` in
+    ``harness/run.py``) can read the width without building a state."""
+    _, entries, _ = protocol_layout(protocol)
+    for e in entries:
+        if isinstance(e, Word):
+            for f in e.fields:
+                if f.path == path:
+                    if isinstance(f.bits, str):
+                        raise ValueError(
+                            f"{protocol}: field {path!r} width {f.bits!r} is "
+                            "symbolic (state-shape-dependent)"
+                        )
+                    return int(f.bits), bool(f.signed)
+    raise KeyError(f"{protocol}: no word field at {path!r}")
 
 
 def layout_fields(protocol: str) -> dict:
@@ -548,7 +583,7 @@ def _build_codec(protocol, leaves, treedef) -> Codec:
                 if off + b > 32:
                     phys.append(slots)
                     slots, off = [], 0
-                slots.append(_Slot(i, off, b, f.signed, f.bool_, f.bv))
+                slots.append(_Slot(i, off, b, f.signed, f.bool_, f.bv, f.path))
                 off += b
             phys.append(slots)
             names = (

@@ -418,3 +418,61 @@ def test_layout_version_folds_into_fingerprint(monkeypatch):
         state_mod, "PAXOS_LAYOUT_VERSION", "paxos-packed-v2-test"
     )
     assert cfg.fingerprint() != before
+
+
+# ---------------------------------------------------------------------------
+# Ticks-per-campaign bound (REVIEW fix): a budget beyond the packed
+# chosen_tick width would wrap latency measurements negative on the fused
+# engine — the guard fails at argument time, where the budget is accepted.
+
+
+def test_tick_budget_bound_per_protocol():
+    from paxos_tpu.harness.run import check_tick_budget
+
+    # 18-bit signed for Multi-Paxos, 19-bit signed for the others.
+    check_tick_budget("multipaxos", (1 << 17) - 1)
+    with pytest.raises(ValueError, match="chosen_tick"):
+        check_tick_budget("multipaxos", 1 << 17)
+    for protocol in ("paxos", "fastpaxos", "raftcore"):
+        check_tick_budget(protocol, (1 << 18) - 1)
+        with pytest.raises(ValueError, match="chosen_tick"):
+            check_tick_budget(protocol, 1 << 18)
+
+
+def test_tick_budget_enforced_at_run_and_soak():
+    from paxos_tpu.harness.run import run
+    from paxos_tpu.harness.soak import soak
+
+    cfg = config2_dueling_drop(n_inst=8, seed=0)
+    with pytest.raises(ValueError, match="chosen_tick"):
+        run(cfg, total_ticks=1 << 18)
+    with pytest.raises(ValueError, match="chosen_tick"):
+        run(cfg, until_all_chosen=True, max_ticks=1 << 18)
+    with pytest.raises(ValueError, match="chosen_tick"):
+        soak(cfg, target_rounds=1.0, ticks_per_seed=1 << 18)
+
+
+def test_layout_field_width_lookup():
+    bits, signed = bitops.layout_field_width("multipaxos", "learner.chosen_tick")
+    assert (bits, signed) == (18, True)
+    with pytest.raises(KeyError):
+        bitops.layout_field_width("paxos", "no.such.field")
+    with pytest.raises(ValueError, match="symbolic"):
+        bitops.layout_field_width("paxos", "learner.lt_mask")
+
+
+# ---------------------------------------------------------------------------
+# Bench rows record what the ROW'S engine actually carries (REVIEW fix):
+# packed codec bytes for fused rows, the unpacked pytree for xla rows.
+
+
+def test_bench_state_bytes_match_engine():
+    from bench import bench_case
+
+    cfg = config2_dueling_drop(n_inst=128, seed=0)
+    row = bench_case(cfg, "xla", chunk=4, timed_chunks=1, repeats=1)
+    state = init_state(cfg)
+    unpacked = bitops.unpacked_bytes_per_lane(state)
+    packed = bitops.codec_for("paxos", state).bytes_per_lane(state)
+    assert row["state_bytes_per_lane"] == pytest.approx(unpacked)
+    assert unpacked > packed  # the xla row must not report the packed figure

@@ -284,3 +284,89 @@ def test_fused_block_degradation_warning_policy():
         interpret=True,
     ))
     assert len(w) == 1
+
+
+# ---------------------------------------------------------------------------
+# Ballot-overflow saturation (REVIEW fix): Codec.pack masks ballots to their
+# field width, so without the packed_fns clamp an election-heavy campaign
+# would WRAP proposer.bal mid-chunk and the report-time max_ballot guard
+# could never fire on the fused engine.  The clamp pins overflowed ballots
+# at the field capacity — sticky, since ballots are monotone — so both
+# engines condemn the campaign at the same threshold.
+
+
+def test_fused_ballot_overflow_saturates_and_guard_fires():
+    import pytest
+
+    from paxos_tpu.harness.run import MeasurementCorrupted, summarize
+    from paxos_tpu.utils import bitops
+
+    cap = bitops.codec_for(
+        "paxos", init_state(config2_dueling_drop(n_inst=32))
+    ).field_capacity("proposer.bal")
+    assert cap == (1 << 15) - 1
+
+    # All messages drop and timeouts are short, so proposers retry with
+    # higher ballots every few ticks; pre-seeded near the capacity, the
+    # campaign crosses it well inside the chunk.
+    cfg = SimConfig(
+        n_inst=32, n_prop=2, n_acc=3, seed=9,
+        fault=FaultConfig(p_drop=1.0, timeout=2, backoff_max=2),
+    )
+    plan = init_plan(cfg)
+
+    def preseed():
+        s = init_state(cfg)
+        bump = jnp.int32(cap - 64)
+        return s.replace(
+            proposer=s.proposer.replace(bal=s.proposer.bal + bump),
+            requests=s.requests.replace(bal=s.requests.bal + bump),
+        )
+
+    fused = fused_paxos_chunk(
+        preseed(), jnp.int32(9), plan, cfg.fault, 64, block=32, interpret=True
+    )
+    # Saturated exactly at the capacity — a wrap would read small here.
+    assert int(fused.proposer.bal.max()) == cap
+    with pytest.raises(MeasurementCorrupted):
+        summarize(fused)
+
+    # The XLA twin of the same schedule grows through the limit unmasked
+    # and trips the identical guard: the engines agree on condemnation.
+    ref = reference_chunk(preseed(), jnp.int32(9), plan, cfg.fault, 64)
+    assert int(ref.proposer.bal.max()) >= cap
+    with pytest.raises(MeasurementCorrupted):
+        summarize(ref)
+
+
+def test_fused_multipaxos_overflowed_input_saturates_at_entry():
+    """An already-overflowed ballot handed to the fused engine must read as
+    at-capacity (guard fires), not wrap small at the entry pack (guard
+    blind).  Also pins the MP guard limit at the 11-bit field capacity —
+    the old 2^11 limit was unrepresentable packed, hence unsatisfiable."""
+    import pytest
+
+    from paxos_tpu.harness.config import config3_multipaxos
+    from paxos_tpu.harness.run import MeasurementCorrupted, summarize
+    from paxos_tpu.kernels.fused_tick import fused_multipaxos_chunk
+    from paxos_tpu.utils import bitops
+
+    cfg = config3_multipaxos(n_inst=32, seed=4)
+    state = init_state(cfg)
+    cap = bitops.codec_for("multipaxos", state).field_capacity("proposer.bal")
+    assert cap == (1 << 11) - 1
+
+    over = state.replace(
+        proposer=state.proposer.replace(bal=state.proposer.bal + jnp.int32(cap + 5))
+    )
+    # The unpacked (XLA-side) guard already condemns this state...
+    with pytest.raises(MeasurementCorrupted):
+        summarize(over, log_total=cfg.fault.log_total)
+    # ...and so does the fused engine's output: the entry pack saturates.
+    out = fused_multipaxos_chunk(
+        over, jnp.int32(4), init_plan(cfg), cfg.fault, 4, block=32,
+        interpret=True,
+    )
+    assert int(out.proposer.bal.max()) == cap
+    with pytest.raises(MeasurementCorrupted):
+        summarize(out, log_total=cfg.fault.log_total)
