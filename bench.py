@@ -71,9 +71,20 @@ def _configs(platform: str):
         config2_dueling_drop(n_inst=n),
         telemetry=TelemetryConfig(counters=True, ring_depth=64, hist_bins=16),
     )
+    # Coverage-overhead row: flagship config with the on-device coverage
+    # sketch on at the CLI default size (64 words = 2048 Bloom bits/lane).
+    # Same contract as the telemetry row: OFF is gated free at 0.7x by the
+    # base row; this row prices ON (two hash insertions + a popcount per
+    # tick, plus 64 extra packed words per lane through the fused engine).
+    from paxos_tpu.obs.coverage import CoverageConfig
+
+    cov_cfg = dataclasses.replace(
+        config2_dueling_drop(n_inst=n), coverage=CoverageConfig(words=64)
+    )
     cases = [
         ("config2-paxos", config2_dueling_drop(n_inst=n), 1024, 1),
         ("config2-paxos-telemetry", tel_cfg, 1024, 1),
+        ("config2-paxos-coverage", cov_cfg, 1024, 1),
         ("config5-fastpaxos", sweep["fastpaxos"], 256, 1),
         ("config5-raftcore", sweep["raftcore"], 256, 1),
         ("config3-multipaxos", config3_multipaxos(n_inst=n), 256, 1),

@@ -131,6 +131,13 @@ def run_audit(
                 traces["default"][1], traces["telemetry"][1],
             )
             checks += 1
+        if "default" in traces and "coverage" in traces:
+            findings += prng_audit.audit_coverage_parity(
+                protocol,
+                traces["default"][0], traces["coverage"][0],
+                traces["default"][1], traces["coverage"][1],
+            )
+            checks += 1
     if lint:
         findings += purity.audit_traced_sources()
         checks += 1

@@ -7,31 +7,43 @@ call out the checkpoint/schedule break in the PR description.
 
 Reading the table is itself documentation: gray/corrupt configs share
 the default treedef (gray faults live in the *plan*, not the state),
-while stale and telemetry each add their own leaves.
+while stale, telemetry, and coverage each add their own leaves.
+
+Round 8 re-record: the coverage plane (obs.coverage) added an Optional
+``coverage`` leaf to every protocol state, so every TREEDEF cell re-keyed
+(default-off still prunes it to None — the leaf exists in the treedef
+string as None, which is the point of the fingerprint) and the new
+"coverage" audit column landed.  LAYOUT_GOLDENS are byte-identical to
+round 7: the sketch rides the fused engine's generic passthrough codec,
+touching no packed word.
 """
 
 # (protocol, config_name) -> sha256[:16] of str(tree_structure(init_state))
 TREEDEF_GOLDENS: dict = {
-    ("paxos", "default"): "9ca86b00e7246200",
-    ("paxos", "gray-chaos"): "9ca86b00e7246200",
-    ("paxos", "corrupt"): "9ca86b00e7246200",
-    ("paxos", "stale"): "2bfb7ddd9a9f5d8f",
-    ("paxos", "telemetry"): "9d5b41ec09f7eab4",
-    ("multipaxos", "default"): "e04bc854b35b2523",
-    ("multipaxos", "gray-chaos"): "e04bc854b35b2523",
-    ("multipaxos", "corrupt"): "e04bc854b35b2523",
-    ("multipaxos", "stale"): "7718aed26d17215b",
-    ("multipaxos", "telemetry"): "c566b8202d265ce7",
-    ("fastpaxos", "default"): "fb315f08a32a08bf",
-    ("fastpaxos", "gray-chaos"): "fb315f08a32a08bf",
-    ("fastpaxos", "corrupt"): "fb315f08a32a08bf",
-    ("fastpaxos", "stale"): "b95ad0ab7eb44998",
-    ("fastpaxos", "telemetry"): "d3013fac26dae0b3",
-    ("raftcore", "default"): "0620776d1e658d16",
-    ("raftcore", "gray-chaos"): "0620776d1e658d16",
-    ("raftcore", "corrupt"): "0620776d1e658d16",
-    ("raftcore", "stale"): "8cb260a60823125a",
-    ("raftcore", "telemetry"): "195f5cdf656377b4",
+    ("paxos", "default"): "916958cadb681ab7",
+    ("paxos", "gray-chaos"): "916958cadb681ab7",
+    ("paxos", "corrupt"): "916958cadb681ab7",
+    ("paxos", "stale"): "56711751dcba9742",
+    ("paxos", "telemetry"): "6beba8310b32bf0f",
+    ("paxos", "coverage"): "d9e7d891bf74493f",
+    ("multipaxos", "default"): "b2fd8e0ca28fd319",
+    ("multipaxos", "gray-chaos"): "b2fd8e0ca28fd319",
+    ("multipaxos", "corrupt"): "b2fd8e0ca28fd319",
+    ("multipaxos", "stale"): "2356e11dbf05410a",
+    ("multipaxos", "telemetry"): "e034820120b6d7ed",
+    ("multipaxos", "coverage"): "60556bc6865780b6",
+    ("fastpaxos", "default"): "80ee53207a000d5a",
+    ("fastpaxos", "gray-chaos"): "80ee53207a000d5a",
+    ("fastpaxos", "corrupt"): "80ee53207a000d5a",
+    ("fastpaxos", "stale"): "f53d895607b39026",
+    ("fastpaxos", "telemetry"): "2e789e30c9714820",
+    ("fastpaxos", "coverage"): "55d6af8fe777f926",
+    ("raftcore", "default"): "1e175bcf3e654edb",
+    ("raftcore", "gray-chaos"): "1e175bcf3e654edb",
+    ("raftcore", "corrupt"): "1e175bcf3e654edb",
+    ("raftcore", "stale"): "d51526ee84290f1f",
+    ("raftcore", "telemetry"): "4695c488a2cb0d7c",
+    ("raftcore", "coverage"): "5eb1ed49ed6a76ae",
 }
 
 # (protocol, config_name) -> SimConfig.fingerprint() of the audit config
@@ -44,21 +56,25 @@ CONFIG_GOLDENS: dict = {
     ("paxos", "corrupt"): "1b476cdd907b5933",
     ("paxos", "stale"): "dd2e59a672568867",
     ("paxos", "telemetry"): "45769fa2f93945e0",
+    ("paxos", "coverage"): "1688a7b588e353ce",
     ("multipaxos", "default"): "c43e601ef68a237f",
     ("multipaxos", "gray-chaos"): "ef22269046287409",
     ("multipaxos", "corrupt"): "8175e48831a73e89",
     ("multipaxos", "stale"): "f68540b11905991c",
     ("multipaxos", "telemetry"): "4ea3f797b32bc566",
+    ("multipaxos", "coverage"): "acdbcb7fcb033a3b",
     ("fastpaxos", "default"): "cb51e3867a43b91b",
     ("fastpaxos", "gray-chaos"): "d311d7e3d86192e7",
     ("fastpaxos", "corrupt"): "72485f432fb7393a",
     ("fastpaxos", "stale"): "0bc8e8e18a940735",
     ("fastpaxos", "telemetry"): "298edfbc20970277",
+    ("fastpaxos", "coverage"): "4cf16c0d9ad6ccc6",
     ("raftcore", "default"): "ff49ab17defc9057",
     ("raftcore", "gray-chaos"): "1755349e01c9d063",
     ("raftcore", "corrupt"): "040a2cdb1838612f",
     ("raftcore", "stale"): "291ba0bd46e6cd30",
     ("raftcore", "telemetry"): "d0b50c940de6b66a",
+    ("raftcore", "coverage"): "b2628ea1f5ad5604",
 }
 
 # protocol -> {"version": layout version string, "fields": canonical per-field

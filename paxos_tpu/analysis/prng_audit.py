@@ -246,32 +246,56 @@ def audit_plan_folds(protocol: str, config_name: str, closed, cfg) -> list:
     return findings
 
 
-def audit_telemetry_parity(
-    protocol: str, default_xla, telem_xla, default_ctr, telem_ctr
+def _audit_observer_parity(
+    protocol: str, check: str, feature: str,
+    default_xla, feat_xla, default_ctr, feat_ctr,
 ) -> list:
-    """Telemetry must consume no randomness: identical PRNG signatures."""
+    """A pure observer (telemetry, coverage) must consume no randomness:
+    its feature-on traces carry identical PRNG signatures to default."""
     findings = []
     sig_d = jt.prng_signature(default_xla.jaxpr)
-    sig_t = jt.prng_signature(telem_xla.jaxpr)
+    sig_t = jt.prng_signature(feat_xla.jaxpr)
     if sig_d != sig_t:
         delta = (sig_t - sig_d) + (sig_d - sig_t)
         findings.append(Finding(
-            check="telemetry-parity", where=f"{protocol} xla step",
+            check=check, where=f"{protocol} xla step",
             message=(
-                f"telemetry-on xla trace for {protocol} changes the PRNG "
-                f"eqn multiset (diff: {dict(delta)}): telemetry must draw "
+                f"{feature}-on xla trace for {protocol} changes the PRNG "
+                f"eqn multiset (diff: {dict(delta)}): {feature} must draw "
                 f"no randomness"
             ),
         ))
     str_d = jt.counter_salt_streams(default_ctr.jaxpr)
-    str_t = jt.counter_salt_streams(telem_ctr.jaxpr)
+    str_t = jt.counter_salt_streams(feat_ctr.jaxpr)
     if str_d != str_t:
         delta = (str_t - str_d) + (str_d - str_t)
         findings.append(Finding(
-            check="telemetry-parity", where=f"{protocol} fused tick",
+            check=check, where=f"{protocol} fused tick",
             message=(
-                f"telemetry-on fused trace for {protocol} changes the "
+                f"{feature}-on fused trace for {protocol} changes the "
                 f"counter-stream multiset (diff: {dict(delta)})"
             ),
         ))
     return findings
+
+
+def audit_telemetry_parity(
+    protocol: str, default_xla, telem_xla, default_ctr, telem_ctr
+) -> list:
+    """Telemetry must consume no randomness: identical PRNG signatures."""
+    return _audit_observer_parity(
+        protocol, "telemetry-parity", "telemetry",
+        default_xla, telem_xla, default_ctr, telem_ctr,
+    )
+
+
+def audit_coverage_parity(
+    protocol: str, default_xla, cov_xla, default_ctr, cov_ctr
+) -> list:
+    """The coverage sketch must consume no randomness — and its digest
+    constants use no add-literals, so ``counter_salt_streams`` cannot
+    mistake a hash fold for a new PRNG stream (obs.coverage docstring)."""
+    return _audit_observer_parity(
+        protocol, "coverage-parity", "coverage",
+        default_xla, cov_xla, default_ctr, cov_ctr,
+    )

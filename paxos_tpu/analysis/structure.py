@@ -41,6 +41,11 @@ _KNOB_LEAVES = (
         lambda cfg: cfg.fault.stale_k > 0,
         "stale_k == 0",
     ),
+    (
+        lambda name: name == "coverage",
+        lambda cfg: cfg.coverage.enabled(),
+        "coverage disabled",
+    ),
 )
 
 _PLAN_GRAY_FIELDS = ("part_dir", "link_drop", "link_dup", "ptimeout", "pboff")

@@ -61,12 +61,21 @@ def _telemetry(protocol: str) -> SimConfig:
     )
 
 
+def _coverage(protocol: str) -> SimConfig:
+    from paxos_tpu.obs.coverage import CoverageConfig
+
+    return dataclasses.replace(
+        _default(protocol), coverage=CoverageConfig(words=8)
+    )
+
+
 CONFIG_MATRIX: dict[str, Callable[[str], SimConfig]] = {
     "default": _default,
     "gray-chaos": _gray,
     "corrupt": _corrupt,
     "stale": _stale,
     "telemetry": _telemetry,
+    "coverage": _coverage,
 }
 
 

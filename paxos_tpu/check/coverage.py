@@ -571,3 +571,106 @@ def coverage_probe(
         "ticks": ticks,
         "seeds": seeds,
     } | chao
+
+
+def sketch_crosscheck(
+    n_inst: int = 512,
+    ticks: int = 32,
+    seeds: int = 2,
+    seed0: int = 0,
+    # Calibration wants m comfortably above k*n (probe-bounds campaigns
+    # visit ~1e4 distinct raw states): an over-full sketch saturates and
+    # honestly reports est_states=None, which is a finding about the
+    # sketch SIZE, not the estimator.  2048 words = 64 Ki bits.
+    words: int = 2048,
+    probe_cfg_kw: Optional[dict] = None,
+    log=None,
+) -> dict[str, Any]:
+    """Calibrate the on-device Bloom sketch against exact digest counts.
+
+    Runs probe-bounds campaigns with the coverage plane ON and, in
+    lockstep, collects the EXACT set of per-lane post-tick digests
+    host-side (the same ``obs.coverage.lane_digest`` the in-tick observe
+    folds into the sketch).  Three claims come back as report fields:
+
+    - ``union_matches_host_mirror``: the device union bitmap equals the
+      pure-Python mirror rebuilt from the exact digest set — the sketch
+      IS the Bloom filter of the digests, bit for bit, not merely an
+      approximation of one;
+    - ``estimate_within_bound``: ``bloom_estimate`` of the union fill
+      recovers the exact distinct-digest count within ``bloom_bound``
+      (z=4) — the calibration the sketch's state-count gauge rests on;
+    - the raw counts, so COVERAGE.json records the measurement.
+
+    Scale note: the exact oracle here is the distinct-DIGEST count, i.e.
+    distinct raw post-tick lane states up to 32-bit digest collisions.
+    ``coverage_probe``'s ``visited`` counts CANONICAL model states (raw
+    rows that project equal are merged), so the two are cross-referenced,
+    not equal; the CLI's ``--exact`` mode records both side by side.
+    """
+    import dataclasses as _dc
+
+    import jax
+
+    from paxos_tpu.harness.run import (
+        base_key, get_step_fn, init_plan, init_state, run_chunk,
+    )
+    from paxos_tpu.obs.coverage import (
+        K_HASHES,
+        CoverageConfig,
+        bloom_bound,
+        bloom_estimate,
+        coverage_report,
+        digest_tree,
+        host_sketch_positions,
+        lane_digest,
+    )
+
+    say = log or (lambda s: None)
+    step = get_step_fn("paxos")
+    m = 32 * words
+    exact_digests: set = set()
+    union = 0  # OR of per-campaign union bitmaps (Python big-int)
+    for s_idx in range(seeds):
+        kw = probe_cfg_kw
+        if kw is None:
+            kw = PORTFOLIO[s_idx % len(PORTFOLIO)]
+        cfg = _dc.replace(
+            probe_config(n_inst, seed0 + s_idx, **kw),
+            coverage=CoverageConfig(words=words),
+        )
+        state = init_state(cfg)
+        plan = init_plan(cfg)
+        key = base_key(cfg)
+        for _ in range(ticks):
+            # 1-tick chunks so every post-tick state the sketch observed
+            # is also observed exactly, host-side.
+            state = run_chunk(state, key, plan, cfg.fault, 1, step)
+            dig = np.asarray(jax.device_get(lane_digest(digest_tree(state))))
+            exact_digests.update(int(v) & 0xFFFFFFFF for v in dig)
+        rep = coverage_report(state.coverage)
+        union |= int(rep["union_hex"], 16)
+        say(f"seed {cfg.seed}: |digests|={len(exact_digests)}, "
+            f"union bits={bin(union).count('1')}")
+    mirror = 0
+    for pos in host_sketch_positions(exact_digests, words):
+        mirror |= 1 << pos
+    bits_set = bin(union).count("1")
+    n = len(exact_digests)
+    est = bloom_estimate(m, K_HASHES, bits_set)
+    bound = bloom_bound(m, K_HASHES, n)
+    return {
+        "metric": "sketch-crosscheck",
+        "words": words,
+        "bits_total": m,
+        "hashes": K_HASHES,
+        "exact_digests": n,
+        "sketch_bits_set": bits_set,
+        "sketch_est_states": None if est is None else round(est, 1),
+        "bloom_bound": round(bound, 1),
+        "estimate_within_bound": est is not None and abs(est - n) <= bound,
+        "union_matches_host_mirror": union == mirror,
+        "n_inst": n_inst,
+        "ticks": ticks,
+        "seeds": seeds,
+    }

@@ -32,6 +32,7 @@ from paxos_tpu.core.ballot import make_ballot
 from paxos_tpu.core.messages import ACCEPT, MsgBuf
 from paxos_tpu.core.state import AcceptorState, LearnerState
 from paxos_tpu.core.telemetry import TelemetryState
+from paxos_tpu.obs.coverage import CoverageState
 
 # Proposer phases (P1/P2/DONE match core.state so summarize() is shared).
 P1 = 0  # classic recovery: prepare sent, collecting promises
@@ -93,6 +94,8 @@ class FastPaxosState:
     tick: jnp.ndarray  # () int32
     # Flight recorder / telemetry (core.telemetry): None when disabled.
     telemetry: Optional[TelemetryState] = None
+    # Coverage sketch (obs.coverage): None when disabled, same contract.
+    coverage: Optional[CoverageState] = None
 
     @classmethod
     def init(

@@ -30,6 +30,7 @@ from flax import struct
 from paxos_tpu.core.ballot import make_ballot
 from paxos_tpu.core.messages import MsgBuf
 from paxos_tpu.core.telemetry import TelemetryState
+from paxos_tpu.obs.coverage import CoverageState
 
 # Proposer phases
 FOLLOW = 0  # passive: watching progress, lease ticking
@@ -221,6 +222,8 @@ class MultiPaxosState:
     base: jnp.ndarray
     # Flight recorder / telemetry (core.telemetry): None when disabled.
     telemetry: Optional[TelemetryState] = None
+    # Coverage sketch (obs.coverage): None when disabled, same contract.
+    coverage: Optional[CoverageState] = None
 
     @classmethod
     def init(

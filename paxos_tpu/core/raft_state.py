@@ -27,6 +27,7 @@ from paxos_tpu.core.ballot import make_ballot
 from paxos_tpu.core.messages import MsgBuf
 from paxos_tpu.core.state import LearnerState
 from paxos_tpu.core.telemetry import TelemetryState
+from paxos_tpu.obs.coverage import CoverageState
 
 # Candidate phases (values match core.state.P1/P2/DONE so summarize() and
 # liveness stats are shared across protocols).
@@ -122,6 +123,8 @@ class RaftState:
     tick: jnp.ndarray  # () int32
     # Flight recorder / telemetry (core.telemetry): None when disabled.
     telemetry: Optional[TelemetryState] = None
+    # Coverage sketch (obs.coverage): None when disabled, same contract.
+    coverage: Optional[CoverageState] = None
 
     @classmethod
     def init(

@@ -29,6 +29,7 @@ from flax import struct
 from paxos_tpu.core.ballot import make_ballot
 from paxos_tpu.core.messages import MsgBuf
 from paxos_tpu.core.telemetry import TelemetryState
+from paxos_tpu.obs.coverage import CoverageState
 
 # Proposer phases
 P1 = 0  # prepare sent, collecting promises
@@ -151,6 +152,8 @@ class PaxosState:
     # pruned from the pytree, so default states keep the pre-telemetry
     # structure (same contract as the snap_* gray fields above).
     telemetry: Optional[TelemetryState] = None
+    # Coverage sketch (obs.coverage): None when disabled, same contract.
+    coverage: Optional[CoverageState] = None
 
     @classmethod
     def init(

@@ -126,15 +126,18 @@ def restore(
         )
     saved_stream = raw.pop("stream", None)
     fault = raw.pop("fault")
-    # Tolerate pre-telemetry snapshots (no "telemetry" key): default off.
+    # Tolerate pre-telemetry / pre-coverage snapshots (no key): default off.
     tel = raw.pop("telemetry", None)
+    cov = raw.pop("coverage", None)
     from paxos_tpu.core.telemetry import TelemetryConfig
     from paxos_tpu.faults.injector import FaultConfig
+    from paxos_tpu.obs.coverage import CoverageConfig
 
     cfg = SimConfig(
         **raw,
         fault=FaultConfig(**fault),
         telemetry=TelemetryConfig(**tel) if tel else TelemetryConfig(),
+        coverage=CoverageConfig(**cov) if cov else CoverageConfig(),
     )
 
     if engine is not None:

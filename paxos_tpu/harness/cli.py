@@ -144,6 +144,17 @@ def build_parser() -> argparse.ArgumentParser:
         "checkpoint writes) as a Chrome/Perfetto trace to PATH; for the "
         "unified device+host view use the `trace` subcommand",
     )
+    r.add_argument(
+        "--coverage", action="store_true",
+        help="on-device coverage sketch: hash every lane's post-tick state "
+        "into a per-lane Bloom bitmap (obs.coverage; default off — off is "
+        "free and schedule-identical)",
+    )
+    r.add_argument(
+        "--coverage-words", type=int, default=64, metavar="W",
+        help="sketch size in int32 words per lane (m = 32*W Bloom bits; "
+        "power of two; only read with --coverage)",
+    )
 
     s = sub.add_parser(
         "sweep",
@@ -189,6 +200,32 @@ def build_parser() -> argparse.ArgumentParser:
         "--span-trace", default=None, metavar="PATH",
         help="write the campaign loop's wall-clock spans (per-seed dispatch "
         "and finalize, retry backoffs) as a Chrome/Perfetto trace to PATH",
+    )
+    so.add_argument(
+        "--coverage", action="store_true",
+        help="on-device coverage sketch per campaign, merged across seeds "
+        "(Bloom unions OR): the report gains the cross-seed coverage "
+        "curve and a plateau flag (obs.coverage)",
+    )
+    so.add_argument(
+        "--coverage-words", type=int, default=64, metavar="W",
+        help="sketch size in int32 words per lane (only read with "
+        "--coverage)",
+    )
+    so.add_argument(
+        "--plateau-seeds", type=int, default=3, metavar="K",
+        help="flag a coverage plateau after K consecutive seeds each "
+        "contribute fewer than --plateau-min-new new union bits",
+    )
+    so.add_argument(
+        "--plateau-min-new", type=int, default=1, metavar="B",
+        help="new-union-bits threshold a seed must reach to reset the "
+        "plateau counter",
+    )
+    so.add_argument(
+        "--plateau-stop", action="store_true",
+        help="end the soak at the plateau instead of only reporting it "
+        "(the tally keeps every finalized seed)",
     )
 
     k = sub.add_parser(
@@ -268,6 +305,17 @@ def build_parser() -> argparse.ArgumentParser:
         "(one span per line; the programmatic-diff format)",
     )
     tr.add_argument("--log", default=None, help="JSONL metrics path")
+    tr.add_argument(
+        "--coverage", action="store_true",
+        help="also sample the coverage sketch at every chunk boundary and "
+        "draw it as a Perfetto counter track (obs.coverage; forces the "
+        "serial per-chunk loop)",
+    )
+    tr.add_argument(
+        "--coverage-words", type=int, default=64, metavar="W",
+        help="sketch size in int32 words per lane (only read with "
+        "--coverage)",
+    )
 
     st = sub.add_parser(
         "stats",
@@ -374,8 +422,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     a.add_argument(
         "--config", action="append", dest="configs", metavar="NAME",
-        choices=["default", "gray-chaos", "corrupt", "stale", "telemetry"],
-        help="restrict to one audit config (repeatable; default: all five)",
+        choices=["default", "gray-chaos", "corrupt", "stale", "telemetry",
+                 "coverage"],
+        help="restrict to one audit config (repeatable; default: all six)",
     )
     a.add_argument(
         "--structure", action="store_true",
@@ -395,6 +444,67 @@ def build_parser() -> argparse.ArgumentParser:
         help="print a fresh goldens table (paste into analysis/goldens.py "
         "after an intentional structure change) instead of auditing",
     )
+
+    cv = sub.add_parser(
+        "coverage",
+        help="coverage plane: run a campaign with the on-device Bloom "
+        "sketch and print the coverage curve; --exact instead runs the "
+        "exhaustive probe (check/coverage) plus the sketch-vs-exact "
+        "calibration cross-check",
+    )
+    cv.add_argument(
+        "--exact", action="store_true",
+        help="exact probe mode (CPU): enumerate the bounded schedule "
+        "space, measure fuzz occupancy, and cross-check the sketch "
+        "estimator against the exact visited set",
+    )
+    # Sketch-campaign mode knobs (any config, any scale).
+    cv.add_argument("--config", choices=sorted(CONFIGS), default="config2")
+    cv.add_argument("--engine", choices=["xla", "fused"], default="xla")
+    cv.add_argument("--n-inst", type=int, default=None,
+                    help="instance count (default: config default; "
+                    "--exact default 4096)")
+    cv.add_argument(
+        "--fault", action="append", default=[], metavar="KEY=VALUE",
+        help="override any FaultConfig knob by name (repeatable)",
+    )
+    cv.add_argument("--seed", type=int, default=0)
+    cv.add_argument("--ticks", type=int, default=None,
+                    help="total ticks (default 256; --exact default 48)")
+    cv.add_argument("--chunk", type=int, default=64)
+    cv.add_argument(
+        "--words", type=int, default=64, metavar="W",
+        help="sketch size in int32 words per lane (m = 32*W Bloom bits; "
+        "power of two)",
+    )
+    cv.add_argument("--log", default=None, help="JSONL metrics path")
+    # Exact-probe mode knobs (scripts/coverage_probe.py, folded in).
+    cv.add_argument("--n-prop", type=int, default=2)
+    cv.add_argument("--n-acc", type=int, default=3)
+    cv.add_argument(
+        "--max-round", type=int, nargs="+", default=[1, 0],
+        help="--exact: retry bounds (one per proposer, or one for all)",
+    )
+    cv.add_argument("--seeds", type=int, default=12,
+                    help="--exact: probe campaigns to rotate through")
+    cv.add_argument("--seed0", type=int, default=0)
+    cv.add_argument("--max-states", type=int, default=50_000_000)
+    cv.add_argument("--record", default=None, metavar="PATH",
+                    help="--exact: also write the report JSON to PATH")
+    cv.add_argument(
+        "--analyze-residue", action="store_true",
+        help="--exact: append residue_analysis (what the UNREACHED states "
+        "share) to the report",
+    )
+    cv.add_argument(
+        "--profile", type=int, default=None,
+        help="--exact: pin ONE portfolio profile index for every seed "
+        "(default: rotate the full portfolio)",
+    )
+    cv.add_argument(
+        "--no-crosscheck", action="store_true",
+        help="--exact: skip the sketch-vs-exact calibration pass",
+    )
     return p
 
 
@@ -409,6 +519,19 @@ def _telemetry_from_args(args: argparse.Namespace):
     return TelemetryConfig(
         counters=True, ring_depth=args.record, hist_bins=args.hist_bins
     )
+
+
+def _coverage_from_args(args: argparse.Namespace, words_attr: str = "coverage_words"):
+    """The --coverage knobs as a CoverageConfig (or None when off)."""
+    if not getattr(args, "coverage", False):
+        return None
+    from paxos_tpu.obs.coverage import CoverageConfig
+
+    try:
+        return CoverageConfig(words=getattr(args, words_attr))
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        raise SystemExit(1)
 
 
 def cmd_run(args: argparse.Namespace) -> int:
@@ -480,6 +603,7 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
         depth = 1
 
     tel_cfg = _telemetry_from_args(args)
+    cov_cfg = _coverage_from_args(args)
     registry = MetricsRegistry()
     registry.gauge("pipeline_depth_effective", depth)
     # Host span recorder (--span-trace): the CLI owns the wall clock and
@@ -502,6 +626,11 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                   "combined with --resume (the recorder's arrays are part "
                   "of the checkpointed state structure)", file=sys.stderr)
             return 1
+        if cov_cfg is not None:
+            print("error: --coverage cannot be combined with --resume (the "
+                  "sketch's arrays are part of the checkpointed state "
+                  "structure; same rule as --telemetry)", file=sys.stderr)
+            return 1
         # Stream-lineage guard (VERDICT r4 weak#3): refuse to resume under
         # a different engine/block than the one that wrote the snapshot.
         state, plan, cfg = ckpt.restore(
@@ -520,6 +649,8 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
             return 1
         if tel_cfg is not None:
             cfg = dataclasses.replace(cfg, telemetry=tel_cfg)
+        if cov_cfg is not None:
+            cfg = dataclasses.replace(cfg, coverage=cov_cfg)
         state, plan = init_state(cfg), init_plan(cfg)
 
     if args.shard:
@@ -598,6 +729,8 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                 log.emit("chunk", **rep)
                 if "telemetry" in rep:
                     registry.ingest(rep["telemetry"])
+                if "coverage" in rep:
+                    registry.ingest_coverage(rep["coverage"])
                 if args.events:
                     # Registry-routed (and into the JSONL stream), with the
                     # historical stderr line kept for eyeball debugging.
@@ -630,6 +763,8 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
         log.emit("checkpoint", path=args.checkpoint_dir, tick=int(state.tick))
     if "telemetry" in report:
         registry.ingest(report["telemetry"])
+    if "coverage" in report:
+        registry.ingest_coverage(report["coverage"])
     if recorder is not None:
         from paxos_tpu.obs.export import write_chrome_trace
 
@@ -713,6 +848,11 @@ def cmd_soak(args: argparse.Namespace) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    cov_cfg = _coverage_from_args(args)
+    if cov_cfg is not None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, coverage=cov_cfg)
     band = args.min_replication
     if band is None:
         rec = config_mod.REPLICATION_RATES.get(args.config)
@@ -764,8 +904,22 @@ def cmd_soak(args: argparse.Namespace) -> int:
             min_slots_per_lane_tick=band or None,
             pipeline_depth=depth,
             spans=recorder,
+            plateau_seeds=args.plateau_seeds,
+            plateau_min_new=args.plateau_min_new,
+            plateau_stop=args.plateau_stop,
         )
         report["config"] = args.config
+        if "coverage" in report:
+            # Cross-seed coverage as gauges, so `stats --prometheus` over
+            # this JSONL stream exposes the curve's endpoint and plateau.
+            from paxos_tpu.harness.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+            registry.ingest_coverage(report["coverage"])
+            registry.gauge(
+                "coverage_plateau", float(report["coverage"]["plateau"])
+            )
+            mlog.emit("metrics", **registry.snapshot())
         if recorder is not None:
             from paxos_tpu.obs.export import write_chrome_trace
 
@@ -864,6 +1018,7 @@ def cmd_stats(args: argparse.Namespace) -> int:
     final = None
     last_tel = None
     last_agg = None
+    last_cov = None
     for rec in records:
         kind = rec.get("event", "?")
         kinds[kind] = kinds.get(kind, 0) + 1
@@ -872,6 +1027,11 @@ def cmd_stats(args: argparse.Namespace) -> int:
         # total, whether it rode a chunk record or the final one.
         if isinstance(rec.get("telemetry"), dict):
             last_tel = rec["telemetry"]
+        # Same for the coverage sketch: the union only grows, so the last
+        # report carries the campaign's (or soak's cross-seed) coverage.
+        cov = rec.get("coverage")
+        if isinstance(cov, dict) and "bits_set" in cov:
+            last_cov = cov
         # Span-trace aggregates (`trace` subcommand) are whole-campaign
         # summaries; the last record wins for the same reason.
         if kind == "spans" and isinstance(rec.get("aggregates"), dict):
@@ -880,6 +1040,10 @@ def cmd_stats(args: argparse.Namespace) -> int:
             final = rec
     if last_tel is not None:
         registry.ingest(last_tel)
+    if last_cov is not None:
+        registry.ingest_coverage(last_cov)
+        if "plateau" in last_cov:
+            registry.gauge("coverage_plateau", float(last_cov["plateau"]))
     if last_agg is not None:
         registry.ingest_span_aggregates(last_agg)
 
@@ -915,6 +1079,8 @@ def cmd_stats(args: argparse.Namespace) -> int:
             # Recompute (rather than trust the record) so logs written
             # before the overflow flag existed still get the verdict.
             out["hist_saturation"] = hist_saturation(last_tel["hist"])
+    if last_cov is not None:
+        out["coverage"] = last_cov
     if last_agg is not None:
         out["span_aggregates"] = last_agg
     print(json.dumps(out))
@@ -1168,12 +1334,14 @@ def cmd_trace(args: argparse.Namespace) -> int:
         cap = capture_round_trace(
             cfg, ticks=args.ticks, chunk=args.chunk, engine=args.engine,
             depth=depth, max_lanes=args.lanes, recorder=recorder,
+            coverage=_coverage_from_args(args),
         )
         write_chrome_trace(
             args.out, cap.spans, host=recorder,
             meta={"config": args.config, "engine": args.engine,
                   "seed": args.seed, "ticks": args.ticks,
                   "fingerprint": cfg.fingerprint()},
+            counters=cap.counters,
         )
         if args.spans_out:
             with open(args.spans_out, "w") as fh:
@@ -1184,6 +1352,8 @@ def cmd_trace(args: argparse.Namespace) -> int:
         log.emit("report", **cap.report)
         if "telemetry" in cap.report:
             registry.ingest(cap.report["telemetry"])
+        if "coverage" in cap.report:
+            registry.ingest_coverage(cap.report["coverage"])
         registry.ingest_span_aggregates(cap.aggregates)
         log.emit("spans", lanes=cap.lanes, aggregates=cap.aggregates)
         log.emit("metrics", **registry.snapshot())
@@ -1201,6 +1371,150 @@ def cmd_trace(args: argparse.Namespace) -> int:
             summary["spans_jsonl"] = args.spans_out
         log.emit("final", **summary)
     print(json.dumps(summary))
+    return 0
+
+
+def cmd_coverage(args: argparse.Namespace) -> int:
+    """Coverage plane: sketch campaign (default) or exact probe (--exact)."""
+    if args.exact:
+        return _cmd_coverage_exact(args)
+    import dataclasses
+
+    import jax
+
+    from paxos_tpu.harness.metrics import MetricsLog, MetricsRegistry
+    from paxos_tpu.harness.run import (
+        init_plan, init_state, make_advance, make_longlog, summarize,
+    )
+    from paxos_tpu.obs.coverage import CoverageConfig
+
+    if args.engine == "fused" and jax.devices()[0].platform != "tpu":
+        print("error: --engine fused compiles Mosaic kernels (TPU only); "
+              "use --engine xla", file=sys.stderr)
+        return 1
+    kw = {"seed": args.seed}
+    if args.n_inst:
+        kw["n_inst"] = args.n_inst
+    cfg = CONFIGS[args.config](**kw)
+    try:
+        cfg = config_mod.apply_fault_overrides(cfg, args.fault)
+        cov_cfg = CoverageConfig(words=args.words)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    cfg = dataclasses.replace(cfg, coverage=cov_cfg)
+    ticks = 256 if args.ticks is None else args.ticks
+
+    registry = MetricsRegistry()
+    with MetricsLog(args.log) as log:
+        log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
+                 n_inst=cfg.n_inst, protocol=cfg.protocol,
+                 engine=args.engine, coverage_words=args.words)
+        state, plan = init_state(cfg), init_plan(cfg)
+        advance = make_advance(
+            cfg, plan, args.engine, compact=bool(make_longlog(cfg))
+        )
+        # Serial per-chunk loop: the per-chunk summarize IS the coverage
+        # curve sampler (the sketch reduces at the summarize boundary).
+        curve: list = []
+        done = 0
+        prev_bits = 0
+        while done < ticks:
+            n = min(args.chunk, ticks - done)
+            state = advance(state, n)
+            done += n
+            rep = summarize(state, log_total=cfg.fault.log_total)
+            cov = rep["coverage"]
+            registry.ingest_coverage(cov)
+            curve.append({
+                "tick": done,
+                "bits_set": cov["bits_set"],
+                "new_bits": cov["bits_set"] - prev_bits,
+                "est_states": cov["est_states"],
+            })
+            prev_bits = cov["bits_set"]
+            log.emit("chunk", ticks=done, coverage=cov)
+        final = summarize(state, log_total=cfg.fault.log_total)
+        out = {
+            "metric": "coverage",
+            "config": args.config,
+            "engine": args.engine,
+            "n_inst": cfg.n_inst,
+            "ticks": ticks,
+            "chunk": args.chunk,
+            "violations": final["violations"],
+            "coverage": final["coverage"],
+            "curve": curve,
+            "config_fingerprint": cfg.fingerprint(),
+        }
+        log.emit("metrics", **registry.snapshot())
+        log.emit("final", **out)
+    print(json.dumps(out))
+    return 0 if final["violations"] == 0 else 2
+
+
+def _cmd_coverage_exact(args: argparse.Namespace) -> int:
+    """Exact probe + sketch calibration (scripts/coverage_probe.py, folded
+    into the CLI; the script remains as a thin wrapper)."""
+    import jax
+
+    # The probe is a CPU tool regardless of --platform.
+    jax.config.update("jax_platforms", "cpu")
+
+    from paxos_tpu.check.coverage import (
+        PORTFOLIO, coverage_probe, sketch_crosscheck,
+    )
+
+    if args.profile is not None and not 0 <= args.profile < len(PORTFOLIO):
+        print(f"error: --profile must be in [0, {len(PORTFOLIO) - 1}]",
+              file=sys.stderr)
+        return 1
+    say = lambda s: print(f"# {s}", file=sys.stderr)
+    mr = args.max_round[0] if len(args.max_round) == 1 else tuple(args.max_round)
+    n_inst = args.n_inst or 4096
+    ticks = 48 if args.ticks is None else args.ticks
+    probe_cfg_kw = None if args.profile is None else PORTFOLIO[args.profile]
+    out = coverage_probe(
+        n_prop=args.n_prop,
+        n_acc=args.n_acc,
+        max_round=mr,
+        n_inst=n_inst,
+        ticks=ticks,
+        seeds=args.seeds,
+        seed0=args.seed0,
+        max_states=args.max_states,
+        log=say,
+        probe_cfg_kw=probe_cfg_kw,
+        analyze_residue=args.analyze_residue,
+    )
+    if not args.no_crosscheck:
+        # Calibrate the on-device sketch at the same bounds/adversaries
+        # (smaller campaigns: the crosscheck re-reads every tick's digests
+        # host-side, so probe-scale lanes would dominate the runtime).
+        out["sketch_crosscheck"] = sketch_crosscheck(
+            n_inst=min(n_inst, 512),
+            ticks=min(ticks, 32),
+            seeds=min(args.seeds, 2),
+            seed0=args.seed0,
+            probe_cfg_kw=probe_cfg_kw,
+            log=say,
+        )
+    sample = out.pop("out_of_space_sample")
+    print(json.dumps(out))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(out, f, indent=1)
+    if out["out_of_space"]:
+        print(f"# SOUNDNESS FAILURE — sample state: {sample[0]}",
+              file=sys.stderr)
+        return 2
+    cross = out.get("sketch_crosscheck")
+    if cross is not None and not (
+        cross["union_matches_host_mirror"] and cross["estimate_within_bound"]
+    ):
+        print("# SKETCH CALIBRATION FAILURE — see sketch_crosscheck",
+              file=sys.stderr)
+        return 2
     return 0
 
 
@@ -1228,6 +1542,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_trace(args)
     if args.cmd == "audit":
         return cmd_audit(args)
+    if args.cmd == "coverage":
+        return cmd_coverage(args)
     return 1
 
 

@@ -16,6 +16,7 @@ import json
 
 from paxos_tpu.core.telemetry import TelemetryConfig
 from paxos_tpu.faults.injector import FaultConfig
+from paxos_tpu.obs.coverage import CoverageConfig
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,6 +37,12 @@ class SimConfig:
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig
     )
+    # On-device coverage sketch (obs.coverage) — same default-off contract:
+    # the state's coverage leaf prunes to None and digest hashing draws no
+    # PRNG, so schedules are bit-identical (tests/test_coverage.py).
+    coverage: CoverageConfig = dataclasses.field(
+        default_factory=CoverageConfig
+    )
 
     def fingerprint(self) -> str:
         d = dataclasses.asdict(self)
@@ -44,6 +51,10 @@ class SimConfig:
         # checkpoints) from pre-telemetry builds keep matching.
         if d["telemetry"] == dataclasses.asdict(TelemetryConfig()):
             del d["telemetry"]
+        # Coverage is an observer under the same contract: disabled (the
+        # default) drops out so pre-coverage fingerprints keep matching.
+        if d["coverage"] == dataclasses.asdict(CoverageConfig()):
+            del d["coverage"]
         # The packed lane-state layout version (core/*_state.py) is part of
         # the on-device representation: a layout change invalidates every
         # checkpoint recorded under the old bit positions, so it must

@@ -61,6 +61,21 @@ class MetricsLog:
             self._fh = None
 
 
+def _escape_label_value(v: Any) -> str:
+    """Escape a label value per the Prometheus text exposition format.
+
+    Backslash first (so the other escapes' own backslashes survive), then
+    double-quote and newline — an unescaped value containing any of these
+    silently truncates or splits the sample line at scrape time.
+    """
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class MetricsRegistry:
     """Host-side metrics registry: named counters + fixed-bin histograms.
 
@@ -81,6 +96,8 @@ class MetricsRegistry:
         self._hists: dict[str, dict[str, Any]] = {}
         # name -> {labels-tuple -> value}; last-write-wins point-in-time values.
         self._gauges: dict[str, dict[tuple, float]] = {}
+        # bits_set at the previous ingest_coverage (per-chunk delta base).
+        self._cov_prev_bits: Optional[float] = None
 
     def inc(self, name: str, value: float = 1, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
@@ -126,6 +143,26 @@ class MetricsRegistry:
             }
         if "hist_overflow" in report:
             self.gauge("hist_overflow_decides", report["hist_overflow"])
+
+    def ingest_coverage(self, cov: dict[str, Any]) -> None:
+        """Fold one ``obs.coverage.coverage_host`` dict into the registry.
+
+        Coverage counts are cumulative (the union sketch only grows), so
+        they land as gauges; ``coverage_new_per_chunk`` is the delta of
+        ``bits_set`` since the previous ingest — the live coverage-curve
+        slope a scraper alerts on when exploration plateaus.
+        """
+        bits = cov["bits_set"]
+        prev = self._cov_prev_bits
+        self._cov_prev_bits = bits
+        self.gauge("coverage_bits_set", bits)
+        self.gauge("coverage_bits_total", cov["bits_total"])
+        self.gauge("coverage_saturation", cov["saturation"])
+        self.gauge(
+            "coverage_new_per_chunk", bits - prev if prev is not None else bits
+        )
+        if cov.get("est_states") is not None:
+            self.gauge("coverage_est_states", cov["est_states"])
 
     def ingest_span_aggregates(self, agg: dict[str, Any]) -> None:
         """Fold ``obs.spans.span_aggregates`` output into gauges.
@@ -180,13 +217,17 @@ class MetricsRegistry:
         for name, series in sorted(self._counters.items()):
             lines.append(f"# TYPE {ns}_{name} counter")
             for key, value in sorted(series.items()):
-                label = ",".join(f'{k}="{v}"' for k, v in key)
+                label = ",".join(
+                    f'{k}="{_escape_label_value(v)}"' for k, v in key
+                )
                 suffix = f"{{{label}}}" if label else ""
                 lines.append(f"{ns}_{name}{suffix} {int(value)}")
         for name, series in sorted(self._gauges.items()):
             lines.append(f"# TYPE {ns}_{name} gauge")
             for key, value in sorted(series.items()):
-                label = ",".join(f'{k}="{v}"' for k, v in key)
+                label = ",".join(
+                    f'{k}="{_escape_label_value(v)}"' for k, v in key
+                )
                 suffix = f"{{{label}}}" if label else ""
                 val = int(value) if float(value).is_integer() else value
                 lines.append(f"{ns}_{name}{suffix} {val}")

@@ -62,6 +62,12 @@ def init_state(cfg: SimConfig):
         state = state.replace(
             telemetry=TelemetryState.init(cfg.n_inst, cfg.telemetry)
         )
+    if cfg.coverage.enabled():
+        from paxos_tpu.obs.coverage import CoverageState
+
+        state = state.replace(
+            coverage=CoverageState.init(cfg.n_inst, cfg.coverage)
+        )
     return state
 
 
@@ -607,6 +613,11 @@ def summarize_device(
         from paxos_tpu.core.telemetry import telemetry_device
 
         dev["telemetry"] = telemetry_device(state.telemetry)
+    if getattr(state, "coverage", None) is not None:
+        from paxos_tpu.obs.coverage import coverage_device
+
+        dev["coverage"] = coverage_device(state.coverage)
+        meta["coverage_words"] = int(state.coverage.bitmap.shape[0])
     if liveness:
         from paxos_tpu.check.liveness import liveness_device
 
@@ -646,6 +657,12 @@ def summarize_host(host: dict, meta: dict) -> dict[str, Any]:
         from paxos_tpu.core.telemetry import telemetry_host
 
         out["telemetry"] = telemetry_host(host["telemetry"])
+    if "coverage" in host:
+        from paxos_tpu.obs.coverage import coverage_host
+
+        out["coverage"] = coverage_host(
+            host["coverage"], meta["coverage_words"]
+        )
     if "liveness" in host:
         from paxos_tpu.check.liveness import liveness_host
 

@@ -94,6 +94,9 @@ def soak(
     min_slots_per_lane_tick: Optional[float] = None,
     pipeline_depth: int = 1,
     spans=None,
+    plateau_seeds: int = 3,
+    plateau_min_new: int = 1,
+    plateau_stop: bool = False,
 ) -> dict[str, Any]:
     """Run campaigns over rotating seeds until ``target_rounds`` accumulate.
 
@@ -162,6 +165,18 @@ def soak(
     ``spans`` (an ``obs.host_spans.HostSpanRecorder``) records wall-clock
     spans for each campaign's dispatch, report drain, recheck replays, and
     retry backoffs — purely observational, never schedule-relevant.
+
+    **Coverage plateau (``cfg.coverage`` enabled):** each campaign's report
+    carries its on-device Bloom sketch union (``obs.coverage``), and the
+    digest is lane-position-free, so ORing the per-seed union bitmaps is
+    the Bloom sketch of the union of all visited state sets across seeds.
+    The soak tally keeps that running cross-seed union, records the
+    new-union-bits each seed contributed (the coverage curve), and flags a
+    plateau after ``plateau_seeds`` consecutive seeds each adding fewer
+    than ``plateau_min_new`` bits — the "more seeds stopped buying new
+    states" signal.  With ``plateau_stop`` the loop ends at the plateau
+    (like the corrupted-measurement path, an in-flight next campaign is
+    discarded unfinalized); by default the plateau is report-only.
     """
     from paxos_tpu.harness.config import validate_pipeline_depth
     from paxos_tpu.obs.host_spans import ensure_recorder
@@ -197,6 +212,16 @@ def soak(
     stuck_max = 0
     lanes_total = 0
     decided_fracs: list[float] = []
+    # Cross-seed coverage union (Python big-int of the OR'd sketch words);
+    # per-seed new-union-bits form the coverage curve.
+    cov_union = 0
+    cov_union_bits = 0
+    cov_curve: list[int] = []
+    cov_per_seed: list[int] = []
+    cov_last: Optional[dict[str, Any]] = None
+    cov_below = 0
+    cov_plateau = False
+    cov_stopped = False
     slots_total = 0
     rep_rates: list[float] = []  # slots replicated per lane-tick, per campaign
     retries_used = 0
@@ -333,6 +358,24 @@ def soak(
         seeds += 1
         say(f"seed {fscfg.seed}: {rounds:.3e} rounds, {violations} violations, "
             f"{report['stuck_lanes']} stuck")
+        cov = report.get("coverage")
+        if cov is not None:
+            cov_last = cov
+            cov_union |= int(cov["union_hex"], 16)
+            new_bits = bin(cov_union).count("1") - cov_union_bits
+            cov_union_bits += new_bits
+            cov_per_seed.append(cov["bits_set"])
+            cov_curve.append(new_bits)
+            cov_below = cov_below + 1 if new_bits < plateau_min_new else 0
+            if cov_below >= plateau_seeds and not cov_plateau:
+                cov_plateau = True
+                say(f"coverage plateau: {cov_below} consecutive seeds under "
+                    f"{plateau_min_new} new bits ({cov_union_bits} total)")
+            if cov_plateau and plateau_stop:
+                # Stop like the corrupted path: keep the tally, drop an
+                # in-flight next campaign unfinalized.
+                cov_stopped = True
+                break
     dt = time.perf_counter() - t0
     replication: dict[str, Any] = {}
     if rep_rates:
@@ -352,6 +395,24 @@ def soak(
         replication["measurement_corrupted"] = corrupted_seed
     if depth > 1:
         replication["pipeline_depth"] = depth
+    if cov_last is not None:
+        from paxos_tpu.obs.coverage import K_HASHES, bloom_estimate
+
+        m = cov_last["bits_total"]
+        # Cross-seed union stats; the per-key shape matches coverage_host
+        # so MetricsRegistry.ingest_coverage folds this block directly.
+        replication["coverage"] = {
+            "bits_set": cov_union_bits,
+            "bits_total": m,
+            "saturation": round(cov_union_bits / max(m, 1), 6),
+            "est_states": bloom_estimate(m, K_HASHES, cov_union_bits),
+            "curve": cov_curve,  # new union bits contributed per seed
+            "per_seed_bits": cov_per_seed,
+            "plateau": cov_plateau,
+            "plateau_seeds": plateau_seeds,
+            "plateau_min_new": plateau_min_new,
+            "stopped_early": cov_stopped,
+        }
     return replication | {
         "metric": "soak",
         "rounds": rounds,

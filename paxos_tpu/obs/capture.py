@@ -40,6 +40,9 @@ class CaptureResult:
     spans: dict[int, list[RoundSpan]]  # lane -> reconstructed rounds
     aggregates: dict[str, Any]  # span_aggregates over every decoded lane
     host: Optional[HostSpanRecorder]  # wall-clock dispatch spans
+    # name -> [(tick, value)] device counter series (Perfetto `ph: C`),
+    # e.g. the per-chunk coverage-bits curve; None when none captured.
+    counters: Optional[dict[str, list]] = None
 
 
 def recorder_config(cfg, ticks: int):
@@ -78,6 +81,7 @@ def capture_round_trace(
     depth: int = 4,
     max_lanes: int = 8,
     recorder: Optional[HostSpanRecorder] = None,
+    coverage=None,
 ) -> CaptureResult:
     """Run ``cfg`` for ``ticks`` with full tracing; decode ``max_lanes`` lanes.
 
@@ -85,12 +89,21 @@ def capture_round_trace(
     host track shows real grouped dispatches; ``depth=1`` degrades to the
     serial per-chunk loop.  The returned spans are per-lane round
     reconstructions (``obs.spans``); aggregates cover every decoded lane.
+
+    ``coverage`` (an ``obs.coverage.CoverageConfig``) additionally samples
+    the union coverage-bits count at every chunk boundary into a counter
+    series for the Perfetto timeline.  Sampling needs the state pytree at
+    each boundary, so the coverage-traced loop is the serial per-chunk
+    dispatcher (the sample itself is a scalar device_get, not a state
+    round-trip); a trace run is a debug tool, so the pipelined host track
+    is the price of the curve.
     """
     from paxos_tpu.core.telemetry import decode_lane
     from paxos_tpu.harness.pipeline import pipelined_run
     from paxos_tpu.harness.run import (
         init_plan,
         init_state,
+        make_advance,
         make_advance_grouped,
         make_longlog,
         summarize,
@@ -98,16 +111,40 @@ def capture_round_trace(
 
     sp = ensure_recorder(recorder)
     tcfg = recorder_config(cfg, ticks)
+    sample_coverage = coverage is not None and coverage.enabled()
+    if sample_coverage:
+        tcfg = dataclasses.replace(tcfg, coverage=coverage)
     with sp.span("init", n_inst=tcfg.n_inst, protocol=tcfg.protocol):
         state = init_state(tcfg)
         plan = init_plan(tcfg)
+    counters: Optional[dict[str, list]] = None
+    if sample_coverage:
+        from paxos_tpu.obs.coverage import coverage_device
+
+        advance = make_advance(
+            tcfg, plan, engine, compact=bool(make_longlog(tcfg))
+        )
+        samples: list = []
+        done = 0
+        while done < ticks:
+            n = min(chunk, ticks - done)
+            with sp.span("dispatch", tick_start=done, ticks=n, groups=1):
+                state = advance(state, n)
+            done += n
+            with sp.span("coverage_sample", tick=done):
+                bits = int(jax.device_get(
+                    coverage_device(state.coverage)["union_bits"]
+                ))
+            samples.append((done, bits))
+        counters = {"coverage_bits_set": samples}
+    else:
         advance = make_advance_grouped(
             tcfg, plan, engine, compact=bool(make_longlog(tcfg))
         )
-    state, _, _ = pipelined_run(
-        state, advance, budget=ticks, chunk=chunk, depth=depth,
-        spans=recorder,
-    )
+        state, _, _ = pipelined_run(
+            state, advance, budget=ticks, chunk=chunk, depth=depth,
+            spans=recorder,
+        )
     with sp.span("summarize"):
         report = summarize(state, log_total=tcfg.fault.log_total)
     with sp.span("violations_readback"):
@@ -125,5 +162,5 @@ def capture_round_trace(
     agg = span_aggregates(s for lane in lanes for s in spans[lane])
     return CaptureResult(
         report=report, lanes=lanes, timelines=timelines, spans=spans,
-        aggregates=agg, host=recorder,
+        aggregates=agg, host=recorder, counters=counters,
     )

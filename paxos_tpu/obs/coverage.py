@@ -1,0 +1,338 @@
+"""Live on-device coverage plane (default OFF, off is free).
+
+Coverage is the canonical observability signal of a fuzzer, but the exact
+probe (``check/coverage.py``) only works at tiny exhaustive bounds on the
+CPU.  This module is the any-scale twin: every lane hashes its post-tick
+protocol state into a per-lane Bloom/bitmap sketch carried on-device
+alongside telemetry, so a campaign reports how much *distinct* state it
+explored — and whether round N explored anything round N-1 didn't — at
+zero host round-trips (the sketch reduces at the existing pipelined
+summarize boundary, ``harness/run.summarize_device``).
+
+The default-off-is-free contract (``core.telemetry`` is the template):
+
+- :class:`CoverageState` rides as an ``Optional`` leaf of every protocol
+  state; ``None`` when disabled (pruned from the pytree), all leaves int32
+  with trailing ``instances`` axis, no scalar leaves — the fused Pallas
+  engine's generic pytree flattening (``utils/bitops`` passthrough words)
+  carries it with ZERO kernel changes, and ``pjit`` shards it with the
+  rest of the state.
+- :func:`observe` is pure int32 arithmetic hashing (splitmix-style
+  finalizers, the ``kernels/counter_prng`` idiom) computed from the state
+  the tick already produced: **no PRNG draws**, so enabling coverage
+  cannot perturb a schedule.  The static auditor holds the module to that
+  (``prng_audit.audit_telemetry_parity`` wired for the "coverage" audit
+  config).  The per-hash mixing deliberately uses only xor/multiply/shift
+  — no scalar add literals — so the auditor's counter-stream recovery
+  (which matches *add*-equation literals against stream salts) can never
+  confuse a digest constant for a PRNG stream.
+- Mosaic-clean: elementwise int32 ops, iota-masked ``where`` instead of
+  scatter, ``lax.population_count`` — the same op diet as telemetry.
+
+Semantics: the digest depends only on the lane's protocol state (never the
+lane index or the tick), so two lanes in the same state set the same bits
+and the cross-lane OR of the per-lane bitmaps is exactly the Bloom filter
+of the UNION of all visited states.  :func:`bloom_estimate` inverts the
+fill fraction into a distinct-state estimate; :func:`bloom_bound` gives
+the matching confidence band, which the calibration tests use to check the
+sketch against the exact ``V`` set from ``check/coverage.py`` at probe
+bounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from paxos_tpu.kernels.counter_prng import i32, shr
+
+# Bloom hash count.  Fixed (not a config knob) because the in-tick update
+# runs inside ``apply_tick``, which only sees the FaultConfig — and k=2 is
+# the standard fill/FP sweet spot for the m/n ratios the default sketch
+# targets.
+K_HASHES = 2
+
+# Per-hash xor salts (distinct odd constants; NOT stream salts — see the
+# module docstring on add-literal avoidance).
+_H_SALTS = (0x2545F491, 0x8B7F1C35)
+
+# Leaf-mix and finalizer multipliers (FNV / splitmix32 family).
+_FNV_PRIME = 0x01000193
+_MIX1 = 0x7FEB352D
+_MIX2 = 0x846CA68B
+
+# State fields that constitute "the lane's protocol state" for the digest.
+# Accounting is excluded on purpose: the learner carries ``chosen_tick``
+# (wall-tick-dependent — equal protocol states at different ticks must hash
+# equally) plus violation/eviction tallies, and telemetry/coverage are
+# observers, not state.  ``base`` (long-log Multi-Paxos window offset) IS
+# state: the same window contents at a different log position is a
+# different point of the run.
+_DIGEST_FIELDS = (
+    "acceptor", "proposer", "requests", "replies", "promises", "accepted",
+    "base",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CoverageConfig:
+    """Static coverage knobs (frozen: rides ``SimConfig`` into jit).
+
+    ``words`` is the per-lane bitmap size in int32 words (m = 32 * words
+    Bloom bits); 0 — the default — disables the plane entirely (the state
+    leaf prunes to ``None``).  Power-of-two words keep the in-kernel bit
+    indexing to shifts and masks (no integer remainder on the Mosaic path).
+    """
+
+    words: int = 0
+
+    def __post_init__(self):
+        if self.words < 0:
+            raise ValueError(f"coverage words must be >= 0, got {self.words}")
+        if self.words and self.words & (self.words - 1):
+            raise ValueError(
+                f"coverage words must be a power of two (bit positions are "
+                f"computed with masks, not remainders), got {self.words}"
+            )
+
+    def enabled(self) -> bool:
+        return self.words > 0
+
+    def bits(self) -> int:
+        return 32 * self.words
+
+
+@struct.dataclass
+class CoverageState:
+    """Per-lane coverage sketch (all int32, instance-minor, no scalars).
+
+    ``bitmap`` is the lane's Bloom filter over its own visited-state
+    digests; ``new_bits`` counts, cumulatively, how many bitmap bits each
+    tick newly set — the on-device coverage-over-time signal whose
+    per-chunk deltas draw the coverage curve.
+    """
+
+    bitmap: jnp.ndarray  # (W, I) int32 Bloom bit words
+    new_bits: jnp.ndarray  # (I,) int32 cumulative newly-set bits
+
+    @classmethod
+    def init(cls, n_inst: int, ccfg: CoverageConfig) -> "CoverageState":
+        return cls(
+            bitmap=jnp.zeros((ccfg.words, n_inst), jnp.int32),
+            new_bits=jnp.zeros((n_inst,), jnp.int32),
+        )
+
+
+def digest_tree(state) -> list:
+    """The sub-pytree of ``state`` the coverage digest hashes.
+
+    Collected by field name so all four protocols share one definition
+    (fields a protocol lacks are skipped); see ``_DIGEST_FIELDS`` for the
+    exclusion rationale.
+    """
+    return [
+        leaf
+        for name in _DIGEST_FIELDS
+        if (leaf := getattr(state, name, None)) is not None
+    ]
+
+
+def lane_digest(tree) -> jnp.ndarray:
+    """(I,) int32 hash of every array leaf's per-lane values.
+
+    FNV-1a-style fold row by row (static leading indices, so the loop
+    unrolls at trace time into elementwise xor/multiply — no reshapes, no
+    gathers), then a splitmix32 finalizer.  Depends only on leaf VALUES:
+    equal lane states produce equal digests regardless of lane or tick.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        raise ValueError("lane_digest needs at least one array leaf")
+    n_inst = leaves[0].shape[-1]
+    h = jnp.full((n_inst,), i32(0x811C9DC5))
+    for leaf in leaves:
+        x = leaf.astype(jnp.int32)
+        for idx in itertools.product(*(range(d) for d in x.shape[:-1])):
+            h = (h ^ x[idx]) * i32(_FNV_PRIME)
+    h = h ^ shr(h, 16)
+    h = h * i32(_MIX1)
+    h = h ^ shr(h, 15)
+    h = h * i32(_MIX2)
+    h = h ^ shr(h, 16)
+    return h
+
+
+def _hash_pos(digest: jnp.ndarray, j: int, m: int) -> jnp.ndarray:
+    """Bloom hash ``j`` of a digest -> bit position in [0, m) (m = 2^p)."""
+    x = digest ^ i32(_H_SALTS[j])
+    x = x * i32(_MIX1)
+    x = x ^ shr(x, 15)
+    x = x * i32(_MIX2)
+    x = x ^ shr(x, 16)
+    return x & jnp.int32(m - 1)
+
+
+def observe(cov: CoverageState, state) -> CoverageState:
+    """Fold the lane's post-tick state into its sketch (pure, PRNG-free).
+
+    Bits are set with an iota-vs-word-index masked ``where`` (no scatter)
+    and the newly-set count comes from one popcount of the xor delta —
+    all Mosaic-clean elementwise int32 work.
+    """
+    digest = lane_digest(digest_tree(state))
+    words = cov.bitmap.shape[0]
+    m = 32 * words
+    rows = jax.lax.broadcasted_iota(jnp.int32, cov.bitmap.shape, 0)
+    bitmap = cov.bitmap
+    for j in range(K_HASHES):
+        pos = _hash_pos(digest, j, m)
+        word_idx = shr(pos, 5)  # pos // 32
+        bit = jnp.left_shift(jnp.int32(1), pos & jnp.int32(31))
+        bitmap = bitmap | jnp.where(rows == word_idx[None], bit[None], 0)
+    newly = jax.lax.population_count(bitmap ^ cov.bitmap).sum(
+        axis=0, dtype=jnp.int32
+    )
+    return cov.replace(bitmap=bitmap, new_bits=cov.new_bits + newly)
+
+
+# ---------------------------------------------------------------------------
+# Bloom math (host side).
+
+
+def bloom_estimate(m: int, k: int, bits_set: int) -> Optional[float]:
+    """Distinct-insert estimate n̂ = -(m/k) ln(1 - X/m); None when saturated.
+
+    The standard fill-fraction inversion: X of m bits set after n distinct
+    k-hash inserts satisfies E[X] = m(1 - e^{-kn/m}).  A saturated sketch
+    (X == m) carries no estimate — report the saturation fraction instead.
+    """
+    if bits_set >= m:
+        return None
+    if bits_set <= 0:
+        return 0.0
+    return -(m / k) * math.log(1.0 - bits_set / m)
+
+
+def bloom_bound(m: int, k: int, n: int, z: float = 4.0) -> float:
+    """Confidence band (±) on :func:`bloom_estimate` after n true inserts.
+
+    The fill count X is approximately binomial with per-bit set probability
+    p = 1 - e^{-kn/m}; propagating std(X) = sqrt(m p (1-p)) through the
+    estimator's derivative dn̂/dX = m/(k(m-X)) gives the band.  ``z`` = 4
+    keeps the calibration tests' false-failure odds negligible; the +2
+    floor absorbs integer rounding at tiny n.
+    """
+    q = math.exp(-k * n / m)
+    std_bits = math.sqrt(m * q * (1.0 - q))
+    return z * std_bits / (k * q) + 2.0
+
+
+# ---------------------------------------------------------------------------
+# Host-side reference (pure-Python ints) — the calibration oracle.
+
+
+def _u32(x: int) -> int:
+    return x & 0xFFFFFFFF
+
+
+def host_finalize(h: int) -> int:
+    h = _u32(h)
+    h ^= h >> 16
+    h = _u32(h * _MIX1)
+    h ^= h >> 15
+    h = _u32(h * _MIX2)
+    h ^= h >> 16
+    return h
+
+
+def host_hash_pos(digest: int, j: int, m: int) -> int:
+    """Pure-Python mirror of :func:`_hash_pos` (same bits, no jax)."""
+    x = _u32(digest) ^ _H_SALTS[j]
+    x = _u32(x * _MIX1)
+    x ^= x >> 15
+    x = _u32(x * _MIX2)
+    x ^= x >> 16
+    return x & (m - 1)
+
+
+def host_sketch_positions(values, words: int) -> set:
+    """Exact union bit-position set after inserting every digest value."""
+    m = 32 * words
+    return {
+        host_hash_pos(int(v), j, m)
+        for v in values
+        for j in range(K_HASHES)
+    }
+
+
+def host_sketch_estimate(values, words: int) -> Optional[float]:
+    """Bloom estimate of ``len(set(values))`` via the exact host sketch."""
+    return bloom_estimate(
+        32 * words, K_HASHES, len(host_sketch_positions(values, words))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Summarize-boundary reductions (harness/run.py merges these into the one
+# composite report pytree) and host formatting.
+
+
+def coverage_device(cov: CoverageState) -> dict:
+    """Device half of the coverage report: reductions only, no transfer."""
+    # OR-reduce over lanes -> the union Bloom filter of every visited state.
+    union = jax.lax.reduce(
+        cov.bitmap, jnp.int32(0), jax.lax.bitwise_or, dimensions=[1]
+    )
+    return {
+        "union_bits": jax.lax.population_count(union).sum(dtype=jnp.int32),
+        "union_words": union,
+        "lane_bits": jax.lax.population_count(cov.bitmap).sum(
+            dtype=jnp.int32
+        ),
+        "new_bits": cov.new_bits.sum(dtype=jnp.int32),
+    }
+
+
+def union_hex(words_arr) -> str:
+    """The union bitmap as one hex integer — the MERGEABLE sketch form.
+
+    OR-ing two runs' values (``int(a, 16) | int(b, 16)``) is exactly the
+    Bloom union of their visited sets; soak uses this for cross-seed
+    coverage curves and a fleet aggregator can use it across hosts.
+    """
+    u = 0
+    for i, w in enumerate(words_arr):
+        u |= (int(w) & 0xFFFFFFFF) << (32 * i)
+    return f"{u:x}"
+
+
+def coverage_host(host: dict, words: int) -> dict:
+    """Format a ``device_get``'d :func:`coverage_device` pytree."""
+    m = 32 * words
+    bits_set = int(host["union_bits"])
+    est = bloom_estimate(m, K_HASHES, bits_set)
+    return {
+        "bits_set": bits_set,
+        "bits_total": m,
+        "words": words,
+        "hashes": K_HASHES,
+        "saturation": round(bits_set / m, 6) if m else 0.0,
+        # None == saturated: the sketch can only lower-bound the state count.
+        "est_states": None if est is None else round(est, 1),
+        "lane_bits": int(host["lane_bits"]),
+        "new_bits": int(host["new_bits"]),
+        "union_hex": union_hex(host["union_words"]),
+    }
+
+
+def coverage_report(cov: CoverageState) -> dict:
+    """Host-readable coverage summary (one blocking transfer; tests/CLI)."""
+    return coverage_host(
+        jax.device_get(coverage_device(cov)), int(cov.bitmap.shape[0])
+    )

@@ -50,14 +50,28 @@ def chrome_trace_events(
     lane_spans: "dict[int, list[RoundSpan]]",
     host: Optional[HostSpanRecorder] = None,
     tick_us: int = TICK_US,
+    counters: "Optional[dict[str, list[tuple[int, float]]]]" = None,
 ) -> list[dict]:
-    """Flatten spans + host recorder into a sorted trace-event list."""
+    """Flatten spans + host recorder into a sorted trace-event list.
+
+    ``counters`` maps a series name to ``(tick, value)`` samples rendered
+    as Chrome counter events (``ph: C``) on the device track — Perfetto
+    draws each as a stepped area chart (e.g. the coverage-bits curve)
+    aligned with the round spans in tick-time.
+    """
     events: list[dict] = []
-    if lane_spans:
+    if lane_spans or counters:
         events.append(_meta(
             "process_name", DEVICE_PID,
             label=f"device (ticks; 1 tick = {tick_us}us)",
         ))
+    for name in sorted(counters or {}):
+        for tick, value in counters[name]:
+            events.append({
+                "ph": "C", "cat": "counter", "name": name,
+                "pid": DEVICE_PID, "ts": tick * tick_us,
+                "args": {"value": value},
+            })
     for lane in sorted(lane_spans):
         events.append(_meta("thread_name", DEVICE_PID, lane, f"lane {lane}"))
         for s in lane_spans[lane]:
@@ -114,10 +128,11 @@ def chrome_trace(
     host: Optional[HostSpanRecorder] = None,
     tick_us: int = TICK_US,
     meta: Optional[dict] = None,
+    counters: "Optional[dict[str, list[tuple[int, float]]]]" = None,
 ) -> dict:
     """The full Chrome trace JSON object (``traceEvents`` container)."""
     return {
-        "traceEvents": chrome_trace_events(lane_spans, host, tick_us),
+        "traceEvents": chrome_trace_events(lane_spans, host, tick_us, counters),
         "displayTimeUnit": "ms",
         "otherData": dict(meta or {}),
     }
@@ -129,9 +144,10 @@ def write_chrome_trace(
     host: Optional[HostSpanRecorder] = None,
     tick_us: int = TICK_US,
     meta: Optional[dict] = None,
+    counters: "Optional[dict[str, list[tuple[int, float]]]]" = None,
 ) -> dict:
     """Write the trace to ``path``; returns the object written."""
-    obj = chrome_trace(lane_spans, host, tick_us, meta)
+    obj = chrome_trace(lane_spans, host, tick_us, meta, counters)
     with open(path, "w") as fh:
         json.dump(obj, fh)
     return obj
@@ -152,6 +168,7 @@ _REQUIRED_BY_PH = {
     "X": ("dur", "tid"),
     "i": ("s",),
     "M": ("args",),
+    "C": ("args",),
 }
 
 

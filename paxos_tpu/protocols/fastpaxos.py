@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from paxos_tpu.check.safety import acceptor_invariants, learner_observe
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import telemetry as tel_mod
+from paxos_tpu.obs import coverage as cov_mod
 from paxos_tpu.core.fp_state import (
     DONE,
     FAST,
@@ -371,7 +372,7 @@ def apply_tick_fast(
             **tel_mod.fault_lane_events(plan, cfg, state.tick),
         )
 
-    return state.replace(
+    state = state.replace(
         acceptor=acc,
         proposer=prop,
         learner=learner,
@@ -380,6 +381,11 @@ def apply_tick_fast(
         tick=state.tick + 1,
         telemetry=tel,
     )
+    # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
+    # replace above just built.  PRNG-free, like telemetry.
+    if state.coverage is not None:
+        state = state.replace(coverage=cov_mod.observe(state.coverage, state))
+    return state
 
 
 def fastpaxos_step(

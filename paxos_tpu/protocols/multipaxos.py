@@ -33,6 +33,7 @@ from paxos_tpu.check.mp_safety import mp_learner_observe
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import streams as streams_mod
 from paxos_tpu.core import telemetry as tel_mod
+from paxos_tpu.obs import coverage as cov_mod
 from paxos_tpu.core.messages import ACCEPT, PREPARE
 from paxos_tpu.core.mp_state import (
     CANDIDATE,
@@ -577,7 +578,7 @@ def apply_tick_mp(
             **tel_mod.fault_lane_events(plan, cfg, state.tick),
         )
 
-    return state.replace(
+    state = state.replace(
         acceptor=acc,
         proposer=prop,
         learner=learner,
@@ -587,6 +588,12 @@ def apply_tick_mp(
         tick=state.tick + 1,
         telemetry=tel,
     )
+    # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
+    # replace above just built (includes `base`, so the same window at a
+    # different log position hashes differently).  PRNG-free.
+    if state.coverage is not None:
+        state = state.replace(coverage=cov_mod.observe(state.coverage, state))
+    return state
 
 
 def multipaxos_step(

@@ -47,6 +47,7 @@ from paxos_tpu.check.safety import acceptor_invariants, learner_observe
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import streams as streams_mod
 from paxos_tpu.core import telemetry as tel_mod
+from paxos_tpu.obs import coverage as cov_mod
 from paxos_tpu.core.messages import ACCEPT, ACCEPTED, PREPARE, PROMISE
 from paxos_tpu.core.state import DONE, P1, P2, PaxosState
 from paxos_tpu.faults.injector import (
@@ -567,7 +568,7 @@ def apply_tick(
             **tel_mod.fault_lane_events(plan, cfg, state.tick),
         )
 
-    return state.replace(
+    state = state.replace(
         acceptor=acc,
         proposer=prop,
         learner=learner,
@@ -576,6 +577,12 @@ def apply_tick(
         tick=state.tick + 1,
         telemetry=tel,
     )
+    # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
+    # replace above just built, so host-side digests of returned states
+    # match the in-flight ones bit for bit.  PRNG-free, like telemetry.
+    if state.coverage is not None:
+        state = state.replace(coverage=cov_mod.observe(state.coverage, state))
+    return state
 
 
 def paxos_step(

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from paxos_tpu.check.safety import learner_observe, raft_voter_invariants
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import telemetry as tel_mod
+from paxos_tpu.obs import coverage as cov_mod
 from paxos_tpu.core.raft_state import (
     ACK,
     APPEND,
@@ -328,7 +329,7 @@ def apply_tick_raft(
             **tel_mod.fault_lane_events(plan, cfg, state.tick),
         )
 
-    return state.replace(
+    state = state.replace(
         acceptor=voter,
         proposer=cand,
         learner=learner,
@@ -337,6 +338,11 @@ def apply_tick_raft(
         tick=state.tick + 1,
         telemetry=tel,
     )
+    # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
+    # replace above just built.  PRNG-free, like telemetry.
+    if state.coverage is not None:
+        state = state.replace(coverage=cov_mod.observe(state.coverage, state))
+    return state
 
 
 def raftcore_step(

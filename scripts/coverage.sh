@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Coverage plane wrapper: on-device sketch campaign (default) or exact
+# probe + sketch calibration (--exact).  One JSON report on stdout; the
+# sketch mode exits 2 on safety violations, the exact mode exits 2 on a
+# soundness or sketch-calibration failure.
+#
+# Usage: scripts/coverage.sh [paxos_tpu coverage flags...]
+#   scripts/coverage.sh --config config2 --n-inst 256 --ticks 128
+#   scripts/coverage.sh --exact --seeds 24 --record COVERAGE.json
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m paxos_tpu coverage "$@"

@@ -1,10 +1,10 @@
-"""CLI for the fuzz-coverage probe (VERDICT r3 #3; `check/coverage.py`).
+"""Thin wrapper for the exact fuzz-coverage probe (VERDICT r3 #3).
 
-Measures what fraction of the exhaustively-enumerated bounded schedule
-space the TPU-style fuzzer actually occupies, the EXACT transport-excluded
-remainder (multiset-only states the fixed-slot transport cannot represent),
-and the soundness dual (every in-bounds fuzz state must be model-reachable:
-``out_of_space`` must print 0).
+The probe now lives in the CLI — ``python -m paxos_tpu coverage --exact``
+(see ``paxos_tpu/harness/cli.py``); this script survives only so recorded
+invocations (`python scripts/coverage_probe.py --seeds 24 --record ...`)
+keep working.  It re-execs the CLI module from the repo root, so there is
+no ``sys.path`` surgery and exactly one argument parser owns the flags.
 
     python scripts/coverage_probe.py                      # default bounds
     python scripts/coverage_probe.py --seeds 24 --record COVERAGE.json
@@ -12,74 +12,18 @@ and the soundness dual (every in-bounds fuzz state must be model-reachable:
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
+import subprocess
 import sys
-
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--n-prop", type=int, default=2)
-    ap.add_argument("--n-acc", type=int, default=3)
-    ap.add_argument(
-        "--max-round", type=int, nargs="+", default=[1, 0],
-        help="retry bounds (one per proposer, or one for all)",
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.call(
+        [sys.executable, "-m", "paxos_tpu", "coverage", "--exact",
+         *sys.argv[1:]],
+        cwd=repo_root,
     )
-    ap.add_argument("--n-inst", type=int, default=4096)
-    ap.add_argument("--ticks", type=int, default=48)
-    ap.add_argument("--seeds", type=int, default=12)
-    ap.add_argument("--seed0", type=int, default=0)
-    ap.add_argument("--max-states", type=int, default=50_000_000)
-    ap.add_argument("--record", default=None)
-    ap.add_argument(
-        "--analyze-residue", action="store_true",
-        help="append residue_analysis (what the UNREACHED states share) "
-        "to the report — the design input for targeted adversaries",
-    )
-    ap.add_argument(
-        "--profile", type=int, default=None,
-        help="pin ONE portfolio profile index for every seed (default: "
-        "rotate the full portfolio)",
-    )
-    args = ap.parse_args()
-
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")  # the probe is a CPU tool
-
-    from paxos_tpu.check.coverage import PORTFOLIO, coverage_probe
-
-    if args.profile is not None and not 0 <= args.profile < len(PORTFOLIO):
-        ap.error(f"--profile must be in [0, {len(PORTFOLIO) - 1}]")
-    mr = args.max_round[0] if len(args.max_round) == 1 else tuple(args.max_round)
-    out = coverage_probe(
-        n_prop=args.n_prop,
-        n_acc=args.n_acc,
-        max_round=mr,
-        n_inst=args.n_inst,
-        ticks=args.ticks,
-        seeds=args.seeds,
-        seed0=args.seed0,
-        max_states=args.max_states,
-        log=lambda s: print(f"# {s}", file=sys.stderr),
-        probe_cfg_kw=(
-            None if args.profile is None else PORTFOLIO[args.profile]
-        ),
-        analyze_residue=args.analyze_residue,
-    )
-    sample = out.pop("out_of_space_sample")
-    print(json.dumps(out))
-    if args.record:
-        with open(args.record, "w") as f:
-            json.dump(out, f, indent=1)
-    if out["out_of_space"]:
-        print(f"# SOUNDNESS FAILURE — sample state: {sample[0]}",
-              file=sys.stderr)
-        return 2
-    return 0
 
 
 if __name__ == "__main__":

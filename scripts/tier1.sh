@@ -65,6 +65,28 @@ if [ "$rc" -eq 0 ]; then
     >/dev/null 2>&1 \
   && echo AUDIT_SMOKE=ok || { echo AUDIT_SMOKE=FAILED; rc=1; }
 fi
+# Coverage smoke: a tiny sketch campaign through the `coverage`
+# subcommand must draw a sane coverage curve — cumulative bits_set
+# monotone nondecreasing, nonzero by the end, and consistent with the
+# final report's union popcount (the zero-round-trip coverage plane's
+# end-to-end acceptance, kept cheap).
+if [ "$rc" -eq 0 ]; then
+  c=/tmp/_t1_coverage.json; rm -f "$c"
+  timeout -k 10 180 env JAX_PLATFORMS=cpu python -m paxos_tpu coverage \
+    --config config1 --n-inst 64 --ticks 32 --chunk 8 --words 8 \
+    >"$c" 2>/dev/null \
+  && timeout -k 10 30 env JAX_PLATFORMS=cpu python - "$c" <<'EOF' \
+  && echo COVERAGE_SMOKE=ok || { echo COVERAGE_SMOKE=FAILED; rc=1; }
+import json, sys
+out = json.load(open(sys.argv[1]))
+curve = [c["bits_set"] for c in out["curve"]]
+assert curve, "empty coverage curve"
+assert curve == sorted(curve), f"curve not monotone: {curve}"
+assert curve[-1] > 0, "coverage curve never left zero"
+assert curve[-1] == out["coverage"]["bits_set"], "curve/report mismatch"
+assert out["coverage"]["bits_total"] == 8 * 32
+EOF
+fi
 # Packed-state smoke: the fused engine now carries lane state bit-packed
 # through VMEM (utils/bitops layout tables); this replays one config per
 # protocol through the packed fused kernel (interpret) AND the unpacked

@@ -1,0 +1,313 @@
+"""Coverage sketch (PR 8): default-off is FREE, on is neutral, math is honest.
+
+Four contracts guard the coverage plane:
+
+1. **Default-off is free**: with coverage disabled (the default) the state's
+   ``coverage`` leaf is ``None`` (pruned from the pytree), schedules are
+   BIT-IDENTICAL to the PR-6 golden digests (tests/test_gray.py, re-pinned
+   here), and the default config fingerprint is unchanged so recorded
+   artifacts keep matching.
+2. **On is outcome-neutral**: the sketch draws NO randomness — it hashes
+   state the tick already produced — so enabling it leaves the protocol
+   schedule bit-identical on BOTH engines (XLA key stream and fused counter
+   stream), and the fused Pallas kernel carries the sketch arrays bit-exact
+   vs its XLA reference via the generic packed-word passthrough.
+3. **The Bloom math is honest**: the fill-fraction estimator lands within
+   the propagated confidence band on known-cardinality insert sets, and the
+   device hash positions match the pure-Python host mirror bit for bit.
+4. **Calibration**: at exact-probe bounds the sketch's covered-set estimate
+   matches the true distinct-digest count within the Bloom bound
+   (``check.coverage.sketch_crosscheck``).
+"""
+
+import dataclasses
+import hashlib
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paxos_tpu.harness import config as C
+from paxos_tpu.harness.run import (
+    base_key,
+    get_step_fn,
+    init_plan,
+    init_state,
+    run,
+    run_chunk,
+)
+from paxos_tpu.obs import coverage as cov
+
+COV = cov.CoverageConfig(words=8)
+
+
+def _digest(state) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        h.update(jax.device_get(leaf).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _xla_final(cfg, n_ticks=32):
+    return run_chunk(
+        init_state(cfg), base_key(cfg), init_plan(cfg), cfg.fault, n_ticks,
+        get_step_fn(cfg.protocol),
+    )
+
+
+def _ctr_final(cfg, n_ticks=32):
+    from paxos_tpu.kernels.fused_tick import fused_fns, reference_chunk
+
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    return reference_chunk(
+        init_state(cfg), cfg.seed, init_plan(cfg), cfg.fault, n_ticks,
+        apply_fn=apply_fn, mask_fn=mask_fn, blk_id=0,
+    )
+
+
+# The PR-6 goldens (tests/test_gray.py, n_inst=256, seed=7, 32 ticks, CPU):
+# coverage-off must reproduce them, and coverage-ON minus the sketch leaf
+# must reproduce them too (schedule unperturbed on both engines).
+_GOLDEN_XLA = {
+    "config2": (lambda: C.config2_dueling_drop(256, 7), "83347bc41b16a2aa"),
+    "config3": (lambda: C.config3_multipaxos(256, 7), "93a2dd9d7b8d66e4"),
+    "fastpaxos": (lambda: C.config5_sweep(256, 7)[1], "c43658973b29e73e"),
+    "raftcore": (lambda: C.config5_sweep(256, 7)[2], "4662db6b2c5a39d3"),
+}
+_GOLDEN_CTR = {
+    "config2": (lambda: C.config2_dueling_drop(256, 7), "db6db6f40f16eb7b"),
+    "config3": (lambda: C.config3_multipaxos(256, 7), "4b6525460815d9c5"),
+    "fastpaxos": (lambda: C.config5_sweep(256, 7)[1], "72beea3ccdacab94"),
+    "raftcore": (lambda: C.config5_sweep(256, 7)[2], "eb285905571b709f"),
+}
+
+_FAST_XLA = ("config2", "config3")
+_FAST_CTR = ("config2",)
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        n if n in _FAST_XLA else pytest.param(n, marks=pytest.mark.slow)
+        for n in sorted(_GOLDEN_XLA)
+    ],
+)
+def test_coverage_on_schedule_identical_xla(name):
+    mk, want = _GOLDEN_XLA[name]
+    assert _digest(_xla_final(mk())) == want  # off == PR-6 golden
+    fin = _xla_final(dataclasses.replace(mk(), coverage=COV))
+    assert fin.coverage is not None
+    assert int(jax.device_get(fin.coverage.new_bits).sum()) > 0
+    assert _digest(fin.replace(coverage=None)) == want  # on == same schedule
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        n if n in _FAST_CTR else pytest.param(n, marks=pytest.mark.slow)
+        for n in sorted(_GOLDEN_CTR)
+    ],
+)
+def test_coverage_on_schedule_identical_counter_stream(name):
+    mk, want = _GOLDEN_CTR[name]
+    assert _digest(_ctr_final(mk())) == want
+    fin = _ctr_final(dataclasses.replace(mk(), coverage=COV))
+    assert _digest(fin.replace(coverage=None)) == want
+
+
+def test_default_off_prunes_to_none():
+    """Disabled coverage leaves NO trace in the pytree or fingerprint."""
+    for mk in (C.config1_no_faults, C.config3_multipaxos):
+        cfg = mk(64, 0)
+        state = init_state(cfg)
+        assert state.coverage is None
+        assert not cfg.coverage.enabled()
+        on = init_state(dataclasses.replace(cfg, coverage=COV))
+        off_n = len(jax.tree_util.tree_leaves(state))
+        on_n = len(jax.tree_util.tree_leaves(on))
+        assert on_n == off_n + 2  # bitmap + new_bits
+        # All sketch leaves are non-scalar int32, instance-minor — the
+        # fused engine's generic flattening rides them with no kernel edits.
+        for leaf in jax.tree_util.tree_leaves(on.coverage):
+            assert leaf.dtype == jnp.int32 and leaf.ndim >= 1
+            assert leaf.shape[-1] == 64
+
+
+def test_fingerprint_unchanged_by_default_coverage():
+    """The default (off) CoverageConfig is dropped from the fingerprint, so
+    pre-coverage artifacts keep matching; a non-default one IS keyed."""
+    cfg = C.config2_dueling_drop(1 << 10)
+    assert (
+        dataclasses.replace(
+            cfg, coverage=cov.CoverageConfig()
+        ).fingerprint()
+        == cfg.fingerprint()
+    )
+    assert (
+        dataclasses.replace(cfg, coverage=COV).fingerprint()
+        != cfg.fingerprint()
+    )
+
+
+def test_coverage_config_validation():
+    with pytest.raises(ValueError):
+        cov.CoverageConfig(words=-1)
+    with pytest.raises(ValueError):
+        cov.CoverageConfig(words=3)  # not a power of two
+    assert cov.CoverageConfig(words=8).bits() == 256
+
+
+def test_device_positions_match_host_mirror():
+    """Every bit the device sketch sets is exactly the host mirror's set
+    (same digests through host_hash_pos) — bit-for-bit, no estimate."""
+    cfg = dataclasses.replace(C.config2_dueling_drop(128, 3), coverage=COV)
+    fin = _xla_final(cfg, n_ticks=24)
+
+    # Replay the run 1 tick at a time and collect every post-tick digest the
+    # lanes hashed.  The step folds the base key by state.tick internally,
+    # so 24 one-tick chunks reproduce exactly the 24-tick chunk above.
+    digests: set = set()
+    state = init_state(cfg)
+    key, plan, step = base_key(cfg), init_plan(cfg), get_step_fn(cfg.protocol)
+    for _ in range(24):
+        state = run_chunk(state, key, plan, cfg.fault, 1, step)
+        d = jax.device_get(cov.lane_digest(cov.digest_tree(state)))
+        digests.update(int(v) & 0xFFFFFFFF for v in d)
+    assert _digest(state.replace(coverage=None)) == _digest(
+        fin.replace(coverage=None)
+    )
+
+    union = int(
+        cov.union_hex(
+            jax.device_get(cov.coverage_device(fin.coverage)["union_words"])
+        ),
+        16,
+    )
+    mirror = 0
+    for p in cov.host_sketch_positions(digests, COV.words):
+        mirror |= 1 << p
+    assert union == mirror
+
+
+def test_bloom_estimator_within_bound_on_known_sets():
+    """FP-rate property: random known-cardinality insert sets must estimate
+    within bloom_bound at several fill levels (seeded, deterministic)."""
+    rng = random.Random(0xC0FFEE)
+    words = 64  # m = 2048
+    m = 32 * words
+    for n in (10, 100, 400, 900):
+        values = {rng.getrandbits(32) for _ in range(n)}
+        bits = len(cov.host_sketch_positions(values, words))
+        est = cov.bloom_estimate(m, cov.K_HASHES, bits)
+        assert est is not None
+        bound = cov.bloom_bound(m, cov.K_HASHES, len(values))
+        assert abs(est - len(values)) <= bound, (n, est, bound)
+        assert cov.host_sketch_estimate(values, words) == est
+
+
+def test_bloom_estimate_edges():
+    assert cov.bloom_estimate(256, 2, 0) == 0.0
+    assert cov.bloom_estimate(256, 2, 256) is None  # saturated
+    assert cov.bloom_estimate(256, 2, 300) is None
+    mid = cov.bloom_estimate(256, 2, 128)
+    assert mid is not None and mid > 0
+
+
+def test_union_hex_is_mergeable():
+    """OR of two runs' union_hex == Bloom union of their visited sets."""
+    import numpy as np
+
+    a = np.array([0b1010, 0, 1], dtype=np.int32)
+    b = np.array([0b0101, 7, 0], dtype=np.int32)
+    ua, ub = int(cov.union_hex(a), 16), int(cov.union_hex(b), 16)
+    merged = ua | ub
+    both = np.array([0b1111, 7, 1], dtype=np.int32)
+    assert merged == int(cov.union_hex(both), 16)
+
+
+def test_run_report_embeds_coverage():
+    cfg = dataclasses.replace(C.config1_no_faults(64, 0), coverage=COV)
+    rep = run(cfg, total_ticks=16, chunk=8)
+    c = rep["coverage"]
+    assert c["bits_total"] == COV.bits()
+    assert 0 < c["bits_set"] <= c["bits_total"]
+    assert c["hashes"] == cov.K_HASHES
+    assert bin(int(c["union_hex"], 16)).count("1") == c["bits_set"]
+    # And with the default config the report has NO coverage block.
+    rep_off = run(C.config1_no_faults(64, 0), total_ticks=16, chunk=8)
+    assert "coverage" not in rep_off
+
+
+@pytest.mark.parametrize(
+    "protocol",
+    [
+        "paxos",
+        pytest.param("multipaxos", marks=pytest.mark.slow),
+        pytest.param("fastpaxos", marks=pytest.mark.slow),
+        pytest.param("raftcore", marks=pytest.mark.slow),
+    ],
+)
+def test_fused_kernel_carries_sketch_bitexact(protocol):
+    """fused_chunk(interpret) == reference_chunk with the sketch ON: the
+    packed-word passthrough codec must round-trip the bitmap bit-exactly."""
+    from paxos_tpu.kernels.fused_tick import (
+        FUSED_CHUNKS,
+        fused_fns,
+        reference_chunk,
+    )
+    from paxos_tpu.utils.trees import tree_mismatches
+
+    base = {
+        "paxos": C.config2_dueling_drop,
+        "multipaxos": C.config3_multipaxos,
+        "fastpaxos": lambda n, s: C.config5_sweep(n, s)[1],
+        "raftcore": lambda n, s: C.config5_sweep(n, s)[2],
+    }[protocol](64, 7)
+    cfg = dataclasses.replace(base, coverage=COV)
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    plan = init_plan(cfg)
+    sr = reference_chunk(
+        init_state(cfg), jnp.int32(cfg.seed), plan, cfg.fault, 24,
+        apply_fn=apply_fn, mask_fn=mask_fn,
+    )
+    sp = FUSED_CHUNKS[cfg.protocol](
+        init_state(cfg), jnp.int32(cfg.seed), plan, cfg.fault, 24,
+        block=64, interpret=True,
+    )
+    assert tree_mismatches(sp, sr) == []
+    assert int(jax.device_get(sp.coverage.new_bits).sum()) > 0
+
+
+def test_new_bits_curve_monotone_and_saturating():
+    """Cumulative new_bits is nondecreasing and bounded by the union fill;
+    re-running from a converged state adds (almost) nothing."""
+    cfg = dataclasses.replace(C.config1_no_faults(64, 0), coverage=COV)
+    state = init_state(cfg)
+    key, plan, step = base_key(cfg), init_plan(cfg), get_step_fn(cfg.protocol)
+    prev = 0
+    totals = []
+    for _ in range(6):
+        state = run_chunk(state, key, plan, cfg.fault, 4, step)
+        total = int(jax.device_get(state.coverage.new_bits).sum())
+        assert total >= prev
+        prev = total
+        totals.append(total)
+    # config1 converges: the tail chunks discover little or nothing new.
+    assert totals[-1] - totals[-2] <= totals[1] - totals[0]
+    rep = cov.coverage_report(state.coverage)
+    assert rep["bits_set"] <= rep["bits_total"]
+    assert rep["lane_bits"] >= rep["bits_set"]
+
+
+@pytest.mark.slow
+def test_sketch_crosschecks_exact_probe():
+    """Acceptance: at coverage_probe bounds the sketch estimate matches the
+    exact distinct-digest count within the Bloom bound, and the device
+    union equals the host mirror bit for bit."""
+    from paxos_tpu.check.coverage import sketch_crosscheck
+
+    out = sketch_crosscheck(n_inst=256, ticks=24, seeds=2)
+    assert out["union_matches_host_mirror"], out
+    assert out["estimate_within_bound"], out
+    assert out["exact_digests"] > 0

@@ -104,6 +104,51 @@ def test_registry_prometheus_format():
     assert text.endswith("\n")
 
 
+def test_prometheus_label_value_escaping():
+    """Backslash, double-quote, and newline in a label value must escape
+    per the exposition format — an unescaped quote splits the sample line
+    at scrape time.  Backslash escapes FIRST (regression: escaping it last
+    re-breaks the quote/newline escapes' own backslashes)."""
+    reg = MetricsRegistry()
+    reg.inc("events_total", 3, path='a\\b"c\nd')
+    reg.gauge("disk_free", 1.5, mount='m"nt')
+    text = reg.to_prometheus()
+    assert 'paxos_tpu_events_total{path="a\\\\b\\"c\\nd"} 3' in text
+    assert 'paxos_tpu_disk_free{mount="m\\"nt"} 1.5' in text
+    # The raw newline must not survive to split the sample line.
+    assert 'c\nd"} 3' not in text
+
+
+def test_registry_ingest_coverage_gauges():
+    """Coverage host reports land as gauges; new_per_chunk is the delta of
+    bits_set across ingests (the live coverage-curve slope)."""
+    reg = MetricsRegistry()
+    reg.ingest_coverage({
+        "bits_set": 40, "bits_total": 256, "saturation": 0.15625,
+        "est_states": 21.5,
+    })
+    reg.ingest_coverage({
+        "bits_set": 50, "bits_total": 256, "saturation": 0.195312,
+        "est_states": 28.0,
+    })
+    g = reg.snapshot()["gauges"]
+    assert g["coverage_bits_set"] == 50
+    assert g["coverage_bits_total"] == 256
+    assert g["coverage_new_per_chunk"] == 10
+    assert g["coverage_est_states"] == 28.0
+    # A saturated report (est_states None) keeps the last finite estimate.
+    reg.ingest_coverage({
+        "bits_set": 256, "bits_total": 256, "saturation": 1.0,
+        "est_states": None,
+    })
+    g = reg.snapshot()["gauges"]
+    assert g["coverage_saturation"] == 1.0
+    assert g["coverage_est_states"] == 28.0
+    text = reg.to_prometheus()
+    assert "# TYPE paxos_tpu_coverage_bits_set gauge" in text
+    assert "paxos_tpu_coverage_bits_set 256" in text
+
+
 def _tiny_state(protocol: str):
     from paxos_tpu.harness import config as C
     from paxos_tpu.harness.run import (

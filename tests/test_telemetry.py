@@ -145,6 +145,7 @@ def test_fingerprint_unchanged_by_default_telemetry():
     cfg = C.config2_dueling_drop(1 << 20)
     d = dataclasses.asdict(cfg)
     del d["telemetry"]  # the pre-telemetry asdict shape
+    del d["coverage"]  # default-off coverage is likewise dropped (PR 8)
     d["layout_version"] = layout_version(cfg.protocol)
     pre = hashlib.sha256(
         json.dumps(d, sort_keys=True).encode()
