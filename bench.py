@@ -81,10 +81,21 @@ def _configs(platform: str):
     cov_cfg = dataclasses.replace(
         config2_dueling_drop(n_inst=n), coverage=CoverageConfig(words=64)
     )
+    # Exposure-overhead row: flagship config with the fault-exposure
+    # counters on (6x2 packed int32 counters/lane through the generic
+    # passthrough).  Same contract again: OFF is gated free by the base
+    # row; this row prices ON (a handful of masked popcount-adds per tick)
+    # and backs the README's "within 10%" acceptance claim.
+    from paxos_tpu.obs.exposure import ExposureConfig
+
+    exp_cfg = dataclasses.replace(
+        config2_dueling_drop(n_inst=n), exposure=ExposureConfig(counters=True)
+    )
     cases = [
         ("config2-paxos", config2_dueling_drop(n_inst=n), 1024, 1),
         ("config2-paxos-telemetry", tel_cfg, 1024, 1),
         ("config2-paxos-coverage", cov_cfg, 1024, 1),
+        ("config2-paxos-exposure", exp_cfg, 1024, 1),
         ("config5-fastpaxos", sweep["fastpaxos"], 256, 1),
         ("config5-raftcore", sweep["raftcore"], 256, 1),
         ("config3-multipaxos", config3_multipaxos(n_inst=n), 256, 1),

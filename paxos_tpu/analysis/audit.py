@@ -138,6 +138,16 @@ def run_audit(
                 traces["default"][1], traces["coverage"][1],
             )
             checks += 1
+        if "gray-chaos" in traces and "exposure" in traces:
+            # Exposure's audit baseline is gray-chaos, not default: the
+            # exposure cell rides the gray-chaos faults so its per-class
+            # arms actually trace (see trace._exposure).
+            findings += prng_audit.audit_exposure_parity(
+                protocol,
+                traces["gray-chaos"][0], traces["exposure"][0],
+                traces["gray-chaos"][1], traces["exposure"][1],
+            )
+            checks += 1
     if lint:
         findings += purity.audit_traced_sources()
         checks += 1

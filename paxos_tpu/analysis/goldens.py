@@ -16,34 +16,46 @@ string as None, which is the point of the fingerprint) and the new
 "coverage" audit column landed.  LAYOUT_GOLDENS are byte-identical to
 round 7: the sketch rides the fused engine's generic passthrough codec,
 touching no packed word.
+
+Round 9 re-record: the fault-exposure plane (obs.exposure) added an
+Optional ``exposure`` leaf to every protocol state — same contract, so
+again every TREEDEF cell re-keyed and the "exposure" audit column landed.
+CONFIG_GOLDENS kept every existing cell (the fingerprint drops a
+default-off ExposureConfig, so recorded campaigns keep their identity)
+and LAYOUT_GOLDENS are byte-identical to round 8: the counters ride the
+same generic passthrough codec, touching no packed word.
 """
 
 # (protocol, config_name) -> sha256[:16] of str(tree_structure(init_state))
 TREEDEF_GOLDENS: dict = {
-    ("paxos", "default"): "916958cadb681ab7",
-    ("paxos", "gray-chaos"): "916958cadb681ab7",
-    ("paxos", "corrupt"): "916958cadb681ab7",
-    ("paxos", "stale"): "56711751dcba9742",
-    ("paxos", "telemetry"): "6beba8310b32bf0f",
-    ("paxos", "coverage"): "d9e7d891bf74493f",
-    ("multipaxos", "default"): "b2fd8e0ca28fd319",
-    ("multipaxos", "gray-chaos"): "b2fd8e0ca28fd319",
-    ("multipaxos", "corrupt"): "b2fd8e0ca28fd319",
-    ("multipaxos", "stale"): "2356e11dbf05410a",
-    ("multipaxos", "telemetry"): "e034820120b6d7ed",
-    ("multipaxos", "coverage"): "60556bc6865780b6",
-    ("fastpaxos", "default"): "80ee53207a000d5a",
-    ("fastpaxos", "gray-chaos"): "80ee53207a000d5a",
-    ("fastpaxos", "corrupt"): "80ee53207a000d5a",
-    ("fastpaxos", "stale"): "f53d895607b39026",
-    ("fastpaxos", "telemetry"): "2e789e30c9714820",
-    ("fastpaxos", "coverage"): "55d6af8fe777f926",
-    ("raftcore", "default"): "1e175bcf3e654edb",
-    ("raftcore", "gray-chaos"): "1e175bcf3e654edb",
-    ("raftcore", "corrupt"): "1e175bcf3e654edb",
-    ("raftcore", "stale"): "d51526ee84290f1f",
-    ("raftcore", "telemetry"): "4695c488a2cb0d7c",
-    ("raftcore", "coverage"): "5eb1ed49ed6a76ae",
+    ("paxos", "default"): "70a1f204f28dd0aa",
+    ("paxos", "gray-chaos"): "70a1f204f28dd0aa",
+    ("paxos", "corrupt"): "70a1f204f28dd0aa",
+    ("paxos", "stale"): "0fcacc1bd7c74b55",
+    ("paxos", "telemetry"): "7a56062c9b43bf0e",
+    ("paxos", "coverage"): "7fc0dc957ffba1a6",
+    ("paxos", "exposure"): "abf4caef44447651",
+    ("multipaxos", "default"): "88bd02bb2b5551ef",
+    ("multipaxos", "gray-chaos"): "88bd02bb2b5551ef",
+    ("multipaxos", "corrupt"): "88bd02bb2b5551ef",
+    ("multipaxos", "stale"): "f67f33b1f405dec3",
+    ("multipaxos", "telemetry"): "3c50da89e2d28493",
+    ("multipaxos", "coverage"): "56706cb41780cc81",
+    ("multipaxos", "exposure"): "7a8170eb91005d93",
+    ("fastpaxos", "default"): "e913bd8567a69327",
+    ("fastpaxos", "gray-chaos"): "e913bd8567a69327",
+    ("fastpaxos", "corrupt"): "e913bd8567a69327",
+    ("fastpaxos", "stale"): "5457e8db0c93e25f",
+    ("fastpaxos", "telemetry"): "eb85b0ad26ba060b",
+    ("fastpaxos", "coverage"): "4e778741ff9e754a",
+    ("fastpaxos", "exposure"): "49a01bd8d6395d03",
+    ("raftcore", "default"): "4677b44e023ecd4e",
+    ("raftcore", "gray-chaos"): "4677b44e023ecd4e",
+    ("raftcore", "corrupt"): "4677b44e023ecd4e",
+    ("raftcore", "stale"): "02ee82c800930ef8",
+    ("raftcore", "telemetry"): "c837c63a9ea5977d",
+    ("raftcore", "coverage"): "9ad9c3c4300d53ab",
+    ("raftcore", "exposure"): "33c040107e72e5c6",
 }
 
 # (protocol, config_name) -> SimConfig.fingerprint() of the audit config
@@ -57,24 +69,28 @@ CONFIG_GOLDENS: dict = {
     ("paxos", "stale"): "dd2e59a672568867",
     ("paxos", "telemetry"): "45769fa2f93945e0",
     ("paxos", "coverage"): "1688a7b588e353ce",
+    ("paxos", "exposure"): "603bc79585bdf597",
     ("multipaxos", "default"): "c43e601ef68a237f",
     ("multipaxos", "gray-chaos"): "ef22269046287409",
     ("multipaxos", "corrupt"): "8175e48831a73e89",
     ("multipaxos", "stale"): "f68540b11905991c",
     ("multipaxos", "telemetry"): "4ea3f797b32bc566",
     ("multipaxos", "coverage"): "acdbcb7fcb033a3b",
+    ("multipaxos", "exposure"): "8cacc47bbd0378c5",
     ("fastpaxos", "default"): "cb51e3867a43b91b",
     ("fastpaxos", "gray-chaos"): "d311d7e3d86192e7",
     ("fastpaxos", "corrupt"): "72485f432fb7393a",
     ("fastpaxos", "stale"): "0bc8e8e18a940735",
     ("fastpaxos", "telemetry"): "298edfbc20970277",
     ("fastpaxos", "coverage"): "4cf16c0d9ad6ccc6",
+    ("fastpaxos", "exposure"): "ea463f9d5b1e9a59",
     ("raftcore", "default"): "ff49ab17defc9057",
     ("raftcore", "gray-chaos"): "1755349e01c9d063",
     ("raftcore", "corrupt"): "040a2cdb1838612f",
     ("raftcore", "stale"): "291ba0bd46e6cd30",
     ("raftcore", "telemetry"): "d0b50c940de6b66a",
     ("raftcore", "coverage"): "b2628ea1f5ad5604",
+    ("raftcore", "exposure"): "a505137b82c1938e",
 }
 
 # protocol -> {"version": layout version string, "fields": canonical per-field

@@ -299,3 +299,19 @@ def audit_coverage_parity(
         protocol, "coverage-parity", "coverage",
         default_xla, cov_xla, default_ctr, cov_ctr,
     )
+
+
+def audit_exposure_parity(
+    protocol: str, base_xla, exp_xla, base_ctr, exp_ctr
+) -> list:
+    """The fault-exposure counters must consume no randomness.
+
+    Compared against the GRAY-CHAOS cell (not default): exposure's
+    per-class arms read event signals the fault hooks already computed,
+    so the exposure-on trace must match the same-faults exposure-off
+    trace — its counting is pure int32 arithmetic over existing values
+    (obs.exposure docstring)."""
+    return _audit_observer_parity(
+        protocol, "exposure-parity", "exposure",
+        base_xla, exp_xla, base_ctr, exp_ctr,
+    )

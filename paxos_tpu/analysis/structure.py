@@ -46,6 +46,11 @@ _KNOB_LEAVES = (
         lambda cfg: cfg.coverage.enabled(),
         "coverage disabled",
     ),
+    (
+        lambda name: name == "exposure",
+        lambda cfg: cfg.exposure.enabled(),
+        "exposure disabled",
+    ),
 )
 
 _PLAN_GRAY_FIELDS = ("part_dir", "link_drop", "link_dup", "ptimeout", "pboff")

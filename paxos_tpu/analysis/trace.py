@@ -69,6 +69,17 @@ def _coverage(protocol: str) -> SimConfig:
     )
 
 
+def _exposure(protocol: str) -> SimConfig:
+    from paxos_tpu.obs.exposure import ExposureConfig
+
+    # Gray-chaos base on purpose: exposure's per-class arms only trace
+    # when their fault knobs are lit, so auditing it over the default
+    # (no-fault) config would prove parity of an empty hook.
+    return dataclasses.replace(
+        _gray(protocol), exposure=ExposureConfig(counters=True)
+    )
+
+
 CONFIG_MATRIX: dict[str, Callable[[str], SimConfig]] = {
     "default": _default,
     "gray-chaos": _gray,
@@ -76,6 +87,7 @@ CONFIG_MATRIX: dict[str, Callable[[str], SimConfig]] = {
     "stale": _stale,
     "telemetry": _telemetry,
     "coverage": _coverage,
+    "exposure": _exposure,
 }
 
 

@@ -33,6 +33,7 @@ from paxos_tpu.core.messages import ACCEPT, MsgBuf
 from paxos_tpu.core.state import AcceptorState, LearnerState
 from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
+from paxos_tpu.obs.exposure import FaultExposure
 
 # Proposer phases (P1/P2/DONE match core.state so summarize() is shared).
 P1 = 0  # classic recovery: prepare sent, collecting promises
@@ -96,6 +97,8 @@ class FastPaxosState:
     telemetry: Optional[TelemetryState] = None
     # Coverage sketch (obs.coverage): None when disabled, same contract.
     coverage: Optional[CoverageState] = None
+    # Fault-exposure counters (obs.exposure): None when disabled, same contract.
+    exposure: Optional[FaultExposure] = None
 
     @classmethod
     def init(

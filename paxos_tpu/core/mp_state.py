@@ -31,6 +31,7 @@ from paxos_tpu.core.ballot import make_ballot
 from paxos_tpu.core.messages import MsgBuf
 from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
+from paxos_tpu.obs.exposure import FaultExposure
 
 # Proposer phases
 FOLLOW = 0  # passive: watching progress, lease ticking
@@ -224,6 +225,8 @@ class MultiPaxosState:
     telemetry: Optional[TelemetryState] = None
     # Coverage sketch (obs.coverage): None when disabled, same contract.
     coverage: Optional[CoverageState] = None
+    # Fault-exposure counters (obs.exposure): None when disabled, same contract.
+    exposure: Optional[FaultExposure] = None
 
     @classmethod
     def init(

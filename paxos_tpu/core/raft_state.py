@@ -28,6 +28,7 @@ from paxos_tpu.core.messages import MsgBuf
 from paxos_tpu.core.state import LearnerState
 from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
+from paxos_tpu.obs.exposure import FaultExposure
 
 # Candidate phases (values match core.state.P1/P2/DONE so summarize() and
 # liveness stats are shared across protocols).
@@ -125,6 +126,8 @@ class RaftState:
     telemetry: Optional[TelemetryState] = None
     # Coverage sketch (obs.coverage): None when disabled, same contract.
     coverage: Optional[CoverageState] = None
+    # Fault-exposure counters (obs.exposure): None when disabled, same contract.
+    exposure: Optional[FaultExposure] = None
 
     @classmethod
     def init(

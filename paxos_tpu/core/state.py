@@ -30,6 +30,7 @@ from paxos_tpu.core.ballot import make_ballot
 from paxos_tpu.core.messages import MsgBuf
 from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
+from paxos_tpu.obs.exposure import FaultExposure
 
 # Proposer phases
 P1 = 0  # prepare sent, collecting promises
@@ -154,6 +155,8 @@ class PaxosState:
     telemetry: Optional[TelemetryState] = None
     # Coverage sketch (obs.coverage): None when disabled, same contract.
     coverage: Optional[CoverageState] = None
+    # Fault-exposure counters (obs.exposure): None when disabled, same contract.
+    exposure: Optional[FaultExposure] = None
 
     @classmethod
     def init(

@@ -158,6 +158,26 @@ def links_dup(cfg: FaultConfig) -> bool:
     return cfg.p_flaky > 0.0 and (cfg.p_dup > 0.0 or cfg.flaky_dup > 0.0)
 
 
+def exposure_lit(cfg: FaultConfig) -> dict:
+    """Which exposure classes (``obs.exposure.CLASSES``) this config lights.
+
+    The knob->class mapping the exposure plane accounts against: a class is
+    "lit" when at least one knob that can produce its fault events is on.
+    A lit class with a zero effective count after a campaign is "vacuous
+    chaos" — randomness burned without ever touching the protocol — which
+    soak and the ``exposure`` subcommand flag loudly.
+    """
+    return {
+        "drop": cfg.p_drop > 0.0
+        or (cfg.p_flaky > 0.0 and cfg.flaky_drop > 0.0),
+        "dup": cfg.p_dup > 0.0 or links_dup(cfg),
+        "corrupt": cfg.p_corrupt > 0.0,
+        "partition": cfg.p_part > 0.0,
+        "timeout": cfg.timeout_skew > 0,
+        "stale": cfg.stale_k > 0,
+    }
+
+
 @struct.dataclass
 class FaultPlan:
     """Per-run static fault schedule (device arrays, shard with the state)."""

@@ -126,18 +126,22 @@ def restore(
         )
     saved_stream = raw.pop("stream", None)
     fault = raw.pop("fault")
-    # Tolerate pre-telemetry / pre-coverage snapshots (no key): default off.
+    # Tolerate pre-telemetry / pre-coverage / pre-exposure snapshots (no
+    # key): default off.
     tel = raw.pop("telemetry", None)
     cov = raw.pop("coverage", None)
+    exp = raw.pop("exposure", None)
     from paxos_tpu.core.telemetry import TelemetryConfig
     from paxos_tpu.faults.injector import FaultConfig
     from paxos_tpu.obs.coverage import CoverageConfig
+    from paxos_tpu.obs.exposure import ExposureConfig
 
     cfg = SimConfig(
         **raw,
         fault=FaultConfig(**fault),
         telemetry=TelemetryConfig(**tel) if tel else TelemetryConfig(),
         coverage=CoverageConfig(**cov) if cov else CoverageConfig(),
+        exposure=ExposureConfig(**exp) if exp else ExposureConfig(),
     )
 
     if engine is not None:

@@ -155,6 +155,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="sketch size in int32 words per lane (m = 32*W Bloom bits; "
         "power of two; only read with --coverage)",
     )
+    r.add_argument(
+        "--exposure", action="store_true",
+        help="on-device fault-exposure counters: per-lane injected-vs-"
+        "effective tallies per fault class (obs.exposure; default off — "
+        "off is free and schedule-identical)",
+    )
 
     s = sub.add_parser(
         "sweep",
@@ -226,6 +232,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--plateau-stop", action="store_true",
         help="end the soak at the plateau instead of only reporting it "
         "(the tally keeps every finalized seed)",
+    )
+    so.add_argument(
+        "--exposure", action="store_true",
+        help="on-device fault-exposure counters per campaign, summed "
+        "across seeds: the report gains per-class injected-vs-effective "
+        "totals and a vacuous-chaos flag for lit knobs that never touched "
+        "the protocol (obs.exposure)",
     )
 
     k = sub.add_parser(
@@ -315,6 +328,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--coverage-words", type=int, default=64, metavar="W",
         help="sketch size in int32 words per lane (only read with "
         "--coverage)",
+    )
+    tr.add_argument(
+        "--exposure", action="store_true",
+        help="also sample the fault-exposure counters at every chunk "
+        "boundary and draw one Perfetto counter track per fault class "
+        "(obs.exposure; forces the serial per-chunk loop)",
     )
 
     st = sub.add_parser(
@@ -423,8 +442,8 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument(
         "--config", action="append", dest="configs", metavar="NAME",
         choices=["default", "gray-chaos", "corrupt", "stale", "telemetry",
-                 "coverage"],
-        help="restrict to one audit config (repeatable; default: all six)",
+                 "coverage", "exposure"],
+        help="restrict to one audit config (repeatable; default: all seven)",
     )
     a.add_argument(
         "--structure", action="store_true",
@@ -505,6 +524,39 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-crosscheck", action="store_true",
         help="--exact: skip the sketch-vs-exact calibration pass",
     )
+
+    ex = sub.add_parser(
+        "exposure",
+        help="fault-exposure plane: run a campaign with the injected-vs-"
+        "effective counters on and print the per-class exposure matrix "
+        "plus the chunk-granular attribution table (which classes were "
+        "live while coverage grew / violations fired)",
+    )
+    ex.add_argument("--config", choices=sorted(CONFIGS), default="gray-chaos")
+    ex.add_argument("--engine", choices=["xla", "fused"], default="xla")
+    ex.add_argument("--n-inst", type=int, default=None)
+    ex.add_argument(
+        "--fault", action="append", default=[], metavar="KEY=VALUE",
+        help="override any FaultConfig knob by name (repeatable)",
+    )
+    ex.add_argument("--seed", type=int, default=0)
+    ex.add_argument("--ticks", type=int, default=256)
+    ex.add_argument("--chunk", type=int, default=64)
+    ex.add_argument(
+        "--coverage", action="store_true",
+        help="also run the coverage sketch so the attribution table can "
+        "credit new bits to the fault classes live in each chunk",
+    )
+    ex.add_argument(
+        "--coverage-words", type=int, default=64, metavar="W",
+        help="sketch size in int32 words per lane (only read with "
+        "--coverage)",
+    )
+    ex.add_argument("--log", default=None, help="JSONL metrics path")
+    ex.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the full report as JSON instead of the text tables",
+    )
     return p
 
 
@@ -532,6 +584,15 @@ def _coverage_from_args(args: argparse.Namespace, words_attr: str = "coverage_wo
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         raise SystemExit(1)
+
+
+def _exposure_from_args(args: argparse.Namespace):
+    """The --exposure flag as an ExposureConfig (or None when off)."""
+    if not getattr(args, "exposure", False):
+        return None
+    from paxos_tpu.obs.exposure import ExposureConfig
+
+    return ExposureConfig(counters=True)
 
 
 def cmd_run(args: argparse.Namespace) -> int:
@@ -604,6 +665,7 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
 
     tel_cfg = _telemetry_from_args(args)
     cov_cfg = _coverage_from_args(args)
+    expo_cfg = _exposure_from_args(args)
     registry = MetricsRegistry()
     registry.gauge("pipeline_depth_effective", depth)
     # Host span recorder (--span-trace): the CLI owns the wall clock and
@@ -631,6 +693,11 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                   "sketch's arrays are part of the checkpointed state "
                   "structure; same rule as --telemetry)", file=sys.stderr)
             return 1
+        if expo_cfg is not None:
+            print("error: --exposure cannot be combined with --resume (the "
+                  "counters' arrays are part of the checkpointed state "
+                  "structure; same rule as --telemetry)", file=sys.stderr)
+            return 1
         # Stream-lineage guard (VERDICT r4 weak#3): refuse to resume under
         # a different engine/block than the one that wrote the snapshot.
         state, plan, cfg = ckpt.restore(
@@ -651,6 +718,8 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
             cfg = dataclasses.replace(cfg, telemetry=tel_cfg)
         if cov_cfg is not None:
             cfg = dataclasses.replace(cfg, coverage=cov_cfg)
+        if expo_cfg is not None:
+            cfg = dataclasses.replace(cfg, exposure=expo_cfg)
         state, plan = init_state(cfg), init_plan(cfg)
 
     if args.shard:
@@ -731,6 +800,8 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                     registry.ingest(rep["telemetry"])
                 if "coverage" in rep:
                     registry.ingest_coverage(rep["coverage"])
+                if "exposure" in rep:
+                    registry.ingest_exposure(rep["exposure"])
                 if args.events:
                     # Registry-routed (and into the JSONL stream), with the
                     # historical stderr line kept for eyeball debugging.
@@ -765,6 +836,14 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
         registry.ingest(report["telemetry"])
     if "coverage" in report:
         registry.ingest_coverage(report["coverage"])
+    if "exposure" in report:
+        from paxos_tpu.faults.injector import exposure_lit
+        from paxos_tpu.obs.exposure import annotate_lit
+
+        report["exposure"] = annotate_lit(report["exposure"], cfg.fault)
+        registry.ingest_exposure(
+            report["exposure"], lit=exposure_lit(cfg.fault)
+        )
     if recorder is not None:
         from paxos_tpu.obs.export import write_chrome_trace
 
@@ -853,6 +932,11 @@ def cmd_soak(args: argparse.Namespace) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, coverage=cov_cfg)
+    expo_cfg = _exposure_from_args(args)
+    if expo_cfg is not None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, exposure=expo_cfg)
     band = args.min_replication
     if band is None:
         rec = config_mod.REPLICATION_RATES.get(args.config)
@@ -909,16 +993,24 @@ def cmd_soak(args: argparse.Namespace) -> int:
             plateau_stop=args.plateau_stop,
         )
         report["config"] = args.config
-        if "coverage" in report:
-            # Cross-seed coverage as gauges, so `stats --prometheus` over
-            # this JSONL stream exposes the curve's endpoint and plateau.
+        if "coverage" in report or "exposure" in report:
+            # Cross-seed coverage/exposure as gauges, so `stats
+            # --prometheus` over this JSONL stream exposes the curve's
+            # endpoint, the plateau, and per-class exposure totals.
             from paxos_tpu.harness.metrics import MetricsRegistry
 
             registry = MetricsRegistry()
-            registry.ingest_coverage(report["coverage"])
-            registry.gauge(
-                "coverage_plateau", float(report["coverage"]["plateau"])
-            )
+            if "coverage" in report:
+                registry.ingest_coverage(report["coverage"])
+                registry.gauge(
+                    "coverage_plateau", float(report["coverage"]["plateau"])
+                )
+            if "exposure" in report:
+                from paxos_tpu.faults.injector import exposure_lit
+
+                registry.ingest_exposure(
+                    report["exposure"], lit=exposure_lit(cfg.fault)
+                )
             mlog.emit("metrics", **registry.snapshot())
         if recorder is not None:
             from paxos_tpu.obs.export import write_chrome_trace
@@ -1019,6 +1111,7 @@ def cmd_stats(args: argparse.Namespace) -> int:
     last_tel = None
     last_agg = None
     last_cov = None
+    last_exp = None
     for rec in records:
         kind = rec.get("event", "?")
         kinds[kind] = kinds.get(kind, 0) + 1
@@ -1032,6 +1125,10 @@ def cmd_stats(args: argparse.Namespace) -> int:
         cov = rec.get("coverage")
         if isinstance(cov, dict) and "bits_set" in cov:
             last_cov = cov
+        # Exposure counters only grow too; last report = campaign totals.
+        exp = rec.get("exposure")
+        if isinstance(exp, dict) and "classes" in exp:
+            last_exp = exp
         # Span-trace aggregates (`trace` subcommand) are whole-campaign
         # summaries; the last record wins for the same reason.
         if kind == "spans" and isinstance(rec.get("aggregates"), dict):
@@ -1044,6 +1141,12 @@ def cmd_stats(args: argparse.Namespace) -> int:
         registry.ingest_coverage(last_cov)
         if "plateau" in last_cov:
             registry.gauge("coverage_plateau", float(last_cov["plateau"]))
+    if last_exp is not None:
+        # A report that passed through annotate_lit carries its lit list;
+        # rebuild the lit map from it (stats has no FaultConfig in hand).
+        registry.ingest_exposure(
+            last_exp, lit={n: True for n in last_exp.get("lit", [])}
+        )
     if last_agg is not None:
         registry.ingest_span_aggregates(last_agg)
 
@@ -1081,6 +1184,8 @@ def cmd_stats(args: argparse.Namespace) -> int:
             out["hist_saturation"] = hist_saturation(last_tel["hist"])
     if last_cov is not None:
         out["coverage"] = last_cov
+    if last_exp is not None:
+        out["exposure"] = last_exp
     if last_agg is not None:
         out["span_aggregates"] = last_agg
     print(json.dumps(out))
@@ -1335,6 +1440,7 @@ def cmd_trace(args: argparse.Namespace) -> int:
             cfg, ticks=args.ticks, chunk=args.chunk, engine=args.engine,
             depth=depth, max_lanes=args.lanes, recorder=recorder,
             coverage=_coverage_from_args(args),
+            exposure=_exposure_from_args(args),
         )
         write_chrome_trace(
             args.out, cap.spans, host=recorder,
@@ -1354,6 +1460,12 @@ def cmd_trace(args: argparse.Namespace) -> int:
             registry.ingest(cap.report["telemetry"])
         if "coverage" in cap.report:
             registry.ingest_coverage(cap.report["coverage"])
+        if "exposure" in cap.report:
+            from paxos_tpu.faults.injector import exposure_lit
+
+            registry.ingest_exposure(
+                cap.report["exposure"], lit=exposure_lit(cfg.fault)
+            )
         registry.ingest_span_aggregates(cap.aggregates)
         log.emit("spans", lanes=cap.lanes, aggregates=cap.aggregates)
         log.emit("metrics", **registry.snapshot())
@@ -1518,6 +1630,120 @@ def _cmd_coverage_exact(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_exposure(args: argparse.Namespace) -> int:
+    """Fault-exposure plane: run a campaign with the injected-vs-effective
+    counters on; print the per-class exposure matrix and the chunk-granular
+    attribution table (obs.exposure)."""
+    import dataclasses
+
+    import jax
+
+    from paxos_tpu.faults.injector import exposure_lit
+    from paxos_tpu.harness.metrics import MetricsLog, MetricsRegistry
+    from paxos_tpu.harness.run import (
+        init_plan, init_state, make_advance, make_longlog, summarize,
+    )
+    from paxos_tpu.obs.exposure import (
+        CLASSES, ExposureConfig, annotate_lit, attribution, effective_delta,
+    )
+
+    if args.engine == "fused" and jax.devices()[0].platform != "tpu":
+        print("error: --engine fused compiles Mosaic kernels (TPU only); "
+              "use --engine xla", file=sys.stderr)
+        return 1
+    kw = {"seed": args.seed}
+    if args.n_inst:
+        kw["n_inst"] = args.n_inst
+    cfg = CONFIGS[args.config](**kw)
+    try:
+        cfg = config_mod.apply_fault_overrides(cfg, args.fault)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    cfg = dataclasses.replace(cfg, exposure=ExposureConfig(counters=True))
+    cov_cfg = _coverage_from_args(args)
+    if cov_cfg is not None:
+        cfg = dataclasses.replace(cfg, coverage=cov_cfg)
+
+    registry = MetricsRegistry()
+    with MetricsLog(args.log) as log:
+        log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
+                 n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)
+        state, plan = init_state(cfg), init_plan(cfg)
+        advance = make_advance(
+            cfg, plan, args.engine, compact=bool(make_longlog(cfg))
+        )
+        # Serial per-chunk loop: each chunk's summarize yields the exposure
+        # deltas (and coverage new-bits / violation deltas) the attribution
+        # table joins on — the counters themselves only grow on-device.
+        chunks: list = []
+        prev_exp = None
+        prev_bits = 0
+        prev_viol = 0
+        done = 0
+        while done < args.ticks:
+            n = min(args.chunk, args.ticks - done)
+            state = advance(state, n)
+            done += n
+            rep = summarize(state, log_total=cfg.fault.log_total)
+            exp = rep["exposure"]
+            ch = {
+                "tick": done,
+                "effective_delta": effective_delta(prev_exp, exp),
+                "violations_delta": rep["violations"] - prev_viol,
+            }
+            if "coverage" in rep:
+                ch["new_bits"] = rep["coverage"]["bits_set"] - prev_bits
+                prev_bits = rep["coverage"]["bits_set"]
+            prev_exp, prev_viol = exp, rep["violations"]
+            chunks.append(ch)
+            registry.ingest_exposure(exp)
+            log.emit("chunk", ticks=done, exposure=exp)
+        final = summarize(state, log_total=cfg.fault.log_total)
+        matrix = annotate_lit(final["exposure"], cfg.fault)
+        registry.ingest_exposure(matrix, lit=exposure_lit(cfg.fault))
+        table = attribution(chunks)
+        out = {
+            "metric": "exposure",
+            "config": args.config,
+            "engine": args.engine,
+            "n_inst": cfg.n_inst,
+            "ticks": args.ticks,
+            "chunk": args.chunk,
+            "violations": final["violations"],
+            "exposure": matrix,
+            "attribution": table,
+            "config_fingerprint": cfg.fingerprint(),
+        }
+        if "coverage" in final:
+            out["coverage"] = final["coverage"]
+        log.emit("metrics", **registry.snapshot())
+        log.emit("final", **out)
+    if args.as_json:
+        print(json.dumps(out))
+    else:
+        lit = set(matrix["lit"])
+        print(f"# exposure matrix  config={args.config} "
+              f"n_inst={cfg.n_inst} ticks={args.ticks} engine={args.engine}")
+        print(f"{'class':<12}{'lit':>4}{'injected':>12}{'effective':>12}"
+              f"{'lanes_exposed':>15}")
+        for name in CLASSES:
+            row = matrix["classes"][name]
+            print(f"{name:<12}{'yes' if name in lit else 'no':>4}"
+                  f"{row['injected']:>12}{row['effective']:>12}"
+                  f"{row['lanes_exposed']:>15}")
+        print(f"# vacuous: {', '.join(matrix['vacuous']) or 'none'}")
+        print("# attribution (chunk-granular co-occurrence, not causality)")
+        print(f"{'class':<12}{'chunks_active':>14}{'effective':>12}"
+              f"{'new_bits':>10}{'violations':>12}")
+        for name in CLASSES:
+            row = table[name]
+            print(f"{name:<12}{row['chunks_active']:>14}"
+                  f"{row['effective']:>12}{row['new_bits']:>10}"
+                  f"{row['violations']:>12}")
+    return 0 if final["violations"] == 0 else 2
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.platform == "cpu":
@@ -1544,6 +1770,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_audit(args)
     if args.cmd == "coverage":
         return cmd_coverage(args)
+    if args.cmd == "exposure":
+        return cmd_exposure(args)
     return 1
 
 

@@ -17,6 +17,7 @@ import json
 from paxos_tpu.core.telemetry import TelemetryConfig
 from paxos_tpu.faults.injector import FaultConfig
 from paxos_tpu.obs.coverage import CoverageConfig
+from paxos_tpu.obs.exposure import ExposureConfig
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,6 +44,12 @@ class SimConfig:
     coverage: CoverageConfig = dataclasses.field(
         default_factory=CoverageConfig
     )
+    # Fault-exposure accounting (obs.exposure) — same default-off contract:
+    # the state's exposure leaf prunes to None and the counters draw no
+    # PRNG, so schedules are bit-identical (tests/test_exposure.py).
+    exposure: ExposureConfig = dataclasses.field(
+        default_factory=ExposureConfig
+    )
 
     def fingerprint(self) -> str:
         d = dataclasses.asdict(self)
@@ -55,6 +62,10 @@ class SimConfig:
         # default) drops out so pre-coverage fingerprints keep matching.
         if d["coverage"] == dataclasses.asdict(CoverageConfig()):
             del d["coverage"]
+        # Exposure too: disabled (the default) drops out so pre-exposure
+        # fingerprints keep matching.
+        if d["exposure"] == dataclasses.asdict(ExposureConfig()):
+            del d["exposure"]
         # The packed lane-state layout version (core/*_state.py) is part of
         # the on-device representation: a layout change invalidates every
         # checkpoint recorded under the old bit positions, so it must

@@ -164,6 +164,31 @@ class MetricsRegistry:
         if cov.get("est_states") is not None:
             self.gauge("coverage_est_states", cov["est_states"])
 
+    def ingest_exposure(
+        self, exp: dict[str, Any], lit: "Optional[dict[str, bool]]" = None
+    ) -> None:
+        """Fold one ``obs.exposure.exposure_host`` dict into the registry.
+
+        Exposure counters are cumulative on-device (the leaf only grows),
+        so per-class injected/effective/lanes_exposed land as gauges keyed
+        by a ``class`` label.  With ``lit`` (the ``faults.injector.
+        exposure_lit`` map) given, every LIT class also gets a
+        ``fault_vacuous{class=...}`` gauge — 1.0 when its effective count
+        is still zero, the "vacuous chaos" alert a scraper pages on.
+        """
+        for name, row in exp["classes"].items():
+            kw = {"class": name}
+            self.gauge("exposure_injected", row["injected"], **kw)
+            self.gauge("exposure_effective", row["effective"], **kw)
+            self.gauge("exposure_lanes_exposed", row["lanes_exposed"], **kw)
+        if lit:
+            for name, on in lit.items():
+                if on:
+                    vacuous = exp["classes"][name]["effective"] == 0
+                    self.gauge(
+                        "fault_vacuous", float(vacuous), **{"class": name}
+                    )
+
     def ingest_span_aggregates(self, agg: dict[str, Any]) -> None:
         """Fold ``obs.spans.span_aggregates`` output into gauges.
 

@@ -68,6 +68,10 @@ def init_state(cfg: SimConfig):
         state = state.replace(
             coverage=CoverageState.init(cfg.n_inst, cfg.coverage)
         )
+    if cfg.exposure.enabled():
+        from paxos_tpu.obs.exposure import FaultExposure
+
+        state = state.replace(exposure=FaultExposure.init(cfg.n_inst))
     return state
 
 
@@ -618,6 +622,10 @@ def summarize_device(
 
         dev["coverage"] = coverage_device(state.coverage)
         meta["coverage_words"] = int(state.coverage.bitmap.shape[0])
+    if getattr(state, "exposure", None) is not None:
+        from paxos_tpu.obs.exposure import exposure_device
+
+        dev["exposure"] = exposure_device(state.exposure)
     if liveness:
         from paxos_tpu.check.liveness import liveness_device
 
@@ -663,6 +671,10 @@ def summarize_host(host: dict, meta: dict) -> dict[str, Any]:
         out["coverage"] = coverage_host(
             host["coverage"], meta["coverage_words"]
         )
+    if "exposure" in host:
+        from paxos_tpu.obs.exposure import exposure_host
+
+        out["exposure"] = exposure_host(host["exposure"])
     if "liveness" in host:
         from paxos_tpu.check.liveness import liveness_host
 

@@ -65,6 +65,12 @@ class ShrinkResult:
     # attempt, open/close ticks, outcome, and fault annotations — the
     # causal reading of the raw timeline.
     spans: Optional[list] = None
+    # Victim-lane fault-exposure annotation (obs.exposure): per-class
+    # injected/effective counts over the repro plus, per surviving atom,
+    # whether its fault class actually touched the protocol — a kept atom
+    # with zero effective events earned its keep through schedule timing
+    # (occupying a PRNG draw), not through the fault itself.
+    exposure: Optional[dict] = None
 
     def to_json(self) -> dict[str, Any]:
         out = {
@@ -80,6 +86,8 @@ class ShrinkResult:
             out["timeline"] = self.timeline
         if self.spans is not None:
             out["spans"] = [s.to_json() for s in self.spans]
+        if self.exposure is not None:
+            out["exposure"] = self.exposure
         return out
 
 
@@ -338,7 +346,60 @@ def shrink(
 
     result.spans = build_spans(result.timeline, lane)
     say(f"spans: {len(result.spans)} ballot rounds reconstructed")
+    result.exposure = exposure_annotation(cfg, result)
+    eff = [a for a, e in result.exposure["atoms_effective"].items() if e]
+    say(f"exposure: {len(eff)}/{len(kept)} surviving atoms effective")
     return result
+
+
+# Surviving-atom base name -> the exposure classes its fault can light up
+# (obs.exposure.CLASSES).  crash/equiv atoms change state directly rather
+# than perturbing messages/timers, so the exposure plane does not track
+# them — they map to None in the annotation.
+ATOM_CLASSES = {
+    "partition": ("partition",),
+    "asym-partition": ("partition",),
+    "flaky": ("drop", "dup"),
+    "skew": ("timeout",),
+}
+
+
+def exposure_annotation(cfg: SimConfig, result: ShrinkResult) -> dict:
+    """Victim-lane injected-vs-effective counts for a minimized repro.
+
+    Re-runs the repro with the exposure counters on — ``obs.exposure``
+    draws no randomness, so the schedule is exactly the one the shrinker
+    minimized — and reads the victim lane's per-class counters plus a
+    per-surviving-atom effectiveness verdict (did the atom's fault class
+    produce ANY effective event in this lane?).
+    """
+    from paxos_tpu.obs.exposure import CLASSES, ExposureConfig
+
+    ecfg = dataclasses.replace(cfg, exposure=ExposureConfig(counters=True))
+    state = init_state(ecfg)
+    advance = make_advance(
+        ecfg, result.plan, result.engine, block=result.block,
+        compact=bool(make_longlog(ecfg)),
+    )
+    done = 0
+    while done < result.ticks:
+        n = min(result.chunk, result.ticks - done)
+        state = advance(state, n)
+        done += n
+    inj = jax.device_get(state.exposure.injected[:, result.lane])
+    eff = jax.device_get(state.exposure.effective[:, result.lane])
+    classes = {
+        name: {"injected": int(inj[c]), "effective": int(eff[c])}
+        for c, name in enumerate(CLASSES)
+    }
+    atoms: dict[str, Optional[bool]] = {}
+    for name in result.atoms:
+        mapped = ATOM_CLASSES.get(name.split("[", 1)[0])
+        atoms[name] = (
+            None if mapped is None
+            else any(classes[c]["effective"] > 0 for c in mapped)
+        )
+    return {"lane_classes": classes, "atoms_effective": atoms}
 
 
 def violation_timeline(cfg: SimConfig, result: ShrinkResult) -> list:

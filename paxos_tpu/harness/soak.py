@@ -97,6 +97,7 @@ def soak(
     plateau_seeds: int = 3,
     plateau_min_new: int = 1,
     plateau_stop: bool = False,
+    vacuous_seeds: int = 3,
 ) -> dict[str, Any]:
     """Run campaigns over rotating seeds until ``target_rounds`` accumulate.
 
@@ -177,6 +178,16 @@ def soak(
     states" signal.  With ``plateau_stop`` the loop ends at the plateau
     (like the corrupted-measurement path, an in-flight next campaign is
     discarded unfinalized); by default the plateau is report-only.
+
+    **Fault exposure (``cfg.exposure`` enabled):** each campaign's report
+    carries its per-class injected-vs-effective counters (``obs.exposure``)
+    and the tally sums them across seeds (``lanes_exposed`` becomes
+    lane-campaigns exposed — each seed's lanes are a fresh population).
+    A soaked-clean claim is only falsifiable against faults that actually
+    TOUCHED the protocol, so after ``vacuous_seeds`` finalized seeds any
+    lit fault knob whose cross-seed effective count is still zero raises a
+    loud VACUOUS CHAOS warning, and the report's ``exposure`` block always
+    lists ``lit``/``vacuous`` classes (``obs.exposure.annotate_lit``).
     """
     from paxos_tpu.harness.config import validate_pipeline_depth
     from paxos_tpu.obs.host_spans import ensure_recorder
@@ -222,6 +233,9 @@ def soak(
     cov_below = 0
     cov_plateau = False
     cov_stopped = False
+    # Cross-seed exposure sums (per-class injected/effective/lanes_exposed).
+    exp_classes: Optional[dict] = None
+    exp_vacuous_warned = False
     slots_total = 0
     rep_rates: list[float] = []  # slots replicated per lane-tick, per campaign
     retries_used = 0
@@ -358,6 +372,30 @@ def soak(
         seeds += 1
         say(f"seed {fscfg.seed}: {rounds:.3e} rounds, {violations} violations, "
             f"{report['stuck_lanes']} stuck")
+        exp = report.get("exposure")
+        if exp is not None:
+            from paxos_tpu.faults.injector import exposure_lit
+            from paxos_tpu.obs.exposure import CLASSES
+
+            if exp_classes is None:
+                exp_classes = {
+                    n: {"injected": 0, "effective": 0, "lanes_exposed": 0}
+                    for n in CLASSES
+                }
+            for n, row in exp["classes"].items():
+                for k in ("injected", "effective", "lanes_exposed"):
+                    exp_classes[n][k] += row[k]
+            if not exp_vacuous_warned and seeds >= vacuous_seeds:
+                vac = sorted(
+                    n for n, on in exposure_lit(cfg.fault).items()
+                    if on and exp_classes[n]["effective"] == 0
+                )
+                if vac:
+                    say(f"VACUOUS CHAOS: lit fault knobs {', '.join(vac)} "
+                        f"produced 0 effective events over {seeds} seeds — "
+                        "the soak is not exercising them; a clean tally "
+                        "says nothing about these classes")
+                    exp_vacuous_warned = True
         cov = report.get("coverage")
         if cov is not None:
             cov_last = cov
@@ -413,6 +451,15 @@ def soak(
             "plateau_min_new": plateau_min_new,
             "stopped_early": cov_stopped,
         }
+    if exp_classes is not None:
+        from paxos_tpu.obs.exposure import annotate_lit
+
+        # Cross-seed exposure sums, annotated with the config's lit knobs;
+        # the per-class shape matches exposure_host so
+        # MetricsRegistry.ingest_exposure folds this block directly.
+        replication["exposure"] = annotate_lit(
+            {"classes": exp_classes}, cfg.fault
+        )
     return replication | {
         "metric": "soak",
         "rounds": rounds,

@@ -82,6 +82,7 @@ def capture_round_trace(
     max_lanes: int = 8,
     recorder: Optional[HostSpanRecorder] = None,
     coverage=None,
+    exposure=None,
 ) -> CaptureResult:
     """Run ``cfg`` for ``ticks`` with full tracing; decode ``max_lanes`` lanes.
 
@@ -92,11 +93,14 @@ def capture_round_trace(
 
     ``coverage`` (an ``obs.coverage.CoverageConfig``) additionally samples
     the union coverage-bits count at every chunk boundary into a counter
-    series for the Perfetto timeline.  Sampling needs the state pytree at
-    each boundary, so the coverage-traced loop is the serial per-chunk
-    dispatcher (the sample itself is a scalar device_get, not a state
-    round-trip); a trace run is a debug tool, so the pipelined host track
-    is the price of the curve.
+    series for the Perfetto timeline; ``exposure`` (an
+    ``obs.exposure.ExposureConfig``) does the same for the per-class
+    effective fault counters — one counter track per fault class, so the
+    timeline shows WHEN each class started touching the protocol.
+    Sampling needs the state pytree at each boundary, so either sampler
+    forces the serial per-chunk dispatcher (the sample itself is a small
+    device_get, not a state round-trip); a trace run is a debug tool, so
+    the pipelined host track is the price of the curves.
     """
     from paxos_tpu.core.telemetry import decode_lane
     from paxos_tpu.harness.pipeline import pipelined_run
@@ -112,31 +116,52 @@ def capture_round_trace(
     sp = ensure_recorder(recorder)
     tcfg = recorder_config(cfg, ticks)
     sample_coverage = coverage is not None and coverage.enabled()
+    sample_exposure = exposure is not None and exposure.enabled()
     if sample_coverage:
         tcfg = dataclasses.replace(tcfg, coverage=coverage)
+    if sample_exposure:
+        tcfg = dataclasses.replace(tcfg, exposure=exposure)
     with sp.span("init", n_inst=tcfg.n_inst, protocol=tcfg.protocol):
         state = init_state(tcfg)
         plan = init_plan(tcfg)
     counters: Optional[dict[str, list]] = None
-    if sample_coverage:
-        from paxos_tpu.obs.coverage import coverage_device
+    if sample_coverage or sample_exposure:
+        if sample_coverage:
+            from paxos_tpu.obs.coverage import coverage_device
+        if sample_exposure:
+            from paxos_tpu.obs.exposure import CLASSES, exposure_device
 
         advance = make_advance(
             tcfg, plan, engine, compact=bool(make_longlog(tcfg))
         )
-        samples: list = []
+        cov_samples: list = []
+        exp_samples: dict[str, list] = (
+            {name: [] for name in CLASSES} if sample_exposure else {}
+        )
         done = 0
         while done < ticks:
             n = min(chunk, ticks - done)
             with sp.span("dispatch", tick_start=done, ticks=n, groups=1):
                 state = advance(state, n)
             done += n
-            with sp.span("coverage_sample", tick=done):
-                bits = int(jax.device_get(
-                    coverage_device(state.coverage)["union_bits"]
-                ))
-            samples.append((done, bits))
-        counters = {"coverage_bits_set": samples}
+            if sample_coverage:
+                with sp.span("coverage_sample", tick=done):
+                    bits = int(jax.device_get(
+                        coverage_device(state.coverage)["union_bits"]
+                    ))
+                cov_samples.append((done, bits))
+            if sample_exposure:
+                with sp.span("exposure_sample", tick=done):
+                    eff = jax.device_get(
+                        exposure_device(state.exposure)["effective"]
+                    )
+                for c, name in enumerate(CLASSES):
+                    exp_samples[name].append((done, int(eff[c])))
+        counters = {}
+        if sample_coverage:
+            counters["coverage_bits_set"] = cov_samples
+        for name, series in exp_samples.items():
+            counters[f"exposure_effective_{name}"] = series
     else:
         advance = make_advance_grouped(
             tcfg, plan, engine, compact=bool(make_longlog(tcfg))

@@ -1,0 +1,239 @@
+"""Fault-exposure accounting plane (default OFF, off is free).
+
+The fuzzer samples fault masks blind: a drop mask fires whether or not a
+message was in flight, a corruption mask fires whether or not anything read
+the corrupted payload.  A soak that reports "1e8 clean rounds under chaos"
+is therefore unfalsifiable until someone counts how many sampled faults
+actually *touched* the protocol.  This module makes that count a
+first-class observable: per-lane packed counters of faults **injected**
+(the mask fired) vs **effective** (the fault changed something a protocol
+participant did or saw), per fault class — the measured denominator behind
+any "soaked clean" claim and the prerequisite for feedback-directed fault
+scheduling.
+
+Class semantics (the injected / effective pair per class):
+
+- ``drop``       sampled drop decisions on send edges / live in-flight
+                 messages actually discarded by those decisions.
+- ``dup``        slots flagged for redelivery / flagged slots that held a
+                 message being consumed this tick (a duplicate actually
+                 re-enters flight).
+- ``corrupt``    corruption masks sampled / corruptions applied to a
+                 payload some acceptor read this tick.
+- ``partition``  link-directions cut this tick / in-flight messages the
+                 cut actually stalled this tick.
+- ``timeout``    proposer slots carrying a nonzero timer skew / slots
+                 whose expiry decision this tick DIFFERS from the
+                 unskewed timer's decision.
+- ``stale``      stale-snapshot restores taken (injected == effective:
+                 every restore rewrites durable state).
+
+The default-off-is-free contract (``core.telemetry`` / ``obs.coverage``
+are the templates):
+
+- :class:`FaultExposure` rides as an ``Optional`` leaf of every protocol
+  state; ``None`` when disabled (pruned from the pytree), all leaves int32
+  with a trailing ``instances`` axis, no scalar leaves — the fused Pallas
+  engine's generic passthrough codec (``utils/bitops``) carries it with
+  ZERO kernel changes, and ``pjit`` shards it with the rest of the state.
+- :func:`record` is pure int32 arithmetic over signals the tick already
+  produced: **no PRNG draws**, so enabling exposure cannot perturb a
+  schedule.  The static auditor holds the module to that
+  (``prng_audit.audit_exposure_parity`` on the "exposure" audit config).
+- Mosaic-clean: elementwise int32 ops and an iota-masked ``where`` instead
+  of scatter — the same op diet as telemetry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from paxos_tpu.core.telemetry import lane_count
+
+# Fault classes, in counter-row order.  The order is part of the on-device
+# layout (row c of the packed counters is CLASSES[c]) — append only.
+CLASSES = ("drop", "dup", "corrupt", "partition", "timeout", "stale")
+
+
+@dataclasses.dataclass(frozen=True)
+class ExposureConfig:
+    """Static exposure knob (frozen: rides ``SimConfig`` into jit).
+
+    ``counters=False`` — the default — disables the plane entirely (the
+    state leaf prunes to ``None``, zero bytes on device, bit-identical
+    schedules).
+    """
+
+    counters: bool = False
+
+    def enabled(self) -> bool:
+        return self.counters
+
+
+@struct.dataclass
+class FaultExposure:
+    """Per-lane packed fault-exposure counters (int32, instance-minor).
+
+    Row ``c`` of both arrays is fault class ``CLASSES[c]``; counts
+    accumulate per tick and reduce at the summarize boundary.  No scalar
+    leaves: the fused engine's packed-word passthrough requires every
+    observer leaf to carry the trailing instances axis.
+    """
+
+    injected: jnp.ndarray  # (C, I) int32 — sampled fault events per class
+    effective: jnp.ndarray  # (C, I) int32 — events that actually fired
+
+    @classmethod
+    def init(cls, n_inst: int) -> "FaultExposure":
+        shape = (len(CLASSES), n_inst)
+        return cls(
+            injected=jnp.zeros(shape, jnp.int32),
+            effective=jnp.zeros(shape, jnp.int32),
+        )
+
+
+def _accumulate(arr: jnp.ndarray, counts: dict) -> jnp.ndarray:
+    """Add per-class (I,) counts into their rows (iota-select, no scatter)."""
+    row = jax.lax.broadcasted_iota(jnp.int32, arr.shape, 0)
+    inc = jnp.zeros_like(arr)
+    for c, name in enumerate(CLASSES):
+        v = counts.get(name)
+        if v is None:
+            continue
+        v = lane_count(v)
+        inc = inc + jnp.where(row == c, v[None], 0)
+    return arr + inc
+
+
+def record(exp: FaultExposure, **classes) -> FaultExposure:
+    """Fold one tick's per-class ``(injected, effective)`` pairs into ``exp``.
+
+    Each keyword is a fault class name from :data:`CLASSES` mapped to a
+    2-tuple ``(injected, effective)``; each element is a bool event array
+    (any leading axes, trailing instances axis — reduced via
+    ``telemetry.lane_count``), an (I,) int32 count, or ``None`` for zero.
+    Omitted classes (knob off this config) add nothing, so a disabled
+    knob leaves zero extra work in the traced tick.
+    """
+    unknown = set(classes) - set(CLASSES)
+    if unknown:
+        raise ValueError(f"unknown exposure classes: {sorted(unknown)}")
+    inj = {k: v[0] for k, v in classes.items() if v is not None}
+    eff = {k: v[1] for k, v in classes.items() if v is not None}
+    return exp.replace(
+        injected=_accumulate(exp.injected, inj),
+        effective=_accumulate(exp.effective, eff),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Summarize-boundary reductions (harness/run.py merges these into the one
+# composite report pytree) and host formatting.
+
+
+def exposure_device(exp: FaultExposure) -> dict:
+    """Device half of the exposure report: reductions only, no transfer."""
+    return {
+        "injected": exp.injected.sum(axis=-1, dtype=jnp.int32),  # (C,)
+        "effective": exp.effective.sum(axis=-1, dtype=jnp.int32),  # (C,)
+        # Per class: how many lanes saw at least one effective fault — the
+        # breadth of the exposure, vs the totals' depth.
+        "lanes_exposed": (exp.effective > 0).astype(jnp.int32).sum(
+            axis=-1, dtype=jnp.int32
+        ),
+    }
+
+
+def exposure_host(host: dict) -> dict:
+    """Format a ``device_get``'d :func:`exposure_device` pytree."""
+    classes = {}
+    for c, name in enumerate(CLASSES):
+        classes[name] = {
+            "injected": int(host["injected"][c]),
+            "effective": int(host["effective"][c]),
+            "lanes_exposed": int(host["lanes_exposed"][c]),
+        }
+    return {"classes": classes}
+
+
+def exposure_report(exp: FaultExposure) -> dict:
+    """Host-readable exposure summary (one blocking transfer; tests/CLI)."""
+    return exposure_host(jax.device_get(exposure_device(exp)))
+
+
+def annotate_lit(report: dict, fcfg) -> dict:
+    """Join an exposure report with the config's lit fault knobs.
+
+    Adds ``lit`` (classes whose knob is on) and ``vacuous`` (lit classes
+    whose effective count is zero — "vacuous chaos": the knob burned
+    randomness without ever touching the protocol).  Separated from
+    :func:`exposure_host` because the summarize boundary sees only the
+    state pytree; callers that hold the :class:`FaultConfig` (CLI, soak)
+    apply the join.
+    """
+    from paxos_tpu.faults.injector import exposure_lit
+
+    lit = exposure_lit(fcfg)
+    out = dict(report)
+    out["lit"] = sorted(n for n, on in lit.items() if on)
+    out["vacuous"] = sorted(
+        n
+        for n, on in lit.items()
+        if on and report["classes"][n]["effective"] == 0
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Attribution: join per-chunk exposure deltas with the coverage plane and
+# the safety checker (host side; the `paxos_tpu exposure` subcommand and
+# soak build the chunk stream).
+
+
+def effective_delta(prev: Optional[dict], cur: dict) -> dict:
+    """Per-class effective-count delta between two exposure reports."""
+    out = {}
+    for name in CLASSES:
+        before = prev["classes"][name]["effective"] if prev else 0
+        out[name] = cur["classes"][name]["effective"] - before
+    return out
+
+
+def attribution(chunks: list) -> dict:
+    """Per-class attribution table over a campaign's chunk stream.
+
+    ``chunks`` is a list of per-chunk records, each carrying
+    ``effective_delta`` (per-class effective counts this chunk, from
+    :func:`effective_delta`), optional ``new_bits`` (coverage bits the
+    chunk newly set), and optional ``violations_delta``.  A chunk's
+    new_bits/violations are attributed to EVERY class effective in it —
+    chunk-granular co-occurrence, not causality; the table answers "which
+    fault classes were live while exploration/violations happened", which
+    is the honest claim chunk-boundary sampling can support.
+    """
+    table = {
+        name: {
+            "chunks_active": 0,
+            "effective": 0,
+            "new_bits": 0,
+            "violations": 0,
+        }
+        for name in CLASSES
+    }
+    for ch in chunks:
+        for name in CLASSES:
+            d = ch.get("effective_delta", {}).get(name, 0)
+            if d <= 0:
+                continue
+            row = table[name]
+            row["chunks_active"] += 1
+            row["effective"] += d
+            if ch.get("new_bits") is not None:
+                row["new_bits"] += ch["new_bits"]
+            row["violations"] += ch.get("violations_delta", 0)
+    return table

@@ -34,6 +34,7 @@ from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import streams as streams_mod
 from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.obs import coverage as cov_mod
+from paxos_tpu.obs import exposure as exp_mod
 from paxos_tpu.core.messages import ACCEPT, PREPARE
 from paxos_tpu.core.mp_state import (
     CANDIDATE,
@@ -467,6 +468,14 @@ def apply_tick_mp(
     )
     candidate_timer = jnp.where(prop.phase == CANDIDATE, prop.candidate_timer + 1, 0)
     cand_fail = (prop.phase == CANDIDATE) & (candidate_timer > timeout) & ~p1_done
+    # Exposure (obs.exposure): a skewed timeout is EFFECTIVE only where the
+    # candidacy-failure decision differs from the unskewed deadline's.
+    # Taken here, before `candidate_timer` is reset below.
+    exp_timeout_delta = None
+    if state.exposure is not None and cfg.timeout_skew > 0:
+        exp_timeout_delta = cand_fail ^ (
+            (prop.phase == CANDIDATE) & (candidate_timer > cfg.timeout) & ~p1_done
+        )
 
     # Stale leader demotes itself after a lease of no progress.
     demote = (prop.phase == LEAD) & lease_out & ~slot_done & ~log_full
@@ -544,10 +553,12 @@ def apply_tick_mp(
         candidate_timer=candidate_timer,
     )
 
-    # ---- Flight recorder (core.telemetry): PRNG-free, from signals the ----
-    # tick already produced, so enabling it cannot perturb the schedule.
+    # ---- Observers (core.telemetry / obs.exposure): PRNG-free, from ----
+    # signals the tick already produced, so enabling them cannot perturb
+    # the schedule.  The effective-drop count is shared.
     tel = state.telemetry
-    if tel is not None:
+    exp = state.exposure
+    if tel is not None or exp is not None:
         dropped = None
         if keep_prom is not None:
             edge = (n_prop, n_acc, n_inst)
@@ -559,6 +570,7 @@ def apply_tick_mp(
                     jnp.broadcast_to(is_lead[:, None], edge) & ~keep_acc
                 )
             )
+    if tel is not None:
         tel = tel_mod.record(
             tel,
             state.tick,
@@ -577,6 +589,41 @@ def apply_tick_mp(
             ),
             **tel_mod.fault_lane_events(plan, cfg, state.tick),
         )
+    if exp is not None:
+        # Injected-vs-effective per fault class (see obs.exposure).
+        events = {}
+        if keep_prom is not None:
+            events["drop"] = (
+                tel_mod.lane_count(~keep_prom)
+                + tel_mod.lane_count(~keep_accd)
+                + tel_mod.lane_count(~keep_prep)
+                + tel_mod.lane_count(~keep_acc),
+                dropped,
+            )
+        if dup_req is not None:
+            events["dup"] = (
+                tel_mod.lane_count(dup_req),
+                tel_mod.lane_count(sel & dup_req),
+            )
+        if cfg.p_corrupt > 0.0:
+            events["corrupt"] = (
+                masks.corrupt,
+                masks.corrupt & (is_prep | is_acc),
+            )
+        if link_req is not None:
+            # Effective: in-flight messages the cut actually stalled (the
+            # pre-tick present masks are the honest candidate set).
+            events["partition"] = (
+                tel_mod.lane_count(~link_req) + tel_mod.lane_count(~link_rep),
+                tel_mod.lane_count(state.requests.present & ~link_req[None])
+                + tel_mod.lane_count(state.promises.present & ~link_rep)
+                + tel_mod.lane_count(state.accepted.present & ~link_rep),
+            )
+        if exp_timeout_delta is not None:
+            events["timeout"] = (plan.ptimeout != 0, exp_timeout_delta)
+        if cfg.stale_k > 0:
+            events["stale"] = (rec, rec)
+        exp = exp_mod.record(exp, **events)
 
     state = state.replace(
         acceptor=acc,
@@ -587,6 +634,7 @@ def apply_tick_mp(
         accepted=accepted,
         tick=state.tick + 1,
         telemetry=tel,
+        exposure=exp,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built (includes `base`, so the same window at a

@@ -48,6 +48,7 @@ from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import streams as streams_mod
 from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.obs import coverage as cov_mod
+from paxos_tpu.obs import exposure as exp_mod
 from paxos_tpu.core.messages import ACCEPT, ACCEPTED, PREPARE, PROMISE
 from paxos_tpu.core.state import DONE, P1, P2, PaxosState
 from paxos_tpu.faults.injector import (
@@ -485,6 +486,14 @@ def apply_tick(
     expired = (
         (prop.phase != DONE) & ~p1_done & ~p2_done & (timer > timeout)
     )
+    # Exposure (obs.exposure): a skewed timeout is EFFECTIVE only where the
+    # expiry decision differs from the unskewed timer's.  Must be taken
+    # here, before `timer` is rebased below.
+    exp_timeout_delta = None
+    if state.exposure is not None and cfg.timeout_skew > 0:
+        exp_timeout_delta = expired ^ (
+            (prop.phase != DONE) & ~p1_done & ~p2_done & (timer > cfg.timeout)
+        )
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
     )
@@ -532,10 +541,12 @@ def apply_tick(
         decided_val=decided_val,
     )
 
-    # ---- Flight recorder (core.telemetry): PRNG-free, from signals the ----
-    # tick already produced, so enabling it cannot perturb the schedule.
+    # ---- Observers (core.telemetry / obs.exposure): PRNG-free, from ----
+    # signals the tick already produced, so enabling them cannot perturb
+    # the schedule.  The effective-drop/dup counts are shared.
     tel = state.telemetry
-    if tel is not None:
+    exp = state.exposure
+    if tel is not None or exp is not None:
         dropped = None
         if keep_prom is not None:
             dropped = (
@@ -549,6 +560,7 @@ def apply_tick(
             dups = tel_mod.lane_count(delivered & dup_rep) + tel_mod.lane_count(
                 sel & dup_req
             )
+    if tel is not None:
         tel = tel_mod.record(
             tel,
             state.tick,
@@ -567,6 +579,44 @@ def apply_tick(
             ),
             **tel_mod.fault_lane_events(plan, cfg, state.tick),
         )
+    if exp is not None:
+        # Injected-vs-effective per fault class.  Injected counts every
+        # sampled fault event; effective counts only events that changed
+        # something the protocol did or saw this tick.  Off knobs are
+        # omitted entirely (zero traced work).
+        events = {}
+        if keep_prom is not None:
+            events["drop"] = (
+                tel_mod.lane_count(~keep_prom)
+                + tel_mod.lane_count(~keep_accd)
+                + tel_mod.lane_count(~keep_p1)
+                + tel_mod.lane_count(~keep_p2),
+                dropped,
+            )
+        if dup_rep is not None:
+            events["dup"] = (
+                tel_mod.lane_count(dup_req) + tel_mod.lane_count(dup_rep),
+                dups,
+            )
+        if cfg.p_corrupt > 0.0:
+            events["corrupt"] = (
+                masks.corrupt,
+                masks.corrupt & (is_prep | is_acc),
+            )
+        if link_req is not None:
+            # Effective: in-flight messages the cut actually stalled (the
+            # pre-tick present masks are the honest candidate set).
+            events["partition"] = (
+                tel_mod.lane_count(~link_req) + tel_mod.lane_count(~link_rep),
+                tel_mod.lane_count(state.requests.present & ~link_req[None])
+                + tel_mod.lane_count(state.replies.present & ~link_rep[None]),
+            )
+        if exp_timeout_delta is not None:
+            events["timeout"] = (plan.ptimeout != 0, exp_timeout_delta)
+        if cfg.stale_k > 0:
+            # Every restore rewrites durable state: injected == effective.
+            events["stale"] = (rec, rec)
+        exp = exp_mod.record(exp, **events)
 
     state = state.replace(
         acceptor=acc,
@@ -576,6 +626,7 @@ def apply_tick(
         replies=replies,
         tick=state.tick + 1,
         telemetry=tel,
+        exposure=exp,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built, so host-side digests of returned states

@@ -41,6 +41,7 @@ from paxos_tpu.check.safety import learner_observe, raft_voter_invariants
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.obs import coverage as cov_mod
+from paxos_tpu.obs import exposure as exp_mod
 from paxos_tpu.core.raft_state import (
     ACK,
     APPEND,
@@ -240,6 +241,14 @@ def apply_tick_raft(
     expired = (
         (cand.phase != DONE) & ~elected & ~committed & (timer > timeout)
     )
+    # Exposure (obs.exposure): a skewed timeout is EFFECTIVE only where the
+    # expiry decision differs from the unskewed timer's.  Must be taken
+    # here, before `timer` is rebased below.
+    exp_timeout_delta = None
+    if state.exposure is not None and cfg.timeout_skew > 0:
+        exp_timeout_delta = expired ^ (
+            (cand.phase != DONE) & ~elected & ~committed & (timer > cfg.timeout)
+        )
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
     )
@@ -291,12 +300,14 @@ def apply_tick_raft(
         decided_val=decided_val,
     )
 
-    # ---- Flight recorder (core.telemetry): PRNG-free, from signals the ----
-    # tick already produced, so enabling it cannot perturb the schedule.
-    # Raft mapping: grants -> promise, append acks -> accept, elections ->
-    # leader (matching the mask-role mapping in the docstring).
+    # ---- Observers (core.telemetry / obs.exposure): PRNG-free, from ----
+    # signals the tick already produced, so enabling them cannot perturb
+    # the schedule.  Raft mapping: grants -> promise, append acks ->
+    # accept, elections -> leader (matching the mask-role mapping in the
+    # docstring).  The effective-drop/dup counts are shared.
     tel = state.telemetry
-    if tel is not None:
+    exp = state.exposure
+    if tel is not None or exp is not None:
         dropped = None
         if keep_prom is not None:
             dropped = (
@@ -310,6 +321,7 @@ def apply_tick_raft(
             dups = tel_mod.lane_count(delivered & dup_rep) + tel_mod.lane_count(
                 sel & dup_req
             )
+    if tel is not None:
         tel = tel_mod.record(
             tel,
             state.tick,
@@ -328,6 +340,40 @@ def apply_tick_raft(
             ),
             **tel_mod.fault_lane_events(plan, cfg, state.tick),
         )
+    if exp is not None:
+        # Injected-vs-effective per fault class (see obs.exposure).
+        events = {}
+        if keep_prom is not None:
+            events["drop"] = (
+                tel_mod.lane_count(~keep_prom)
+                + tel_mod.lane_count(~keep_accd)
+                + tel_mod.lane_count(~keep_p1)
+                + tel_mod.lane_count(~keep_p2),
+                dropped,
+            )
+        if dup_rep is not None:
+            events["dup"] = (
+                tel_mod.lane_count(dup_req) + tel_mod.lane_count(dup_rep),
+                dups,
+            )
+        if cfg.p_corrupt > 0.0:
+            events["corrupt"] = (
+                masks.corrupt,
+                masks.corrupt & (is_rv | is_ap),
+            )
+        if link_req is not None:
+            # Effective: in-flight messages the cut actually stalled (the
+            # pre-tick present masks are the honest candidate set).
+            events["partition"] = (
+                tel_mod.lane_count(~link_req) + tel_mod.lane_count(~link_rep),
+                tel_mod.lane_count(state.requests.present & ~link_req[None])
+                + tel_mod.lane_count(state.replies.present & ~link_rep[None]),
+            )
+        if exp_timeout_delta is not None:
+            events["timeout"] = (plan.ptimeout != 0, exp_timeout_delta)
+        if cfg.stale_k > 0:
+            events["stale"] = (rec, rec)
+        exp = exp_mod.record(exp, **events)
 
     state = state.replace(
         acceptor=voter,
@@ -337,6 +383,7 @@ def apply_tick_raft(
         replies=replies,
         tick=state.tick + 1,
         telemetry=tel,
+        exposure=exp,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built.  PRNG-free, like telemetry.

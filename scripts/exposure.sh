@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Fault-exposure wrapper: injected-vs-effective fault accounting over one
+# campaign — the exposure matrix (per class: injected, effective,
+# lanes_exposed, lit/vacuous) plus the chunk-granular attribution table
+# (which classes were live while coverage/violations moved).  One report
+# on stdout (--json for machines); exits 2 on safety violations.
+#
+# Usage: scripts/exposure.sh [paxos_tpu exposure flags...]
+#   scripts/exposure.sh --config gray-chaos --n-inst 4096 --ticks 256
+#   scripts/exposure.sh --config corrupt --coverage --json
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m paxos_tpu exposure "$@"

@@ -87,6 +87,39 @@ assert curve[-1] == out["coverage"]["bits_set"], "curve/report mismatch"
 assert out["coverage"]["bits_total"] == 8 * 32
 EOF
 fi
+# Exposure smoke: a short gray-chaos campaign through the `exposure`
+# subcommand must account its faults honestly — every LIT class (drop,
+# dup, partition, timeout under gray-chaos) shows a nonzero effective
+# count, every unlit class (corrupt, stale) shows exactly zero, and
+# effective never exceeds injected (the injected-vs-effective plane's
+# end-to-end acceptance, kept cheap).
+if [ "$rc" -eq 0 ]; then
+  e=/tmp/_t1_exposure.json; rm -f "$e"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxos_tpu exposure \
+    --config gray-chaos --n-inst 1024 --ticks 128 --chunk 32 --json \
+    >"$e" 2>/dev/null
+  erc=$?
+  if [ "$erc" -eq 0 ] || [ "$erc" -eq 2 ]; then  # 2 = violations, still a report
+    timeout -k 10 30 env JAX_PLATFORMS=cpu python - "$e" <<'EOF' \
+    && echo EXPOSURE_SMOKE=ok || { echo EXPOSURE_SMOKE=FAILED; rc=1; }
+import json, sys
+out = json.load(open(sys.argv[1]))
+classes = out["exposure"]["classes"]
+lit, vacuous = out["exposure"]["lit"], out["exposure"]["vacuous"]
+assert lit == ["drop", "dup", "partition", "timeout"], lit
+assert vacuous == [], f"vacuous chaos in the smoke config: {vacuous}"
+for name, row in classes.items():
+    assert 0 <= row["effective"] <= row["injected"], (name, row)
+    if name in lit:
+        assert row["effective"] > 0, (name, row)
+    else:
+        assert row["injected"] == 0 == row["effective"], (name, row)
+assert set(out["attribution"]) == set(classes)
+EOF
+  else
+    echo EXPOSURE_SMOKE=FAILED; rc=1
+  fi
+fi
 # Packed-state smoke: the fused engine now carries lane state bit-packed
 # through VMEM (utils/bitops layout tables); this replays one config per
 # protocol through the packed fused kernel (interpret) AND the unpacked

@@ -1,0 +1,472 @@
+"""Fault-exposure accounting (PR 9): off is free, on is neutral and honest.
+
+Four contracts guard the exposure plane:
+
+1. **Default-off is free**: with exposure disabled (the default) the state's
+   ``exposure`` leaf is ``None`` (pruned from the pytree), schedules are
+   BIT-IDENTICAL to the PR-6 golden digests (tests/test_gray.py, re-pinned
+   here), and the default config fingerprint is unchanged so recorded
+   artifacts keep matching.
+2. **On is outcome-neutral**: the counters draw NO randomness — they count
+   signals the tick already produced — so enabling them leaves the protocol
+   schedule bit-identical on BOTH engines, and the fused Pallas kernel
+   carries the counter arrays bit-exact vs its XLA reference via the
+   generic packed-word passthrough.
+3. **The counts are honest (the oracle)**: over a corrupt-fault campaign
+   the device leaf's injected/effective corruption totals equal an
+   independent host-side replay — jax-sampled masks plus a pure-numpy
+   reimplementation of ``select_from_scores`` — exactly, on both engines'
+   schedules, for all four protocols.
+4. **The plumbing round-trips**: checkpoints restore the exposure config
+   and counters bit-exact (pre-exposure snapshots default off), run
+   reports embed the per-class block, and the metrics registry exports
+   deterministically ordered gauges with the vacuous-chaos alert.
+"""
+
+import copy
+import dataclasses
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paxos_tpu.faults.injector import exposure_lit
+from paxos_tpu.harness import checkpoint
+from paxos_tpu.harness import config as C
+from paxos_tpu.harness.metrics import MetricsRegistry
+from paxos_tpu.harness.run import (
+    base_key,
+    get_step_fn,
+    init_plan,
+    init_state,
+    run,
+    run_chunk,
+)
+from paxos_tpu.obs import exposure as expo
+
+EXP = expo.ExposureConfig(counters=True)
+
+
+def _digest(state) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        h.update(jax.device_get(leaf).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _xla_final(cfg, n_ticks=32):
+    return run_chunk(
+        init_state(cfg), base_key(cfg), init_plan(cfg), cfg.fault, n_ticks,
+        get_step_fn(cfg.protocol),
+    )
+
+
+def _ctr_final(cfg, n_ticks=32):
+    from paxos_tpu.kernels.fused_tick import fused_fns, reference_chunk
+
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    return reference_chunk(
+        init_state(cfg), cfg.seed, init_plan(cfg), cfg.fault, n_ticks,
+        apply_fn=apply_fn, mask_fn=mask_fn, blk_id=0,
+    )
+
+
+# The PR-6 goldens (tests/test_gray.py, n_inst=256, seed=7, 32 ticks, CPU):
+# exposure-off must reproduce them, and exposure-ON minus the counter leaf
+# must reproduce them too (schedule unperturbed on both engines).
+_GOLDEN_XLA = {
+    "config2": (lambda: C.config2_dueling_drop(256, 7), "83347bc41b16a2aa"),
+    "config3": (lambda: C.config3_multipaxos(256, 7), "93a2dd9d7b8d66e4"),
+    "fastpaxos": (lambda: C.config5_sweep(256, 7)[1], "c43658973b29e73e"),
+    "raftcore": (lambda: C.config5_sweep(256, 7)[2], "4662db6b2c5a39d3"),
+}
+_GOLDEN_CTR = {
+    "config2": (lambda: C.config2_dueling_drop(256, 7), "db6db6f40f16eb7b"),
+    "config3": (lambda: C.config3_multipaxos(256, 7), "4b6525460815d9c5"),
+    "fastpaxos": (lambda: C.config5_sweep(256, 7)[1], "72beea3ccdacab94"),
+    "raftcore": (lambda: C.config5_sweep(256, 7)[2], "eb285905571b709f"),
+}
+
+_FAST_XLA = ("config2",)
+_FAST_CTR = ("config2",)
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        n if n in _FAST_XLA else pytest.param(n, marks=pytest.mark.slow)
+        for n in sorted(_GOLDEN_XLA)
+    ],
+)
+def test_exposure_on_schedule_identical_xla(name):
+    mk, want = _GOLDEN_XLA[name]
+    assert _digest(_xla_final(mk())) == want  # off == PR-6 golden
+    fin = _xla_final(dataclasses.replace(mk(), exposure=EXP))
+    assert fin.exposure is not None
+    # Every golden config has p_drop > 0, so the drop arm must count.
+    rep = expo.exposure_report(fin.exposure)
+    assert rep["classes"]["drop"]["injected"] > 0
+    assert _digest(fin.replace(exposure=None)) == want  # on == same schedule
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        n if n in _FAST_CTR else pytest.param(n, marks=pytest.mark.slow)
+        for n in sorted(_GOLDEN_CTR)
+    ],
+)
+def test_exposure_on_schedule_identical_counter_stream(name):
+    mk, want = _GOLDEN_CTR[name]
+    assert _digest(_ctr_final(mk())) == want
+    fin = _ctr_final(dataclasses.replace(mk(), exposure=EXP))
+    assert _digest(fin.replace(exposure=None)) == want
+
+
+def test_default_off_prunes_to_none():
+    """Disabled exposure leaves NO trace in the pytree or fingerprint."""
+    for mk in (C.config1_no_faults, C.config3_multipaxos):
+        cfg = mk(64, 0)
+        state = init_state(cfg)
+        assert state.exposure is None
+        assert not cfg.exposure.enabled()
+        on = init_state(dataclasses.replace(cfg, exposure=EXP))
+        off_n = len(jax.tree_util.tree_leaves(state))
+        on_n = len(jax.tree_util.tree_leaves(on))
+        assert on_n == off_n + 2  # injected + effective
+        # All counter leaves are non-scalar int32, instance-minor — the
+        # fused engine's generic flattening rides them with no kernel edits.
+        for leaf in jax.tree_util.tree_leaves(on.exposure):
+            assert leaf.dtype == jnp.int32
+            assert leaf.shape == (len(expo.CLASSES), 64)
+
+
+def test_fingerprint_unchanged_by_default_exposure():
+    """The default (off) ExposureConfig is dropped from the fingerprint, so
+    pre-exposure artifacts keep matching; a non-default one IS keyed."""
+    cfg = C.config2_dueling_drop(1 << 10)
+    assert (
+        dataclasses.replace(
+            cfg, exposure=expo.ExposureConfig()
+        ).fingerprint()
+        == cfg.fingerprint()
+    )
+    assert (
+        dataclasses.replace(cfg, exposure=EXP).fingerprint()
+        != cfg.fingerprint()
+    )
+
+
+def test_record_accumulates_rows():
+    exp = expo.FaultExposure.init(4)
+    exp = expo.record(
+        exp,
+        drop=(
+            jnp.array([1, 0, 2, 0], jnp.int32),
+            jnp.array([1, 0, 0, 0], jnp.int32),
+        ),
+        # Bool event arrays with leading axes reduce via lane_count.
+        dup=(jnp.ones((2, 4), jnp.bool_), None),
+        stale=(None, None),
+    )
+    rep = expo.exposure_report(exp)
+    assert rep["classes"]["drop"] == {
+        "injected": 3, "effective": 1, "lanes_exposed": 1,
+    }
+    assert rep["classes"]["dup"] == {
+        "injected": 8, "effective": 0, "lanes_exposed": 0,
+    }
+    assert rep["classes"]["stale"]["injected"] == 0
+    with pytest.raises(ValueError):
+        expo.record(exp, frobnicate=(None, None))
+
+
+def test_annotate_lit_gray_chaos():
+    fcfg = C.config_gray_chaos().fault
+    assert sorted(n for n, on in exposure_lit(fcfg).items() if on) == [
+        "drop", "dup", "partition", "timeout",
+    ]
+    zero = {
+        "classes": {
+            n: {"injected": 0, "effective": 0, "lanes_exposed": 0}
+            for n in expo.CLASSES
+        }
+    }
+    out = expo.annotate_lit(zero, fcfg)
+    assert out["lit"] == ["drop", "dup", "partition", "timeout"]
+    assert out["vacuous"] == out["lit"]  # all-zero report: every lit knob
+    # config_corrupt lights drop AND corrupt (p_drop=0.1, p_corrupt=0.2).
+    lit_c = exposure_lit(C.config_corrupt().fault)
+    assert lit_c["corrupt"] and lit_c["drop"]
+    assert not lit_c["stale"] and not lit_c["partition"]
+
+
+def test_effective_delta_and_attribution():
+    zero = {
+        "classes": {
+            n: {"injected": 0, "effective": 0, "lanes_exposed": 0}
+            for n in expo.CLASSES
+        }
+    }
+    cur = copy.deepcopy(zero)
+    cur["classes"]["drop"]["effective"] = 5
+    cur["classes"]["corrupt"]["effective"] = 2
+    d = expo.effective_delta(zero, cur)
+    assert d["drop"] == 5 and d["corrupt"] == 2 and d["timeout"] == 0
+    assert expo.effective_delta(None, cur) == d
+    chunks = [
+        {"effective_delta": d, "new_bits": 3, "violations_delta": 1},
+        {"effective_delta": {"drop": 1}, "new_bits": 2},
+        {"effective_delta": {"timeout": 0}},  # zero delta: not active
+    ]
+    table = expo.attribution(chunks)
+    assert table["drop"] == {
+        "chunks_active": 2, "effective": 6, "new_bits": 5, "violations": 1,
+    }
+    assert table["corrupt"] == {
+        "chunks_active": 1, "effective": 2, "new_bits": 3, "violations": 1,
+    }
+    assert table["timeout"]["chunks_active"] == 0
+
+
+def test_run_report_embeds_exposure():
+    cfg = dataclasses.replace(C.config2_dueling_drop(64, 0), exposure=EXP)
+    rep = run(cfg, total_ticks=32, chunk=16)
+    classes = rep["exposure"]["classes"]
+    assert classes["drop"]["injected"] > 0
+    assert classes["drop"]["effective"] <= classes["drop"]["injected"]
+    assert classes["corrupt"]["injected"] == 0  # knob off: arm never traced
+    # And with the default config the report has NO exposure block.
+    rep_off = run(C.config2_dueling_drop(64, 0), total_ticks=16, chunk=8)
+    assert "exposure" not in rep_off
+
+
+# ---------------------------------------------------------------------------
+# The oracle: device injected/effective corruption totals == an independent
+# host-side replay (jax-sampled masks + a pure-numpy reimplementation of
+# transport.select_from_scores), exactly, on both engines' schedules.
+
+_ORACLE_TICKS = 256
+
+
+def _np_select(present, score_bits, busy):
+    """Numpy mirror of ``transport.inmemory_tpu.select_from_scores``."""
+    k, p, a, i = present.shape
+    nbits = max((k * p - 1).bit_length(), 1)
+    sid = (
+        np.arange(k, dtype=np.int32).reshape(k, 1, 1, 1) * p
+        + np.arange(p, dtype=np.int32).reshape(1, p, 1, 1)
+    )
+    score = (score_bits.astype(np.int32) & np.int32(~((1 << nbits) - 1))) | sid
+    neg_inf = np.iinfo(np.int32).min
+    score = np.where(present, score, neg_inf)
+    fiber_max = score.max(axis=(0, 1), keepdims=True)
+    sel = present & (score == fiber_max) & (fiber_max > neg_inf)
+    if busy is not None:
+        sel = sel & busy
+    return sel
+
+
+def _corrupt_cfg(protocol):
+    return dataclasses.replace(
+        C.config_corrupt(128, 11), protocol=protocol, exposure=EXP
+    )
+
+
+@pytest.mark.parametrize(
+    "engine,protocol",
+    [
+        ("xla", "paxos"),
+        ("ctr", "paxos"),
+        pytest.param("xla", "multipaxos", marks=pytest.mark.slow),
+        pytest.param("xla", "fastpaxos", marks=pytest.mark.slow),
+        pytest.param("xla", "raftcore", marks=pytest.mark.slow),
+        pytest.param("ctr", "multipaxos", marks=pytest.mark.slow),
+        pytest.param("ctr", "fastpaxos", marks=pytest.mark.slow),
+        pytest.param("ctr", "raftcore", marks=pytest.mark.slow),
+    ],
+)
+def test_injected_vs_effective_oracle(engine, protocol):
+    """Effective corruption = mask & "some acceptor selected a message":
+    replaying the campaign tick by tick and recomputing the selection with
+    an independent numpy mirror must reproduce the device leaf EXACTLY."""
+    from paxos_tpu.core import streams as streams_mod
+
+    cfg = _corrupt_cfg(protocol)
+    plan = init_plan(cfg)
+    state = init_state(cfg)
+    if protocol == "multipaxos":
+        from paxos_tpu.protocols.multipaxos import sample_mp_masks as sampler
+    else:
+        from paxos_tpu.protocols.paxos import sample_masks as sampler
+
+    if engine == "xla":
+        key = base_key(cfg)
+        step = get_step_fn(cfg.protocol)
+
+        def masks_at(t, st):
+            return sampler(
+                streams_mod.tick_key(key, jnp.int32(t)), cfg.fault,
+                cfg.n_prop, cfg.n_acc, cfg.n_inst,
+            )
+
+        def advance(st):
+            return run_chunk(st, key, plan, cfg.fault, 1, step)
+    else:  # the fused engine's schedule via its bit-exact XLA reference
+        from paxos_tpu.kernels.counter_prng import mix
+        from paxos_tpu.kernels.fused_tick import fused_fns, reference_chunk
+
+        apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+        seed = jnp.int32(cfg.seed)
+
+        # Jit the per-tick stepper and sampler ONCE — re-tracing
+        # reference_chunk 256 times costs minutes; the compiled ticks are
+        # bit-identical to the untraced ones.
+        @jax.jit
+        def _masks(t, st):
+            return mask_fn(cfg.fault, mix(seed, t, jnp.int32(0)), st)
+
+        @jax.jit
+        def advance(st):
+            return reference_chunk(
+                st, seed, plan, cfg.fault, 1,
+                apply_fn=apply_fn, mask_fn=mask_fn,
+            )
+
+        def masks_at(t, st):
+            return _masks(jnp.int32(t), st)
+
+    host_inj = host_eff = 0
+    for t in range(_ORACLE_TICKS):
+        present = np.asarray(jax.device_get(state.requests.present))
+        m = masks_at(t, state)
+        corrupt = np.asarray(jax.device_get(m.corrupt))
+        sel = _np_select(
+            present,
+            np.asarray(jax.device_get(m.sel_score)),
+            np.asarray(jax.device_get(m.busy)),
+        )
+        # config_corrupt has no crash/partition knobs, but apply the plan's
+        # alive mask anyway — the mirror must track the protocol, not the
+        # config we happen to test with.
+        sel = sel & np.asarray(jax.device_get(plan.alive(jnp.int32(t))))[
+            None, None
+        ]
+        eff = corrupt & sel.any(axis=(0, 1))
+        host_inj += int(corrupt.sum())
+        host_eff += int(eff.sum())
+        state = advance(state)
+
+    row = expo.exposure_report(state.exposure)["classes"]["corrupt"]
+    assert row["injected"] == host_inj
+    assert row["effective"] == host_eff
+    assert 0 < host_eff <= host_inj
+
+
+@pytest.mark.parametrize(
+    "protocol",
+    [
+        "paxos",
+        pytest.param("multipaxos", marks=pytest.mark.slow),
+        pytest.param("fastpaxos", marks=pytest.mark.slow),
+        pytest.param("raftcore", marks=pytest.mark.slow),
+    ],
+)
+def test_fused_kernel_carries_exposure_bitexact(protocol):
+    """fused_chunk(interpret) == reference_chunk with the counters ON: the
+    packed-word passthrough codec must round-trip them bit-exactly."""
+    from paxos_tpu.kernels.fused_tick import (
+        FUSED_CHUNKS,
+        fused_fns,
+        reference_chunk,
+    )
+    from paxos_tpu.utils.trees import tree_mismatches
+
+    cfg = dataclasses.replace(
+        C.config_corrupt(64, 7), protocol=protocol, exposure=EXP
+    )
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    plan = init_plan(cfg)
+    sr = reference_chunk(
+        init_state(cfg), jnp.int32(cfg.seed), plan, cfg.fault, 24,
+        apply_fn=apply_fn, mask_fn=mask_fn,
+    )
+    sp = FUSED_CHUNKS[cfg.protocol](
+        init_state(cfg), jnp.int32(cfg.seed), plan, cfg.fault, 24,
+        block=64, interpret=True,
+    )
+    assert tree_mismatches(sp, sr) == []
+    rep = expo.exposure_report(sp.exposure)
+    assert rep["classes"]["corrupt"]["injected"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint round-trip (satellite 1) and metrics determinism (satellite 2).
+
+
+def test_checkpoint_roundtrip_with_exposure(tmp_path):
+    """Save/restore rebuilds the exposure config AND the counter arrays, so
+    a resumed campaign's exposure totals are bit-identical."""
+    cfg = dataclasses.replace(C.config2_dueling_drop(64, 3), exposure=EXP)
+    step = get_step_fn(cfg.protocol)
+    key, plan = base_key(cfg), init_plan(cfg)
+    state = run_chunk(init_state(cfg), key, plan, cfg.fault, 16, step)
+    checkpoint.save(tmp_path / "ck", state, plan, cfg, engine="xla")
+    st2, pl2, cfg2 = checkpoint.restore(tmp_path / "ck", engine="xla")
+    assert cfg2.exposure == EXP
+    assert st2.exposure is not None
+    fin_a = run_chunk(state, key, plan, cfg.fault, 16, step)
+    fin_b = run_chunk(st2, base_key(cfg2), pl2, cfg2.fault, 16, step)
+    assert _digest(fin_a) == _digest(fin_b)  # exposure leaves included
+
+
+def test_checkpoint_restore_pre_exposure_snapshot(tmp_path):
+    """Snapshots written before the exposure plane (no key in the JSON)
+    restore with the default-off config and a pruned leaf."""
+    cfg = C.config2_dueling_drop(64, 3)
+    checkpoint.save(tmp_path / "ck", init_state(cfg), init_plan(cfg), cfg)
+    meta_path = tmp_path / "ck" / "simconfig.json"
+    raw = json.loads(meta_path.read_text())
+    raw.pop("exposure")
+    meta_path.write_text(json.dumps(raw))
+    st2, _, cfg2 = checkpoint.restore(tmp_path / "ck")
+    assert cfg2.exposure == expo.ExposureConfig()
+    assert st2.exposure is None
+
+
+def test_exposure_metrics_sorted_and_pinned():
+    """Registry exports are deterministically ordered regardless of ingest
+    order, and lit-but-zero classes raise the vacuous-chaos gauge."""
+    rep = {
+        "classes": {
+            n: {
+                "injected": 10 * (i + 1),
+                "effective": 0 if n == "timeout" else i + 1,
+                "lanes_exposed": i,
+            }
+            for i, n in enumerate(expo.CLASSES)
+        }
+    }
+    lit = {"drop": True, "timeout": True, "corrupt": False}
+    reg = MetricsRegistry()
+    reg.ingest_exposure(rep, lit=lit)
+    gauges = reg.snapshot()["gauges"]
+    keys = list(gauges)
+    assert keys == sorted(keys)  # the JSONL/stats ordering pin
+    assert gauges["exposure_injected{class=drop}"] == 10
+    assert gauges["fault_vacuous{class=timeout}"] == 1.0
+    assert gauges["fault_vacuous{class=drop}"] == 0.0
+    assert "fault_vacuous{class=corrupt}" not in gauges  # unlit: no alert
+    prom = reg.to_prometheus()
+    assert 'paxos_tpu_fault_vacuous{class="timeout"} 1' in prom
+    # Reversed-order ingest must serialize identically (sorted everywhere).
+    rep2 = {"classes": dict(reversed(list(rep["classes"].items())))}
+    reg2 = MetricsRegistry()
+    reg2.ingest_exposure(rep2, lit=dict(reversed(list(lit.items()))))
+    assert json.dumps(reg2.snapshot(), sort_keys=False) == json.dumps(
+        reg.snapshot(), sort_keys=False
+    )
+    assert reg2.to_prometheus() == prom
