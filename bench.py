@@ -6,9 +6,17 @@ config 2, fused engine on TPU.
 
 ``--sweep``: one JSON line per (protocol x engine) case — the full measured
 table of BASELINE.md, reproducible in one command.  ``--record PATH``
-additionally writes the sweep to a JSON artifact (list of case dicts);
+additionally writes the rows to a JSON artifact (list of case dicts);
 ``tests/test_perf_regression.py`` gates future rounds against that artifact
-(each case must stay >= 0.7x its recorded value on TPU).
+(each case must stay >= 0.7x its recorded value on TPU), and
+``paxos_tpu bench-compare`` diffs any fresh ``--record`` file against it
+with a noise-aware tolerance (exit 2 on regression).
+
+Provenance: every row follows ``obs.perf.BENCH_ROW_SCHEMA`` — per-run
+samples (not just a mean), median/min/stdev, explicit warm-up vs measured
+group counts, config fingerprint, engine, platform, packed-layout version,
+and the host-span perf summary (occupancy, chunk-latency percentiles,
+compile vs steady-state split).
 
 Metric definition (BASELINE.md): quorum-rounds/sec/chip — each scheduler
 tick advances every instance's consensus state machine by one protocol
@@ -20,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import statistics
 import sys
 import time
 
@@ -125,20 +134,34 @@ def _configs(platform: str):
 
 def bench_case(
     cfg, engine: str, chunk: int = 64, timed_chunks: int = 4,
-    repeats: int = 3, pipeline_depth: int = 1,
+    repeats: int = 3, pipeline_depth: int = 1, warmup_groups: int = 1,
+    profile_dir: "str | None" = None,
 ) -> dict:
     """Measure one (config, engine) case; returns the result dict.
 
-    ``repeats`` timed groups of ``timed_chunks`` chunks each are measured
-    after one warmup group; ``value`` is the BEST group's throughput (the
-    standard min-time discipline — noise on a shared tunnel only ever
-    slows a run down) and ``throughput_runs`` records every group so a
-    reader can judge the spread.
+    ``warmup_groups`` full groups run first — identical in shape to the
+    timed groups (compile lands in the first one, cache warming in the
+    rest) and recorded as ``warmup_runs`` so the steady-state bias is
+    *visible* in the row instead of silently folded into the first timed
+    sample.  Then ``repeats`` timed groups of ``timed_chunks`` chunks each
+    are measured; ``value`` is the BEST group's throughput (the standard
+    min-time discipline — noise on a shared tunnel only ever slows a run
+    down) and ``samples`` records every group so a reader can judge the
+    spread (``median``/``min``/``stdev`` summarize it for the
+    ``bench-compare`` noise model).
 
     ``pipeline_depth`` groups that many chunk bodies per device dispatch
     (harness.pipeline) — same ticks, same schedule, 1/depth the dispatch
     count — and must divide ``timed_chunks`` so every timed group is a
     whole number of dispatches.
+
+    ``profile_dir`` wraps the measured region in ``jax.profiler.trace``
+    (XLA op/memory timelines, viewable in TensorBoard/Perfetto); the path
+    is recorded in the row so the trace links back to its provenance.
+
+    Every dispatch is also wrapped in a ``HostSpanRecorder`` span, and the
+    row carries the derived ``obs.perf`` summary — bench is the one place
+    where the perf plane is on by default.
     """
     import jax
 
@@ -151,6 +174,9 @@ def bench_case(
         make_longlog,
         summarize,
     )
+    from paxos_tpu.harness.trace import profile
+    from paxos_tpu.obs import perf as perf_mod
+    from paxos_tpu.obs.host_spans import HostSpanRecorder
 
     depth = validate_pipeline_depth(pipeline_depth)
     if timed_chunks % depth:
@@ -158,6 +184,9 @@ def bench_case(
             f"timed_chunks={timed_chunks} must be a multiple of "
             f"pipeline_depth={depth} (whole dispatches per timed group)"
         )
+    if warmup_groups < 1:
+        raise ValueError("warmup_groups must be >= 1 (compile must land "
+                         "outside the measured region)")
     platform = jax.devices()[0].platform
     state = init_state(cfg)
     plan = init_plan(cfg)
@@ -185,51 +214,113 @@ def bench_case(
         cfg, plan, engine, compact=bool(make_longlog(cfg))
     )
 
-    # Warmup: compile + one dispatch of the grouped program.  NOTE: timing
-    # must end with a device->host readback, not block_until_ready — on the
-    # axon tunnel backend block_until_ready can return before execution
-    # finishes.
-    state = advance(state, chunk, depth)
-    int(state.tick)
-
     ticks = timed_chunks * chunk
-    runs = []
-    violations = 0
-    for _ in range(max(repeats, 1)):
+    rec = HostSpanRecorder(time.perf_counter)
+    state_box = [state]
+    done_ticks = [0]
+    violations = [0]
+
+    def one_group(samples: list) -> None:
+        # NOTE: timing must end with a device->host readback, not
+        # block_until_ready — on the axon tunnel backend block_until_ready
+        # can return before execution finishes.
+        st = state_box[0]
         t0 = time.perf_counter()
         for _ in range(timed_chunks // depth):
-            state = advance(state, chunk, depth)
-        violations = int(state.learner.violations.sum())  # forces completion
-        runs.append(cfg.n_inst * ticks / (time.perf_counter() - t0))
+            with rec.span("dispatch", tick_start=done_ticks[0],
+                          ticks=chunk * depth, groups=depth):
+                st = advance(st, chunk, depth)
+            done_ticks[0] += chunk * depth
+        with rec.span("probe", tick=done_ticks[0]):
+            violations[0] = int(st.learner.violations.sum())
+        samples.append(cfg.n_inst * ticks / (time.perf_counter() - t0))
+        state_box[0] = st
+
+    # Warmup: groups identical in shape to the timed ones (satellite fix —
+    # the old single-dispatch warmup left compile residue and cold caches
+    # in the first timed sample).  Recorded, reported, never measured.
+    warmup_runs: list = []
+    for _ in range(warmup_groups):
+        one_group(warmup_runs)
+
+    runs: list = []
+    with profile(profile_dir):
+        for _ in range(max(repeats, 1)):
+            one_group(runs)
 
     # Post-run measurement audit (outside the timed loop): summarize runs
     # the packed-ballot overflow guard, so a corrupted MP campaign raises
     # here instead of recording untrustworthy violation counts.
-    summarize(state, log_total=cfg.fault.log_total)
+    summarize(state_box[0], log_total=cfg.fault.log_total)
+
+    perf = perf_mod.perf_summary(rec, cfg.n_inst)
+    if eff_block is not None:
+        perf["vmem"] = perf_mod.vmem_gauges(state_bytes, eff_block)
 
     value = max(runs)
-    return {
+    row = {
+        "schema": perf_mod.BENCH_ROW_SCHEMA,
         "metric": "quorum-rounds/sec/chip",
         "value": round(value, 1),
         "unit": "instance-rounds/sec",
         "vs_baseline": round(value / NORTH_STAR, 3),
+        "samples": [round(r, 1) for r in runs],
+        "median": round(statistics.median(runs), 1),
+        "min": round(min(runs), 1),
+        "stdev": round(statistics.stdev(runs), 1) if len(runs) > 1 else 0.0,
+        "warmup_groups": warmup_groups,
+        "timed_groups": len(runs),
+        "warmup_runs": [round(r, 1) for r in warmup_runs],
         "n_instances": cfg.n_inst,
         "chunk": chunk,
         "pipeline_depth": depth,
         "ticks": ticks,
         "seconds": round(cfg.n_inst * ticks / value, 4),
+        # Legacy alias for pre-schema artifact readers (r4-r9 perf gate).
         "throughput_runs": [round(r, 1) for r in runs],
         "platform": platform,
         "engine": engine,
         "protocol": cfg.protocol,
-        "violations": violations,
+        "violations": violations[0],
         "state_bytes_per_lane": state_bytes,
         "block": eff_block,
         # Stream lineage (VERDICT r4 weak#3): the fused block this case ran
         # under — replays must match it or the schedule differs.
         "stream": sid,
+        "layout_version": bitops.layout_version(cfg.protocol),
         "config_fingerprint": cfg.fingerprint(),
+        "perf": perf,
     }
+    if profile_dir:
+        row["profile_dir"] = profile_dir
+    return row
+
+
+def _attach_roofline(row: dict, case_name: str) -> None:
+    """Roofline occupancy vs the committed ROOFLINE.json census (TPU only).
+
+    The census was measured at the flagship sizes, so the ceiling only
+    means something when the row ran on the same platform; CPU rows and
+    unknown cases pass through untouched.
+    """
+    import pathlib
+
+    from paxos_tpu.obs import perf as perf_mod
+
+    if row.get("platform") != "tpu":
+        return
+    path = pathlib.Path(__file__).resolve().parent / "ROOFLINE.json"
+    if not path.exists():
+        return
+    roof = json.loads(path.read_text())
+    case = next(
+        (c for c in roof.get("cases", []) if c.get("case") == case_name), None
+    )
+    if case is None:
+        return
+    gauges = perf_mod.roofline_gauges(row["value"], case, roof)
+    if gauges:
+        row.setdefault("perf", {})["roofline"] = gauges
 
 
 def main(argv=None) -> None:
@@ -237,16 +328,30 @@ def main(argv=None) -> None:
     ap.add_argument("--sweep", action="store_true",
                     help="bench all protocols x engines (one JSON line each)")
     ap.add_argument("--record", metavar="PATH",
-                    help="with --sweep: also write the case list to PATH")
+                    help="also write the measured rows (a JSON list) to PATH "
+                    "— the artifact `paxos_tpu bench-compare` diffs against")
     ap.add_argument(
         "--pipeline-depth", type=int, default=None, metavar="K",
         help="flagship case only: chunks grouped per device dispatch "
         "(harness.pipeline; default 16 on TPU — 64-tick chunks in "
         "1024-tick dispatches, the measured-best dispatch size — else 4)",
     )
+    ap.add_argument(
+        "--n-inst", type=int, default=None, metavar="N",
+        help="flagship case only: instance-count override (smoke tests "
+        "shrink it; the recorded artifact uses the platform default)",
+    )
+    ap.add_argument(
+        "--warmup-groups", type=int, default=1, metavar="W",
+        help="unmeasured warm-up groups before the timed ones (default 1; "
+        "each is shaped exactly like a timed group)",
+    )
+    ap.add_argument(
+        "--profile-dir", metavar="DIR", default=None,
+        help="flagship case only: wrap the measured region in "
+        "jax.profiler.trace(DIR) and link DIR from the row",
+    )
     args = ap.parse_args(argv)
-    if args.record and not args.sweep:
-        ap.error("--record requires --sweep")
 
     import jax
 
@@ -258,8 +363,10 @@ def main(argv=None) -> None:
     if args.sweep:
         results = []
         for name, cfg, engine, chunk, depth in _configs(platform):
-            out = bench_case(cfg, engine, chunk=chunk, pipeline_depth=depth)
+            out = bench_case(cfg, engine, chunk=chunk, pipeline_depth=depth,
+                             warmup_groups=args.warmup_groups)
             out["case"] = name
+            _attach_roofline(out, name)
             results.append(out)
             print(json.dumps(out), flush=True)
         if args.record:
@@ -269,7 +376,10 @@ def main(argv=None) -> None:
 
     from paxos_tpu.harness.config import config2_dueling_drop
 
-    n_inst = 1 << 20 if platform != "cpu" else 1 << 14  # 1,048,576 on TPU
+    if args.n_inst is not None:
+        n_inst = args.n_inst
+    else:
+        n_inst = 1 << 20 if platform != "cpu" else 1 << 14  # 1M on TPU
     cfg = config2_dueling_drop(n_inst=n_inst, seed=0)
     # Engine: the fused Pallas path (whole chunk resident in VMEM) on TPU;
     # the scanned XLA path on CPU (Mosaic doesn't target host CPUs).
@@ -283,9 +393,15 @@ def main(argv=None) -> None:
     depth = args.pipeline_depth
     if depth is None:
         depth = 16 if platform == "tpu" else 4
-    print(json.dumps(bench_case(
-        cfg, engine, chunk=64, timed_chunks=4 * depth, pipeline_depth=depth
-    )))
+    row = bench_case(
+        cfg, engine, chunk=64, timed_chunks=4 * depth, pipeline_depth=depth,
+        warmup_groups=args.warmup_groups, profile_dir=args.profile_dir,
+    )
+    row["case"] = "config2-paxos-flagship"
+    print(json.dumps(row))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump([row], f, indent=1)
 
 
 if __name__ == "__main__":

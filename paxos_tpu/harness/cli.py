@@ -161,6 +161,13 @@ def build_parser() -> argparse.ArgumentParser:
         "effective tallies per fault class (obs.exposure; default off — "
         "off is free and schedule-identical)",
     )
+    r.add_argument(
+        "--perf", action="store_true",
+        help="host-side performance plane (obs.perf): rounds/sec, pipeline "
+        "occupancy, chunk-latency percentiles, compile-vs-steady split in "
+        "the final report and metrics stream (default off — zero device "
+        "ops, schedule-identical either way)",
+    )
 
     s = sub.add_parser(
         "sweep",
@@ -239,6 +246,13 @@ def build_parser() -> argparse.ArgumentParser:
         "across seeds: the report gains per-class injected-vs-effective "
         "totals and a vacuous-chaos flag for lit knobs that never touched "
         "the protocol (obs.exposure)",
+    )
+    so.add_argument(
+        "--perf", action="store_true",
+        help="host-side performance plane (obs.perf) over the campaign "
+        "loop: cumulative/windowed rounds/sec, occupancy, and dispatch "
+        "latency percentiles in the soak report and metrics stream "
+        "(default off; the per-seed throughput trend is recorded always)",
     )
 
     k = sub.add_parser(
@@ -344,6 +358,48 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument(
         "--prometheus", action="store_true",
         help="print the Prometheus text exposition instead of a JSON summary",
+    )
+    st.add_argument(
+        "--follow", action="store_true",
+        help="tail the stream: re-render the summary every --interval "
+        "seconds as new records land (watch a running soak from a second "
+        "terminal); stops when a 'final' record arrives",
+    )
+    st.add_argument(
+        "--interval", type=float, default=2.0, metavar="S",
+        help="seconds between --follow re-renders (default 2)",
+    )
+    st.add_argument(
+        "--max-renders", type=int, default=0, metavar="N",
+        help="with --follow: stop after N renders even without a 'final' "
+        "record (0 = unbounded; the scriptable exit hatch)",
+    )
+
+    bc = sub.add_parser(
+        "bench-compare",
+        help="diff a fresh bench.py --record file against committed history "
+        "and gate on regression (exit 2) with a noise-aware tolerance",
+    )
+    bc.add_argument(
+        "--baseline", default="BENCH_SWEEP.json", metavar="PATH",
+        help="committed bench artifact (a JSON list of rows; default "
+        "BENCH_SWEEP.json)",
+    )
+    bc.add_argument(
+        "--fresh", default=None, metavar="PATH",
+        help="fresh bench.py --record output to judge; omitted = compare "
+        "the baseline against itself (the CI sanity check: must exit 0)",
+    )
+    bc.add_argument(
+        "--tolerance", type=float, default=0.10, metavar="T",
+        help="minimum allowed relative drop before a case regresses "
+        "(default 0.10); widened per case to noise-k x the baseline's own "
+        "sample CV — see obs.perf.compare_benches",
+    )
+    bc.add_argument(
+        "--noise-k", type=float, default=3.0, metavar="K",
+        help="noise multiplier on the baseline coefficient of variation "
+        "(default 3.0)",
     )
 
     c = sub.add_parser(
@@ -668,10 +724,11 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
     expo_cfg = _exposure_from_args(args)
     registry = MetricsRegistry()
     registry.gauge("pipeline_depth_effective", depth)
-    # Host span recorder (--span-trace): the CLI owns the wall clock and
-    # injects it — the obs package itself stays clock-free (purity audit).
+    # Host span recorder (--span-trace / --perf): the CLI owns the wall
+    # clock and injects it — the obs package itself stays clock-free
+    # (purity audit).  The perf plane is derived entirely from these spans.
     recorder = None
-    if args.span_trace:
+    if args.span_trace or args.perf:
         import time
 
         from paxos_tpu.obs.host_spans import HostSpanRecorder
@@ -760,6 +817,9 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
             print(f"error: {e}", file=sys.stderr)
             raise SystemExit(1)
 
+    from paxos_tpu.obs.host_spans import ensure_recorder
+
+    sp = ensure_recorder(recorder)
     done, since_ckpt = 0, 0
     if depth > 1:
         # Pipelined loop: grouped dispatches, async done-flag probe, and
@@ -783,9 +843,6 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                 ),
             )
     else:
-        from paxos_tpu.obs.host_spans import ensure_recorder
-
-        sp = ensure_recorder(recorder)
         with trace_mod.profile(args.trace):
             while done < args.ticks:
                 n = min(args.chunk, args.ticks - done)
@@ -823,7 +880,10 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                             else bool(state.learner.chosen.all())):
                         break
 
-    report = observe(liveness=args.liveness)
+    # The final readback is where async dispatch catches up with the host;
+    # spanned so the perf plane's wall clock covers real device completion.
+    with sp.span("report", tick=done):
+        report = observe(liveness=args.liveness)
     report["config_fingerprint"] = cfg.fingerprint()
     # EFFECTIVE depth, always: the requested depth may have been degraded
     # above, and a silent fallback must not be invisible in the report.
@@ -844,7 +904,24 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
         registry.ingest_exposure(
             report["exposure"], lit=exposure_lit(cfg.fault)
         )
-    if recorder is not None:
+    if args.perf:
+        from paxos_tpu.obs import perf as perf_mod
+
+        perf = perf_mod.perf_summary(recorder, cfg.n_inst)
+        if args.engine == "fused" and "dispatches" in perf:
+            from paxos_tpu.harness.checkpoint import stream_id
+            from paxos_tpu.utils import bitops
+
+            sid = stream_id(cfg, args.engine, block=args.block)
+            vmem = perf_mod.vmem_gauges(
+                bitops.codec_for(cfg.protocol, state).bytes_per_lane(state),
+                sid.get("block"),
+            )
+            if vmem:
+                perf["vmem"] = vmem
+        report["perf"] = perf
+        registry.ingest_perf(perf)
+    if args.span_trace:
         from paxos_tpu.obs.export import write_chrome_trace
 
         write_chrome_trace(
@@ -969,7 +1046,7 @@ def cmd_soak(args: argparse.Namespace) -> int:
     from paxos_tpu.harness.metrics import MetricsLog
 
     recorder = None
-    if args.span_trace:
+    if args.span_trace or args.perf:
         import time
 
         from paxos_tpu.obs.host_spans import HostSpanRecorder
@@ -991,12 +1068,20 @@ def cmd_soak(args: argparse.Namespace) -> int:
             plateau_seeds=args.plateau_seeds,
             plateau_min_new=args.plateau_min_new,
             plateau_stop=args.plateau_stop,
+            # Per-seed throughput trend, streamed as it lands so `stats
+            # --follow` over this JSONL shows the live cadence.
+            on_seed=lambda rec: mlog.emit("seed", **rec),
         )
         report["config"] = args.config
-        if "coverage" in report or "exposure" in report:
-            # Cross-seed coverage/exposure as gauges, so `stats
+        if args.perf:
+            from paxos_tpu.obs import perf as perf_mod
+
+            report["perf"] = perf_mod.perf_summary(recorder, cfg.n_inst)
+        if "coverage" in report or "exposure" in report or args.perf:
+            # Cross-seed coverage/exposure/perf as gauges, so `stats
             # --prometheus` over this JSONL stream exposes the curve's
-            # endpoint, the plateau, and per-class exposure totals.
+            # endpoint, the plateau, per-class exposure totals, and the
+            # campaign-loop throughput/occupancy.
             from paxos_tpu.harness.metrics import MetricsRegistry
 
             registry = MetricsRegistry()
@@ -1011,8 +1096,10 @@ def cmd_soak(args: argparse.Namespace) -> int:
                 registry.ingest_exposure(
                     report["exposure"], lit=exposure_lit(cfg.fault)
                 )
+            if args.perf:
+                registry.ingest_perf(report["perf"])
             mlog.emit("metrics", **registry.snapshot())
-        if recorder is not None:
+        if args.span_trace:
             from paxos_tpu.obs.export import write_chrome_trace
 
             write_chrome_trace(
@@ -1082,16 +1169,8 @@ def cmd_audit(args: argparse.Namespace) -> int:
     return 0 if report.ok else 2
 
 
-def cmd_stats(args: argparse.Namespace) -> int:
-    """Summarize a JSONL metrics stream; optionally as Prometheus text."""
-    import pathlib
-
-    from paxos_tpu.harness.metrics import MetricsRegistry
-
-    path = pathlib.Path(args.path)
-    if not path.exists():
-        print(f"error: no metrics file at {path}", file=sys.stderr)
-        return 1
+def _stats_read(path) -> "tuple[list, int]":
+    """Parse a JSONL metrics file; returns (records, malformed_lines)."""
     records, malformed = [], 0
     for line in path.read_text().splitlines():
         line = line.strip()
@@ -1101,9 +1180,14 @@ def cmd_stats(args: argparse.Namespace) -> int:
             records.append(json.loads(line))
         except json.JSONDecodeError:
             malformed += 1
-    if not records:
-        print(f"error: {path} holds no JSONL records", file=sys.stderr)
-        return 1
+    return records, malformed
+
+
+def _stats_render(
+    records: list, malformed: int, path, prometheus: bool
+) -> "tuple[str, bool]":
+    """One summary render; returns (text, saw_final_record)."""
+    from paxos_tpu.harness.metrics import MetricsRegistry
 
     registry = MetricsRegistry()
     kinds: dict[str, int] = {}
@@ -1112,10 +1196,19 @@ def cmd_stats(args: argparse.Namespace) -> int:
     last_agg = None
     last_cov = None
     last_exp = None
+    last_perf = None
+    last_seed = None
     for rec in records:
         kind = rec.get("event", "?")
         kinds[kind] = kinds.get(kind, 0) + 1
         registry.inc("log_records_total", record=kind)
+        # Perf-plane summaries ride the final report (run/soak/trace
+        # --perf); the last one wins like every cumulative plane.
+        perf = rec.get("perf")
+        if isinstance(perf, dict) and "dispatches" in perf:
+            last_perf = perf
+        if kind == "seed":  # soak per-seed throughput trend
+            last_seed = rec
         # Device telemetry is cumulative; the LAST report is the campaign
         # total, whether it rode a chunk record or the final one.
         if isinstance(rec.get("telemetry"), dict):
@@ -1149,10 +1242,16 @@ def cmd_stats(args: argparse.Namespace) -> int:
         )
     if last_agg is not None:
         registry.ingest_span_aggregates(last_agg)
+    if last_perf is not None:
+        registry.ingest_perf(last_perf)
+    if last_seed is not None:
+        registry.gauge(
+            "perf_seed_rounds_per_sec", last_seed.get("rounds_per_sec", 0)
+        )
 
-    if args.prometheus:
-        print(registry.to_prometheus(), end="")
-        return 0
+    saw_final = final is not None
+    if prometheus:
+        return registry.to_prometheus().rstrip("\n"), saw_final
 
     out: dict = {
         "path": str(path),
@@ -1188,7 +1287,150 @@ def cmd_stats(args: argparse.Namespace) -> int:
         out["exposure"] = last_exp
     if last_agg is not None:
         out["span_aggregates"] = last_agg
-    print(json.dumps(out))
+    if last_perf is not None:
+        out["perf"] = last_perf
+    if last_seed is not None:
+        out["last_seed"] = {
+            k: last_seed[k]
+            for k in ("seed", "wall_s", "rounds", "rounds_per_sec")
+            if k in last_seed
+        }
+    return json.dumps(out), saw_final
+
+
+def _devnull_stdout() -> None:
+    """Point the stdout fd at devnull after a BrokenPipeError.
+
+    The buffered writer may still hold bytes the reader will never take;
+    without this the interpreter's exit-time flush re-raises EPIPE and
+    turns a clean exit into status 120.
+    """
+    import os
+
+    os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
+def cmd_stats(args: argparse.Namespace) -> int:
+    """Summarize a JSONL metrics stream; optionally as Prometheus text.
+
+    ``--follow`` tails the file: re-parse and re-render every
+    ``--interval`` seconds (the writer flushes per record, so new seeds
+    and chunks appear as they land), stopping when a ``final`` record
+    arrives or after ``--max-renders`` renders.  A missing file is waited
+    for rather than an error — the natural race when the watcher starts
+    before the soak opens its log.
+
+    A closed stdout (``stats ... | head``, ``| grep -q``) ends the
+    command cleanly instead of tracebacking — the reader deciding it has
+    seen enough is a normal way for a tailing pipeline to stop.
+    """
+    import pathlib
+
+    path = pathlib.Path(args.path)
+    if not args.follow:
+        if not path.exists():
+            print(f"error: no metrics file at {path}", file=sys.stderr)
+            return 1
+        records, malformed = _stats_read(path)
+        if not records:
+            print(f"error: {path} holds no JSONL records", file=sys.stderr)
+            return 1
+        text, _ = _stats_render(records, malformed, path, args.prometheus)
+        try:
+            print(text, flush=True)
+        except BrokenPipeError:
+            _devnull_stdout()
+        return 0
+
+    import time
+
+    renders = 0
+    while True:
+        records, malformed = (
+            _stats_read(path) if path.exists() else ([], 0)
+        )
+        if records:
+            text, saw_final = _stats_render(
+                records, malformed, path, args.prometheus
+            )
+            try:
+                print(text, flush=True)
+            except BrokenPipeError:
+                _devnull_stdout()
+                return 0
+            renders += 1
+            if saw_final:
+                return 0
+        if args.max_renders and renders >= args.max_renders:
+            return 0
+        time.sleep(max(args.interval, 0.05))
+
+
+def cmd_bench_compare(args: argparse.Namespace) -> int:
+    """Regression-gate a fresh bench run against committed history.
+
+    Exit 0 = every overlapping case within tolerance, 2 = regression
+    beyond the noise-aware band, 1 = unusable inputs (missing files,
+    schema-less rows, zero overlapping cases — a vacuous pass must not
+    gate CI).  See ``obs.perf.compare_benches`` for the tolerance model.
+    """
+    import pathlib
+
+    from paxos_tpu.obs import perf as perf_mod
+
+    def load_rows(path_str: str) -> "Optional[list]":
+        path = pathlib.Path(path_str)
+        if not path.exists():
+            print(f"error: no bench artifact at {path}", file=sys.stderr)
+            return None
+        try:
+            data = json.loads(path.read_text())
+        except json.JSONDecodeError as e:
+            print(f"error: {path}: {e}", file=sys.stderr)
+            return None
+        rows = data if isinstance(data, list) else [data]
+        if not all(isinstance(r, dict) for r in rows) or not rows:
+            print(f"error: {path} is not a list of bench rows",
+                  file=sys.stderr)
+            return None
+        return rows
+
+    baseline = load_rows(args.baseline)
+    if baseline is None:
+        return 1
+    fresh = baseline if args.fresh is None else load_rows(args.fresh)
+    if fresh is None:
+        return 1
+    # Schema-gate fresh rows that claim the schema; pre-schema baselines
+    # (older BENCH_SWEEP.json) are grandfathered via throughput_runs.
+    bad = 0
+    for row in fresh:
+        if "schema" in row:
+            for err in perf_mod.validate_bench_row(row):
+                print(f"error: fresh row "
+                      f"{row.get('case', row.get('protocol'))}: {err}",
+                      file=sys.stderr)
+                bad += 1
+    if bad:
+        return 1
+    result = perf_mod.compare_benches(
+        baseline, fresh, tolerance=args.tolerance, noise_k=args.noise_k
+    )
+    result["baseline"] = args.baseline
+    result["fresh"] = args.fresh or args.baseline
+    print(json.dumps(result))
+    if not result["compared"]:
+        print("error: no overlapping (case, engine, platform) rows — "
+              "nothing was actually compared", file=sys.stderr)
+        return 1
+    if result["regressions"]:
+        for r in result["regressions"]:
+            print(f"REGRESSION: {r['case']} [{r['engine']}/{r['platform']}] "
+                  f"{r['fresh_best']:.3g} vs baseline median "
+                  f"{r['baseline_median']:.3g} "
+                  f"(ratio {r['ratio']}, allowed drop {r['allowed_drop']})",
+                  file=sys.stderr)
+        return 2
     return 0
 
 
@@ -1442,12 +1684,20 @@ def cmd_trace(args: argparse.Namespace) -> int:
             coverage=_coverage_from_args(args),
             exposure=_exposure_from_args(args),
         )
+        # Perf plane (obs.perf): host throughput/occupancy as counter
+        # tracks on the same unified timeline — free here, the recorder
+        # already watched every dispatch.
+        from paxos_tpu.obs import perf as perf_mod
+
+        counters = dict(cap.counters or {})
+        counters.update(perf_mod.perf_counter_tracks(recorder, cfg.n_inst))
+        perf = perf_mod.perf_summary(recorder, cfg.n_inst)
         write_chrome_trace(
             args.out, cap.spans, host=recorder,
             meta={"config": args.config, "engine": args.engine,
                   "seed": args.seed, "ticks": args.ticks,
                   "fingerprint": cfg.fingerprint()},
-            counters=cap.counters,
+            counters=counters or None,
         )
         if args.spans_out:
             with open(args.spans_out, "w") as fh:
@@ -1467,6 +1717,7 @@ def cmd_trace(args: argparse.Namespace) -> int:
                 cap.report["exposure"], lit=exposure_lit(cfg.fault)
             )
         registry.ingest_span_aggregates(cap.aggregates)
+        registry.ingest_perf(perf)
         log.emit("spans", lanes=cap.lanes, aggregates=cap.aggregates)
         log.emit("metrics", **registry.snapshot())
         summary = {
@@ -1477,6 +1728,7 @@ def cmd_trace(args: argparse.Namespace) -> int:
             "lanes": cap.lanes,
             "violations": cap.report.get("violations"),
             "host_spans": len(recorder.spans),
+            "perf": perf,
             **cap.aggregates,
         }
         if args.spans_out:
@@ -1764,6 +2016,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_check(args)
     if args.cmd == "stats":
         return cmd_stats(args)
+    if args.cmd == "bench-compare":
+        return cmd_bench_compare(args)
     if args.cmd == "trace":
         return cmd_trace(args)
     if args.cmd == "audit":

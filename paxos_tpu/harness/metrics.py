@@ -210,6 +210,42 @@ class MetricsRegistry:
             if agg.get(name) is not None:
                 self.gauge(name, agg[name])
 
+    def ingest_perf(self, perf: dict[str, Any]) -> None:
+        """Fold an ``obs.perf.perf_summary`` dict into the registry.
+
+        Every perf value is a point-in-time host-side measurement, so they
+        all land as gauges under a ``perf_`` prefix — the prefix keeps the
+        plane's namespace disjoint from the telemetry/coverage/exposure
+        planes, so one shared registry never collides.  Chunk-latency
+        quantiles become one ``perf_chunk_latency_us`` series labelled by
+        quantile (the same summary idiom as ``round_latency_ticks``);
+        the optional ``vmem``/``roofline`` sub-dicts flatten in under the
+        same prefix.
+        """
+        for name in (
+            "dispatches",
+            "chunks",
+            "rounds_total",
+            "rounds_per_sec",
+            "rounds_per_sec_steady",
+            "rounds_per_sec_windowed",
+            "occupancy",
+            "compile_s",
+            "wall_s",
+            "dispatch_enqueue_s",
+            "probe_wait_s",
+        ):
+            v = perf.get(name)
+            if v is not None:
+                self.gauge(f"perf_{name}", v)
+        lat = perf.get("chunk_latency_us") or {}
+        for q in ("p50", "p95", "p99"):
+            if lat.get(q) is not None:
+                self.gauge("perf_chunk_latency_us", lat[q], quantile=q)
+        for sub in ("vmem", "roofline"):
+            for name, v in (perf.get(sub) or {}).items():
+                self.gauge(f"perf_{name}", v)
+
     def snapshot(self) -> dict[str, Any]:
         """One JSON-ready dict of everything in the registry."""
         counters: dict[str, Any] = {}

@@ -760,7 +760,15 @@ def run(
         state, advance, budget=budget, chunk=chunk, depth=depth,
         done_fn=done_fn, spans=spans,
     )
-    report = summarize(state, liveness=liveness, log_total=cfg.fault.log_total)
+    # The summarize readback is the moment async dispatch catches up with
+    # the host, so it rides in a "report" span — without it the perf plane
+    # (obs.perf) would clock a fully-async loop at enqueue speed.
+    from paxos_tpu.obs.host_spans import ensure_recorder
+
+    with ensure_recorder(spans).span("report"):
+        report = summarize(
+            state, liveness=liveness, log_total=cfg.fault.log_total
+        )
     report["config_fingerprint"] = cfg.fingerprint()
     report["engine"] = engine
     if depth > 1:

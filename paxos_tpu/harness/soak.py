@@ -98,6 +98,7 @@ def soak(
     plateau_min_new: int = 1,
     plateau_stop: bool = False,
     vacuous_seeds: int = 3,
+    on_seed: Optional[Callable[[dict], None]] = None,
 ) -> dict[str, Any]:
     """Run campaigns over rotating seeds until ``target_rounds`` accumulate.
 
@@ -166,6 +167,19 @@ def soak(
     ``spans`` (an ``obs.host_spans.HostSpanRecorder``) records wall-clock
     spans for each campaign's dispatch, report drain, recheck replays, and
     retry backoffs — purely observational, never schedule-relevant.
+
+    **Per-seed throughput (perf plane):** every finalized seed appends
+    ``{"seed", "wall_s", "rounds", "rounds_per_sec"}`` to the report's
+    ``per_seed`` list — the throughput TREND over a long campaign, the
+    perf twin of the coverage curve (a soak that silently slows down now
+    shows it seed-by-seed, not just in the final average).  ``wall_s`` is
+    the host wall between consecutive finalizations, so under pipelining
+    it includes the overlapped next-seed dispatch — exactly the effective
+    cadence of the campaign loop.  Any recheck replays a seed triggered
+    are counted in that seed's ``rounds``.  ``on_seed`` (a callback taking
+    the record) streams each one as it lands — the CLI emits them into the
+    metrics JSONL so ``paxos_tpu stats --follow`` can watch the trend
+    live.
 
     **Coverage plateau (``cfg.coverage`` enabled):** each campaign's report
     carries its on-device Bloom sketch union (``obs.coverage``), and the
@@ -240,6 +254,10 @@ def soak(
     rep_rates: list[float] = []  # slots replicated per lane-tick, per campaign
     retries_used = 0
     t0 = time.perf_counter()
+    # Per-seed throughput trend: wall between consecutive finalizations.
+    per_seed: list[dict] = []
+    seed_mark = t0
+    recheck_mark = 0
     corrupted_seed: Optional[int] = None
 
     def serial_campaign(rcfg):
@@ -370,8 +388,25 @@ def soak(
             )
         rounds += fscfg.n_inst * ticks_per_seed
         seeds += 1
+        now = time.perf_counter()
+        seed_rounds = (
+            fscfg.n_inst * ticks_per_seed + recheck_rounds - recheck_mark
+        )
+        seed_wall = max(now - seed_mark, 1e-9)
+        seed_rec = {
+            "seed": fscfg.seed,
+            "wall_s": round(now - seed_mark, 4),
+            "rounds": seed_rounds,
+            "rounds_per_sec": round(seed_rounds / seed_wall, 1),
+        }
+        per_seed.append(seed_rec)
+        seed_mark = now
+        recheck_mark = recheck_rounds
+        if on_seed is not None:
+            on_seed(seed_rec)
         say(f"seed {fscfg.seed}: {rounds:.3e} rounds, {violations} violations, "
-            f"{report['stuck_lanes']} stuck")
+            f"{report['stuck_lanes']} stuck, "
+            f"{seed_rec['rounds_per_sec']:.3g} rounds/s")
         exp = report.get("exposure")
         if exp is not None:
             from paxos_tpu.faults.injector import exposure_lit
@@ -484,6 +519,7 @@ def soak(
         ),
         "decided_frac_min": round(min(decided_fracs, default=0.0), 6),
         "seeds": seeds,
+        "per_seed": per_seed,  # throughput trend: one record per seed
         "ticks_per_seed": ticks_per_seed,
         "n_inst": cfg.n_inst,
         "seconds": round(dt, 2),

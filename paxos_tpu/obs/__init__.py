@@ -25,6 +25,14 @@ them in formats standard tooling loads:
 - :mod:`capture` — drive a campaign with the recorder on and the host
   span layer wrapping the pipelined dispatch loop; backs the
   ``paxos_tpu trace`` CLI subcommand.
+- :mod:`perf` — the performance plane: derive throughput (cumulative /
+  steady-state / windowed rounds-per-sec), pipeline occupancy, chunk-
+  latency percentiles, and compile-vs-steady splits from the host span
+  stream; VMEM/roofline occupancy from the recorded ceilings; plus the
+  bench-row provenance schema and the noise-aware regression comparison
+  behind ``paxos_tpu bench-compare``.  Like the rest of the package it
+  is pure decode over injected-clock spans — no clock, no IO, no device
+  ops.
 
 Everything here is host-side decode: zero new device ops, zero PRNG
 draws, schedules bit-identical (the PR 4 auditor and the golden digests

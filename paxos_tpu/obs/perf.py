@@ -1,0 +1,423 @@
+"""Performance observability plane — host-side throughput and occupancy.
+
+Six observability layers watch correctness and faults; this one watches
+*performance*.  It is a pure decode layer over the host span stream
+(``obs.host_spans.HostSpanRecorder``): the harness wraps every device
+dispatch, done-flag probe, and report drain in wall-clock spans, and this
+module derives the live gauges —
+
+- instance-rounds/sec (cumulative, steady-state, and windowed),
+- pipeline occupancy (fraction of loop wall time with a dispatch in
+  flight or a device wait in progress, vs host bookkeeping gaps),
+- per-chunk wall-time percentiles (p50/p95/p99),
+- compile vs steady-state split (the first dispatch's enqueue blocks on
+  JIT compilation; later enqueues do not),
+- VMEM-footprint and roofline occupancy (from the ``fit_block`` budget
+  and the recorded ROOFLINE.json ceilings).
+
+It also owns the bench-provenance contract: the structured ``BENCH_r*``
+row schema (:data:`BENCH_ROW_SCHEMA`, :func:`validate_bench_row`) and the
+noise-aware regression comparison behind ``paxos_tpu bench-compare``
+(:func:`compare_benches`).
+
+Clock doctrine (purity lint): this module never reads a clock, a file, or
+an RNG — it consumes span dicts whose timestamps came from the recorder's
+*injected* clock, so the whole plane is replayable from a recorded span
+list and ``obs`` stays in TRACED_PACKAGES.  Everything is host-side:
+zero new device ops, zero PRNG draws, schedules untouched.
+
+Async-dispatch caveat, documented once here: JAX dispatch is asynchronous,
+so a "dispatch" span measures *enqueue* time (plus compile on the first
+call) while the device keeps running; blocking spans ("probe", "report",
+"report_drain") are where device time becomes visible to the host.  The
+gauges are therefore the host's view of the pipeline — exactly the view
+that matters for dispatch-boundary overhead, which is the gap the perf
+roadmap items chase.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+from typing import Any, Optional
+
+# Span names that mean "the host is driving or waiting on the device".
+# These never nest inside one another (campaign_finalize nests report_drain,
+# so only the inner one is counted), which makes their durations additive.
+DISPATCH_SPAN = "dispatch"
+WAIT_SPANS = frozenset(
+    {"probe", "report", "report_transfer_start", "report_drain"}
+)
+BUSY_SPANS = frozenset({DISPATCH_SPAN}) | WAIT_SPANS
+
+
+def _span_list(spans) -> list[dict]:
+    """Accept a HostSpanRecorder or a raw span-dict list."""
+    return list(getattr(spans, "spans", spans) or [])
+
+
+def percentile(values, q: float):
+    """Nearest-rank percentile (q in [0, 1]); None on empty input.
+
+    Pure and deterministic — the same discipline as the tick-domain
+    quantiles in ``obs.spans`` but over float microseconds.
+    """
+    vs = sorted(values)
+    if not vs:
+        return None
+    k = max(0, min(len(vs) - 1, math.ceil(q * len(vs)) - 1))
+    return vs[k]
+
+
+def _dispatches(sl: list[dict]) -> list[dict]:
+    return [s for s in sl if s["name"] == DISPATCH_SPAN]
+
+
+def _busy(sl: list[dict]) -> list[dict]:
+    return [s for s in sl if s["name"] in BUSY_SPANS]
+
+
+def _loop_end_us(sl: list[dict]) -> int:
+    """End of the last busy span — the loop's wall-clock end."""
+    return max(s["ts"] + s["dur"] for s in _busy(sl))
+
+
+def chunk_latencies_us(spans) -> list[float]:
+    """Per-chunk wall time in µs, one sample per chunk body.
+
+    A dispatch covering ``groups`` chunks contributes ``groups`` equal
+    samples of (interval to the next dispatch start) / groups — the
+    host-observed cadence, which folds in any blocking probe between the
+    two dispatches.  The trailing dispatch's interval runs to the end of
+    the last busy span (its drain), since no successor start exists.
+    """
+    sl = _span_list(spans)
+    disp = _dispatches(sl)
+    if not disp:
+        return []
+    end = _loop_end_us(sl)
+    out: list[float] = []
+    for s, nxt in zip(disp, disp[1:] + [None]):
+        interval = (nxt["ts"] if nxt is not None else end) - s["ts"]
+        g = max(1, int(s.get("args", {}).get("groups", 1)))
+        out.extend([interval / g] * g)
+    return out
+
+
+def perf_summary(spans, n_inst: int, *, window: int = 8) -> dict[str, Any]:
+    """Derive the perf-plane gauges from a recorded span stream.
+
+    ``n_inst`` converts ticks to instance-rounds (one tick advances every
+    instance by one protocol round).  ``window`` sizes the trailing-window
+    throughput gauge (last ``window`` dispatches) — the live "now" signal
+    a soak trend wants, vs the cumulative average that buries a slowdown.
+
+    Returns a JSON-ready dict; ``{"dispatches": 0}`` when the stream holds
+    no dispatch spans (perf off, or a loop that never ran).
+    """
+    sl = _span_list(spans)
+    disp = _dispatches(sl)
+    if not disp:
+        return {"dispatches": 0, "rounds_total": 0}
+
+    def rounds(s: dict) -> int:
+        return n_inst * int(s.get("args", {}).get("ticks", 0))
+
+    t0 = disp[0]["ts"]
+    end = _loop_end_us(sl)
+    wall_us = max(0, end - t0)
+    busy_us = sum(s["dur"] for s in _busy(sl) if s["ts"] >= t0)
+    dispatch_us = sum(s["dur"] for s in disp)
+    wait_us = sum(
+        s["dur"] for s in sl if s["name"] in WAIT_SPANS and s["ts"] >= t0
+    )
+    total_rounds = sum(rounds(s) for s in disp)
+
+    def rate(r: int, us: float) -> float:
+        return r / (us / 1e6) if us > 0 else 0.0
+
+    out: dict[str, Any] = {
+        "dispatches": len(disp),
+        "chunks": sum(
+            max(1, int(s.get("args", {}).get("groups", 1))) for s in disp
+        ),
+        "rounds_total": total_rounds,
+        "wall_s": round(wall_us / 1e6, 6),
+        # First enqueue blocks on JIT compile; steady enqueues don't.  An
+        # upper-bound attribution (tracing work rides in the same span).
+        "compile_s": round(disp[0]["dur"] / 1e6, 6),
+        "dispatch_enqueue_s": round(dispatch_us / 1e6, 6),
+        "probe_wait_s": round(wait_us / 1e6, 6),
+        "occupancy": (
+            round(min(1.0, max(0.0, busy_us / wall_us)), 4)
+            if wall_us > 0
+            else 0.0
+        ),
+        "rounds_per_sec": round(rate(total_rounds, wall_us), 1),
+    }
+    if len(disp) > 1:
+        steady = disp[1:]
+        steady_us = end - steady[0]["ts"]
+        out["rounds_per_sec_steady"] = round(
+            rate(sum(rounds(s) for s in steady), steady_us), 1
+        )
+    w = min(window, len(disp))
+    tail = disp[-w:]
+    out["window_dispatches"] = w
+    out["rounds_per_sec_windowed"] = round(
+        rate(sum(rounds(s) for s in tail), end - tail[0]["ts"]), 1
+    )
+    lats = chunk_latencies_us(sl)
+    if lats:
+        out["chunk_latency_us"] = {
+            "p50": round(percentile(lats, 0.50), 1),
+            "p95": round(percentile(lats, 0.95), 1),
+            "p99": round(percentile(lats, 0.99), 1),
+            "max": round(max(lats), 1),
+            "mean": round(sum(lats) / len(lats), 1),
+            "samples": len(lats),
+        }
+    return out
+
+
+def perf_counter_tracks(
+    spans, n_inst: int
+) -> dict[str, list[tuple[int, float]]]:
+    """Perfetto counter series for the unified timeline.
+
+    Returns ``{name: [(tick, value), ...]}`` in the same shape as the
+    coverage/exposure counter tracks (``obs.capture``): one sample per
+    dispatch, stamped at the dispatch's END tick so the counter steps when
+    its window completes.  Tracks: instantaneous ``host_rounds_per_sec``
+    (this dispatch's rounds over its host interval) and cumulative
+    ``host_occupancy_pct`` (busy/wall so far, 0-100).
+    """
+    sl = _span_list(spans)
+    disp = _dispatches(sl)
+    if not disp:
+        return {}
+    end = _loop_end_us(sl)
+    busy = sorted(_busy(sl), key=lambda s: s["ts"])
+    rps_track: list[tuple[int, float]] = []
+    occ_track: list[tuple[int, float]] = []
+    t0 = disp[0]["ts"]
+    for s, nxt in zip(disp, disp[1:] + [None]):
+        args = s.get("args", {})
+        tick = int(args.get("tick_start", 0)) + int(args.get("ticks", 0))
+        interval_us = (nxt["ts"] if nxt is not None else end) - s["ts"]
+        rounds = n_inst * int(args.get("ticks", 0))
+        rps = rounds / (interval_us / 1e6) if interval_us > 0 else 0.0
+        horizon = s["ts"] + s["dur"]
+        wall = horizon - t0
+        busy_us = sum(
+            min(b["dur"], max(0, horizon - b["ts"]))
+            for b in busy
+            if b["ts"] < horizon
+        )
+        occ = min(1.0, busy_us / wall) if wall > 0 else 0.0
+        rps_track.append((tick, round(rps, 1)))
+        occ_track.append((tick, round(100.0 * occ, 2)))
+    return {
+        "host_rounds_per_sec": rps_track,
+        "host_occupancy_pct": occ_track,
+    }
+
+
+def vmem_gauges(
+    state_bytes_per_lane: int,
+    block: Optional[int],
+    budget: Optional[int] = None,
+) -> dict[str, Any]:
+    """VMEM-footprint gauges for a fused-engine run.
+
+    ``state_bytes_per_lane * block`` is what one fused grid step keeps
+    resident (the quantity ``kernels.fused_tick.fit_block`` budgets);
+    ``vmem_occupancy`` is its fraction of the planning budget — near 1.0
+    means the block is VMEM-bound, small means dispatch-bound headroom.
+    """
+    if budget is None:
+        from paxos_tpu.kernels.fused_tick import VMEM_STATE_BUDGET
+
+        budget = VMEM_STATE_BUDGET
+    if not block:
+        return {}
+    vmem = int(state_bytes_per_lane) * int(block)
+    return {
+        "vmem_state_bytes": vmem,
+        "vmem_budget_bytes": int(budget),
+        "vmem_occupancy": round(vmem / budget, 4) if budget else 0.0,
+    }
+
+
+def roofline_gauges(
+    rounds_per_sec: float,
+    case: dict[str, Any],
+    ceilings: dict[str, Any],
+) -> dict[str, Any]:
+    """Roofline occupancy vs the recorded ceilings.
+
+    ``case`` is a ROOFLINE.json per-case census dict (needs
+    ``alu_per_lane_tick``); ``ceilings`` the artifact's top-level device
+    ceilings (``vpu_ops_per_sec``).  File loading stays with the caller —
+    this function is pure so the plane replays from recorded inputs.
+    """
+    alu = case.get("alu_per_lane_tick")
+    vpu = ceilings.get("vpu_ops_per_sec")
+    if not alu or not vpu:
+        return {}
+    ceiling_rps = float(vpu) / float(alu)
+    return {
+        "roofline_ceiling_rps": round(ceiling_rps, 1),
+        "roofline_occupancy": round(float(rounds_per_sec) / ceiling_rps, 4),
+    }
+
+
+# --------------------------------------------------------------------------
+# Bench provenance: row schema + noise-aware regression comparison.
+
+BENCH_ROW_SCHEMA = "paxos-tpu-bench-row-v1"
+
+# field -> required type(s).  The provenance core: anyone holding a row can
+# tell WHAT was measured (config fingerprint + layout version + engine +
+# platform) and HOW WELL (per-run samples, not just a mean).
+_ROW_REQUIRED: dict[str, Any] = {
+    "schema": str,
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "samples": list,
+    "median": (int, float),
+    "min": (int, float),
+    "stdev": (int, float),
+    "warmup_groups": int,
+    "timed_groups": int,
+    "n_instances": int,
+    "chunk": int,
+    "pipeline_depth": int,
+    "ticks": int,
+    "platform": str,
+    "engine": str,
+    "protocol": str,
+    "layout_version": str,
+    "config_fingerprint": str,
+}
+
+
+def validate_bench_row(row: Any) -> list[str]:
+    """Schema-check one bench row; returns a list of problems (empty = ok)."""
+    if not isinstance(row, dict):
+        return [f"row is not a dict: {type(row).__name__}"]
+    errs: list[str] = []
+    for field, types in _ROW_REQUIRED.items():
+        if field not in row:
+            errs.append(f"missing field {field!r}")
+        elif not isinstance(row[field], types):
+            errs.append(
+                f"field {field!r}: got {type(row[field]).__name__}"
+            )
+    if errs:
+        return errs
+    if row["schema"] != BENCH_ROW_SCHEMA:
+        errs.append(f"schema {row['schema']!r} != {BENCH_ROW_SCHEMA!r}")
+    if not row["samples"]:
+        errs.append("samples is empty")
+    elif not all(
+        isinstance(s, (int, float)) and s > 0 for s in row["samples"]
+    ):
+        errs.append("samples must be positive numbers")
+    if row["value"] <= 0:
+        errs.append("value must be positive")
+    return errs
+
+
+def _row_key(row: dict) -> tuple:
+    return (
+        row.get("case") or row.get("protocol"),
+        row.get("engine"),
+        row.get("platform"),
+    )
+
+
+def _row_samples(row: dict) -> list[float]:
+    """Per-run samples, tolerating pre-schema rows (throughput_runs/value)."""
+    for field in ("samples", "throughput_runs"):
+        vals = row.get(field)
+        if vals:
+            return [float(v) for v in vals]
+    v = row.get("value")
+    return [float(v)] if v else []
+
+
+def compare_benches(
+    baseline: list[dict],
+    fresh: list[dict],
+    *,
+    tolerance: float = 0.10,
+    noise_k: float = 3.0,
+) -> dict[str, Any]:
+    """Diff a fresh bench run against committed history.
+
+    Tolerance model (documented in README §bench-compare): for each case
+    matched on (case, engine, platform), the allowed relative drop is
+
+        ``max(tolerance, noise_k * cv)``
+
+    where ``cv`` is the coefficient of variation (stdev/median) of the
+    BASELINE's own per-run samples — a case that historically wobbles 5%
+    run-to-run gets a proportionally wider band than a quiet one, so the
+    gate is noise-aware instead of flaking on shared-machine jitter.  The
+    fresh side is judged by its BEST sample (min-time discipline: external
+    noise only ever slows a run down), the baseline by its median.
+
+    Cases present on only one side are reported but never gate (platform
+    or sweep-set drift is provenance, not regression); zero overlapping
+    cases is a failure (``ok: False``) — a vacuous pass must not gate CI.
+    """
+    base_map = {_row_key(r): r for r in baseline}
+    fresh_keys = [_row_key(r) for r in fresh]
+    rows: list[dict] = []
+    regressions: list[dict] = []
+    unmatched = [list(k) for k in fresh_keys if k not in base_map]
+    for fr in fresh:
+        br = base_map.get(_row_key(fr))
+        if br is None:
+            continue
+        bs, fs = _row_samples(br), _row_samples(fr)
+        if not bs or not fs:
+            continue
+        b_med = statistics.median(bs)
+        cv = (
+            statistics.stdev(bs) / b_med
+            if len(bs) > 1 and b_med > 0
+            else 0.0
+        )
+        allowed = max(tolerance, noise_k * cv)
+        f_best = max(fs)
+        ratio = f_best / b_med if b_med > 0 else 0.0
+        entry = {
+            "case": _row_key(fr)[0],
+            "engine": fr.get("engine"),
+            "platform": fr.get("platform"),
+            "baseline_median": round(b_med, 1),
+            "fresh_best": round(f_best, 1),
+            "ratio": round(ratio, 4),
+            "allowed_drop": round(allowed, 4),
+            "baseline_cv": round(cv, 4),
+            "regressed": ratio < 1.0 - allowed,
+        }
+        rows.append(entry)
+        if entry["regressed"]:
+            regressions.append(entry)
+    missing_in_fresh = [
+        list(k) for k in base_map if k not in set(fresh_keys)
+    ]
+    return {
+        "compared": len(rows),
+        "rows": rows,
+        "regressions": regressions,
+        "fresh_only": unmatched,
+        "baseline_only": missing_in_fresh,
+        "tolerance": tolerance,
+        "noise_k": noise_k,
+        "ok": bool(rows) and not regressions,
+    }

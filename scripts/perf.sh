@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Perf plane wrapper: record a fresh provenance-carrying bench artifact
+# (bench.py rows — per-run samples, warm-up/timed split, layout version,
+# config fingerprint) and gate it through `paxos_tpu bench-compare`'s
+# noise-aware tolerance model.  Exit codes follow bench-compare: 0 = no
+# regression, 1 = nothing comparable / bad artifact, 2 = regression
+# beyond max(tolerance, noise_k * baseline CV).
+#
+# Usage: scripts/perf.sh [BASELINE.json] [bench.py flags...]
+#   scripts/perf.sh                       # fresh flagship row, self-compare
+#                                         # (measurement+gate end-to-end)
+#   scripts/perf.sh BENCH_SWEEP.json --sweep
+#                                         # fresh sweep vs committed baseline
+#   scripts/perf.sh --n-inst 1024 --pipeline-depth 2
+#                                         # small smoke-sized self-compare
+#
+# NOTE: the committed BENCH_SWEEP.json holds TPU rows; a CPU measurement
+# has zero (case, engine, platform) overlap with it and bench-compare
+# exits 1 BY DESIGN — a vacuous pass must never gate CI.  On a CPU rig,
+# run without a baseline (self-compare) or against a CPU-recorded one.
+cd "$(dirname "$0")/.." || exit 1
+baseline=""
+case "${1:-}" in
+  *.json) baseline="$1"; shift ;;
+esac
+fresh="${PERF_FRESH:-/tmp/paxos_tpu_bench_fresh.json}"
+python bench.py --record "$fresh" "$@" || exit 1
+if [ -n "$baseline" ]; then
+  exec python -m paxos_tpu bench-compare --baseline "$baseline" --fresh "$fresh"
+fi
+exec python -m paxos_tpu bench-compare --baseline "$fresh"

@@ -14,9 +14,9 @@ if [ "$rc" -eq 0 ]; then
     --config config1 --n-inst 64 --ticks 16 --chunk 8 \
     --telemetry --record 8 --hist-bins 4 --log "$m" >/dev/null 2>&1 \
   && timeout -k 10 30 env JAX_PLATFORMS=cpu python -m paxos_tpu stats "$m" \
-       | grep -q '"telemetry"' \
+       | grep '"telemetry"' >/dev/null \
   && timeout -k 10 30 env JAX_PLATFORMS=cpu python -m paxos_tpu stats "$m" --prometheus \
-       | grep -q '^paxos_tpu_events_total' \
+       | grep '^paxos_tpu_events_total' >/dev/null \
   && echo STATS_SMOKE=ok || { echo STATS_SMOKE=FAILED; rc=1; }
 fi
 # Dispatch-pipeline smoke: a pipelined run (grouped dispatches + async
@@ -163,6 +163,42 @@ for protocol, cfg in cases.items():
         init_state(cfg), seed, plan, cfg.fault, 16, apply_fn, mask_fn,
     )
     assert digest(fused) == digest(ref), f"{protocol}: packed fused != XLA reference"
+EOF
+fi
+# Perf-plane smoke: a --perf run must carry throughput/occupancy gauges
+# (occupancy in [0,1]) into both the report and the Prometheus export; a
+# smoke-sized bench row must validate against the provenance schema
+# (per-run samples, warm-up/timed split, layout version, fingerprint);
+# and bench-compare against the freshly recorded artifact must exit 0.
+# The committed BENCH_SWEEP.json is TPU-recorded, so the CPU gate
+# self-compares — zero-overlap exits 1 and can never pass vacuously.
+if [ "$rc" -eq 0 ]; then
+  p=/tmp/_t1_perf.jsonl; b=/tmp/_t1_bench.json; pr=/tmp/_t1_perf_report.json
+  rm -f "$p" "$b" "$pr"
+  timeout -k 10 180 env JAX_PLATFORMS=cpu python -m paxos_tpu run \
+    --config config1 --n-inst 128 --ticks 64 --chunk 32 \
+    --pipeline-depth 2 --perf --log "$p" >"$pr" 2>/dev/null \
+  && timeout -k 10 30 env JAX_PLATFORMS=cpu python -m paxos_tpu stats "$p" --prometheus \
+       | grep '^paxos_tpu_perf_occupancy' >/dev/null \
+  && timeout -k 10 300 env JAX_PLATFORMS=cpu python bench.py \
+       --n-inst 512 --pipeline-depth 2 --record "$b" >/dev/null 2>&1 \
+  && timeout -k 10 60 env JAX_PLATFORMS=cpu python -m paxos_tpu bench-compare \
+       --baseline "$b" >/dev/null 2>&1 \
+  && timeout -k 10 30 env JAX_PLATFORMS=cpu python - "$b" "$pr" <<'EOF' \
+  && echo PERF_SMOKE=ok || { echo PERF_SMOKE=FAILED; rc=1; }
+import json, sys
+from paxos_tpu.obs.perf import validate_bench_row
+rows = json.load(open(sys.argv[1]))
+assert rows, "bench artifact empty"
+for row in rows:
+    errs = validate_bench_row(row)
+    assert not errs, errs
+    assert row["warmup_groups"] >= 1 and row["warmup_runs"], row
+report = json.loads(open(sys.argv[2]).read().strip().splitlines()[-1])
+p = report["perf"]
+assert p["dispatches"] >= 1 and p["rounds_total"] > 0, p
+assert 0.0 <= p["occupancy"] <= 1.0, p["occupancy"]
+assert {"p50", "p95", "p99"} <= set(p["chunk_latency_us"]), p
 EOF
 fi
 exit $rc

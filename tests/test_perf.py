@@ -1,0 +1,405 @@
+"""Performance plane (obs.perf): gauges, bench provenance, regression gate.
+
+Contracts under test:
+
+1. **Pure decode**: ``perf_summary``/``perf_counter_tracks`` derive
+   throughput, occupancy, compile-vs-steady split, and chunk-latency
+   percentiles from a span stream recorded under a FAKE injected clock —
+   fully deterministic, no wall clock in the assertions.
+2. **Default-off is free**: a ``--perf`` run's report equals the bare
+   run's report minus the ``perf`` block (the plane is host-side only and
+   cannot perturb the campaign).
+3. **Bench provenance**: reworked ``bench.py`` rows validate against
+   ``BENCH_ROW_SCHEMA`` (per-run samples, explicit warm-up vs measured
+   counts, layout version, fingerprint) and ``compare_benches`` passes a
+   self-comparison, flags a planted regression, and widens its band for
+   noisy baselines (the noise-aware tolerance model).
+4. **One registry, all planes**: telemetry + coverage + exposure + perf
+   gauges coexist in a single registry export with no sample-line
+   collisions, and the combined overhead of running every plane at once
+   stays within a stated factor of the bare run.
+"""
+
+import json
+import time
+
+import pytest
+
+from paxos_tpu.harness.cli import main
+from paxos_tpu.harness.metrics import MetricsRegistry
+from paxos_tpu.obs import perf
+from paxos_tpu.obs.host_spans import HostSpanRecorder
+
+
+# ---------------------------------------------------------------- fake clock
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _recorded_loop(n_dispatches=6, compile_s=0.5, dispatch_s=0.01,
+                   probe_s=0.09, gap_s=0.002, ticks=128, groups=2):
+    """A synthetic pipelined loop: slow first dispatch, steady tail."""
+    clock = FakeClock()
+    rec = HostSpanRecorder(clock)
+    tick = 0
+    for i in range(n_dispatches):
+        with rec.span("dispatch", tick_start=tick, ticks=ticks,
+                      groups=groups):
+            clock.advance(compile_s if i == 0 else dispatch_s)
+        tick += ticks
+        with rec.span("probe", tick=tick):
+            clock.advance(probe_s)
+        clock.advance(gap_s)
+    with rec.span("report"):
+        clock.advance(0.05)
+    return rec
+
+
+def test_perf_summary_fake_clock():
+    rec = _recorded_loop()
+    s = perf.perf_summary(rec, n_inst=1000, window=4)
+    assert s["dispatches"] == 6
+    assert s["chunks"] == 12
+    assert s["rounds_total"] == 6 * 128 * 1000
+    assert s["compile_s"] == pytest.approx(0.5)
+    # busy = all dispatch/probe/report time; gaps are host bookkeeping
+    assert 0.0 <= s["occupancy"] <= 1.0
+    assert s["occupancy"] > 0.95  # gaps are tiny in the synthetic loop
+    # steady-state excludes the compile-heavy first dispatch
+    assert s["rounds_per_sec_steady"] > s["rounds_per_sec"]
+    assert s["window_dispatches"] == 4
+    lat = s["chunk_latency_us"]
+    assert lat["samples"] == 12
+    assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+    # the compile dispatch dominates the tail percentile
+    assert lat["max"] > 5 * lat["p50"]
+
+
+def test_perf_summary_empty_and_single():
+    assert perf.perf_summary([], 10) == {"dispatches": 0, "rounds_total": 0}
+    clock = FakeClock()
+    rec = HostSpanRecorder(clock)
+    with rec.span("dispatch", tick_start=0, ticks=64, groups=1):
+        clock.advance(0.25)
+    s = perf.perf_summary(rec, n_inst=100)
+    assert s["dispatches"] == 1
+    assert "rounds_per_sec_steady" not in s  # needs >= 2 dispatches
+    assert s["rounds_per_sec"] == pytest.approx(100 * 64 / 0.25)
+    assert s["occupancy"] == 1.0
+
+
+def test_perf_counter_tracks_shape():
+    rec = _recorded_loop()
+    tracks = perf.perf_counter_tracks(rec, n_inst=1000)
+    assert set(tracks) == {"host_rounds_per_sec", "host_occupancy_pct"}
+    for name, series in tracks.items():
+        assert len(series) == 6
+        ticks = [t for t, _ in series]
+        assert ticks == sorted(ticks)
+        assert ticks[-1] == 6 * 128  # stamped at dispatch END ticks
+    for _, pct in tracks["host_occupancy_pct"]:
+        assert 0.0 <= pct <= 100.0
+    assert perf.perf_counter_tracks([], 10) == {}
+
+
+def test_percentile_nearest_rank():
+    vals = list(range(1, 101))
+    assert perf.percentile(vals, 0.50) == 50
+    assert perf.percentile(vals, 0.95) == 95
+    assert perf.percentile(vals, 0.99) == 99
+    assert perf.percentile([7], 0.99) == 7
+    assert perf.percentile([], 0.5) is None
+
+
+def test_vmem_and_roofline_gauges():
+    g = perf.vmem_gauges(356, 1024)
+    assert g["vmem_state_bytes"] == 356 * 1024
+    assert 0 < g["vmem_occupancy"] <= 1.0
+    assert g["vmem_budget_bytes"] > 0
+    assert perf.vmem_gauges(356, None) == {}
+    r = perf.roofline_gauges(
+        3.7e8, {"alu_per_lane_tick": 5329.0},
+        {"vpu_ops_per_sec": 2.35e12},
+    )
+    assert r["roofline_ceiling_rps"] == pytest.approx(2.35e12 / 5329.0, rel=1e-3)
+    assert 0 < r["roofline_occupancy"] < 1.5
+    assert perf.roofline_gauges(1.0, {}, {}) == {}
+
+
+# ----------------------------------------------------------- bench provenance
+
+
+def _fake_row(**over):
+    row = {
+        "schema": perf.BENCH_ROW_SCHEMA,
+        "metric": "quorum-rounds/sec/chip",
+        "value": 100.0,
+        "unit": "instance-rounds/sec",
+        "samples": [98.0, 100.0, 99.0],
+        "median": 99.0,
+        "min": 98.0,
+        "stdev": 1.0,
+        "warmup_groups": 1,
+        "timed_groups": 3,
+        "n_instances": 1024,
+        "chunk": 64,
+        "pipeline_depth": 1,
+        "ticks": 256,
+        "platform": "cpu",
+        "engine": "xla",
+        "protocol": "paxos",
+        "layout_version": "paxos-packed-v1",
+        "config_fingerprint": "deadbeef00000000",
+        "case": "case-a",
+    }
+    row.update(over)
+    return row
+
+
+def test_validate_bench_row():
+    assert perf.validate_bench_row(_fake_row()) == []
+    assert perf.validate_bench_row("nope")
+    errs = perf.validate_bench_row(_fake_row(samples=[]))
+    assert any("samples" in e for e in errs)
+    errs = perf.validate_bench_row({k: v for k, v in _fake_row().items()
+                                    if k != "layout_version"})
+    assert any("layout_version" in e for e in errs)
+    errs = perf.validate_bench_row(_fake_row(schema="bogus-v9"))
+    assert any("schema" in e for e in errs)
+
+
+def test_compare_benches_self_and_regression():
+    rows = [_fake_row(), _fake_row(case="case-b", engine="fused")]
+    ok = perf.compare_benches(rows, rows)
+    assert ok["ok"] and ok["compared"] == 2 and not ok["regressions"]
+    # planted regression: 50% drop >> 10% tolerance
+    slow = [dict(rows[0], samples=[49.0, 50.0, 49.5], value=50.0), rows[1]]
+    bad = perf.compare_benches(rows, slow)
+    assert not bad["ok"]
+    assert [r["case"] for r in bad["regressions"]] == ["case-a"]
+    assert bad["regressions"][0]["ratio"] == pytest.approx(50 / 99, rel=1e-3)
+
+
+def test_compare_benches_noise_widens_band():
+    # Baseline CV ~20% -> allowed drop 3*0.2 = 60%: a 50% drop passes.
+    noisy = [_fake_row(samples=[60.0, 100.0, 140.0], median=100.0)]
+    slow = [_fake_row(samples=[50.0], value=50.0)]
+    res = perf.compare_benches(noisy, slow)
+    assert res["ok"], res
+    assert res["rows"][0]["allowed_drop"] > 0.5
+    # Quiet baseline: same 50% drop regresses.
+    quiet = [_fake_row()]
+    assert not perf.compare_benches(quiet, slow)["ok"]
+
+
+def test_compare_benches_no_overlap_is_not_ok():
+    a = [_fake_row(case="only-a")]
+    b = [_fake_row(case="only-b")]
+    res = perf.compare_benches(a, b)
+    assert res["compared"] == 0 and not res["ok"]
+    assert res["fresh_only"] and res["baseline_only"]
+
+
+def test_compare_benches_legacy_rows():
+    """Pre-schema BENCH_SWEEP.json rows (throughput_runs) still compare."""
+    legacy = {"case": "old", "engine": "xla", "platform": "tpu",
+              "value": 100.0, "throughput_runs": [99.0, 100.0, 98.0]}
+    fresh = _fake_row(case="old", platform="tpu")
+    res = perf.compare_benches([legacy], [fresh])
+    assert res["compared"] == 1 and res["ok"]
+
+
+def test_bench_case_schema_and_warmup_split():
+    """A real (tiny) bench_case run emits a schema-valid provenance row."""
+    from bench import bench_case
+    from paxos_tpu.harness.config import config1_no_faults
+
+    row = bench_case(config1_no_faults(n_inst=64), "xla", chunk=16,
+                     timed_chunks=2, repeats=2, warmup_groups=1)
+    assert perf.validate_bench_row(row) == []
+    assert row["warmup_groups"] == 1 and len(row["warmup_runs"]) == 1
+    assert row["timed_groups"] == 2 and len(row["samples"]) == 2
+    assert row["layout_version"] == "paxos-packed-v1"
+    assert row["perf"]["dispatches"] >= 2
+    assert 0.0 <= row["perf"]["occupancy"] <= 1.0
+    # warm-up (compile) must not leak into the measured samples
+    assert row["perf"]["compile_s"] > 0
+
+
+# ------------------------------------------------------------------ CLI paths
+
+
+def _run_cli(tmp_path, capsys, *extra):
+    log = tmp_path / f"m{abs(hash(extra)) % 997}.jsonl"
+    rc = main([
+        "run", "--config", "config1", "--n-inst", "128", "--ticks", "64",
+        "--chunk", "32", "--pipeline-depth", "2", "--log", str(log), *extra,
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    return report, log
+
+
+def test_cli_run_perf_gauges(tmp_path, capsys):
+    report, log = _run_cli(tmp_path, capsys, "--perf")
+    p = report["perf"]
+    assert p["dispatches"] >= 1
+    assert 0.0 <= p["occupancy"] <= 1.0
+    assert p["rounds_total"] == 128 * 64
+    assert {"p50", "p95", "p99"} <= set(p["chunk_latency_us"])
+    # gauges land in the JSONL metrics record too
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    snap = [r for r in recs if r["event"] == "metrics"][-1]
+    assert "perf_occupancy" in snap["gauges"]
+    assert "perf_rounds_per_sec" in snap["gauges"]
+
+
+def test_cli_run_perf_default_off_report_identical(tmp_path, capsys):
+    """Default-off guarantee at the report level: --perf only ADDS a key."""
+    bare, _ = _run_cli(tmp_path, capsys)
+    perf_on, _ = _run_cli(tmp_path, capsys, "--perf")
+    assert "perf" not in bare
+    perf_on.pop("perf")
+    assert perf_on == bare
+
+
+def test_cli_stats_perf_prometheus_and_follow(tmp_path, capsys):
+    _, log = _run_cli(tmp_path, capsys, "--perf")
+    rc = main(["stats", str(log), "--prometheus"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "paxos_tpu_perf_occupancy" in text
+    assert "paxos_tpu_perf_chunk_latency_us{quantile=\"p95\"}" in text
+    # --follow stops on the final record already present in the stream
+    rc = main(["stats", str(log), "--follow", "--interval", "0.05"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["perf"]["dispatches"] >= 1
+
+
+def test_cli_stats_follow_max_renders_without_final(tmp_path, capsys):
+    log = tmp_path / "partial.jsonl"
+    log.write_text(json.dumps({"event": "start"}) + "\n"
+                   + json.dumps({"event": "seed", "seed": 0, "wall_s": 1.0,
+                                 "rounds": 100, "rounds_per_sec": 100.0})
+                   + "\n")
+    rc = main(["stats", str(log), "--follow", "--interval", "0.05",
+               "--max-renders", "2"])
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2  # rendered exactly max-renders times
+    assert json.loads(lines[-1])["last_seed"]["rounds_per_sec"] == 100.0
+
+
+def test_cli_bench_compare(tmp_path, capsys):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps([_fake_row()]))
+    # self-comparison: exit 0
+    assert main(["bench-compare", "--baseline", str(base)]) == 0
+    capsys.readouterr()
+    # planted >= tolerance regression: exit 2
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps(
+        [_fake_row(samples=[60.0, 61.0], median=60.5, min=60.0, value=61.0)]
+    ))
+    rc = main(["bench-compare", "--baseline", str(base),
+               "--fresh", str(slow)])
+    assert rc == 2
+    out = capsys.readouterr()
+    assert "REGRESSION" in out.err
+    # missing artifact: exit 1
+    assert main(["bench-compare", "--baseline",
+                 str(tmp_path / "absent.json")]) == 1
+    capsys.readouterr()
+    # schema-invalid fresh row: exit 1
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps([_fake_row(samples=[])]))
+    assert main(["bench-compare", "--baseline", str(base),
+                 "--fresh", str(bad)]) == 1
+    capsys.readouterr()
+
+
+# --------------------------------------------------------- soak per-seed trend
+
+
+def test_soak_per_seed_throughput_trend():
+    from paxos_tpu.harness.config import config1_no_faults
+    from paxos_tpu.harness.soak import soak
+
+    streamed = []
+    report = soak(
+        config1_no_faults(n_inst=64),
+        target_rounds=3 * 64 * 32,
+        ticks_per_seed=32,
+        chunk=16,
+        engine="xla",
+        on_seed=streamed.append,
+    )
+    assert report["seeds"] == 3
+    assert len(report["per_seed"]) == 3
+    assert streamed == report["per_seed"]
+    for rec in report["per_seed"]:
+        assert rec["rounds"] == 64 * 32
+        assert rec["rounds_per_sec"] > 0
+        assert rec["wall_s"] >= 0
+    assert [r["seed"] for r in report["per_seed"]] == [0, 1, 2]
+
+
+# ------------------------------------------- all planes in one registry/budget
+
+
+def test_all_planes_one_registry_no_collisions():
+    """Telemetry + coverage + exposure + spans + perf share one registry."""
+    registry = MetricsRegistry()
+    registry.ingest({"counters": {"decide": 7}, "hist": [1, 2, 3],
+                     "hist_ticks_per_bin": 4})
+    registry.ingest_coverage({"bits_set": 10, "bits_total": 64,
+                              "saturation": 0.15, "est_states": 12})
+    registry.ingest_exposure(
+        {"classes": {"drop": {"injected": 5, "effective": 3,
+                              "lanes_exposed": 2}}},
+        lit={"drop": True},
+    )
+    registry.ingest_span_aggregates({"round_latency_p50": 3,
+                                     "rounds_total": 9})
+    registry.ingest_perf(perf.perf_summary(_recorded_loop(), 1000))
+    text = registry.to_prometheus()
+    sample_lines = [l for l in text.splitlines()
+                    if l and not l.startswith("#")]
+    names = [l.split(" ")[0] for l in sample_lines]
+    assert len(names) == len(set(names)), "label collision in shared registry"
+    for expected in ("paxos_tpu_events_total", "paxos_tpu_coverage_bits_set",
+                     "paxos_tpu_exposure_effective",
+                     "paxos_tpu_round_latency_ticks",
+                     "paxos_tpu_perf_occupancy",
+                     "paxos_tpu_perf_rounds_per_sec"):
+        assert any(n.startswith(expected) for n in names), expected
+
+
+@pytest.mark.slow
+def test_all_planes_on_overhead_budget(tmp_path, capsys):
+    """Stated budget: every observability plane on at once stays within
+    15x of the bare run, steady-state.  Each variant runs once to compile
+    (the planes add device state, so their computation is distinct and
+    compiles separately) and the SECOND run is timed — the overhead being
+    pinned is the per-campaign cost of readbacks + host decode, not the
+    one-time compile."""
+    def timed(*extra):
+        _run_cli(tmp_path, capsys, *extra)  # warm: compile both variants
+        t0 = time.perf_counter()
+        _run_cli(tmp_path, capsys, *extra)
+        return time.perf_counter() - t0
+
+    bare = timed()
+    allon = timed("--telemetry", "--coverage", "--coverage-words", "8",
+                  "--exposure", "--perf")
+    assert allon < 15 * bare, f"all-planes overhead {allon / bare:.1f}x"
