@@ -34,6 +34,40 @@ import time
 
 NORTH_STAR = 10_000_000.0  # BASELINE.md north-star target
 
+_ROOFLINE_MOD = None  # scripts/roofline.py, loaded once per process
+
+
+def _tick_ops_per_lane(cfg, block: int) -> float:
+    """Census op count (alu + codec_alu + reduce per lane-tick) for ``cfg``.
+
+    Traced FRESH at bench time from the same ``tick_census`` the roofline
+    artifact uses, so every row records the op count of the program it
+    actually measured (ROOFLINE.json could be stale, and the flagship /
+    CPU cases have no committed census entry).  This is the denominator of
+    the VPU roofline — a bench-compare delta with an unchanged
+    ``ops_per_lane_tick`` is clock/schedule, a changed one is an op-count
+    cut (or regression).
+    """
+    global _ROOFLINE_MOD
+    if _ROOFLINE_MOD is None:
+        import importlib.util
+        import pathlib
+
+        path = pathlib.Path(__file__).resolve().parent / "scripts"
+        spec = importlib.util.spec_from_file_location(
+            "_bench_roofline_census", path / "roofline.py"
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _ROOFLINE_MOD = mod
+    census = _ROOFLINE_MOD.tick_census(cfg, block)
+    return round(
+        census["alu_per_lane_tick"]
+        + census["codec_alu_per_lane_tick"]
+        + census["reduce_per_lane_tick"],
+        1,
+    )
+
 
 def _configs(platform: str):
     """The sweep table: (name, SimConfig, engine, chunk, depth) per case.
@@ -196,7 +230,7 @@ def bench_case(
     # THIS engine carries: packed codec words for fused rows, the unpacked
     # pytree for xla rows (which never packs).  eval_shape/leaf-shape based:
     # free, computed before the state is donated away.
-    from paxos_tpu.kernels.fused_tick import fit_block
+    from paxos_tpu.kernels.fused_tick import fit_block, fused_fns
     from paxos_tpu.utils import bitops
 
     state_bytes = (
@@ -282,6 +316,15 @@ def bench_case(
         "engine": engine,
         "protocol": cfg.protocol,
         "violations": violations[0],
+        # v2 schema: the fused-tick census op count this row ran under
+        # (XLA rows census at the protocol's default fused block — the op
+        # count is a property of the tick program, not the engine).
+        "ops_per_lane_tick": _tick_ops_per_lane(
+            cfg,
+            eff_block if eff_block is not None
+            else fit_block(fused_fns(cfg.protocol)[2], cfg.n_inst,
+                           warn=False),
+        ),
         "state_bytes_per_lane": state_bytes,
         "block": eff_block,
         # Stream lineage (VERDICT r4 weak#3): the fused block this case ran

@@ -94,7 +94,13 @@ def run_audit(
         # ``structure``): a layout edit without a version bump corrupts
         # live checkpoints, which is never a release-gate-only concern.
         findings += struct_mod.audit_layout(protocol)
-        checks += 1
+        # Write-set + clamp-hoist guards are likewise always on: a tick
+        # writing outside its declared *_TICK_WRITES would have that write
+        # silently dropped by the delta codec, and a ballot clamp leaking
+        # back into the per-tick body silently re-taxes every tick.
+        findings += struct_mod.audit_write_set(protocol)
+        findings += struct_mod.audit_clamp_hoist(protocol)
+        checks += 3
         traces = {}
         for config_name in confs:
             cfg = trace_mod.build_config(protocol, config_name)

@@ -24,6 +24,15 @@ CONFIG_GOLDENS kept every existing cell (the fingerprint drops a
 default-off ExposureConfig, so recorded campaigns keep their identity)
 and LAYOUT_GOLDENS are byte-identical to round 8: the counters ride the
 same generic passthrough codec, touching no packed word.
+
+Round 11 re-record: the delta-codec release (*-packed-v2).  proposer.bal
+widened for the chunk-boundary ballot-clamp hoist (17 bits single-decree,
+12 bits Multi-Paxos — headroom over the unchanged report limits), and
+``bitops.layout_fields`` now folds the per-protocol ``__reads__`` /
+``__writes__`` tick declarations, so every LAYOUT cell re-keyed and every
+CONFIG cell re-keyed through the version fold.  TREEDEF cells are
+byte-identical to round 9: packing width is invisible to the pytree
+structure.
 """
 
 # (protocol, config_name) -> sha256[:16] of str(tree_structure(init_state))
@@ -63,34 +72,34 @@ TREEDEF_GOLDENS: dict = {
 # the per-protocol layout version (paxos-packed-v1 / multipaxos-packed-v1 /
 # fastpaxos-packed-v1 / raftcore-packed-v1), re-keying every cell.
 CONFIG_GOLDENS: dict = {
-    ("paxos", "default"): "f50cfbfdf74b11c0",
-    ("paxos", "gray-chaos"): "a68d36156e155a29",
-    ("paxos", "corrupt"): "1b476cdd907b5933",
-    ("paxos", "stale"): "dd2e59a672568867",
-    ("paxos", "telemetry"): "45769fa2f93945e0",
-    ("paxos", "coverage"): "1688a7b588e353ce",
-    ("paxos", "exposure"): "603bc79585bdf597",
-    ("multipaxos", "default"): "c43e601ef68a237f",
-    ("multipaxos", "gray-chaos"): "ef22269046287409",
-    ("multipaxos", "corrupt"): "8175e48831a73e89",
-    ("multipaxos", "stale"): "f68540b11905991c",
-    ("multipaxos", "telemetry"): "4ea3f797b32bc566",
-    ("multipaxos", "coverage"): "acdbcb7fcb033a3b",
-    ("multipaxos", "exposure"): "8cacc47bbd0378c5",
-    ("fastpaxos", "default"): "cb51e3867a43b91b",
-    ("fastpaxos", "gray-chaos"): "d311d7e3d86192e7",
-    ("fastpaxos", "corrupt"): "72485f432fb7393a",
-    ("fastpaxos", "stale"): "0bc8e8e18a940735",
-    ("fastpaxos", "telemetry"): "298edfbc20970277",
-    ("fastpaxos", "coverage"): "4cf16c0d9ad6ccc6",
-    ("fastpaxos", "exposure"): "ea463f9d5b1e9a59",
-    ("raftcore", "default"): "ff49ab17defc9057",
-    ("raftcore", "gray-chaos"): "1755349e01c9d063",
-    ("raftcore", "corrupt"): "040a2cdb1838612f",
-    ("raftcore", "stale"): "291ba0bd46e6cd30",
-    ("raftcore", "telemetry"): "d0b50c940de6b66a",
-    ("raftcore", "coverage"): "b2628ea1f5ad5604",
-    ("raftcore", "exposure"): "a505137b82c1938e",
+    ("paxos", "default"): "18de70331e1f13fe",
+    ("paxos", "gray-chaos"): "d375ecd0a0130cae",
+    ("paxos", "corrupt"): "eb408e35f2743ee1",
+    ("paxos", "stale"): "9bda52d0d855f214",
+    ("paxos", "telemetry"): "a71171b4a628a1be",
+    ("paxos", "coverage"): "aeaca5f24fbdfcea",
+    ("paxos", "exposure"): "9d9c96379b0b9972",
+    ("multipaxos", "default"): "3cc71d01ec7ec84e",
+    ("multipaxos", "gray-chaos"): "120f1c32622f6769",
+    ("multipaxos", "corrupt"): "04b29093ed3c7ad6",
+    ("multipaxos", "stale"): "74305d7853d2b18c",
+    ("multipaxos", "telemetry"): "e69a9168cd12ae35",
+    ("multipaxos", "coverage"): "035d59fe1e972a90",
+    ("multipaxos", "exposure"): "b73cc15a9d4d42f7",
+    ("fastpaxos", "default"): "f666d3ca9066fcb7",
+    ("fastpaxos", "gray-chaos"): "5c52340743718cc9",
+    ("fastpaxos", "corrupt"): "6dd54955e967856c",
+    ("fastpaxos", "stale"): "2cb53cfea1744c3f",
+    ("fastpaxos", "telemetry"): "904e07b30eb99bd4",
+    ("fastpaxos", "coverage"): "70390a8635254d21",
+    ("fastpaxos", "exposure"): "994c005d0bf061b3",
+    ("raftcore", "default"): "db4b28950ad681d8",
+    ("raftcore", "gray-chaos"): "3250ae1b49be26b9",
+    ("raftcore", "corrupt"): "ce3ffc88b74b0b9f",
+    ("raftcore", "stale"): "68b16adbda72f7ce",
+    ("raftcore", "telemetry"): "12dfb29f71807ce0",
+    ("raftcore", "coverage"): "d78aa0ad54c87736",
+    ("raftcore", "exposure"): "faecd36c8698b3e9",
 }
 
 # protocol -> {"version": layout version string, "fields": canonical per-field
@@ -102,10 +111,14 @@ CONFIG_GOLDENS: dict = {
 # name the version in the commit.
 LAYOUT_GOLDENS: dict = {
     "paxos": {
-        "version": "paxos-packed-v1",
+        "version": "paxos-packed-v2",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.promised', 0))]",
+            "__reads__":
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.*', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+            "__writes__":
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.bal', 'proposer.best_bal', 'proposer.best_val', 'proposer.decided_val', 'proposer.heard', 'proposer.phase', 'proposer.prop_val', 'proposer.timer', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
             "acceptor.acc_bal":
                 "word=acc slot=1 bits=15 signed=0 bool=0 bv=None",
             "acceptor.promised":
@@ -127,7 +140,7 @@ LAYOUT_GOLDENS: dict = {
             "learner.lt_val":
                 "word=lt slot=1 bits=12 signed=0 bool=0 bv=None",
             "proposer.bal":
-                "word=prop0 slot=0 bits=15 signed=0 bool=0 bv=None",
+                "word=prop0 slot=0 bits=17 signed=0 bool=0 bv=None",
             "proposer.best_bal":
                 "word=prop2 slot=1 bits=15 signed=0 bool=0 bv=None",
             "proposer.best_val":
@@ -161,10 +174,14 @@ LAYOUT_GOLDENS: dict = {
         },
     },
     "multipaxos": {
-        "version": "multipaxos-packed-v1",
+        "version": "multipaxos-packed-v2",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.promised', 0))]",
+            "__reads__":
+                "('accepted.*', 'acceptor.*', 'base', 'coverage.*', 'exposure.*', 'learner.*', 'promises.*', 'proposer.*', 'requests.*', 'telemetry.*', 'tick')",
+            "__writes__":
+                "('accepted.*', 'acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'promises.*', 'proposer.*', 'requests.*', 'telemetry.*', 'tick')",
             "accepted.bal":
                 "word=accd slot=0 bits=12 signed=0 bool=0 bv=None",
             "accepted.present":
@@ -192,7 +209,7 @@ LAYOUT_GOLDENS: dict = {
             "promises.present":
                 "word=prom slot=1 bits=1 signed=0 bool=1 bv=None",
             "proposer.bal":
-                "word=prop0 slot=0 bits=11 signed=0 bool=0 bv=None",
+                "word=prop0 slot=0 bits=12 signed=0 bool=0 bv=None",
             "proposer.candidate_timer":
                 "word=prop0 slot=3 bits=12 signed=0 bool=0 bv=None",
             "proposer.commit_idx":
@@ -214,10 +231,14 @@ LAYOUT_GOLDENS: dict = {
         },
     },
     "fastpaxos": {
-        "version": "fastpaxos-packed-v1",
+        "version": "fastpaxos-packed-v2",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.promised', 0))]",
+            "__reads__":
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.*', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+            "__writes__":
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.bal', 'proposer.best_bal', 'proposer.decided_val', 'proposer.heard', 'proposer.phase', 'proposer.prop_val', 'proposer.rep_mask', 'proposer.timer', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
             "acceptor.acc_bal":
                 "word=acc slot=1 bits=15 signed=0 bool=0 bv=None",
             "acceptor.promised":
@@ -239,7 +260,7 @@ LAYOUT_GOLDENS: dict = {
             "learner.lt_val":
                 "word=lt slot=1 bits=12 signed=0 bool=0 bv=None",
             "proposer.bal":
-                "word=prop0 slot=0 bits=15 signed=0 bool=0 bv=None",
+                "word=prop0 slot=0 bits=17 signed=0 bool=0 bv=None",
             "proposer.best_bal":
                 "word=prop2 slot=1 bits=15 signed=0 bool=0 bv=None",
             "proposer.heard":
@@ -269,10 +290,14 @@ LAYOUT_GOLDENS: dict = {
         },
     },
     "raftcore": {
-        "version": "raftcore-packed-v1",
+        "version": "raftcore-packed-v2",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.voted', 0))]",
+            "__reads__":
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.*', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+            "__writes__":
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.bal', 'proposer.decided_val', 'proposer.ent_term', 'proposer.ent_val', 'proposer.heard', 'proposer.phase', 'proposer.prop_val', 'proposer.timer', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
             "acceptor.ent_term":
                 "word=acc slot=1 bits=15 signed=0 bool=0 bv=None",
             "acceptor.snap_term":
@@ -294,7 +319,7 @@ LAYOUT_GOLDENS: dict = {
             "learner.lt_val":
                 "word=lt slot=1 bits=12 signed=0 bool=0 bv=None",
             "proposer.bal":
-                "word=prop0 slot=0 bits=15 signed=0 bool=0 bv=None",
+                "word=prop0 slot=0 bits=17 signed=0 bool=0 bv=None",
             "proposer.decided_val":
                 "word=prop3 slot=1 bits=12 signed=0 bool=0 bv=None",
             "proposer.ent_term":

@@ -263,6 +263,147 @@ def audit_layout(protocol: str) -> list:
     return findings
 
 
+# Where each protocol declares its layout + read/write-set tables — audit
+# findings name the file so the fix needs no grepping.
+_STATE_FILES = {
+    "paxos": "paxos_tpu/core/state.py",
+    "multipaxos": "paxos_tpu/core/mp_state.py",
+    "fastpaxos": "paxos_tpu/core/fp_state.py",
+    "raftcore": "paxos_tpu/core/raft_state.py",
+}
+
+
+def _written_leaf_paths(protocol: str, cfg: SimConfig) -> set:
+    """Dotted paths of state leaves the fused tick actually writes.
+
+    Traces the counter tick body (the exact program the Pallas kernel
+    lowers) with state as the ONLY free input; a leaf is unwritten iff its
+    output var is literally its input var (the tracer passed it through
+    untouched), written otherwise.
+    """
+    import jax.numpy as jnp
+
+    from paxos_tpu.kernels.counter_prng import mix
+    from paxos_tpu.kernels.fused_tick import fused_fns
+    from paxos_tpu.utils import bitops
+
+    apply_fn, mask_fn, _ = fused_fns(protocol)
+    state = init_state(cfg)
+    plan = init_plan(cfg)
+
+    def body(st):
+        tick_seed = mix(jnp.int32(cfg.seed), st.tick, jnp.int32(0))
+        return apply_fn(st, mask_fn(cfg.fault, tick_seed, st), plan, cfg.fault)
+
+    jaxpr = jax.make_jaxpr(body)(state).jaxpr
+    paths = bitops.leaf_paths(state)
+    written = set()
+    for i, (iv, ov) in enumerate(zip(jaxpr.invars, jaxpr.outvars)):
+        if ov is not iv:
+            written.add(paths[i])
+    return written
+
+
+def audit_write_set(protocol: str) -> list:
+    """Always-on: the fused tick must write INSIDE its declared write-set.
+
+    The delta codec (``bitops.Codec.pack_delta``) re-encodes only the
+    declared ``*_TICK_WRITES`` leaves and carries everything else through
+    the fori_loop unchanged — so a transition that starts writing an
+    undeclared leaf would have that write silently DROPPED by the packed
+    engine while the XLA engine applies it.  This audit catches the drift
+    at trace time and names the leaf and the declaration file.
+
+    Audited over the ``default`` and ``stale`` cells: together they cover
+    every always-on leaf plus the snapshot shadows; the telemetry /
+    coverage / exposure planes are declared as whole-subtree globs, so
+    their leaves cannot drift outside the set.
+    """
+    from paxos_tpu.analysis import trace as trace_mod
+    from paxos_tpu.utils import bitops
+
+    findings = []
+    _, writes_decl = bitops.protocol_rw(protocol)
+    for config_name in ("default", "stale"):
+        cfg = trace_mod.build_config(protocol, config_name)
+        where = f"{protocol}/{config_name}"
+        for path in sorted(_written_leaf_paths(protocol, cfg)):
+            if not bitops.path_matches(path, writes_decl):
+                findings.append(Finding(
+                    check="write-set", where=where,
+                    message=(
+                        f"fused tick for {where} writes state leaf "
+                        f"'{path}' OUTSIDE the declared write-set: the "
+                        f"delta codec would silently drop this write on "
+                        f"the packed engine — add '{path}' to the "
+                        f"*_TICK_WRITES table in {_STATE_FILES[protocol]}"
+                    ),
+                ))
+    return findings
+
+
+def _count_min_eqns(jaxpr) -> int:
+    """Total ``min`` primitives in a (possibly nested) jaxpr."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    n = 0
+    for eq in jaxpr.eqns:
+        if eq.primitive.name == "min":
+            n += 1
+        for p in eq.params.values():
+            if hasattr(p, "jaxpr") or hasattr(p, "eqns"):
+                n += _count_min_eqns(p)
+            elif isinstance(p, (list, tuple)):
+                n += sum(
+                    _count_min_eqns(q)
+                    for q in p
+                    if hasattr(q, "jaxpr") or hasattr(q, "eqns")
+                )
+    return n
+
+
+def audit_clamp_hoist(protocol: str) -> list:
+    """Always-on: the ballot clamp must be ABSENT from the per-tick jaxpr.
+
+    The hoisted clamp (``fused_tick._saturate_ballots`` at chunk entry /
+    exit) is only a win if the default per-tick program really lost its
+    saturation ``min``; this audits the traced tick rather than eyeballing
+    it, by diffing the hoisted trace against the ``clamp_per_tick=True``
+    fallback — the fallback must carry exactly one extra ``min``.
+    """
+    import jax.numpy as jnp
+
+    from paxos_tpu.analysis import trace as trace_mod
+    from paxos_tpu.kernels.fused_tick import packed_fns
+    from paxos_tpu.utils import bitops
+
+    cfg = trace_mod.build_config(protocol, "default")
+    state = init_state(cfg)
+    plan = init_plan(cfg)
+    codec = bitops.codec_for(protocol, state)
+    pst = bitops.pack_state(codec, state)
+    counts = {}
+    for per_tick in (False, True):
+        apply_fn, _, _ = packed_fns(protocol, clamp_per_tick=per_tick)
+
+        def body(p):
+            return apply_fn(p, jnp.int32(1), plan, cfg.fault)
+
+        counts[per_tick] = _count_min_eqns(jax.make_jaxpr(body)(pst))
+    if counts[True] != counts[False] + 1:
+        return [Finding(
+            check="clamp-hoist", where=f"{protocol}/default",
+            message=(
+                f"per-tick packed jaxpr for {protocol} does not show the "
+                f"hoisted ballot clamp: expected the clamp_per_tick=True "
+                f"fallback to carry exactly one extra `min` eqn, got "
+                f"{counts[False]} (hoisted) vs {counts[True]} (fallback) — "
+                f"the clamp leaked back into the tick body "
+                f"(kernels/fused_tick.packed_fns) or the fallback lost it"
+            ),
+        )]
+    return []
+
+
 def record_goldens(matrix) -> dict:
     """Compute fresh goldens for ``matrix`` = [(protocol, config_name, cfg)].
 

@@ -154,7 +154,7 @@ class FastPaxosState:
 
 from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
 
-FP_LAYOUT_VERSION = "fastpaxos-packed-v1"
+FP_LAYOUT_VERSION = "fastpaxos-packed-v2"
 FP_LAYOUT = (
     Word("req", F("requests.bal", 15), F("requests.v1", 12),
          F("requests.present", 1, bool_=True)),
@@ -164,7 +164,10 @@ FP_LAYOUT = (
     Word("acc", F("acceptor.promised", 15), F("acceptor.acc_bal", 15)),
     Word("snap_acc", F("acceptor.snap_promised", 15),
          F("acceptor.snap_bal", 15), optional=True),
-    Word("prop0", F("proposer.bal", 15), F("proposer.phase", 2),
+    # 17-bit proposer.bal: 2 headroom bits over the 15-bit report threshold
+    # so the chunk-boundary-only ballot clamp (fused_tick) cannot wrap
+    # mid-chunk — see core/state.py.
+    Word("prop0", F("proposer.bal", 17), F("proposer.phase", 2),
          F("proposer.timer", 13, signed=True)),
     Word("prop1", F("proposer.own_val", 12), F("proposer.prop_val", 12)),
     Word("prop2", F("proposer.heard", 16), F("proposer.best_bal", 15)),
@@ -175,3 +178,20 @@ FP_LAYOUT = (
          F("learner.chosen_tick", 19, signed=True)),
 )
 FP_LAYOUT_DIMS = {"n_acc": ("acceptor.promised", 0)}
+
+# Tick read/write-set declarations (delta codec + write-set audit — see the
+# read/write-set section of utils/bitops.py).  As in classic paxos, the tick
+# writes everything except proposer.own_val (the fixed fast-round candidate
+# value, assigned at init and only ever read).
+FP_TICK_READS = (
+    "acceptor.*", "proposer.*", "learner.*", "requests.*", "replies.*",
+    "telemetry.*", "coverage.*", "exposure.*", "tick",
+)
+FP_TICK_WRITES = (
+    "acceptor.*",
+    "proposer.bal", "proposer.phase", "proposer.timer", "proposer.prop_val",
+    "proposer.heard", "proposer.best_bal", "proposer.rep_mask",
+    "proposer.decided_val",
+    "learner.*", "requests.*", "replies.*",
+    "telemetry.*", "coverage.*", "exposure.*", "tick",
+)

@@ -290,7 +290,7 @@ class MultiPaxosState:
 
 from paxos_tpu.utils.bitops import F, Stream, Word  # noqa: E402
 
-MP_LAYOUT_VERSION = "multipaxos-packed-v1"
+MP_LAYOUT_VERSION = "multipaxos-packed-v2"
 MP_LAYOUT = (
     Word("req", F("requests.bal", 12), F("requests.v1", 13),
          F("requests.present", 1, bool_=True)),
@@ -301,7 +301,13 @@ MP_LAYOUT = (
     Stream("acc_log", "acceptor.log", bal_bits=11, val_bits=13),
     Stream("snap_log", "acceptor.snap_log", bal_bits=11, val_bits=13,
            optional=True),
-    Word("prop0", F("proposer.bal", 11), F("proposer.phase", 2),
+    # proposer.bal gets 1 headroom bit over the 11-bit report threshold
+    # ((1 << 11) - 1, hardcoded in harness/run.summarize_device): ballots
+    # are clamped at chunk boundaries only (fused_tick), so the field must
+    # absorb chunk_ticks * BALLOT_GROWTH_PER_TICK of un-clamped monotone
+    # growth mid-chunk; chunks too long for one bit fall back to the
+    # per-tick clamp.
+    Word("prop0", F("proposer.bal", 12), F("proposer.phase", 2),
          F("proposer.commit_idx", 6), F("proposer.candidate_timer", 12)),
     Word("prop1", F("proposer.heard", 16),
          F("proposer.last_chosen_count", 16)),
@@ -313,3 +319,18 @@ MP_LAYOUT = (
          F("learner.chosen_tick", 18, signed=True)),
 )
 MP_LAYOUT_DIMS = {"n_acc": ("acceptor.promised", 0)}
+
+# Tick read/write-set declarations (delta codec + write-set audit — see the
+# read/write-set section of utils/bitops.py).  The tick reads every leaf;
+# it writes everything except ``base`` (the compacted-prefix origin, bumped
+# only by the host-side compaction path, never by the in-trace tick).
+MP_TICK_READS = (
+    "acceptor.*", "proposer.*", "learner.*", "requests.*", "promises.*",
+    "accepted.*", "base",
+    "telemetry.*", "coverage.*", "exposure.*", "tick",
+)
+MP_TICK_WRITES = (
+    "acceptor.*", "proposer.*", "learner.*", "requests.*", "promises.*",
+    "accepted.*",
+    "telemetry.*", "coverage.*", "exposure.*", "tick",
+)

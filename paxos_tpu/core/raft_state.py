@@ -181,7 +181,7 @@ class RaftState:
 
 from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
 
-RAFT_LAYOUT_VERSION = "raftcore-packed-v1"
+RAFT_LAYOUT_VERSION = "raftcore-packed-v2"
 RAFT_LAYOUT = (
     Word("req", F("requests.bal", 15), F("requests.v1", 15),
          F("requests.present", 1, bool_=True)),
@@ -191,7 +191,10 @@ RAFT_LAYOUT = (
     Word("acc", F("acceptor.voted", 15), F("acceptor.ent_term", 15)),
     Word("snap_acc", F("acceptor.snap_voted", 15),
          F("acceptor.snap_term", 15), optional=True),
-    Word("prop0", F("proposer.bal", 15), F("proposer.phase", 2),
+    # 17-bit proposer.bal (term): 2 headroom bits over the 15-bit report
+    # threshold so the chunk-boundary-only ballot clamp (fused_tick) cannot
+    # wrap mid-chunk — see core/state.py.
+    Word("prop0", F("proposer.bal", 17), F("proposer.phase", 2),
          F("proposer.timer", 13, signed=True)),
     Word("prop1", F("proposer.own_val", 12), F("proposer.prop_val", 12)),
     Word("prop2", F("proposer.heard", 16), F("proposer.ent_term", 15)),
@@ -203,3 +206,19 @@ RAFT_LAYOUT = (
          F("learner.chosen_tick", 19, signed=True)),
 )
 RAFT_LAYOUT_DIMS = {"n_acc": ("acceptor.voted", 0)}
+
+# Tick read/write-set declarations (delta codec + write-set audit — see the
+# read/write-set section of utils/bitops.py).  The tick writes everything
+# except proposer.own_val (the candidate's fixed value, only ever read).
+RAFT_TICK_READS = (
+    "acceptor.*", "proposer.*", "learner.*", "requests.*", "replies.*",
+    "telemetry.*", "coverage.*", "exposure.*", "tick",
+)
+RAFT_TICK_WRITES = (
+    "acceptor.*",
+    "proposer.bal", "proposer.phase", "proposer.timer", "proposer.prop_val",
+    "proposer.heard", "proposer.ent_term", "proposer.ent_val",
+    "proposer.decided_val",
+    "learner.*", "requests.*", "replies.*",
+    "telemetry.*", "coverage.*", "exposure.*", "tick",
+)

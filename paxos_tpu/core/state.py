@@ -230,7 +230,7 @@ class PaxosState:
 
 from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
 
-PAXOS_LAYOUT_VERSION = "paxos-packed-v1"
+PAXOS_LAYOUT_VERSION = "paxos-packed-v2"
 PAXOS_LAYOUT = (
     Word("req", F("requests.bal", 15), F("requests.v1", 12),
          F("requests.present", 1, bool_=True)),
@@ -240,7 +240,12 @@ PAXOS_LAYOUT = (
     Word("acc", F("acceptor.promised", 15), F("acceptor.acc_bal", 15)),
     Word("snap_acc", F("acceptor.snap_promised", 15),
          F("acceptor.snap_bal", 15), optional=True),
-    Word("prop0", F("proposer.bal", 15), F("proposer.phase", 2),
+    # proposer.bal gets 2 headroom bits over the 15-bit report threshold
+    # ((1 << 15) - 1, hardcoded in harness/run.summarize_device): the fused
+    # engine clamps ballots at chunk *boundaries* only (fused_tick), so the
+    # field must absorb up to chunk_ticks * BALLOT_GROWTH_PER_TICK of
+    # un-clamped monotone growth mid-chunk without wrapping.
+    Word("prop0", F("proposer.bal", 17), F("proposer.phase", 2),
          F("proposer.timer", 13, signed=True)),
     Word("prop1", F("proposer.own_val", 12), F("proposer.prop_val", 12)),
     Word("prop2", F("proposer.heard", 16), F("proposer.best_bal", 15)),
@@ -252,3 +257,22 @@ PAXOS_LAYOUT = (
          F("learner.chosen_tick", 19, signed=True)),
 )
 PAXOS_LAYOUT_DIMS = {"n_acc": ("acceptor.promised", 0)}
+
+# Tick read/write-set declarations (delta codec + write-set audit — see the
+# read/write-set section of utils/bitops.py).  The tick reads every leaf;
+# it writes everything except proposer.own_val (each proposer's fixed
+# candidate value, assigned at init and only ever read).  Globs cover the
+# optional planes (snap_* gray shadows under acceptor.*, telemetry /
+# coverage / exposure) so one declaration serves every config shape.
+PAXOS_TICK_READS = (
+    "acceptor.*", "proposer.*", "learner.*", "requests.*", "replies.*",
+    "telemetry.*", "coverage.*", "exposure.*", "tick",
+)
+PAXOS_TICK_WRITES = (
+    "acceptor.*",
+    "proposer.bal", "proposer.phase", "proposer.timer", "proposer.prop_val",
+    "proposer.heard", "proposer.best_bal", "proposer.best_val",
+    "proposer.decided_val",
+    "learner.*", "requests.*", "replies.*",
+    "telemetry.*", "coverage.*", "exposure.*", "tick",
+)

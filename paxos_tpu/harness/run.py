@@ -344,24 +344,31 @@ def make_advance(
 
         if mesh is not None:
             from paxos_tpu.kernels.fused_tick import (
+                _saturate_ballots, ballot_hoist_safe_ticks,
                 fused_chunk_sharded, packed_fns,
             )
             from paxos_tpu.utils import bitops
-
-            apply_fn, mask_fn, dblk = packed_fns(cfg.protocol)
 
             def advance_sharded(state, n):
                 # Pack/unpack at the chunk boundary, like FUSED_CHUNKS:
                 # both are elementwise or non-I-axis ops, so the instance
                 # sharding propagates through them under pjit unchanged.
+                # Same ballot-clamp hoist guard as _make_chunk: boundary
+                # clamps when the chunk fits the packed headroom, per-tick
+                # clamp otherwise.
                 codec = bitops.codec_for(cfg.protocol, state)
-                pst = bitops.pack_state(codec, state)
+                hoisted = n <= ballot_hoist_safe_ticks(cfg.protocol, codec)
+                apply_fn, mask_fn, dblk = packed_fns(
+                    cfg.protocol, clamp_per_tick=not hoisted
+                )
+                pst = bitops.pack_state(codec, _saturate_ballots(codec, state))
                 pst = fused_chunk_sharded(
                     pst, jnp.int32(cfg.seed), plan, cfg.fault, n,
                     apply_fn, mask_fn, mesh, block=block,
                     interpret=interpret, default=dblk,
                 )
-                return bitops.unpack_state(codec, pst)
+                out = bitops.unpack_state(codec, pst)
+                return _saturate_ballots(codec, out) if hoisted else out
 
             if compact:
                 from paxos_tpu.protocols.multipaxos import compact_mp

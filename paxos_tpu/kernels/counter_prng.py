@@ -61,13 +61,26 @@ def stream_salt(stream: int) -> int:
 
 
 def _linear_index(shape) -> jnp.ndarray:
-    """int32 linear position of every element (broadcasted_iota — TPU-safe)."""
-    idx = jnp.zeros(shape, jnp.int32)
+    """int32 linear position of every element (broadcasted_iota — TPU-safe).
+
+    Built from one iota PER DIMENSION sized (1, …, d, …, 1) and
+    broadcast-added with its stride — the same integer in every element as
+    a full-shape row-major index (so every counter stream is bit-identical
+    to the naive form), but the only full-shape traffic is the final
+    broadcast+add instead of ndim full-shape iotas.
+    """
+    nd = len(shape)
+    idx = None
     stride = 1
-    for d in range(len(shape) - 1, -1, -1):
-        idx = idx + jax.lax.broadcasted_iota(jnp.int32, shape, d) * jnp.int32(stride)
+    for d in range(nd - 1, -1, -1):
+        s = [1] * nd
+        s[d] = shape[d]
+        part = jax.lax.broadcasted_iota(jnp.int32, tuple(s), d)
+        if stride != 1:
+            part = part * jnp.int32(stride)
+        idx = part if idx is None else idx + part
         stride *= shape[d]
-    return idx
+    return jnp.broadcast_to(idx, shape)
 
 
 def counter_bits(seed: jnp.ndarray, stream: int, shape) -> jnp.ndarray:

@@ -683,54 +683,108 @@ def fused_fns(protocol: str, ablate: frozenset = frozenset()):
     raise ValueError(f"unknown protocol: {protocol!r}")
 
 
+# Worst-case proposer.bal growth per tick: every ballot bump is
+# make_ballot(round + 1, pid) = (round + 1) * MAX_PROPOSERS + pid + 1, so
+# new - old <= MAX_PROPOSERS + (pid_new - pid_old) < 2 * MAX_PROPOSERS = 16
+# (core/ballot.py; all four protocols bump through make_ballot).  The
+# chunk-boundary clamp hoist sizes its headroom check with this bound.
+BALLOT_GROWTH_PER_TICK = 16
+
+
+def report_ballot_limit(protocol: str) -> int:
+    """The report-time ``max_ballot >= limit`` threshold — the SAME constant
+    ``harness/run.summarize_device`` hardcodes (11-bit Multi-Paxos, 15-bit
+    single-decree).  The packed ``proposer.bal`` field is deliberately wider
+    (v2 layouts) so mid-chunk growth cannot wrap; every clamp in this module
+    pins at THIS limit, not the field capacity, keeping both engines'
+    ``MeasurementCorrupted`` threshold identical to the v1 contract."""
+    return (1 << 11) - 1 if protocol == "multipaxos" else (1 << 15) - 1
+
+
 def _saturate_ballots(codec, state):
-    """Pin ``proposer.bal`` at its packed field capacity before a pack.
+    """Pin ``proposer.bal`` at the report-time ballot limit before a pack.
 
     ``Codec.pack`` masks every field to its declared width, so a ballot
     that outgrew its field would WRAP to a small value and the report-time
     ``max_ballot >= limit`` guard (harness/run.summarize_host) could never
     observe the overflow — the exact silent corruption it exists to catch.
-    Ballots are monotone, so clamping at the capacity is sticky: once any
-    proposer's ballot tries to exceed the field, the unpacked state reads
-    exactly the capacity at every subsequent chunk boundary and the guard
-    (whose limit IS this capacity) raises ``MeasurementCorrupted`` at the
-    next report — same threshold the XLA engine trips by growing through
-    it unmasked.  Below the capacity the clamp is the identity, so the
-    fused(packed) == reference(unpacked) bit-exactness contract holds for
-    every uncorrupted campaign.
+    Ballots are monotone, so clamping at the limit is sticky: once any
+    proposer's ballot tries to exceed it, the unpacked state reads exactly
+    the limit at every subsequent chunk boundary and the guard raises
+    ``MeasurementCorrupted`` at the next report — same threshold the XLA
+    engine trips by growing through it unmasked (``min(bal, limit) >=
+    limit`` iff ``bal >= limit``).  Below the limit the clamp is the
+    identity, so the fused(packed) == reference(unpacked) bit-exactness
+    contract holds for every uncorrupted campaign.
+
+    Since the v2 layouts this runs at chunk BOUNDARIES (entry pack + exit
+    unpack in ``_make_chunk``), not in the per-tick body: ``proposer.bal``
+    carries ``ceil(log2(chunk_ticks * BALLOT_GROWTH_PER_TICK))`` headroom
+    bits over the limit, so un-clamped mid-chunk growth cannot wrap the
+    field.  Chunks too long for the headroom fall back to the per-tick
+    clamp (``packed_fns(clamp_per_tick=True)``).
     """
     cap = codec.field_capacity("proposer.bal")
     if cap is None:
         return state
+    cap = min(cap, report_ballot_limit(codec.protocol))
     prop = state.proposer
     return state.replace(proposer=prop.replace(bal=jnp.minimum(prop.bal, cap)))
 
 
+def ballot_hoist_safe_ticks(protocol: str, codec) -> int:
+    """Largest per-chunk tick count for which the chunk-boundary ballot
+    clamp cannot wrap the packed ``proposer.bal`` field mid-chunk.  Chunks
+    beyond this use the per-tick clamp; campaign-level tick budgets are
+    bounded separately by ``run.check_tick_budget``."""
+    cap = codec.field_capacity("proposer.bal")
+    if cap is None:
+        return 0
+    headroom = cap - report_ballot_limit(protocol)
+    return max(0, headroom // BALLOT_GROWTH_PER_TICK)
+
+
 @functools.lru_cache(maxsize=None)
-def packed_fns(protocol: str, ablate: frozenset = frozenset()):
+def packed_fns(protocol: str, ablate: frozenset = frozenset(),
+               clamp_per_tick: bool = False):
     """(apply_fn, mask_fn, default_block) lifted to the packed state.
 
     The raw :func:`fused_fns` pair operates on the unpacked pytree; these
     wrappers carry a ``bitops.PackedState`` across the fused engine's
-    fori_loop instead — unpacking on use inside the tick body (shift+mask is
-    ALU work the VPU eats, not layout shuffles) and repacking the result, so
-    the VMEM-resident carry is the dense words.  The mask path's unpack is
-    dead-code-eliminated (mask samplers read only shapes).  PRNG streams are
-    untouched: same mask fns, same (seed, tick, block) keying, and the
-    unpack/apply/pack composition is value-identical to the raw pair below
-    the ballot capacity (overflow saturates instead of wrapping —
-    :func:`_saturate_ballots` — so the report-time guard stays satisfiable),
-    so fused(packed) == reference(unpacked) bit-exactly (tier1 PACKED_SMOKE).
+    fori_loop instead, and the tick body unpacks exactly ONCE: the mask
+    slot returns ``tick_seed`` unchanged (the generic kernel treats masks
+    as an opaque value between ``mask_fn`` and ``apply_fn``), and
+    ``packed_apply`` runs ``unpack_read -> mask_fn -> apply_fn ->
+    pack_delta`` — the differential codec entry points (utils/bitops) that
+    decode only the declared read-set and re-encode only the declared
+    write-set, carrying untouched words through the fori_loop unchanged.
+    PRNG streams are untouched: same mask fns, same (seed, tick, block)
+    keying, so the composition is value-identical to the raw pair below the
+    report-time ballot limit and fused(packed) == reference(unpacked)
+    bit-exactly (tier1 PACKED_SMOKE / DELTA_SMOKE).
+
+    ``clamp_per_tick`` re-inserts the v1-era per-tick ballot saturation for
+    chunks longer than :func:`ballot_hoist_safe_ticks`; the default leaves
+    the clamp hoisted to the chunk boundaries (``_make_chunk``), off the
+    per-tick jaxpr entirely (audited by ``paxos_tpu audit``).
     """
     apply_fn, mask_fn, default_block = fused_fns(protocol, ablate)
 
-    def packed_apply(pst, masks, plan, cfg):
+    def packed_apply(pst, tick_seed, plan, cfg):
         codec = pst.codec
-        new = apply_fn(codec.unpack(pst), masks, plan, cfg)
-        return codec.pack(_saturate_ballots(codec, new))
+        st = codec.unpack_read(pst)
+        masks = mask_fn(cfg, tick_seed, st)
+        new = apply_fn(st, masks, plan, cfg)
+        if clamp_per_tick:
+            new = _saturate_ballots(codec, new)
+        return codec.pack_delta(pst, new)
 
     def packed_mask(cfg, tick_seed, pst):
-        return mask_fn(cfg, tick_seed, pst.codec.unpack(pst))
+        # Opaque pass-through: the single unpack lives in packed_apply, fed
+        # by this seed — the mask path's former second full unpack is gone
+        # from the traced tick body (it was DCE'd at compile time before,
+        # but censuses and trace size paid for it).
+        return tick_seed
 
     packed_apply.__name__ = f"packed_{protocol}_apply"
     packed_mask.__name__ = f"packed_{protocol}_masks"
@@ -741,24 +795,41 @@ def _make_chunk(protocol: str) -> Callable:
     def chunk(state, seed, plan, cfg, n_ticks, block=None, interpret=False):
         from paxos_tpu.utils import bitops
 
-        apply_fn, mask_fn, default_block = packed_fns(protocol)
         codec = bitops.codec_for(protocol, state)
-        # The entry pack saturates too: a resumed/handed-in state whose
-        # ballots already overflowed must read as at-capacity (guard fires),
-        # not wrap to a small value (guard blind).
+        # Clamp hoist guard (trace-time, per chunk): the boundary-only clamp
+        # is sound iff this chunk's un-clamped growth fits the headroom bits
+        # of the packed proposer.bal field.  n_ticks is static here, so the
+        # choice is baked into the compiled chunk; campaign budgets are
+        # bounded separately (run.check_tick_budget).
+        hoisted = n_ticks <= ballot_hoist_safe_ticks(protocol, codec)
+        apply_fn, mask_fn, default_block = packed_fns(
+            protocol, clamp_per_tick=not hoisted
+        )
+        # The entry pack saturates: a resumed/handed-in state whose ballots
+        # already overflowed must read as at-limit (guard fires), not wrap
+        # to a small value (guard blind).
         pst = bitops.pack_state(codec, _saturate_ballots(codec, state))
         pst = fused_chunk_auto(
             pst, seed, plan, cfg, n_ticks, apply_fn, mask_fn,
             block=block, interpret=interpret, default=default_block,
         )
-        return bitops.unpack_state(codec, pst)
+        out = bitops.unpack_state(codec, pst)
+        # Exit clamp: with the per-tick clamp hoisted, mid-chunk ballots may
+        # sit between the report limit and the field capacity; pin them back
+        # to the limit so summaries and the next chunk see the v1-identical
+        # sticky saturation value.
+        if hoisted:
+            out = _saturate_ballots(codec, out)
+        return out
 
     chunk.__name__ = f"fused_{protocol}_chunk"
     chunk.__doc__ = (
         f"{protocol} on the fused engine (binding: packed_fns over "
         f"fused_fns): state packs to dense words (utils/bitops) at the "
-        f"chunk boundary, rides VMEM packed, and unpacks on return; "
-        f"batches over MAX_LANES_PER_CALL auto-segment (fused_chunk_auto)."
+        f"chunk boundary, rides VMEM packed (differential pack/unpack per "
+        f"tick, ballot clamp hoisted to the boundaries), and unpacks on "
+        f"return; batches over MAX_LANES_PER_CALL auto-segment "
+        f"(fused_chunk_auto)."
     )
     return chunk
 

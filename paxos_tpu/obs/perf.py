@@ -257,7 +257,10 @@ def roofline_gauges(
     """Roofline occupancy vs the recorded ceilings.
 
     ``case`` is a ROOFLINE.json per-case census dict (needs
-    ``alu_per_lane_tick``); ``ceilings`` the artifact's top-level device
+    ``alu_per_lane_tick``; the delta-codec split's
+    ``codec_alu_per_lane_tick`` is folded back in when present, so the
+    ceiling keeps meaning "VPU ops the tick actually issues" across the
+    r11 census-column split); ``ceilings`` the artifact's top-level device
     ceilings (``vpu_ops_per_sec``).  File loading stays with the caller —
     this function is pure so the plane replays from recorded inputs.
     """
@@ -265,7 +268,8 @@ def roofline_gauges(
     vpu = ceilings.get("vpu_ops_per_sec")
     if not alu or not vpu:
         return {}
-    ceiling_rps = float(vpu) / float(alu)
+    alu = float(alu) + float(case.get("codec_alu_per_lane_tick") or 0.0)
+    ceiling_rps = float(vpu) / alu
     return {
         "roofline_ceiling_rps": round(ceiling_rps, 1),
         "roofline_occupancy": round(float(rounds_per_sec) / ceiling_rps, 4),
@@ -275,7 +279,12 @@ def roofline_gauges(
 # --------------------------------------------------------------------------
 # Bench provenance: row schema + noise-aware regression comparison.
 
-BENCH_ROW_SCHEMA = "paxos-tpu-bench-row-v1"
+BENCH_ROW_SCHEMA = "paxos-tpu-bench-row-v2"
+
+# Read-side compat: v1 rows (r5-r10 artifacts) predate ``ops_per_lane_tick``
+# and stay valid — ``bench-compare`` must keep diffing against committed
+# history.  New rows are always written at BENCH_ROW_SCHEMA.
+BENCH_ROW_SCHEMAS = ("paxos-tpu-bench-row-v1", BENCH_ROW_SCHEMA)
 
 # field -> required type(s).  The provenance core: anyone holding a row can
 # tell WHAT was measured (config fingerprint + layout version + engine +
@@ -317,8 +326,18 @@ def validate_bench_row(row: Any) -> list[str]:
             )
     if errs:
         return errs
-    if row["schema"] != BENCH_ROW_SCHEMA:
-        errs.append(f"schema {row['schema']!r} != {BENCH_ROW_SCHEMA!r}")
+    if row["schema"] not in BENCH_ROW_SCHEMAS:
+        errs.append(
+            f"schema {row['schema']!r} not in {BENCH_ROW_SCHEMAS!r}"
+        )
+    elif row["schema"] == BENCH_ROW_SCHEMA:
+        # v2 additions: the census op count the row was measured under, so
+        # a bench-compare delta can be attributed to op-count cuts vs clock.
+        ops = row.get("ops_per_lane_tick")
+        if not isinstance(ops, (int, float)) or isinstance(ops, bool):
+            errs.append("ops_per_lane_tick must be a number (v2 row)")
+        elif ops <= 0:
+            errs.append("ops_per_lane_tick must be positive")
     if not row["samples"]:
         errs.append("samples is empty")
     elif not all(

@@ -100,9 +100,11 @@ def select_from_scores(
     """
     k, p, a, i = present.shape
     nbits = max((k * p - 1).bit_length(), 1)  # low bits reserved for slot id
+    # Slot ids from two (k|p, 1, 1)-sized iotas broadcast-added — the same
+    # integers as full-shape iotas, without two full-shape layout passes.
     sid = (
-        jax.lax.broadcasted_iota(jnp.int32, present.shape, 0) * p
-        + jax.lax.broadcasted_iota(jnp.int32, present.shape, 1)
+        jax.lax.broadcasted_iota(jnp.int32, (k, 1, 1, 1), 0) * p
+        + jax.lax.broadcasted_iota(jnp.int32, (1, p, 1, 1), 1)
     )
     # All-int32 scoring (Mosaic has neither unsigned reductions nor clean
     # unsigned register casts): random int32 bits give a uniform total order
@@ -142,29 +144,25 @@ def send(
     if keep is not None:
         send_mask = send_mask & keep
 
+    # One full-shape write mask, shared by every leaf: the kind one-hot AND
+    # the broadcast send edges.  Kind-axis updates stay in the elementwise
+    # where-over-iota form — NOT `.at[kind].set` (lowers to scatter) and NOT
+    # stack/concat (invalid register casts): Mosaic, the Pallas TPU
+    # compiler, only lowers the elementwise form cleanly.  Payloads land via
+    # where's implicit broadcast, so there is no per-field slice/squeeze of
+    # the old kind plane and no zero-broadcast to shape them.
     kind_hot = (
         jax.lax.broadcasted_iota(jnp.int32, buf.bal.shape, 0) == kind
     )  # (2, P, A, I)
-
-    def set_kind(arr, new_slice):
-        # Static-index update along the size-2 kind axis as a full-shape
-        # where over an iota mask — NOT `.at[kind].set` (lowers to scatter)
-        # and NOT stack/concat (invalid register casts): Mosaic, the Pallas
-        # TPU compiler, only lowers the elementwise form cleanly.
-        return jnp.where(
-            kind_hot, jnp.broadcast_to(new_slice[None], arr.shape), arr
-        )
-
-    zero = jnp.zeros_like(buf.bal[kind])
+    write = kind_hot & jnp.broadcast_to(send_mask[None], buf.present.shape)
     # `present` is monotone (old | sent), so its kind-axis update is pure
     # boolean algebra — Mosaic rejects select_n on bool vectors, which rules
-    # out jnp.where/set_kind for the bool leaf.
-    sent_full = kind_hot & jnp.broadcast_to(send_mask[None], buf.present.shape)
+    # out jnp.where for the bool leaf.
     return buf.replace(
-        bal=set_kind(buf.bal, jnp.where(send_mask, bal + zero, buf.bal[kind])),
-        v1=set_kind(buf.v1, jnp.where(send_mask, v1 + zero, buf.v1[kind])),
-        v2=set_kind(buf.v2, jnp.where(send_mask, v2 + zero, buf.v2[kind])),
-        present=buf.present | sent_full,
+        bal=jnp.where(write, bal, buf.bal),
+        v1=jnp.where(write, v1, buf.v1),
+        v2=jnp.where(write, v2, buf.v2),
+        present=buf.present | write,
     )
 
 

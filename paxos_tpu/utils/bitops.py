@@ -30,6 +30,7 @@ behavior at the field boundaries.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -167,6 +168,61 @@ class Zero:
 
     def __init__(self, path, like):
         self.path, self.like = path, like
+
+
+# ---------------------------------------------------------------------------
+# Read/write-set declarations (the delta-codec contract).
+#
+# Each protocol's layout module declares which dotted leaf paths its tick
+# (`apply_fn`, including the fused counter-PRNG mask source) may READ and
+# which it may WRITE.  Entries are exact paths ("proposer.bal"), subtree
+# globs ("acceptor.*"), or "*".  The codec's differential entry points key
+# off these: ``unpack_read`` materializes only read leaves, ``pack_delta``
+# re-encodes only written fields and carries every untouched word through
+# unchanged.  The declarations are load-bearing, not documentation — the
+# always-on audit (analysis/structure.py) traces each protocol's tick jaxpr
+# and fails if an actual write escapes the declared write-set, and the
+# layout goldens pin both sets, so edits require a version bump.
+
+
+def path_matches(path: str, decls) -> bool:
+    """True when dotted leaf ``path`` is covered by a declaration tuple."""
+    for d in decls:
+        if d == "*" or d == path:
+            return True
+        if d.endswith(".*") and path.startswith(d[:-1]):
+            return True
+    return False
+
+
+def leaf_paths(state) -> "list[str]":
+    """Dotted attribute paths for every leaf of a state pytree, aligned with
+    ``jax.tree_util.tree_leaves`` order.  Works by unflattening integer
+    tokens and walking dataclass fields — the same trick ``_build_codec``
+    uses for single-path lookup, generalized to the full inventory (shared
+    by the write-set audit and the delta-codec tests)."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    tokens = jax.tree_util.tree_unflatten(treedef, list(range(len(leaves))))
+    paths: list = [None] * len(leaves)
+
+    def walk(obj, prefix):
+        if isinstance(obj, int):
+            paths[obj] = prefix.rstrip(".") or "<root>"
+            return
+        if dataclasses.is_dataclass(obj):
+            for f in dataclasses.fields(obj):
+                v = getattr(obj, f.name)
+                if v is not None:
+                    walk(v, prefix + f.name + ".")
+            return
+        if isinstance(obj, (list, tuple)):
+            for i, v in enumerate(obj):
+                walk(v, f"{prefix}{i}.")
+            return
+        raise TypeError(f"cannot derive leaf paths through {type(obj)!r}")
+
+    walk(tokens, "")
+    return paths
 
 
 # ---------------------------------------------------------------------------
@@ -312,7 +368,8 @@ class Codec:
     """
 
     def __init__(self, protocol, version, treedef, n_leaves, tick_leaf,
-                 words, streams, zeros, passthroughs, dims):
+                 words, streams, zeros, passthroughs, dims, paths,
+                 reads, writes):
         self.protocol, self.version = protocol, version
         self.treedef, self.n_leaves = treedef, n_leaves
         self.tick_leaf = tick_leaf
@@ -321,6 +378,15 @@ class Codec:
         self.zeros = tuple(zeros)  # (leaf_idx, like_name, dtype)
         self.passthroughs = tuple(passthroughs)  # (name, leaf_idx)
         self.dims = dict(dims)
+        self.paths = tuple(paths)  # leaf index -> dotted path
+        self.reads = tuple(reads)  # declared read-set (paths / globs)
+        self.writes = tuple(writes)  # declared write-set (paths / globs)
+
+    def is_read(self, path: str) -> bool:
+        return path_matches(path, self.reads)
+
+    def is_written(self, path: str) -> bool:
+        return path_matches(path, self.writes)
 
     def __repr__(self):
         return (f"Codec({self.protocol!r}, {self.version!r}, "
@@ -373,6 +439,106 @@ class Codec:
             leaves[leaf] = vals[name]
         leaves[self.tick_leaf] = pst.tick
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def unpack_read(self, pst: PackedState):
+        """Differential unpack: shift+mask only the declared read-set.
+
+        Leaves outside the read-set materialize as zeros — reading one is a
+        write-set-audit-class bug, not a supported path.  With every current
+        protocol declaring a full read-set this is op-identical to
+        :meth:`unpack`; the asymmetry that pays today is on the pack side
+        (:meth:`pack_delta`), but the read filter keeps the contract
+        symmetric for future sparse-read protocols.
+        """
+        vals = pst.words
+        leaves: list = [None] * self.n_leaves
+        for w in self.words:
+            arr = vals[w.name]
+            for s in w.slots:
+                if not self.is_read(s.path):
+                    leaves[s.leaf] = jnp.zeros(
+                        arr.shape, jnp.bool_ if s.bool_ else jnp.int32
+                    )
+                    continue
+                x = unpack_field(arr, s.off, s.bits, s.signed)
+                if s.bv is not None:
+                    x = dense_to_bv(x, *s.bv)
+                if s.bool_:
+                    x = x.astype(jnp.bool_)
+                leaves[s.leaf] = x
+        for st in self.streams:
+            warr = vals[st.name]
+            if self.is_read(self.paths[st.leaf]):
+                leaves[st.leaf] = _stream_unpack(warr, st.bal_bits,
+                                                 st.val_bits, st.length)
+            else:
+                shape = warr.shape[:-2] + (st.length, warr.shape[-1])
+                leaves[st.leaf] = jnp.zeros(shape, jnp.int32)
+        for leaf, like, dtype in self.zeros:
+            leaves[leaf] = jnp.zeros(vals[like].shape, dtype)
+        for name, leaf in self.passthroughs:
+            leaves[leaf] = vals[name]
+        leaves[self.tick_leaf] = pst.tick
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def pack_delta(self, pst: PackedState, new_state) -> PackedState:
+        """Differential pack: merge only the declared write-set into the
+        carried words of ``pst``.
+
+        Per physical word: no written slot -> the carried word array passes
+        through the fori_loop carry untouched (zero ops); every slot written
+        -> full shift+OR rebuild (cheaper than clearing holes first); mixed
+        -> :func:`set_field` merge per written slot, preserving the
+        untouched bits in place.  Streams repack only when their leaf is in
+        the write-set.  Bit-exactness contract (pinned by the write-set
+        property fuzz in tests/test_bitops.py): whenever ``new_state``
+        differs from ``unpack(pst)`` only at written leaves,
+        ``pack_delta(pst, new_state)`` equals full ``pack(new_state)``.
+        """
+        leaves, treedef = jax.tree_util.tree_flatten(new_state)
+        if treedef != self.treedef:
+            raise ValueError(
+                f"state structure does not match codec for {self.protocol!r}"
+            )
+        vals = pst.words
+        words = {}
+        for w in self.words:
+            written = [self.is_written(s.path) for s in w.slots]
+            if not any(written):
+                words[w.name] = vals[w.name]
+                continue
+
+            def enc(s):
+                x = leaves[s.leaf]
+                if s.bool_:
+                    x = x.astype(jnp.int32)
+                if s.bv is not None:
+                    x = bv_to_dense(x, *s.bv)
+                return x
+
+            if all(written):
+                acc = None
+                for s in w.slots:
+                    v = pack_field(enc(s), s.off, s.bits)
+                    acc = v if acc is None else acc | v
+                words[w.name] = acc
+            else:
+                arr = vals[w.name]
+                for s, wr in zip(w.slots, written):
+                    if wr:
+                        arr = set_field(arr, enc(s), s.off, s.bits)
+                words[w.name] = arr
+        for st in self.streams:
+            if self.is_written(self.paths[st.leaf]):
+                words[st.name] = _stream_pack(leaves[st.leaf], st.bal_bits,
+                                              st.val_bits)
+            else:
+                words[st.name] = vals[st.name]
+        for name, leaf in self.passthroughs:
+            # Written passthroughs cost nothing either way; unwritten ones
+            # are the same array by the write-set contract.
+            words[name] = leaves[leaf]
+        return PackedState(words, leaves[self.tick_leaf], self)
 
     def field_capacity(self, path: str) -> "int | None":
         """Largest value the packed field at ``path`` can hold, or None when
@@ -449,6 +615,29 @@ def layout_version(protocol: str) -> str:
     return protocol_layout(protocol)[0]
 
 
+def protocol_rw(protocol: str) -> "tuple[tuple, tuple]":
+    """Resolve a protocol name to its declared ``(read_set, write_set)``
+    tick declarations (dotted paths / subtree globs — see the read/write-set
+    section above)."""
+    if protocol == "paxos":
+        from paxos_tpu.core import state as m
+
+        return m.PAXOS_TICK_READS, m.PAXOS_TICK_WRITES
+    if protocol == "multipaxos":
+        from paxos_tpu.core import mp_state as m
+
+        return m.MP_TICK_READS, m.MP_TICK_WRITES
+    if protocol == "fastpaxos":
+        from paxos_tpu.core import fp_state as m
+
+        return m.FP_TICK_READS, m.FP_TICK_WRITES
+    if protocol == "raftcore":
+        from paxos_tpu.core import raft_state as m
+
+        return m.RAFT_TICK_READS, m.RAFT_TICK_WRITES
+    raise ValueError(f"unknown protocol: {protocol!r}")
+
+
 def layout_field_width(protocol: str, path: str) -> "tuple[int, bool]":
     """(bits, signed) for a fixed-width word field in a protocol's layout
     table — state-free, so config/argument-time bound checks (e.g. the
@@ -495,6 +684,9 @@ def layout_fields(protocol: str) -> dict:
         else:  # pragma: no cover - spec bug
             raise TypeError(f"unknown layout entry: {e!r}")
     out["__dims__"] = repr(sorted(dims_spec.items()))
+    reads, writes = protocol_rw(protocol)
+    out["__reads__"] = repr(tuple(sorted(reads)))
+    out["__writes__"] = repr(tuple(sorted(writes)))
     return out
 
 
@@ -648,8 +840,11 @@ def _build_codec(protocol, leaves, treedef) -> Codec:
             raise ValueError(f"{protocol}: duplicate packed word name {n!r}")
         seen.add(n)
 
+    paths = leaf_paths(token_state)
+    reads, writes = protocol_rw(protocol)
     return Codec(protocol, version, treedef, len(leaves), tick_leaf,
-                 words, streams, zeros, passthroughs, dims)
+                 words, streams, zeros, passthroughs, dims, paths,
+                 reads, writes)
 
 
 # Jitted adapters (static codec, so each codec gets its own cache entry).

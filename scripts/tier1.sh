@@ -165,6 +165,84 @@ for protocol, cfg in cases.items():
     assert digest(fused) == digest(ref), f"{protocol}: packed fused != XLA reference"
 EOF
 fi
+# Delta-codec smoke: the fused tick now unpacks ONCE per tick through the
+# declared read-set and merges only the declared write-set back
+# (bitops.unpack_read / pack_delta), with the ballot saturation clamp
+# hoisted to chunk boundaries.  Replays every protocol through TWO fused
+# chunks (interpret) vs the unpacked reference — two entry/exit clamp
+# crossings ride the stream — and then pre-seeds a near-limit paxos
+# campaign on BOTH engines: each must saturate/grow to the identical
+# report threshold and trip the same MeasurementCorrupted guard.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF' >/dev/null 2>&1 \
+  && echo DELTA_SMOKE=ok || { echo DELTA_SMOKE=FAILED; rc=1; }
+import hashlib
+import jax
+import jax.numpy as jnp
+import numpy as np
+from paxos_tpu.harness.config import (
+    FaultConfig, SimConfig,
+    config2_dueling_drop, config3_multipaxos, config5_sweep)
+from paxos_tpu.harness.run import MeasurementCorrupted, init_plan, init_state, summarize
+from paxos_tpu.kernels.fused_tick import (
+    FUSED_CHUNKS, fused_fns, reference_chunk, report_ballot_limit)
+
+def digest(state):
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+sweep = {c.protocol: c for c in config5_sweep(n_inst=256)}
+cases = {
+    "paxos": config2_dueling_drop(n_inst=256),
+    "multipaxos": config3_multipaxos(n_inst=256),
+    "fastpaxos": sweep["fastpaxos"],
+    "raftcore": sweep["raftcore"],
+}
+for protocol, cfg in cases.items():
+    plan = init_plan(cfg)
+    seed = jnp.int32(cfg.seed)
+    apply_fn, mask_fn, _ = fused_fns(protocol)
+    fused, ref = init_state(cfg), init_state(cfg)
+    for _chunk in range(2):
+        fused = FUSED_CHUNKS[protocol](
+            fused, seed, plan, cfg.fault, 8, block=256, interpret=True,
+        )
+        ref = reference_chunk(ref, seed, plan, cfg.fault, 8, apply_fn, mask_fn)
+    assert digest(fused) == digest(ref), f"{protocol}: delta-codec fused != reference"
+
+# Overflow-guard threshold identity: all-drop + fast timeouts force ballot
+# growth; pre-seeded 64 below the report limit, 64 ticks cross it on both
+# engines — fused saturates AT the limit, reference grows through it, and
+# summarize condemns both.
+limit = report_ballot_limit("paxos")
+cfg = SimConfig(n_inst=32, n_prop=2, n_acc=3, seed=9,
+                fault=FaultConfig(p_drop=1.0, timeout=2, backoff_max=2))
+plan = init_plan(cfg)
+
+def preseed():
+    s = init_state(cfg)
+    bump = jnp.int32(limit - 64)
+    return s.replace(
+        proposer=s.proposer.replace(bal=s.proposer.bal + bump),
+        requests=s.requests.replace(bal=s.requests.bal + bump),
+    )
+
+fused = FUSED_CHUNKS["paxos"](
+    preseed(), jnp.int32(9), plan, cfg.fault, 64, block=32, interpret=True)
+ref = reference_chunk(preseed(), jnp.int32(9), plan, cfg.fault, 64)
+assert int(fused.proposer.bal.max()) == limit, "fused did not saturate at limit"
+assert int(ref.proposer.bal.max()) >= limit, "reference never crossed limit"
+for name, st in (("fused", fused), ("reference", ref)):
+    try:
+        summarize(st)
+    except MeasurementCorrupted:
+        pass
+    else:
+        raise AssertionError(f"{name}: overflow guard did not fire")
+EOF
+fi
 # Perf-plane smoke: a --perf run must carry throughput/occupancy gauges
 # (occupancy in [0,1]) into both the report and the Prometheus export; a
 # smoke-sized bench row must validate against the provenance schema

@@ -185,6 +185,120 @@ def test_roundtrip_field_boundaries(protocol):
             )
 
 
+# ---------------------------------------------------------------------------
+# Write-set property fuzz: pack_delta == full pack under written mutations,
+# and a write OUTSIDE the declared set is (by contract) dropped.
+
+
+def _packed_words_bitexact(a, b):
+    assert set(a.words) == set(b.words)
+    for name in sorted(a.words):
+        np.testing.assert_array_equal(
+            np.asarray(a.words[name]), np.asarray(b.words[name]),
+            err_msg=f"packed word {name!r} differs",
+        )
+    np.testing.assert_array_equal(np.asarray(a.tick), np.asarray(b.tick))
+
+
+def _mutate_one_leaf(base, donor, idx):
+    leaves, treedef = jax.tree_util.tree_flatten(base)
+    leaves[idx] = jax.tree_util.tree_flatten(donor)[0][idx]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _fuzz_cfgs(protocol):
+    """The fuzz domain: the protocol's bench config, plus (multipaxos) a
+    log_len that is NOT a multiple of the 4-entry stream group, so the
+    delta repack is exercised on a partial tail group too."""
+    cfgs = [_cfg(protocol, n_inst=64)]
+    if protocol == "multipaxos":
+        cfgs.append(dataclasses.replace(cfgs[0], log_len=6))
+    return cfgs
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_pack_delta_matches_full_pack_on_written_mutations(protocol, seed):
+    """Property (the pack_delta contract): start from a random in-range
+    state's packed words, mutate ONE leaf inside the declared write-set to
+    another random in-range value, and ``pack_delta`` must equal a full
+    ``pack`` of the mutated state, bit-exact — per leaf kind this covers
+    the carried-word passthrough, the all-written rebuild, the mixed-word
+    ``set_field`` merge, and the stream repack (partial groups included)."""
+    for cfg in _fuzz_cfgs(protocol):
+        base, codec = _random_in_range_state(protocol, cfg, seed)
+        donor, _ = _random_in_range_state(protocol, cfg, seed + 100)
+        pst = codec.pack(base)
+        kinds = _leaf_kinds(codec)
+        writable = [
+            i for i in range(codec.n_leaves)
+            if kinds[i][0] in ("slot", "stream", "pt")
+            and codec.is_written(codec.paths[i])
+        ]
+        assert writable, "write-set unexpectedly empty"
+        streams = [i for i in writable if kinds[i][0] == "stream"]
+        rng = np.random.default_rng(10_000 + seed)
+        picks = set(streams) | set(
+            rng.choice(writable, size=min(8, len(writable)), replace=False)
+        )
+        for idx in sorted(picks):
+            mutated = _mutate_one_leaf(base, donor, idx)
+            _packed_words_bitexact(
+                codec.pack_delta(pst, mutated), codec.pack(mutated)
+            )
+        # Multi-leaf mutation (every written leaf at once) holds too.
+        every = base
+        for idx in writable:
+            every = _mutate_one_leaf(every, donor, idx)
+        _packed_words_bitexact(codec.pack_delta(pst, every), codec.pack(every))
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_pack_delta_drops_write_outside_declared_set(protocol, monkeypatch):
+    """Planted violation: mutating a word/stream leaf OUTSIDE the declared
+    write-set must be dropped by ``pack_delta`` (the carried word passes
+    through untouched) — the failure mode ``audit_write_set`` exists to
+    catch at trace time before it can corrupt a campaign.
+
+    paxos/fastpaxos/raftcore exercise their REAL exclusion
+    (``proposer.own_val``); multipaxos's real exclusion (``base``) is a
+    passthrough leaf — outside pack_delta's merge machinery, guarded by the
+    audit alone — so its planted case narrows the cached codec's write-set
+    (monkeypatch, restored at teardown) to un-declare the learner leaves."""
+    cfg = _cfg(protocol, n_inst=64)
+    base, codec = _random_in_range_state(protocol, cfg, 7)
+    donor, _ = _random_in_range_state(protocol, cfg, 107)
+    if protocol == "multipaxos":
+        monkeypatch.setattr(
+            codec, "writes",
+            tuple(w for w in codec.writes if not w.startswith("learner")),
+        )
+        unwritten_path = next(
+            p for p in codec.paths if p.startswith("learner.")
+        )
+    else:
+        unwritten_path = "proposer.own_val"
+    assert not codec.is_written(unwritten_path)
+    idx = codec.paths.index(unwritten_path)
+    mutated = _mutate_one_leaf(base, donor, idx)
+    # Non-vacuity: the mutation really changed the leaf's value.
+    assert not np.array_equal(
+        np.asarray(jax.tree_util.tree_flatten(base)[0][idx]),
+        np.asarray(jax.tree_util.tree_flatten(mutated)[0][idx]),
+    )
+    pst = codec.pack(base)
+    delta = codec.pack_delta(pst, mutated)
+    # The out-of-set write is dropped: delta equals the ORIGINAL packing...
+    _packed_words_bitexact(delta, codec.pack(base))
+    # ...and differs from a full pack of the mutated state (which would
+    # have carried the rogue write through).
+    full = codec.pack(mutated)
+    assert any(
+        not np.array_equal(np.asarray(delta.words[n]), np.asarray(full.words[n]))
+        for n in delta.words
+    )
+
+
 def test_signed_negative_roundtrip():
     """Signed fields (timers, chosen_tick sentinels) keep negatives exact."""
     cfg = _cfg("paxos", n_inst=8)

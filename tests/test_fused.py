@@ -299,15 +299,21 @@ def test_fused_ballot_overflow_saturates_and_guard_fires():
     import pytest
 
     from paxos_tpu.harness.run import MeasurementCorrupted, summarize
+    from paxos_tpu.kernels.fused_tick import report_ballot_limit
     from paxos_tpu.utils import bitops
 
+    # v2 layout: the packed field is WIDER than the report threshold (the
+    # clamp-hoist headroom), and every clamp pins at the threshold — the
+    # guard contract is unchanged from v1.
     cap = bitops.codec_for(
         "paxos", init_state(config2_dueling_drop(n_inst=32))
     ).field_capacity("proposer.bal")
-    assert cap == (1 << 15) - 1
+    limit = report_ballot_limit("paxos")
+    assert limit == (1 << 15) - 1
+    assert cap > limit
 
     # All messages drop and timeouts are short, so proposers retry with
-    # higher ballots every few ticks; pre-seeded near the capacity, the
+    # higher ballots every few ticks; pre-seeded near the limit, the
     # campaign crosses it well inside the chunk.
     cfg = SimConfig(
         n_inst=32, n_prop=2, n_acc=3, seed=9,
@@ -317,7 +323,7 @@ def test_fused_ballot_overflow_saturates_and_guard_fires():
 
     def preseed():
         s = init_state(cfg)
-        bump = jnp.int32(cap - 64)
+        bump = jnp.int32(limit - 64)
         return s.replace(
             proposer=s.proposer.replace(bal=s.proposer.bal + bump),
             requests=s.requests.replace(bal=s.requests.bal + bump),
@@ -326,38 +332,44 @@ def test_fused_ballot_overflow_saturates_and_guard_fires():
     fused = fused_paxos_chunk(
         preseed(), jnp.int32(9), plan, cfg.fault, 64, block=32, interpret=True
     )
-    # Saturated exactly at the capacity — a wrap would read small here.
-    assert int(fused.proposer.bal.max()) == cap
+    # Saturated exactly at the report limit — a wrap would read small here.
+    assert int(fused.proposer.bal.max()) == limit
     with pytest.raises(MeasurementCorrupted):
         summarize(fused)
 
     # The XLA twin of the same schedule grows through the limit unmasked
     # and trips the identical guard: the engines agree on condemnation.
     ref = reference_chunk(preseed(), jnp.int32(9), plan, cfg.fault, 64)
-    assert int(ref.proposer.bal.max()) >= cap
+    assert int(ref.proposer.bal.max()) >= limit
     with pytest.raises(MeasurementCorrupted):
         summarize(ref)
 
 
 def test_fused_multipaxos_overflowed_input_saturates_at_entry():
     """An already-overflowed ballot handed to the fused engine must read as
-    at-capacity (guard fires), not wrap small at the entry pack (guard
-    blind).  Also pins the MP guard limit at the 11-bit field capacity —
-    the old 2^11 limit was unrepresentable packed, hence unsatisfiable."""
+    at-limit (guard fires), not wrap small at the entry pack (guard
+    blind).  Pins the MP guard limit at the v1 11-bit threshold: the v2
+    packed field is one bit wider (clamp-hoist headroom) but every clamp
+    still saturates at the report limit, so the condemnation threshold is
+    unchanged."""
     import pytest
 
     from paxos_tpu.harness.config import config3_multipaxos
     from paxos_tpu.harness.run import MeasurementCorrupted, summarize
-    from paxos_tpu.kernels.fused_tick import fused_multipaxos_chunk
+    from paxos_tpu.kernels.fused_tick import (
+        fused_multipaxos_chunk, report_ballot_limit,
+    )
     from paxos_tpu.utils import bitops
 
     cfg = config3_multipaxos(n_inst=32, seed=4)
     state = init_state(cfg)
     cap = bitops.codec_for("multipaxos", state).field_capacity("proposer.bal")
-    assert cap == (1 << 11) - 1
+    limit = report_ballot_limit("multipaxos")
+    assert limit == (1 << 11) - 1
+    assert cap > limit
 
     over = state.replace(
-        proposer=state.proposer.replace(bal=state.proposer.bal + jnp.int32(cap + 5))
+        proposer=state.proposer.replace(bal=state.proposer.bal + jnp.int32(limit + 5))
     )
     # The unpacked (XLA-side) guard already condemns this state...
     with pytest.raises(MeasurementCorrupted):
@@ -367,6 +379,6 @@ def test_fused_multipaxos_overflowed_input_saturates_at_entry():
         over, jnp.int32(4), init_plan(cfg), cfg.fault, 4, block=32,
         interpret=True,
     )
-    assert int(out.proposer.bal.max()) == cap
+    assert int(out.proposer.bal.max()) == limit
     with pytest.raises(MeasurementCorrupted):
         summarize(out, log_total=cfg.fault.log_total)

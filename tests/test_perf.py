@@ -133,6 +133,14 @@ def test_vmem_and_roofline_gauges():
     assert r["roofline_ceiling_rps"] == pytest.approx(2.35e12 / 5329.0, rel=1e-3)
     assert 0 < r["roofline_occupancy"] < 1.5
     assert perf.roofline_gauges(1.0, {}, {}) == {}
+    # r11 census split: codec shifts folded back into the ceiling's ops so
+    # alu=5329 and alu=4448+codec=881 describe the same program.
+    split = perf.roofline_gauges(
+        3.7e8,
+        {"alu_per_lane_tick": 4448.0, "codec_alu_per_lane_tick": 881.0},
+        {"vpu_ops_per_sec": 2.35e12},
+    )
+    assert split["roofline_ceiling_rps"] == r["roofline_ceiling_rps"]
 
 
 # ----------------------------------------------------------- bench provenance
@@ -157,7 +165,8 @@ def _fake_row(**over):
         "platform": "cpu",
         "engine": "xla",
         "protocol": "paxos",
-        "layout_version": "paxos-packed-v1",
+        "ops_per_lane_tick": 4426.1,
+        "layout_version": "paxos-packed-v2",
         "config_fingerprint": "deadbeef00000000",
         "case": "case-a",
     }
@@ -175,6 +184,28 @@ def test_validate_bench_row():
     assert any("layout_version" in e for e in errs)
     errs = perf.validate_bench_row(_fake_row(schema="bogus-v9"))
     assert any("schema" in e for e in errs)
+
+
+def test_validate_bench_row_pins_both_schema_versions():
+    """v2 is current; v1 rows (committed r5-r10 artifacts) stay valid."""
+    assert perf.BENCH_ROW_SCHEMA == "paxos-tpu-bench-row-v2"
+    assert perf.BENCH_ROW_SCHEMAS == (
+        "paxos-tpu-bench-row-v1", "paxos-tpu-bench-row-v2",
+    )
+    # A v1 row has no ops_per_lane_tick — the legacy compat path accepts it.
+    v1 = _fake_row(schema="paxos-tpu-bench-row-v1")
+    del v1["ops_per_lane_tick"]
+    assert perf.validate_bench_row(v1) == []
+    # A v2 row must carry a positive census op count.
+    v2 = _fake_row()
+    assert perf.validate_bench_row(v2) == []
+    del v2["ops_per_lane_tick"]
+    errs = perf.validate_bench_row(v2)
+    assert any("ops_per_lane_tick" in e for e in errs)
+    errs = perf.validate_bench_row(_fake_row(ops_per_lane_tick=-1.0))
+    assert any("positive" in e for e in errs)
+    errs = perf.validate_bench_row(_fake_row(ops_per_lane_tick=True))
+    assert any("ops_per_lane_tick" in e for e in errs)
 
 
 def test_compare_benches_self_and_regression():
@@ -228,7 +259,8 @@ def test_bench_case_schema_and_warmup_split():
     assert perf.validate_bench_row(row) == []
     assert row["warmup_groups"] == 1 and len(row["warmup_runs"]) == 1
     assert row["timed_groups"] == 2 and len(row["samples"]) == 2
-    assert row["layout_version"] == "paxos-packed-v1"
+    assert row["layout_version"] == "paxos-packed-v2"
+    assert row["ops_per_lane_tick"] > 0
     assert row["perf"]["dispatches"] >= 2
     assert 0.0 <= row["perf"]["occupancy"] <= 1.0
     # warm-up (compile) must not leak into the measured samples
