@@ -134,11 +134,22 @@ def _configs(platform: str):
     exp_cfg = dataclasses.replace(
         config2_dueling_drop(n_inst=n), exposure=ExposureConfig(counters=True)
     )
+    # Margin-overhead row: flagship config with the safety-margin counters
+    # on (4 packed int32 minima/counts per lane through the generic
+    # passthrough).  Same contract again: OFF is gated free by the base
+    # row; this row prices ON (masked min/count reductions over the
+    # learner table the checker already scans).
+    from paxos_tpu.obs.margin import MarginConfig
+
+    mar_cfg = dataclasses.replace(
+        config2_dueling_drop(n_inst=n), margin=MarginConfig(counters=True)
+    )
     cases = [
         ("config2-paxos", config2_dueling_drop(n_inst=n), 1024, 1),
         ("config2-paxos-telemetry", tel_cfg, 1024, 1),
         ("config2-paxos-coverage", cov_cfg, 1024, 1),
         ("config2-paxos-exposure", exp_cfg, 1024, 1),
+        ("config2-paxos-margin", mar_cfg, 1024, 1),
         ("config5-fastpaxos", sweep["fastpaxos"], 256, 1),
         ("config5-raftcore", sweep["raftcore"], 256, 1),
         ("config3-multipaxos", config3_multipaxos(n_inst=n), 256, 1),

@@ -144,6 +144,13 @@ def run_audit(
                 traces["default"][1], traces["coverage"][1],
             )
             checks += 1
+        if "default" in traces and "margin" in traces:
+            findings += prng_audit.audit_margin_parity(
+                protocol,
+                traces["default"][0], traces["margin"][0],
+                traces["default"][1], traces["margin"][1],
+            )
+            checks += 1
         if "gray-chaos" in traces and "exposure" in traces:
             # Exposure's audit baseline is gray-chaos, not default: the
             # exposure cell rides the gray-chaos faults so its per-class

@@ -33,38 +33,51 @@ widened for the chunk-boundary ballot-clamp hoist (17 bits single-decree,
 CONFIG cell re-keyed through the version fold.  TREEDEF cells are
 byte-identical to round 9: packing width is invisible to the pytree
 structure.
+
+Round 12 re-record: the safety-margin plane (obs.margin) added an
+Optional ``margin`` leaf to every protocol state (TREEDEF re-key, same
+contract as rounds 8/9) and its counters joined the fused passthrough
+via the per-protocol ``__reads__``/``__writes__`` globs — since
+``bitops.layout_fields`` folds those declarations, every LAYOUT cell
+re-keyed under the *-packed-v3 versions and every CONFIG cell re-keyed
+through the version fold.  No packed word changed: margin arrays ride
+the generic passthrough codec, like coverage and exposure before them.
 """
 
 # (protocol, config_name) -> sha256[:16] of str(tree_structure(init_state))
 TREEDEF_GOLDENS: dict = {
-    ("paxos", "default"): "70a1f204f28dd0aa",
-    ("paxos", "gray-chaos"): "70a1f204f28dd0aa",
-    ("paxos", "corrupt"): "70a1f204f28dd0aa",
-    ("paxos", "stale"): "0fcacc1bd7c74b55",
-    ("paxos", "telemetry"): "7a56062c9b43bf0e",
-    ("paxos", "coverage"): "7fc0dc957ffba1a6",
-    ("paxos", "exposure"): "abf4caef44447651",
-    ("multipaxos", "default"): "88bd02bb2b5551ef",
-    ("multipaxos", "gray-chaos"): "88bd02bb2b5551ef",
-    ("multipaxos", "corrupt"): "88bd02bb2b5551ef",
-    ("multipaxos", "stale"): "f67f33b1f405dec3",
-    ("multipaxos", "telemetry"): "3c50da89e2d28493",
-    ("multipaxos", "coverage"): "56706cb41780cc81",
-    ("multipaxos", "exposure"): "7a8170eb91005d93",
-    ("fastpaxos", "default"): "e913bd8567a69327",
-    ("fastpaxos", "gray-chaos"): "e913bd8567a69327",
-    ("fastpaxos", "corrupt"): "e913bd8567a69327",
-    ("fastpaxos", "stale"): "5457e8db0c93e25f",
-    ("fastpaxos", "telemetry"): "eb85b0ad26ba060b",
-    ("fastpaxos", "coverage"): "4e778741ff9e754a",
-    ("fastpaxos", "exposure"): "49a01bd8d6395d03",
-    ("raftcore", "default"): "4677b44e023ecd4e",
-    ("raftcore", "gray-chaos"): "4677b44e023ecd4e",
-    ("raftcore", "corrupt"): "4677b44e023ecd4e",
-    ("raftcore", "stale"): "02ee82c800930ef8",
-    ("raftcore", "telemetry"): "c837c63a9ea5977d",
-    ("raftcore", "coverage"): "9ad9c3c4300d53ab",
-    ("raftcore", "exposure"): "33c040107e72e5c6",
+    ("paxos", "default"): "b944b96eecb6916b",
+    ("paxos", "gray-chaos"): "b944b96eecb6916b",
+    ("paxos", "corrupt"): "b944b96eecb6916b",
+    ("paxos", "stale"): "57701d5e08af921d",
+    ("paxos", "telemetry"): "908380c70bf11357",
+    ("paxos", "coverage"): "020d06ba22d05602",
+    ("paxos", "exposure"): "88c737d571032a75",
+    ("paxos", "margin"): "c947f544922d8dec",
+    ("multipaxos", "default"): "4c14452e0c86cf21",
+    ("multipaxos", "gray-chaos"): "4c14452e0c86cf21",
+    ("multipaxos", "corrupt"): "4c14452e0c86cf21",
+    ("multipaxos", "stale"): "3bd7c26ccfe579f4",
+    ("multipaxos", "telemetry"): "323fcfc3ea7b5a65",
+    ("multipaxos", "coverage"): "f56ad531d82cf7de",
+    ("multipaxos", "exposure"): "8987d6e996265649",
+    ("multipaxos", "margin"): "349ec6b34e3a8e5b",
+    ("fastpaxos", "default"): "dc7bc31711913343",
+    ("fastpaxos", "gray-chaos"): "dc7bc31711913343",
+    ("fastpaxos", "corrupt"): "dc7bc31711913343",
+    ("fastpaxos", "stale"): "d55120263fd2c558",
+    ("fastpaxos", "telemetry"): "6c909576a4254e82",
+    ("fastpaxos", "coverage"): "58d871e93cedb922",
+    ("fastpaxos", "exposure"): "1557839690837a21",
+    ("fastpaxos", "margin"): "eb72261b26b797f0",
+    ("raftcore", "default"): "e3edde71713d0764",
+    ("raftcore", "gray-chaos"): "e3edde71713d0764",
+    ("raftcore", "corrupt"): "e3edde71713d0764",
+    ("raftcore", "stale"): "e8b2170a5e3c9bdd",
+    ("raftcore", "telemetry"): "dc51a7e9f7d6e61d",
+    ("raftcore", "coverage"): "299c2f793394aaa8",
+    ("raftcore", "exposure"): "3207dd7b792d96e6",
+    ("raftcore", "margin"): "2e4b9fcbe2bfeb7b",
 }
 
 # (protocol, config_name) -> SimConfig.fingerprint() of the audit config
@@ -72,34 +85,38 @@ TREEDEF_GOLDENS: dict = {
 # the per-protocol layout version (paxos-packed-v1 / multipaxos-packed-v1 /
 # fastpaxos-packed-v1 / raftcore-packed-v1), re-keying every cell.
 CONFIG_GOLDENS: dict = {
-    ("paxos", "default"): "18de70331e1f13fe",
-    ("paxos", "gray-chaos"): "d375ecd0a0130cae",
-    ("paxos", "corrupt"): "eb408e35f2743ee1",
-    ("paxos", "stale"): "9bda52d0d855f214",
-    ("paxos", "telemetry"): "a71171b4a628a1be",
-    ("paxos", "coverage"): "aeaca5f24fbdfcea",
-    ("paxos", "exposure"): "9d9c96379b0b9972",
-    ("multipaxos", "default"): "3cc71d01ec7ec84e",
-    ("multipaxos", "gray-chaos"): "120f1c32622f6769",
-    ("multipaxos", "corrupt"): "04b29093ed3c7ad6",
-    ("multipaxos", "stale"): "74305d7853d2b18c",
-    ("multipaxos", "telemetry"): "e69a9168cd12ae35",
-    ("multipaxos", "coverage"): "035d59fe1e972a90",
-    ("multipaxos", "exposure"): "b73cc15a9d4d42f7",
-    ("fastpaxos", "default"): "f666d3ca9066fcb7",
-    ("fastpaxos", "gray-chaos"): "5c52340743718cc9",
-    ("fastpaxos", "corrupt"): "6dd54955e967856c",
-    ("fastpaxos", "stale"): "2cb53cfea1744c3f",
-    ("fastpaxos", "telemetry"): "904e07b30eb99bd4",
-    ("fastpaxos", "coverage"): "70390a8635254d21",
-    ("fastpaxos", "exposure"): "994c005d0bf061b3",
-    ("raftcore", "default"): "db4b28950ad681d8",
-    ("raftcore", "gray-chaos"): "3250ae1b49be26b9",
-    ("raftcore", "corrupt"): "ce3ffc88b74b0b9f",
-    ("raftcore", "stale"): "68b16adbda72f7ce",
-    ("raftcore", "telemetry"): "12dfb29f71807ce0",
-    ("raftcore", "coverage"): "d78aa0ad54c87736",
-    ("raftcore", "exposure"): "faecd36c8698b3e9",
+    ("paxos", "default"): "2f2c18a912fd9d9f",
+    ("paxos", "gray-chaos"): "1ca7815b8ded8f80",
+    ("paxos", "corrupt"): "34b6abbb425004e2",
+    ("paxos", "stale"): "4700921b7f908b7f",
+    ("paxos", "telemetry"): "15fd1a096d103553",
+    ("paxos", "coverage"): "8ac6f2bb875b4564",
+    ("paxos", "exposure"): "c07f92cc60bbf635",
+    ("paxos", "margin"): "e17ce877e256b71c",
+    ("multipaxos", "default"): "a92a094d538d14e8",
+    ("multipaxos", "gray-chaos"): "d2d0078df18f7bdc",
+    ("multipaxos", "corrupt"): "70b8b09fbdab2c0b",
+    ("multipaxos", "stale"): "eb1a07fa0d72ae6f",
+    ("multipaxos", "telemetry"): "889fed636367e055",
+    ("multipaxos", "coverage"): "21ae9e433def7c67",
+    ("multipaxos", "exposure"): "d6ec699879cdc876",
+    ("multipaxos", "margin"): "5457a5841cb263e1",
+    ("fastpaxos", "default"): "1e0a4848f3c6713a",
+    ("fastpaxos", "gray-chaos"): "f23cda06403ec7e2",
+    ("fastpaxos", "corrupt"): "f64e61267636c6c4",
+    ("fastpaxos", "stale"): "5531b38c51d3389b",
+    ("fastpaxos", "telemetry"): "d547af2c3903f6fd",
+    ("fastpaxos", "coverage"): "41bfdaf87b1d61cb",
+    ("fastpaxos", "exposure"): "3d4360e4c1e628df",
+    ("fastpaxos", "margin"): "b975b70c4f9e7b4f",
+    ("raftcore", "default"): "8b3a6800f7c68486",
+    ("raftcore", "gray-chaos"): "c511f800922f6478",
+    ("raftcore", "corrupt"): "cbebe656f68feba2",
+    ("raftcore", "stale"): "aeba76a9df603c7e",
+    ("raftcore", "telemetry"): "8289428af0eba4d7",
+    ("raftcore", "coverage"): "4e059d075c566e47",
+    ("raftcore", "exposure"): "65e509af4be13f0e",
+    ("raftcore", "margin"): "0f9cc700f0b45551",
 }
 
 # protocol -> {"version": layout version string, "fields": canonical per-field
@@ -111,14 +128,14 @@ CONFIG_GOLDENS: dict = {
 # name the version in the commit.
 LAYOUT_GOLDENS: dict = {
     "paxos": {
-        "version": "paxos-packed-v2",
+        "version": "paxos-packed-v3",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.promised', 0))]",
             "__reads__":
-                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.*', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'margin.*', 'proposer.*', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
             "__writes__":
-                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.bal', 'proposer.best_bal', 'proposer.best_val', 'proposer.decided_val', 'proposer.heard', 'proposer.phase', 'proposer.prop_val', 'proposer.timer', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'margin.*', 'proposer.bal', 'proposer.best_bal', 'proposer.best_val', 'proposer.decided_val', 'proposer.heard', 'proposer.phase', 'proposer.prop_val', 'proposer.timer', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
             "acceptor.acc_bal":
                 "word=acc slot=1 bits=15 signed=0 bool=0 bv=None",
             "acceptor.promised":
@@ -174,14 +191,14 @@ LAYOUT_GOLDENS: dict = {
         },
     },
     "multipaxos": {
-        "version": "multipaxos-packed-v2",
+        "version": "multipaxos-packed-v3",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.promised', 0))]",
             "__reads__":
-                "('accepted.*', 'acceptor.*', 'base', 'coverage.*', 'exposure.*', 'learner.*', 'promises.*', 'proposer.*', 'requests.*', 'telemetry.*', 'tick')",
+                "('accepted.*', 'acceptor.*', 'base', 'coverage.*', 'exposure.*', 'learner.*', 'margin.*', 'promises.*', 'proposer.*', 'requests.*', 'telemetry.*', 'tick')",
             "__writes__":
-                "('accepted.*', 'acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'promises.*', 'proposer.*', 'requests.*', 'telemetry.*', 'tick')",
+                "('accepted.*', 'acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'margin.*', 'promises.*', 'proposer.*', 'requests.*', 'telemetry.*', 'tick')",
             "accepted.bal":
                 "word=accd slot=0 bits=12 signed=0 bool=0 bv=None",
             "accepted.present":
@@ -231,14 +248,14 @@ LAYOUT_GOLDENS: dict = {
         },
     },
     "fastpaxos": {
-        "version": "fastpaxos-packed-v2",
+        "version": "fastpaxos-packed-v3",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.promised', 0))]",
             "__reads__":
-                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.*', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'margin.*', 'proposer.*', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
             "__writes__":
-                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.bal', 'proposer.best_bal', 'proposer.decided_val', 'proposer.heard', 'proposer.phase', 'proposer.prop_val', 'proposer.rep_mask', 'proposer.timer', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'margin.*', 'proposer.bal', 'proposer.best_bal', 'proposer.decided_val', 'proposer.heard', 'proposer.phase', 'proposer.prop_val', 'proposer.rep_mask', 'proposer.timer', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
             "acceptor.acc_bal":
                 "word=acc slot=1 bits=15 signed=0 bool=0 bv=None",
             "acceptor.promised":
@@ -290,14 +307,14 @@ LAYOUT_GOLDENS: dict = {
         },
     },
     "raftcore": {
-        "version": "raftcore-packed-v2",
+        "version": "raftcore-packed-v3",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.voted', 0))]",
             "__reads__":
-                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.*', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'margin.*', 'proposer.*', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
             "__writes__":
-                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'proposer.bal', 'proposer.decided_val', 'proposer.ent_term', 'proposer.ent_val', 'proposer.heard', 'proposer.phase', 'proposer.prop_val', 'proposer.timer', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'margin.*', 'proposer.bal', 'proposer.decided_val', 'proposer.ent_term', 'proposer.ent_val', 'proposer.heard', 'proposer.phase', 'proposer.prop_val', 'proposer.timer', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
             "acceptor.ent_term":
                 "word=acc slot=1 bits=15 signed=0 bool=0 bv=None",
             "acceptor.snap_term":

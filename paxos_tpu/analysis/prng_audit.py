@@ -315,3 +315,18 @@ def audit_exposure_parity(
         protocol, "exposure-parity", "exposure",
         base_xla, exp_xla, base_ctr, exp_ctr,
     )
+
+
+def audit_margin_parity(
+    protocol: str, default_xla, mar_xla, default_ctr, mar_ctr
+) -> list:
+    """The safety-margin counters must consume no randomness.
+
+    Margin folds are pure int32 min/count reductions over learner-table
+    and promise/accept state the step already computed (obs.margin
+    docstring), so the margin-on traces must carry identical PRNG
+    signatures to the default cell."""
+    return _audit_observer_parity(
+        protocol, "margin-parity", "margin",
+        default_xla, mar_xla, default_ctr, mar_ctr,
+    )

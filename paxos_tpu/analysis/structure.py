@@ -51,6 +51,11 @@ _KNOB_LEAVES = (
         lambda cfg: cfg.exposure.enabled(),
         "exposure disabled",
     ),
+    (
+        lambda name: name == "margin",
+        lambda cfg: cfg.margin.enabled(),
+        "margin disabled",
+    ),
 )
 
 _PLAN_GRAY_FIELDS = ("part_dir", "link_drop", "link_dup", "ptimeout", "pboff")

@@ -80,6 +80,14 @@ def _exposure(protocol: str) -> SimConfig:
     )
 
 
+def _margin(protocol: str) -> SimConfig:
+    from paxos_tpu.obs.margin import MarginConfig
+
+    return dataclasses.replace(
+        _default(protocol), margin=MarginConfig(counters=True)
+    )
+
+
 CONFIG_MATRIX: dict[str, Callable[[str], SimConfig]] = {
     "default": _default,
     "gray-chaos": _gray,
@@ -88,6 +96,7 @@ CONFIG_MATRIX: dict[str, Callable[[str], SimConfig]] = {
     "telemetry": _telemetry,
     "coverage": _coverage,
     "exposure": _exposure,
+    "margin": _margin,
 }
 
 

@@ -134,3 +134,66 @@ def mp_learner_observe(
         violations=learner.violations + viol,
         evictions=evictions,
     )
+
+
+def mp_margin_observe(
+    margin,
+    pre: MPLearnerState,
+    post: MPLearnerState,
+    promised: jnp.ndarray,  # (A, I) int32 promise fence
+    acc_bal: jnp.ndarray,  # (A, I) int32 max accepted ballot over the log
+    honest: jnp.ndarray,  # (A, I) bool
+    quorum: int,
+):
+    """Multi-Paxos margin fold: :func:`paxos_tpu.check.safety.margin_observe`
+    lifted to the (L, K, I) table — per-slot rivals and decide edges,
+    per-lane running minima (see ``obs.margin`` for counter semantics).
+    """
+    from paxos_tpu.obs.margin import SENTINEL
+
+    bal = bv_bal(post.lt_bv)  # (L, K, I)
+    val = bv_val(post.lt_bv)
+    votes = popcount(post.lt_mask)
+    live = post.lt_bv > 0
+
+    # Quorum slack: best competing row across every decided slot.
+    competing = (
+        live & post.chosen[:, None] & (val != post.chosen_val[:, None])
+    )
+    slack = jnp.maximum(quorum - votes, 0)
+    tick_slack = jnp.where(competing, slack, SENTINEL).min(axis=(0, 1))  # (I,)
+    qslack_min = jnp.minimum(margin.qslack_min, tick_slack)
+
+    # Near-split contention: any slot with >= 2 distinct hot values.
+    hot = live & (votes >= quorum - 1)
+    vmin = jnp.where(hot, val, SENTINEL).min(axis=1)  # (L, I)
+    vmax = jnp.where(hot, val, 0).max(axis=1)
+    near = (
+        (hot.sum(axis=1, dtype=jnp.int32) >= 2) & (vmin != vmax)
+    ).any(axis=0)
+    near_split = margin.near_split + near.astype(jnp.int32)
+
+    # Ballot-race margin on slots deciding this tick.
+    decided_now = post.chosen & ~pre.chosen  # (L, I)
+    win_rows = (votes >= quorum) & live & (val == post.chosen_val[:, None])
+    win_bal = jnp.where(win_rows, bal, 0).max(axis=1)  # (L, I)
+    rival_bal = jnp.where(live & ~win_rows, bal, 0).max(axis=1)
+    gap = jnp.maximum(win_bal - rival_bal, 0)
+    tick_gap = jnp.where(decided_now & (rival_bal > 0), gap, SENTINEL).min(
+        axis=0
+    )
+    bal_gap_min = jnp.minimum(margin.bal_gap_min, tick_gap)
+
+    # Checker headroom: one promise fence covers the whole log, so the
+    # slack partner is the acceptor's highest accepted ballot.
+    pslack = jnp.where(
+        honest & (acc_bal > 0), promised - acc_bal, SENTINEL
+    ).min(axis=0)  # (I,)
+    promise_slack_min = jnp.minimum(margin.promise_slack_min, pslack)
+
+    return margin.replace(
+        qslack_min=qslack_min,
+        near_split=near_split,
+        bal_gap_min=bal_gap_min,
+        promise_slack_min=promise_slack_min,
+    )

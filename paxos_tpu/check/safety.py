@@ -140,6 +140,79 @@ def learner_observe(
     )
 
 
+def margin_observe(
+    margin,
+    pre: LearnerState,
+    post: LearnerState,
+    promised: jnp.ndarray,  # (A, I) int32 promise fence (Raft: voted)
+    acc_bal: jnp.ndarray,  # (A, I) int32 accepted ballot (Raft: ent_term)
+    honest: jnp.ndarray,  # (A, I) bool — equivocators violate by design
+    quorum: int,
+    fast_quorum: int | None = None,
+):
+    """Fold one tick's distance-to-violation signals into the margin sketch.
+
+    Reads the post-:func:`learner_observe` table (``post``) plus the
+    pre-tick learner (``pre``, for decide edges) and the post-tick
+    acceptor fence — signals the tick already produced, no PRNG, so the
+    plane rides the default-off-is-free contract (see ``obs.margin`` for
+    counter semantics).  ``margin`` is an ``obs.margin.MarginState``.
+    """
+    from paxos_tpu.obs.margin import SENTINEL
+
+    lt_bal, lt_val, lt_mask = post.lt_bal, post.lt_val, post.lt_mask
+    votes = popcount(lt_mask)  # (K, I)
+    if fast_quorum is None:
+        sq = jnp.full(lt_bal.shape, quorum, jnp.int32)
+    else:
+        from paxos_tpu.core.ballot import ballot_round
+
+        sq = jnp.where(ballot_round(lt_bal) == 0, fast_quorum, quorum)
+    live = lt_bal > 0  # (K, I)
+
+    # Quorum slack: the best competing row — a live pair on a decided
+    # instance carrying a value that is NOT the chosen one.  Slack 0 means
+    # the rival reached quorum: the agreement violation fired this tick.
+    competing = live & post.chosen[None] & (lt_val != post.chosen_val[None])
+    slack = jnp.maximum(sq - votes, 0)
+    tick_slack = jnp.where(competing, slack, SENTINEL).min(axis=0)  # (I,)
+    qslack_min = jnp.minimum(margin.qslack_min, tick_slack)
+
+    # Near-split contention: >= 2 live rows with distinct values each
+    # within one accept of quorum on the same instance this tick.
+    hot = live & (votes >= sq - 1)
+    vmin = jnp.where(hot, lt_val, SENTINEL).min(axis=0)
+    vmax = jnp.where(hot, lt_val, 0).max(axis=0)
+    near = (hot.sum(axis=0, dtype=jnp.int32) >= 2) & (vmin != vmax)
+    near_split = margin.near_split + near.astype(jnp.int32)
+
+    # Ballot-race margin, taken on the decide tick: winning-row ballot vs
+    # the best rival row still in the table.  Unopposed decides (no live
+    # rival) record nothing.
+    decided_now = post.chosen & ~pre.chosen  # (I,)
+    win_rows = (votes >= sq) & live & (lt_val == post.chosen_val[None])
+    win_bal = jnp.where(win_rows, lt_bal, 0).max(axis=0)  # (I,)
+    rival_bal = jnp.where(live & ~win_rows, lt_bal, 0).max(axis=0)
+    gap = jnp.maximum(win_bal - rival_bal, 0)
+    tick_gap = jnp.where(decided_now & (rival_bal > 0), gap, SENTINEL)
+    bal_gap_min = jnp.minimum(margin.bal_gap_min, tick_gap)
+
+    # Checker headroom on the acceptance bound: promised - acc_bal over
+    # honest acceptors holding a live accepted pair.  0 = accepts landing
+    # exactly at the fence; negative is already an invariant violation.
+    pslack = jnp.where(
+        honest & (acc_bal > 0), promised - acc_bal, SENTINEL
+    ).min(axis=0)  # (I,)
+    promise_slack_min = jnp.minimum(margin.promise_slack_min, pslack)
+
+    return margin.replace(
+        qslack_min=qslack_min,
+        near_split=near_split,
+        bal_gap_min=bal_gap_min,
+        promise_slack_min=promise_slack_min,
+    )
+
+
 def acceptor_invariants(
     old: AcceptorState, new: AcceptorState, honest: jnp.ndarray
 ) -> jnp.ndarray:

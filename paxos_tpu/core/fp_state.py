@@ -34,6 +34,7 @@ from paxos_tpu.core.state import AcceptorState, LearnerState
 from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
 from paxos_tpu.obs.exposure import FaultExposure
+from paxos_tpu.obs.margin import MarginState
 
 # Proposer phases (P1/P2/DONE match core.state so summarize() is shared).
 P1 = 0  # classic recovery: prepare sent, collecting promises
@@ -99,6 +100,8 @@ class FastPaxosState:
     coverage: Optional[CoverageState] = None
     # Fault-exposure counters (obs.exposure): None when disabled, same contract.
     exposure: Optional[FaultExposure] = None
+    # Near-miss safety-margin sketch (obs.margin): None when disabled, same contract.
+    margin: Optional[MarginState] = None
 
     @classmethod
     def init(
@@ -154,7 +157,9 @@ class FastPaxosState:
 
 from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
 
-FP_LAYOUT_VERSION = "fastpaxos-packed-v2"
+# v3: the margin.* observer plane joined the tick read/write sets (the
+# declarations fold into layout_fields — see core/state.py).
+FP_LAYOUT_VERSION = "fastpaxos-packed-v3"
 FP_LAYOUT = (
     Word("req", F("requests.bal", 15), F("requests.v1", 12),
          F("requests.present", 1, bool_=True)),
@@ -185,7 +190,7 @@ FP_LAYOUT_DIMS = {"n_acc": ("acceptor.promised", 0)}
 # value, assigned at init and only ever read).
 FP_TICK_READS = (
     "acceptor.*", "proposer.*", "learner.*", "requests.*", "replies.*",
-    "telemetry.*", "coverage.*", "exposure.*", "tick",
+    "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )
 FP_TICK_WRITES = (
     "acceptor.*",
@@ -193,5 +198,5 @@ FP_TICK_WRITES = (
     "proposer.heard", "proposer.best_bal", "proposer.rep_mask",
     "proposer.decided_val",
     "learner.*", "requests.*", "replies.*",
-    "telemetry.*", "coverage.*", "exposure.*", "tick",
+    "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )

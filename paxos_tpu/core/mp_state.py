@@ -32,6 +32,7 @@ from paxos_tpu.core.messages import MsgBuf
 from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
 from paxos_tpu.obs.exposure import FaultExposure
+from paxos_tpu.obs.margin import MarginState
 
 # Proposer phases
 FOLLOW = 0  # passive: watching progress, lease ticking
@@ -227,6 +228,8 @@ class MultiPaxosState:
     coverage: Optional[CoverageState] = None
     # Fault-exposure counters (obs.exposure): None when disabled, same contract.
     exposure: Optional[FaultExposure] = None
+    # Near-miss safety-margin sketch (obs.margin): None when disabled, same contract.
+    margin: Optional[MarginState] = None
 
     @classmethod
     def init(
@@ -290,7 +293,9 @@ class MultiPaxosState:
 
 from paxos_tpu.utils.bitops import F, Stream, Word  # noqa: E402
 
-MP_LAYOUT_VERSION = "multipaxos-packed-v2"
+# v3: the margin.* observer plane joined the tick read/write sets (the
+# declarations fold into layout_fields — see core/state.py).
+MP_LAYOUT_VERSION = "multipaxos-packed-v3"
 MP_LAYOUT = (
     Word("req", F("requests.bal", 12), F("requests.v1", 13),
          F("requests.present", 1, bool_=True)),
@@ -327,10 +332,10 @@ MP_LAYOUT_DIMS = {"n_acc": ("acceptor.promised", 0)}
 MP_TICK_READS = (
     "acceptor.*", "proposer.*", "learner.*", "requests.*", "promises.*",
     "accepted.*", "base",
-    "telemetry.*", "coverage.*", "exposure.*", "tick",
+    "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )
 MP_TICK_WRITES = (
     "acceptor.*", "proposer.*", "learner.*", "requests.*", "promises.*",
     "accepted.*",
-    "telemetry.*", "coverage.*", "exposure.*", "tick",
+    "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )

@@ -29,6 +29,7 @@ from paxos_tpu.core.state import LearnerState
 from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
 from paxos_tpu.obs.exposure import FaultExposure
+from paxos_tpu.obs.margin import MarginState
 
 # Candidate phases (values match core.state.P1/P2/DONE so summarize() and
 # liveness stats are shared across protocols).
@@ -128,6 +129,8 @@ class RaftState:
     coverage: Optional[CoverageState] = None
     # Fault-exposure counters (obs.exposure): None when disabled, same contract.
     exposure: Optional[FaultExposure] = None
+    # Near-miss safety-margin sketch (obs.margin): None when disabled, same contract.
+    margin: Optional[MarginState] = None
 
     @classmethod
     def init(
@@ -181,7 +184,9 @@ class RaftState:
 
 from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
 
-RAFT_LAYOUT_VERSION = "raftcore-packed-v2"
+# v3: the margin.* observer plane joined the tick read/write sets (the
+# declarations fold into layout_fields — see core/state.py).
+RAFT_LAYOUT_VERSION = "raftcore-packed-v3"
 RAFT_LAYOUT = (
     Word("req", F("requests.bal", 15), F("requests.v1", 15),
          F("requests.present", 1, bool_=True)),
@@ -212,7 +217,7 @@ RAFT_LAYOUT_DIMS = {"n_acc": ("acceptor.voted", 0)}
 # except proposer.own_val (the candidate's fixed value, only ever read).
 RAFT_TICK_READS = (
     "acceptor.*", "proposer.*", "learner.*", "requests.*", "replies.*",
-    "telemetry.*", "coverage.*", "exposure.*", "tick",
+    "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )
 RAFT_TICK_WRITES = (
     "acceptor.*",
@@ -220,5 +225,5 @@ RAFT_TICK_WRITES = (
     "proposer.heard", "proposer.ent_term", "proposer.ent_val",
     "proposer.decided_val",
     "learner.*", "requests.*", "replies.*",
-    "telemetry.*", "coverage.*", "exposure.*", "tick",
+    "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )

@@ -31,6 +31,7 @@ from paxos_tpu.core.messages import MsgBuf
 from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
 from paxos_tpu.obs.exposure import FaultExposure
+from paxos_tpu.obs.margin import MarginState
 
 # Proposer phases
 P1 = 0  # prepare sent, collecting promises
@@ -157,6 +158,8 @@ class PaxosState:
     coverage: Optional[CoverageState] = None
     # Fault-exposure counters (obs.exposure): None when disabled, same contract.
     exposure: Optional[FaultExposure] = None
+    # Near-miss safety-margin sketch (obs.margin): None when disabled, same contract.
+    margin: Optional[MarginState] = None
 
     @classmethod
     def init(
@@ -230,7 +233,10 @@ class PaxosState:
 
 from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
 
-PAXOS_LAYOUT_VERSION = "paxos-packed-v2"
+# v3: the margin.* observer plane joined the tick read/write sets (the
+# declarations fold into layout_fields, so the glob addition re-keys the
+# descriptor even though no packed word changed).
+PAXOS_LAYOUT_VERSION = "paxos-packed-v3"
 PAXOS_LAYOUT = (
     Word("req", F("requests.bal", 15), F("requests.v1", 12),
          F("requests.present", 1, bool_=True)),
@@ -266,7 +272,7 @@ PAXOS_LAYOUT_DIMS = {"n_acc": ("acceptor.promised", 0)}
 # coverage / exposure) so one declaration serves every config shape.
 PAXOS_TICK_READS = (
     "acceptor.*", "proposer.*", "learner.*", "requests.*", "replies.*",
-    "telemetry.*", "coverage.*", "exposure.*", "tick",
+    "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )
 PAXOS_TICK_WRITES = (
     "acceptor.*",
@@ -274,5 +280,5 @@ PAXOS_TICK_WRITES = (
     "proposer.heard", "proposer.best_bal", "proposer.best_val",
     "proposer.decided_val",
     "learner.*", "requests.*", "replies.*",
-    "telemetry.*", "coverage.*", "exposure.*", "tick",
+    "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )

@@ -126,15 +126,17 @@ def restore(
         )
     saved_stream = raw.pop("stream", None)
     fault = raw.pop("fault")
-    # Tolerate pre-telemetry / pre-coverage / pre-exposure snapshots (no
-    # key): default off.
+    # Tolerate snapshots predating an observer plane (no key for
+    # telemetry / coverage / exposure / margin): default off.
     tel = raw.pop("telemetry", None)
     cov = raw.pop("coverage", None)
     exp = raw.pop("exposure", None)
+    mar = raw.pop("margin", None)
     from paxos_tpu.core.telemetry import TelemetryConfig
     from paxos_tpu.faults.injector import FaultConfig
     from paxos_tpu.obs.coverage import CoverageConfig
     from paxos_tpu.obs.exposure import ExposureConfig
+    from paxos_tpu.obs.margin import MarginConfig
 
     cfg = SimConfig(
         **raw,
@@ -142,6 +144,7 @@ def restore(
         telemetry=TelemetryConfig(**tel) if tel else TelemetryConfig(),
         coverage=CoverageConfig(**cov) if cov else CoverageConfig(),
         exposure=ExposureConfig(**exp) if exp else ExposureConfig(),
+        margin=MarginConfig(**mar) if mar else MarginConfig(),
     )
 
     if engine is not None:

@@ -162,6 +162,13 @@ def build_parser() -> argparse.ArgumentParser:
         "off is free and schedule-identical)",
     )
     r.add_argument(
+        "--margin", action="store_true",
+        help="on-device near-miss safety-margin counters: per-lane distance "
+        "to violation (quorum slack, near-split ticks, ballot-race gap, "
+        "promise headroom; obs.margin; default off — off is free and "
+        "schedule-identical)",
+    )
+    r.add_argument(
         "--perf", action="store_true",
         help="host-side performance plane (obs.perf): rounds/sec, pipeline "
         "occupancy, chunk-latency percentiles, compile-vs-steady split in "
@@ -246,6 +253,12 @@ def build_parser() -> argparse.ArgumentParser:
         "across seeds: the report gains per-class injected-vs-effective "
         "totals and a vacuous-chaos flag for lit knobs that never touched "
         "the protocol (obs.exposure)",
+    )
+    so.add_argument(
+        "--margin", action="store_true",
+        help="on-device near-miss margin counters per campaign: the report "
+        "gains cross-seed minima and a per-seed near-miss ranking — which "
+        "seeds came closest to a violation (obs.margin)",
     )
     so.add_argument(
         "--perf", action="store_true",
@@ -348,6 +361,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="also sample the fault-exposure counters at every chunk "
         "boundary and draw one Perfetto counter track per fault class "
         "(obs.exposure; forces the serial per-chunk loop)",
+    )
+    tr.add_argument(
+        "--margin", action="store_true",
+        help="also sample the near-miss margin counters at every chunk "
+        "boundary and draw min_quorum_slack / near_miss_lanes Perfetto "
+        "counter tracks (obs.margin; forces the serial per-chunk loop)",
     )
 
     st = sub.add_parser(
@@ -498,8 +517,8 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument(
         "--config", action="append", dest="configs", metavar="NAME",
         choices=["default", "gray-chaos", "corrupt", "stale", "telemetry",
-                 "coverage", "exposure"],
-        help="restrict to one audit config (repeatable; default: all seven)",
+                 "coverage", "exposure", "margin"],
+        help="restrict to one audit config (repeatable; default: all eight)",
     )
     a.add_argument(
         "--structure", action="store_true",
@@ -613,6 +632,48 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true", dest="as_json",
         help="emit the full report as JSON instead of the text tables",
     )
+
+    mg = sub.add_parser(
+        "margin",
+        help="near-miss margin plane: run a campaign with the distance-to-"
+        "violation counters on and print the per-chunk min-slack curve, "
+        "the tightest-lane ranking, and the correlation table against "
+        "coverage growth and effective faults (obs.margin)",
+    )
+    mg.add_argument("--config", choices=sorted(CONFIGS), default="corrupt")
+    mg.add_argument("--engine", choices=["xla", "fused"], default="xla")
+    mg.add_argument("--n-inst", type=int, default=None)
+    mg.add_argument(
+        "--fault", action="append", default=[], metavar="KEY=VALUE",
+        help="override any FaultConfig knob by name (repeatable)",
+    )
+    mg.add_argument("--seed", type=int, default=0)
+    mg.add_argument("--ticks", type=int, default=256)
+    mg.add_argument("--chunk", type=int, default=64)
+    mg.add_argument(
+        "--coverage", action="store_true",
+        help="also run the coverage sketch so the correlation table can "
+        "join tightening chunks against new union bits",
+    )
+    mg.add_argument(
+        "--coverage-words", type=int, default=64, metavar="W",
+        help="sketch size in int32 words per lane (only read with "
+        "--coverage)",
+    )
+    mg.add_argument(
+        "--exposure", action="store_true",
+        help="also run the fault-exposure counters so the correlation "
+        "table can join tightening chunks against effective-fault deltas",
+    )
+    mg.add_argument(
+        "--lanes", type=int, default=8, metavar="N",
+        help="how many tightest lanes to rank in the report",
+    )
+    mg.add_argument("--log", default=None, help="JSONL metrics path")
+    mg.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the full report as JSON instead of the text tables",
+    )
     return p
 
 
@@ -649,6 +710,28 @@ def _exposure_from_args(args: argparse.Namespace):
     from paxos_tpu.obs.exposure import ExposureConfig
 
     return ExposureConfig(counters=True)
+
+
+def _margin_from_args(args: argparse.Namespace):
+    """The --margin flag as a MarginConfig (or None when off)."""
+    if not getattr(args, "margin", False):
+        return None
+    from paxos_tpu.obs.margin import MarginConfig
+
+    return MarginConfig(counters=True)
+
+
+def _warn_checker_incomplete(report: dict) -> None:
+    """Loud stderr warning when the safety oracle lost rows (satellite:
+    an eviction means a violation could have been MISSED, so a clean
+    violations=0 from this campaign is weaker than it looks)."""
+    ev = report.get("evictions", 0)
+    if ev:
+        print(f"warning: learner table evicted {ev} row(s) — the safety "
+              "checker is INCOMPLETE for this campaign (a quorum on an "
+              "evicted (ballot, value) row would not have been flagged); "
+              "treat violations=0 as unverified, raise the table capacity "
+              "or shorten the campaign", file=sys.stderr)
 
 
 def cmd_run(args: argparse.Namespace) -> int:
@@ -722,6 +805,7 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
     tel_cfg = _telemetry_from_args(args)
     cov_cfg = _coverage_from_args(args)
     expo_cfg = _exposure_from_args(args)
+    mar_cfg = _margin_from_args(args)
     registry = MetricsRegistry()
     registry.gauge("pipeline_depth_effective", depth)
     # Host span recorder (--span-trace / --perf): the CLI owns the wall
@@ -755,6 +839,11 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                   "counters' arrays are part of the checkpointed state "
                   "structure; same rule as --telemetry)", file=sys.stderr)
             return 1
+        if mar_cfg is not None:
+            print("error: --margin cannot be combined with --resume (the "
+                  "counters' arrays are part of the checkpointed state "
+                  "structure; same rule as --telemetry)", file=sys.stderr)
+            return 1
         # Stream-lineage guard (VERDICT r4 weak#3): refuse to resume under
         # a different engine/block than the one that wrote the snapshot.
         state, plan, cfg = ckpt.restore(
@@ -777,6 +866,8 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
             cfg = dataclasses.replace(cfg, coverage=cov_cfg)
         if expo_cfg is not None:
             cfg = dataclasses.replace(cfg, exposure=expo_cfg)
+        if mar_cfg is not None:
+            cfg = dataclasses.replace(cfg, margin=mar_cfg)
         state, plan = init_state(cfg), init_plan(cfg)
 
     if args.shard:
@@ -859,6 +950,10 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                     registry.ingest_coverage(rep["coverage"])
                 if "exposure" in rep:
                     registry.ingest_exposure(rep["exposure"])
+                if "margin" in rep:
+                    registry.ingest_margin(
+                        rep["margin"], rep.get("checker_complete")
+                    )
                 if args.events:
                     # Registry-routed (and into the JSONL stream), with the
                     # historical stderr line kept for eyeball debugging.
@@ -904,6 +999,11 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
         registry.ingest_exposure(
             report["exposure"], lit=exposure_lit(cfg.fault)
         )
+    if "margin" in report:
+        registry.ingest_margin(
+            report["margin"], report.get("checker_complete")
+        )
+    _warn_checker_incomplete(report)
     if args.perf:
         from paxos_tpu.obs import perf as perf_mod
 
@@ -1014,6 +1114,11 @@ def cmd_soak(args: argparse.Namespace) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, exposure=expo_cfg)
+    mar_cfg = _margin_from_args(args)
+    if mar_cfg is not None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, margin=mar_cfg)
     band = args.min_replication
     if band is None:
         rec = config_mod.REPLICATION_RATES.get(args.config)
@@ -1077,11 +1182,12 @@ def cmd_soak(args: argparse.Namespace) -> int:
             from paxos_tpu.obs import perf as perf_mod
 
             report["perf"] = perf_mod.perf_summary(recorder, cfg.n_inst)
-        if "coverage" in report or "exposure" in report or args.perf:
-            # Cross-seed coverage/exposure/perf as gauges, so `stats
+        if ("coverage" in report or "exposure" in report
+                or "margin" in report or args.perf):
+            # Cross-seed coverage/exposure/margin/perf as gauges, so `stats
             # --prometheus` over this JSONL stream exposes the curve's
-            # endpoint, the plateau, per-class exposure totals, and the
-            # campaign-loop throughput/occupancy.
+            # endpoint, the plateau, per-class exposure totals, the
+            # near-miss minima, and the campaign-loop throughput/occupancy.
             from paxos_tpu.harness.metrics import MetricsRegistry
 
             registry = MetricsRegistry()
@@ -1095,6 +1201,10 @@ def cmd_soak(args: argparse.Namespace) -> int:
 
                 registry.ingest_exposure(
                     report["exposure"], lit=exposure_lit(cfg.fault)
+                )
+            if "margin" in report:
+                registry.ingest_margin(
+                    report["margin"], report.get("checker_complete")
                 )
             if args.perf:
                 registry.ingest_perf(report["perf"])
@@ -1113,6 +1223,7 @@ def cmd_soak(args: argparse.Namespace) -> int:
             # in the JSONL stream even if the process dies right after.
             mlog.emit("violation", violations=report["violations"],
                       violating_seeds=report.get("violating_seeds"))
+        _warn_checker_incomplete(report)
         mlog.emit("final", **report)
     print(json.dumps(report))
     if report["violations"]:
@@ -1196,6 +1307,8 @@ def _stats_render(
     last_agg = None
     last_cov = None
     last_exp = None
+    last_margin = None
+    last_checker = None
     last_perf = None
     last_seed = None
     for rec in records:
@@ -1222,6 +1335,12 @@ def _stats_render(
         exp = rec.get("exposure")
         if isinstance(exp, dict) and "classes" in exp:
             last_exp = exp
+        # Margin minima only tighten; last report = campaign-wide minima.
+        mar = rec.get("margin")
+        if isinstance(mar, dict) and "min_quorum_slack" in mar:
+            last_margin = mar
+        if "checker_complete" in rec:
+            last_checker = rec["checker_complete"]
         # Span-trace aggregates (`trace` subcommand) are whole-campaign
         # summaries; the last record wins for the same reason.
         if kind == "spans" and isinstance(rec.get("aggregates"), dict):
@@ -1240,6 +1359,8 @@ def _stats_render(
         registry.ingest_exposure(
             last_exp, lit={n: True for n in last_exp.get("lit", [])}
         )
+    if last_margin is not None or last_checker is not None:
+        registry.ingest_margin(last_margin or {}, last_checker)
     if last_agg is not None:
         registry.ingest_span_aggregates(last_agg)
     if last_perf is not None:
@@ -1285,6 +1406,10 @@ def _stats_render(
         out["coverage"] = last_cov
     if last_exp is not None:
         out["exposure"] = last_exp
+    if last_margin is not None:
+        out["margin"] = last_margin
+    if last_checker is not None:
+        out["checker_complete"] = last_checker
     if last_agg is not None:
         out["span_aggregates"] = last_agg
     if last_perf is not None:
@@ -1683,6 +1808,7 @@ def cmd_trace(args: argparse.Namespace) -> int:
             depth=depth, max_lanes=args.lanes, recorder=recorder,
             coverage=_coverage_from_args(args),
             exposure=_exposure_from_args(args),
+            margin=_margin_from_args(args),
         )
         # Perf plane (obs.perf): host throughput/occupancy as counter
         # tracks on the same unified timeline — free here, the recorder
@@ -1715,6 +1841,10 @@ def cmd_trace(args: argparse.Namespace) -> int:
 
             registry.ingest_exposure(
                 cap.report["exposure"], lit=exposure_lit(cfg.fault)
+            )
+        if "margin" in cap.report:
+            registry.ingest_margin(
+                cap.report["margin"], cap.report.get("checker_complete")
             )
         registry.ingest_span_aggregates(cap.aggregates)
         registry.ingest_perf(perf)
@@ -1996,6 +2126,161 @@ def cmd_exposure(args: argparse.Namespace) -> int:
     return 0 if final["violations"] == 0 else 2
 
 
+def cmd_margin(args: argparse.Namespace) -> int:
+    """Near-miss margin plane: run a campaign with the distance-to-violation
+    counters on; print the per-chunk min-slack curve, the tightest-lane
+    ranking, and the margin-vs-progress correlation table (obs.margin)."""
+    import dataclasses
+
+    import jax
+
+    from paxos_tpu.harness.metrics import MetricsLog, MetricsRegistry
+    from paxos_tpu.harness.run import (
+        init_plan, init_state, make_advance, make_longlog, summarize,
+    )
+    from paxos_tpu.obs.margin import MarginConfig, correlation, lane_ranking
+
+    if args.engine == "fused" and jax.devices()[0].platform != "tpu":
+        print("error: --engine fused compiles Mosaic kernels (TPU only); "
+              "use --engine xla", file=sys.stderr)
+        return 1
+    kw = {"seed": args.seed}
+    if args.n_inst:
+        kw["n_inst"] = args.n_inst
+    cfg = CONFIGS[args.config](**kw)
+    try:
+        cfg = config_mod.apply_fault_overrides(cfg, args.fault)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    cfg = dataclasses.replace(cfg, margin=MarginConfig(counters=True))
+    cov_cfg = _coverage_from_args(args)
+    if cov_cfg is not None:
+        cfg = dataclasses.replace(cfg, coverage=cov_cfg)
+    expo_cfg = _exposure_from_args(args)
+    if expo_cfg is not None:
+        cfg = dataclasses.replace(cfg, exposure=expo_cfg)
+
+    registry = MetricsRegistry()
+    with MetricsLog(args.log) as log:
+        log.emit("start", config=args.config, fingerprint=cfg.fingerprint(),
+                 n_inst=cfg.n_inst, protocol=cfg.protocol, engine=args.engine)
+        state, plan = init_state(cfg), init_plan(cfg)
+        advance = make_advance(
+            cfg, plan, args.engine, compact=bool(make_longlog(cfg))
+        )
+        # Serial per-chunk loop: each chunk's summarize samples the running
+        # minima, so the curve shows WHEN the campaign got close — the
+        # counters themselves only tighten on-device.
+        chunks: list = []
+        prev_min = None  # None = uncontested so far
+        prev_near = 0
+        prev_bits = 0
+        prev_viol = 0
+        prev_exp = None
+        done = 0
+        while done < args.ticks:
+            n = min(args.chunk, args.ticks - done)
+            state = advance(state, n)
+            done += n
+            rep = summarize(state, log_total=cfg.fault.log_total)
+            mar = rep["margin"]
+            cur_min = mar["min_quorum_slack"]
+            tightened = (
+                (cur_min is not None and (prev_min is None or cur_min < prev_min))
+                or mar["near_miss_lanes"] > prev_near
+            )
+            ch = {
+                "tick": done,
+                "min_quorum_slack": cur_min,
+                "near_miss_lanes": mar["near_miss_lanes"],
+                "zero_slack_lanes": mar["zero_slack_lanes"],
+                "near_split_ticks": mar["near_split_ticks"],
+                "violations_delta": rep["violations"] - prev_viol,
+                "tightened": tightened,
+            }
+            if "coverage" in rep:
+                ch["new_bits"] = rep["coverage"]["bits_set"] - prev_bits
+                prev_bits = rep["coverage"]["bits_set"]
+            if "exposure" in rep:
+                from paxos_tpu.obs.exposure import effective_delta
+
+                ch["effective_total"] = sum(
+                    effective_delta(prev_exp, rep["exposure"]).values()
+                )
+                prev_exp = rep["exposure"]
+            prev_min, prev_near = cur_min, mar["near_miss_lanes"]
+            prev_viol = rep["violations"]
+            chunks.append(ch)
+            registry.ingest_margin(mar, rep.get("checker_complete"))
+            log.emit("chunk", ticks=done, margin=mar)
+        final_rep = summarize(state, log_total=cfg.fault.log_total)
+        table = correlation(chunks)
+        ranking = lane_ranking(state.margin, top=args.lanes)
+        out = {
+            "metric": "margin",
+            "config": args.config,
+            "engine": args.engine,
+            "n_inst": cfg.n_inst,
+            "ticks": args.ticks,
+            "chunk": args.chunk,
+            "violations": final_rep["violations"],
+            "checker_complete": final_rep["checker_complete"],
+            "margin": final_rep["margin"],
+            "curve": chunks,
+            "lane_ranking": ranking,
+            "correlation": table,
+            "config_fingerprint": cfg.fingerprint(),
+        }
+        if "coverage" in final_rep:
+            out["coverage"] = final_rep["coverage"]
+        if "exposure" in final_rep:
+            out["exposure"] = final_rep["exposure"]
+        registry.ingest_margin(
+            final_rep["margin"], final_rep["checker_complete"]
+        )
+        log.emit("metrics", **registry.snapshot())
+        log.emit("final", **out)
+        _warn_checker_incomplete(final_rep)
+    if args.as_json:
+        print(json.dumps(out))
+    else:
+        m = final_rep["margin"]
+        fmt = lambda v: "-" if v is None else v
+        print(f"# margin plane  config={args.config} n_inst={cfg.n_inst} "
+              f"ticks={args.ticks} engine={args.engine}")
+        print(f"# min_quorum_slack={fmt(m['min_quorum_slack'])} "
+              f"(0 = a violation fired, 1 = one accept short)  "
+              f"min_ballot_gap={fmt(m['min_ballot_gap'])}  "
+              f"min_promise_slack={fmt(m['min_promise_slack'])}")
+        print(f"# near_miss_lanes={m['near_miss_lanes']}  "
+              f"zero_slack_lanes={m['zero_slack_lanes']}  "
+              f"contested_lanes={m['contested_lanes']}  "
+              f"near_split_ticks={m['near_split_ticks']}  "
+              f"checker_complete={out['checker_complete']}")
+        print("# min-slack curve (per chunk)")
+        print(f"{'tick':>6}{'min_slack':>11}{'near_miss':>11}"
+              f"{'zero_slack':>12}{'viol_delta':>12}{'tightened':>11}")
+        for ch in chunks:
+            print(f"{ch['tick']:>6}{fmt(ch['min_quorum_slack']):>11}"
+                  f"{ch['near_miss_lanes']:>11}{ch['zero_slack_lanes']:>12}"
+                  f"{ch['violations_delta']:>12}"
+                  f"{'yes' if ch['tightened'] else 'no':>11}")
+        print("# tightest lanes")
+        for row in ranking:
+            print(f"#   lane {row['lane']:>6}  "
+                  f"min_quorum_slack={fmt(row['min_quorum_slack'])}  "
+                  f"near_split_ticks={row['near_split_ticks']}")
+        print("# correlation (chunk-granular co-occurrence, not causality)")
+        print(f"{'margin':<12}{'chunks':>8}{'new_bits':>10}"
+              f"{'effective':>11}{'violations':>12}")
+        for key in ("tightened", "flat"):
+            row = table[key]
+            print(f"{key:<12}{row['chunks']:>8}{row['new_bits']:>10}"
+                  f"{row['effective']:>11}{row['violations']:>12}")
+    return 0 if final_rep["violations"] == 0 else 2
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.platform == "cpu":
@@ -2026,6 +2311,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_coverage(args)
     if args.cmd == "exposure":
         return cmd_exposure(args)
+    if args.cmd == "margin":
+        return cmd_margin(args)
     return 1
 
 

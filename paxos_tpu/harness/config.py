@@ -18,6 +18,7 @@ from paxos_tpu.core.telemetry import TelemetryConfig
 from paxos_tpu.faults.injector import FaultConfig
 from paxos_tpu.obs.coverage import CoverageConfig
 from paxos_tpu.obs.exposure import ExposureConfig
+from paxos_tpu.obs.margin import MarginConfig
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,6 +51,10 @@ class SimConfig:
     exposure: ExposureConfig = dataclasses.field(
         default_factory=ExposureConfig
     )
+    # Near-miss safety-margin sketch (obs.margin) — same default-off
+    # contract: the state's margin leaf prunes to None and the fold draws
+    # no PRNG, so schedules are bit-identical (tests/test_margin.py).
+    margin: MarginConfig = dataclasses.field(default_factory=MarginConfig)
 
     def fingerprint(self) -> str:
         d = dataclasses.asdict(self)
@@ -66,6 +71,10 @@ class SimConfig:
         # fingerprints keep matching.
         if d["exposure"] == dataclasses.asdict(ExposureConfig()):
             del d["exposure"]
+        # Margin too: disabled (the default) drops out so pre-margin
+        # fingerprints keep matching.
+        if d["margin"] == dataclasses.asdict(MarginConfig()):
+            del d["margin"]
         # The packed lane-state layout version (core/*_state.py) is part of
         # the on-device representation: a layout change invalidates every
         # checkpoint recorded under the old bit positions, so it must

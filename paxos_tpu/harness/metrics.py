@@ -189,6 +189,29 @@ class MetricsRegistry:
                         "fault_vacuous", float(vacuous), **{"class": name}
                     )
 
+    def ingest_margin(
+        self, margin: dict[str, Any], checker_complete: "Optional[bool]" = None
+    ) -> None:
+        """Fold one ``obs.margin.margin_host`` dict into the registry.
+
+        Margin counters are running minima / cumulative tallies on-device,
+        so they land as gauges (overwrite — the last chunk's report is the
+        campaign-to-date value).  The ``min_*`` keys arrive as ``None``
+        while uncontested (the sentinel never folded); an uncontested
+        minimum is simply not exported rather than faked as a number, so a
+        scraper alerting on ``margin_min_quorum_slack <= 1`` only fires on
+        lanes that were actually contested.  ``checker_complete`` (the
+        evictions-free bit from ``summarize``) rides along as a 0/1 gauge —
+        0 means the safety oracle may have missed a violation.
+        """
+        for name, v in margin.items():
+            # Numeric keys only: soak's cross-seed block carries list-valued
+            # extras (the per-seed near-miss ranking) that are report-only.
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.gauge(f"margin_{name}", v)
+        if checker_complete is not None:
+            self.gauge("checker_complete", float(checker_complete))
+
     def ingest_span_aggregates(self, agg: dict[str, Any]) -> None:
         """Fold ``obs.spans.span_aggregates`` output into gauges.
 

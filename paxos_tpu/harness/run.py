@@ -72,6 +72,10 @@ def init_state(cfg: SimConfig):
         from paxos_tpu.obs.exposure import FaultExposure
 
         state = state.replace(exposure=FaultExposure.init(cfg.n_inst))
+    if cfg.margin.enabled():
+        from paxos_tpu.obs.margin import MarginState
+
+        state = state.replace(margin=MarginState.init(cfg.n_inst))
     return state
 
 
@@ -633,6 +637,10 @@ def summarize_device(
         from paxos_tpu.obs.exposure import exposure_device
 
         dev["exposure"] = exposure_device(state.exposure)
+    if getattr(state, "margin", None) is not None:
+        from paxos_tpu.obs.margin import margin_device
+
+        dev["margin"] = margin_device(state.margin)
     if liveness:
         from paxos_tpu.check.liveness import liveness_device
 
@@ -655,6 +663,11 @@ def summarize_host(host: dict, meta: dict) -> dict[str, Any]:
               "mean_choose_tick", "decided_frac", "proposer_disagree"):
         v = host[k]
         out[k] = v.item() if hasattr(v, "item") else v
+    # Checker headroom (obs.margin plane, satellite gauge): an eviction means
+    # the learner table dropped a row mid-campaign, so the safety oracle may
+    # have MISSED a violation — the report says so explicitly instead of
+    # leaving "evictions" as an easily-skimmed count.
+    out["checker_complete"] = out["evictions"] == 0
     if "max_ballot" in host:
         limit = meta.get("ballot_limit", (1 << 15) - 1)
         if int(host["max_ballot"]) >= limit:
@@ -682,6 +695,10 @@ def summarize_host(host: dict, meta: dict) -> dict[str, Any]:
         from paxos_tpu.obs.exposure import exposure_host
 
         out["exposure"] = exposure_host(host["exposure"])
+    if "margin" in host:
+        from paxos_tpu.obs.margin import margin_host
+
+        out["margin"] = margin_host(host["margin"])
     if "liveness" in host:
         from paxos_tpu.check.liveness import liveness_host
 

@@ -71,6 +71,11 @@ class ShrinkResult:
     # with zero effective events earned its keep through schedule timing
     # (occupying a PRNG draw), not through the fault itself.
     exposure: Optional[dict] = None
+    # Victim-lane safety-margin annotation (obs.margin): the tightest
+    # distance-to-violation the repro reached in its lane (quorum slack 0
+    # on a violating repro, by construction) — tells the reader how close
+    # the MINIMIZED schedule runs to the edge, not just that it crosses it.
+    margin: Optional[dict] = None
 
     def to_json(self) -> dict[str, Any]:
         out = {
@@ -88,6 +93,8 @@ class ShrinkResult:
             out["spans"] = [s.to_json() for s in self.spans]
         if self.exposure is not None:
             out["exposure"] = self.exposure
+        if self.margin is not None:
+            out["margin"] = self.margin
         return out
 
 
@@ -349,6 +356,11 @@ def shrink(
     result.exposure = exposure_annotation(cfg, result)
     eff = [a for a, e in result.exposure["atoms_effective"].items() if e]
     say(f"exposure: {len(eff)}/{len(kept)} surviving atoms effective")
+    result.margin = margin_annotation(cfg, result)
+    say(
+        "margin: min quorum slack "
+        f"{result.margin['min_quorum_slack']} in lane {lane}"
+    )
     return result
 
 
@@ -400,6 +412,43 @@ def exposure_annotation(cfg: SimConfig, result: ShrinkResult) -> dict:
             else any(classes[c]["effective"] > 0 for c in mapped)
         )
     return {"lane_classes": classes, "atoms_effective": atoms}
+
+
+def margin_annotation(cfg: SimConfig, result: ShrinkResult) -> dict:
+    """Victim-lane distance-to-violation minima for a minimized repro.
+
+    Re-runs the repro with the margin counters on — ``obs.margin`` draws
+    no randomness, so the schedule is exactly the one the shrinker
+    minimized — and reads the victim lane's tightest quorum slack,
+    near-split count, ballot-race gap, and promise slack.  Minima the lane
+    never contested come back as ``None`` (the sentinel never folded).
+    """
+    from paxos_tpu.obs.margin import SENTINEL, MarginConfig
+
+    mcfg = dataclasses.replace(cfg, margin=MarginConfig(counters=True))
+    state = init_state(mcfg)
+    advance = make_advance(
+        mcfg, result.plan, result.engine, block=result.block,
+        compact=bool(make_longlog(mcfg)),
+    )
+    done = 0
+    while done < result.ticks:
+        n = min(result.chunk, result.ticks - done)
+        state = advance(state, n)
+        done += n
+    lane = result.lane
+    mar = jax.device_get(state.margin)
+
+    def _min(arr):
+        v = int(arr[lane])
+        return None if v >= SENTINEL else v
+
+    return {
+        "min_quorum_slack": _min(mar.qslack_min),
+        "near_split_ticks": int(mar.near_split[lane]),
+        "min_ballot_gap": _min(mar.bal_gap_min),
+        "min_promise_slack": _min(mar.promise_slack_min),
+    }
 
 
 def violation_timeline(cfg: SimConfig, result: ShrinkResult) -> list:

@@ -202,6 +202,13 @@ def soak(
     lit fault knob whose cross-seed effective count is still zero raises a
     loud VACUOUS CHAOS warning, and the report's ``exposure`` block always
     lists ``lit``/``vacuous`` classes (``obs.exposure.annotate_lit``).
+
+    **Near-miss margins (``cfg.margin`` enabled):** each campaign's report
+    carries its distance-to-violation minima (``obs.margin``); the tally
+    tightens the minima across seeds, sums the tick/lane tallies, and
+    ranks the seeds by how close each came (``seed_ranking``: min quorum
+    slack ascending, then near-miss lanes) — the shortlist of seeds worth
+    re-fuzzing at higher fault rates even when every one soaked clean.
     """
     from paxos_tpu.harness.config import validate_pipeline_depth
     from paxos_tpu.obs.host_spans import ensure_recorder
@@ -250,6 +257,9 @@ def soak(
     # Cross-seed exposure sums (per-class injected/effective/lanes_exposed).
     exp_classes: Optional[dict] = None
     exp_vacuous_warned = False
+    # Per-seed margin snapshots (obs.margin): ranked at the end into the
+    # which-seed-came-closest table.
+    mar_rows: list = []
     slots_total = 0
     rep_rates: list[float] = []  # slots replicated per lane-tick, per campaign
     retries_used = 0
@@ -431,6 +441,9 @@ def soak(
                         "the soak is not exercising them; a clean tally "
                         "says nothing about these classes")
                     exp_vacuous_warned = True
+        mar = report.get("margin")
+        if mar is not None:
+            mar_rows.append({"seed": fscfg.seed, **mar})
         cov = report.get("coverage")
         if cov is not None:
             cov_last = cov
@@ -495,12 +508,47 @@ def soak(
         replication["exposure"] = annotate_lit(
             {"classes": exp_classes}, cfg.fault
         )
+    if mar_rows:
+        # Cross-seed margin tally (obs.margin): minima tighten across
+        # seeds, tick/lane tallies sum (lane-campaigns, like exposure's
+        # lanes_exposed — each seed's lanes are a fresh population).  The
+        # scalar keys match margin_host so MetricsRegistry.ingest_margin
+        # folds this block directly; seed_ranking is report-only: which
+        # seeds came closest to a violation, the re-fuzz shortlist.
+        def _min(key):
+            vals = [r[key] for r in mar_rows if r[key] is not None]
+            return min(vals) if vals else None
+
+        def _tightness(row):
+            s = row["min_quorum_slack"]
+            return (
+                s if s is not None else 0x7FFFFFFF,
+                -row["near_miss_lanes"],
+                -row["near_split_ticks"],
+            )
+
+        replication["margin"] = {
+            "min_quorum_slack": _min("min_quorum_slack"),
+            "min_ballot_gap": _min("min_ballot_gap"),
+            "min_promise_slack": _min("min_promise_slack"),
+            **{
+                key: sum(r[key] for r in mar_rows)
+                for key in (
+                    "near_miss_lanes", "zero_slack_lanes", "contested_lanes",
+                    "near_split_ticks", "near_split_lanes",
+                )
+            },
+            "seed_ranking": sorted(mar_rows, key=_tightness),
+        }
     return replication | {
         "metric": "soak",
         "rounds": rounds,
         "violations": violations,
         "violating_seeds": violating_seeds,
         "evictions": evictions,  # post-recheck: nonzero only if unresolved
+        # False ⟺ rows were lost even at the largest recheck table, so the
+        # safety oracle may have missed a violation (see run.summarize_host).
+        "checker_complete": evictions == 0,
         "evictions_first_pass": evictions_first_pass,
         "rechecked_seeds": rechecked_seeds,
         # Rounds re-examined by escalations: real work in the wall-clock but

@@ -83,6 +83,7 @@ def capture_round_trace(
     recorder: Optional[HostSpanRecorder] = None,
     coverage=None,
     exposure=None,
+    margin=None,
 ) -> CaptureResult:
     """Run ``cfg`` for ``ticks`` with full tracing; decode ``max_lanes`` lanes.
 
@@ -96,8 +97,11 @@ def capture_round_trace(
     series for the Perfetto timeline; ``exposure`` (an
     ``obs.exposure.ExposureConfig``) does the same for the per-class
     effective fault counters — one counter track per fault class, so the
-    timeline shows WHEN each class started touching the protocol.
-    Sampling needs the state pytree at each boundary, so either sampler
+    timeline shows WHEN each class started touching the protocol; and
+    ``margin`` (an ``obs.margin.MarginConfig``) draws the
+    ``min_quorum_slack`` / ``near_miss_lanes`` distance-to-violation
+    curves, so the timeline shows WHEN the campaign got close.
+    Sampling needs the state pytree at each boundary, so any sampler
     forces the serial per-chunk dispatcher (the sample itself is a small
     device_get, not a state round-trip); a trace run is a debug tool, so
     the pipelined host track is the price of the curves.
@@ -117,19 +121,24 @@ def capture_round_trace(
     tcfg = recorder_config(cfg, ticks)
     sample_coverage = coverage is not None and coverage.enabled()
     sample_exposure = exposure is not None and exposure.enabled()
+    sample_margin = margin is not None and margin.enabled()
     if sample_coverage:
         tcfg = dataclasses.replace(tcfg, coverage=coverage)
     if sample_exposure:
         tcfg = dataclasses.replace(tcfg, exposure=exposure)
+    if sample_margin:
+        tcfg = dataclasses.replace(tcfg, margin=margin)
     with sp.span("init", n_inst=tcfg.n_inst, protocol=tcfg.protocol):
         state = init_state(tcfg)
         plan = init_plan(tcfg)
     counters: Optional[dict[str, list]] = None
-    if sample_coverage or sample_exposure:
+    if sample_coverage or sample_exposure or sample_margin:
         if sample_coverage:
             from paxos_tpu.obs.coverage import coverage_device
         if sample_exposure:
             from paxos_tpu.obs.exposure import CLASSES, exposure_device
+        if sample_margin:
+            from paxos_tpu.obs.margin import SENTINEL, margin_device
 
         advance = make_advance(
             tcfg, plan, engine, compact=bool(make_longlog(tcfg))
@@ -138,6 +147,9 @@ def capture_round_trace(
         exp_samples: dict[str, list] = (
             {name: [] for name in CLASSES} if sample_exposure else {}
         )
+        mar_samples: dict[str, list] = {
+            name: [] for name in ("min_quorum_slack", "near_miss_lanes")
+        }
         done = 0
         while done < ticks:
             n = min(chunk, ticks - done)
@@ -157,11 +169,26 @@ def capture_round_trace(
                     )
                 for c, name in enumerate(CLASSES):
                     exp_samples[name].append((done, int(eff[c])))
+            if sample_margin:
+                with sp.span("margin_sample", tick=done):
+                    md = jax.device_get(margin_device(state.margin))
+                # Uncontested minima (SENTINEL) would flatten the counter
+                # track's scale; the slack curve starts at first contact.
+                slack = int(md["min_quorum_slack"])
+                if slack < SENTINEL:
+                    mar_samples["min_quorum_slack"].append((done, slack))
+                mar_samples["near_miss_lanes"].append(
+                    (done, int(md["near_miss_lanes"]))
+                )
         counters = {}
         if sample_coverage:
             counters["coverage_bits_set"] = cov_samples
         for name, series in exp_samples.items():
             counters[f"exposure_effective_{name}"] = series
+        if sample_margin:
+            for name, series in mar_samples.items():
+                if series:
+                    counters[f"margin_{name}"] = series
     else:
         advance = make_advance_grouped(
             tcfg, plan, engine, compact=bool(make_longlog(tcfg))

@@ -1,0 +1,371 @@
+"""Near-miss safety-margin plane (default OFF, off is free).
+
+The planes so far measure novelty (obs.coverage), fault effectiveness
+(obs.exposure) and speed (harness.profile) — none of them measures
+*danger*.  A campaign that drove a second value to within one accept of
+being chosen is indistinguishable from one that never contested an
+instance: both report ``violations == 0``.  This module tracks per-lane
+**distance to violation** live on device, the fitness signal a
+feedback-directed fuzzer rewards (ROADMAP item 1): how close did this
+seed get, not just what did it find.
+
+Counter semantics (all running extrema over ticks, per lane):
+
+- ``qslack_min``   minimum **quorum slack**: ``slot_quorum - votes`` for
+                   the best *competing* learner-table row — a live
+                   (ballot, value) pair on a decided instance whose value
+                   differs from the chosen one.  0 ⟺ an agreement
+                   violation actually fired; 1 ⟺ one accept short of
+                   disagreement.  ``SENTINEL`` while no competitor exists.
+- ``near_split``   count of ticks where two distinct values each sat
+                   within slack <= 1 on the same instance (same log slot
+                   for Multi-Paxos) — contested razor-edge ticks.
+- ``bal_gap_min``  minimum **ballot-race margin**: winning-row ballot
+                   minus the best rival row's ballot, taken on the tick
+                   an instance (or slot) decides.  Small gap = the decide
+                   barely outran a competing ballot.  ``SENTINEL`` when
+                   every decide was unopposed.
+- ``promise_slack_min``  minimum **checker headroom** on the acceptor
+                   invariant: ``promised - accepted_ballot`` over honest
+                   acceptors with a live accepted pair (Raft:
+                   ``voted - entry_term``).  0 = accepts landing exactly
+                   at the promise fence; negative would already be an
+                   invariant violation.
+
+The fourth headroom signal — learner-table eviction pressure — already
+lives in ``LearnerState.evictions`` and is surfaced (with the
+``checker_complete`` gauge) at the summarize boundary, not duplicated
+here.  Preemption depth at decide comes from the span plane
+(``obs.spans.span_aggregates``) and is joined host-side by the CLI.
+
+The default-off-is-free contract (``obs.exposure`` is the template):
+
+- :class:`MarginState` rides as an ``Optional`` leaf of every protocol
+  state; ``None`` when disabled (pruned from the pytree), all leaves
+  int32 with a trailing ``instances`` axis, no scalar leaves — the fused
+  Pallas engine's generic passthrough codec (``utils/bitops``) carries it
+  with ZERO kernel changes.
+- The fold (``check.safety.margin_observe`` /
+  ``check.mp_safety.mp_margin_observe`` — beside the learner they read)
+  is pure int32 arithmetic over the post-observe learner table and the
+  post-tick acceptor state: **no PRNG draws**, so enabling the plane
+  cannot perturb a schedule.  The static auditor holds it to that
+  (``prng_audit.audit_margin_parity`` on the "margin" audit config).
+- Mosaic-clean: elementwise int32 ops, masked min/max reductions over
+  the small leading axes — no gathers, no scatters, no first_true.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# "No competitor observed" marker for the running minima.  int32 max, so
+# jnp.minimum folds replace it with the first real observation; kept raw
+# on device (host formatting maps it to None) so the numpy replay oracle
+# can compare leaves bit for bit.
+SENTINEL = 0x7FFFFFFF
+
+
+@dataclasses.dataclass(frozen=True)
+class MarginConfig:
+    """Static margin knob (frozen: rides ``SimConfig`` into jit).
+
+    ``counters=False`` — the default — disables the plane entirely (the
+    state leaf prunes to ``None``, zero bytes on device, bit-identical
+    schedules).
+    """
+
+    counters: bool = False
+
+    def enabled(self) -> bool:
+        return self.counters
+
+
+@struct.dataclass
+class MarginState:
+    """Per-lane distance-to-violation sketch (int32, instance-minor).
+
+    Running minima start at :data:`SENTINEL`; ``near_split`` is a plain
+    tick counter.  No scalar leaves: the fused engine's packed-word
+    passthrough requires every observer leaf to carry the trailing
+    instances axis.
+    """
+
+    qslack_min: jnp.ndarray  # (I,) int32 — min quorum slack of best rival
+    near_split: jnp.ndarray  # (I,) int32 — ticks with a contested razor edge
+    bal_gap_min: jnp.ndarray  # (I,) int32 — min winner-vs-rival ballot gap
+    promise_slack_min: jnp.ndarray  # (I,) int32 — min promised - accepted
+
+    @classmethod
+    def init(cls, n_inst: int) -> "MarginState":
+        # Fresh buffer per field: aliased leaves break buffer donation.
+        def full():
+            return jnp.full((n_inst,), SENTINEL, jnp.int32)
+
+        return cls(
+            qslack_min=full(),
+            near_split=jnp.zeros((n_inst,), jnp.int32),
+            bal_gap_min=full(),
+            promise_slack_min=full(),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Summarize-boundary reductions (harness/run.py merges these into the one
+# composite report pytree) and host formatting.
+
+
+def margin_device(m: MarginState) -> dict:
+    """Device half of the margin report: reductions only, no transfer."""
+    return {
+        "min_quorum_slack": m.qslack_min.min(),
+        # Lanes whose tightest rival came within one accept of quorum —
+        # the near-miss population (includes actual violations, slack 0).
+        "near_miss_lanes": (m.qslack_min <= 1).astype(jnp.int32).sum(
+            dtype=jnp.int32
+        ),
+        "zero_slack_lanes": (m.qslack_min == 0).astype(jnp.int32).sum(
+            dtype=jnp.int32
+        ),
+        # Lanes where a competing (ballot, value) row existed at all.
+        "contested_lanes": (m.qslack_min < SENTINEL).astype(jnp.int32).sum(
+            dtype=jnp.int32
+        ),
+        "near_split_ticks": m.near_split.sum(dtype=jnp.int32),
+        "near_split_lanes": (m.near_split > 0).astype(jnp.int32).sum(
+            dtype=jnp.int32
+        ),
+        "min_ballot_gap": m.bal_gap_min.min(),
+        "min_promise_slack": m.promise_slack_min.min(),
+    }
+
+
+# Report keys whose SENTINEL means "never observed" (host shows None).
+_MIN_KEYS = ("min_quorum_slack", "min_ballot_gap", "min_promise_slack")
+
+
+def margin_host(host: dict) -> dict:
+    """Format a ``device_get``'d :func:`margin_device` pytree."""
+    out = {}
+    for k, v in host.items():
+        v = int(v)
+        out[k] = None if (k in _MIN_KEYS and v == SENTINEL) else v
+    return out
+
+
+def margin_report(m: MarginState) -> dict:
+    """Host-readable margin summary (one blocking transfer; tests/CLI)."""
+    return margin_host(jax.device_get(margin_device(m)))
+
+
+def lane_ranking(m: MarginState, top: int = 8) -> list:
+    """Host-side top-N tightest lanes: (lane, slack, near_split_ticks).
+
+    One transfer; soak's per-seed near-miss ranking and the shrink
+    annotation use this to name the lanes worth re-fuzzing.
+    """
+    import numpy as np
+
+    qs = np.asarray(jax.device_get(m.qslack_min))
+    ns = np.asarray(jax.device_get(m.near_split))
+    order = np.lexsort((-ns, qs))  # tightest slack first, then most contested
+    out = []
+    for lane in order[: max(0, int(top))]:
+        if qs[lane] >= SENTINEL and ns[lane] == 0:
+            break  # rest of the order is uncontested lanes
+        out.append(
+            {
+                "lane": int(lane),
+                "min_quorum_slack": None if qs[lane] >= SENTINEL else int(qs[lane]),
+                "near_split_ticks": int(ns[lane]),
+            }
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Correlation: join the per-chunk min-slack curve with the coverage plane
+# and the exposure plane (host side; the `paxos_tpu margin` subcommand and
+# soak build the chunk stream).
+
+
+def correlation(chunks: list) -> dict:
+    """Margin-vs-progress co-occurrence table over a campaign's chunks.
+
+    ``chunks`` is a list of per-chunk records, each carrying
+    ``tightened`` (did the running min slack drop or the near-miss lane
+    count grow this chunk), optional ``new_bits`` (coverage),
+    ``effective_total`` (exposure effective-fault delta) and
+    ``violations_delta``.  Chunk-granular co-occurrence, not causality:
+    the table answers "when margins tightened, were exploration and
+    effective faults also moving" — the honest claim chunk-boundary
+    sampling can support, and the shape the exposure attribution table
+    established.
+    """
+    table = {
+        key: {"chunks": 0, "new_bits": 0, "effective": 0, "violations": 0}
+        for key in ("tightened", "flat")
+    }
+    for ch in chunks:
+        row = table["tightened" if ch.get("tightened") else "flat"]
+        row["chunks"] += 1
+        if ch.get("new_bits") is not None:
+            row["new_bits"] += ch["new_bits"]
+        row["effective"] += ch.get("effective_total", 0)
+        row["violations"] += ch.get("violations_delta", 0)
+    return table
+
+
+# ---------------------------------------------------------------------------
+# Host numpy replay oracle (PR 9 style): the same fold, in numpy, over
+# device_get'd learner/acceptor snapshots.  tests/test_margin.py replays a
+# margin-OFF campaign tick by tick through these and compares the final
+# device leaves bit for bit — margin-on cannot perturb the schedule, so
+# the off-trajectory is the on-trajectory.
+
+
+def np_margin_tick(
+    counters: dict,
+    pre: dict,
+    post: dict,
+    promised,
+    acc_bal,
+    honest,
+    quorum: int,
+    fast_quorum: Optional[int] = None,
+    fast_round=None,
+) -> dict:
+    """One tick of the single-table margin fold, in numpy.
+
+    ``pre``/``post`` are dicts of LearnerState leaves (numpy);
+    ``fast_round`` is a (K, I) bool mask of fast-round table ballots when
+    ``fast_quorum`` is set (the caller derives it from ``ballot_round``).
+    Returns the updated ``counters`` dict of four (I,) int64/32 arrays.
+    """
+    import numpy as np
+
+    lt_bal, lt_val, lt_mask = post["lt_bal"], post["lt_val"], post["lt_mask"]
+    votes = _np_popcount(lt_mask)
+    if fast_quorum is None:
+        sq = np.full(lt_bal.shape, quorum, np.int32)
+    else:
+        sq = np.where(fast_round, fast_quorum, quorum).astype(np.int32)
+    live = lt_bal > 0
+
+    competing = live & post["chosen"][None] & (lt_val != post["chosen_val"][None])
+    slack = np.maximum(sq - votes, 0)
+    tick_slack = np.where(competing, slack, SENTINEL).min(axis=0)
+    qslack_min = np.minimum(counters["qslack_min"], tick_slack)
+
+    hot = live & (votes >= sq - 1)
+    vmin = np.where(hot, lt_val, SENTINEL).min(axis=0)
+    vmax = np.where(hot, lt_val, 0).max(axis=0)
+    near = (hot.sum(axis=0) >= 2) & (vmin != vmax)
+    near_split = counters["near_split"] + near.astype(np.int32)
+
+    decided_now = post["chosen"] & ~pre["chosen"]
+    chosen_rows = votes >= sq
+    win_rows = chosen_rows & live & (lt_val == post["chosen_val"][None])
+    win_bal = np.where(win_rows, lt_bal, 0).max(axis=0)
+    rival_bal = np.where(live & ~win_rows, lt_bal, 0).max(axis=0)
+    gap = np.maximum(win_bal - rival_bal, 0)
+    tick_gap = np.where(decided_now & (rival_bal > 0), gap, SENTINEL)
+    bal_gap_min = np.minimum(counters["bal_gap_min"], tick_gap)
+
+    pslack = np.where(honest & (acc_bal > 0), promised - acc_bal, SENTINEL).min(
+        axis=0
+    )
+    promise_slack_min = np.minimum(counters["promise_slack_min"], pslack)
+
+    return {
+        "qslack_min": qslack_min.astype(np.int32),
+        "near_split": near_split.astype(np.int32),
+        "bal_gap_min": bal_gap_min.astype(np.int32),
+        "promise_slack_min": promise_slack_min.astype(np.int32),
+    }
+
+
+def np_mp_margin_tick(
+    counters: dict,
+    pre: dict,
+    post: dict,
+    promised,
+    acc_bal,
+    honest,
+    quorum: int,
+) -> dict:
+    """One tick of the Multi-Paxos (L, K, I) margin fold, in numpy.
+
+    ``pre``/``post`` hold MPLearnerState leaves (``lt_bv`` packed);
+    ``acc_bal`` is the per-acceptor max accepted ballot over the log.
+    """
+    import numpy as np
+
+    from paxos_tpu.core.mp_state import bv_bal, bv_val
+
+    bal = bv_bal(post["lt_bv"])
+    val = bv_val(post["lt_bv"])
+    votes = _np_popcount(post["lt_mask"])
+    live = post["lt_bv"] > 0
+
+    competing = (
+        live & post["chosen"][:, None] & (val != post["chosen_val"][:, None])
+    )
+    slack = np.maximum(quorum - votes, 0)
+    tick_slack = np.where(competing, slack, SENTINEL).min(axis=(0, 1))
+    qslack_min = np.minimum(counters["qslack_min"], tick_slack)
+
+    hot = live & (votes >= quorum - 1)
+    vmin = np.where(hot, val, SENTINEL).min(axis=1)  # (L, I)
+    vmax = np.where(hot, val, 0).max(axis=1)
+    near = ((hot.sum(axis=1) >= 2) & (vmin != vmax)).any(axis=0)
+    near_split = counters["near_split"] + near.astype(np.int32)
+
+    decided_now = post["chosen"] & ~pre["chosen"]  # (L, I)
+    chosen_rows = votes >= quorum
+    win_rows = chosen_rows & live & (val == post["chosen_val"][:, None])
+    win_bal = np.where(win_rows, bal, 0).max(axis=1)  # (L, I)
+    rival_bal = np.where(live & ~win_rows, bal, 0).max(axis=1)
+    gap = np.maximum(win_bal - rival_bal, 0)
+    tick_gap = np.where(decided_now & (rival_bal > 0), gap, SENTINEL).min(
+        axis=0
+    )
+    bal_gap_min = np.minimum(counters["bal_gap_min"], tick_gap)
+
+    pslack = np.where(honest & (acc_bal > 0), promised - acc_bal, SENTINEL).min(
+        axis=0
+    )
+    promise_slack_min = np.minimum(counters["promise_slack_min"], pslack)
+
+    return {
+        "qslack_min": qslack_min.astype(np.int32),
+        "near_split": near_split.astype(np.int32),
+        "bal_gap_min": bal_gap_min.astype(np.int32),
+        "promise_slack_min": promise_slack_min.astype(np.int32),
+    }
+
+
+def np_margin_init(n_inst: int) -> dict:
+    import numpy as np
+
+    return {
+        "qslack_min": np.full((n_inst,), SENTINEL, np.int32),
+        "near_split": np.zeros((n_inst,), np.int32),
+        "bal_gap_min": np.full((n_inst,), SENTINEL, np.int32),
+        "promise_slack_min": np.full((n_inst,), SENTINEL, np.int32),
+    }
+
+
+def _np_popcount(x):
+    import numpy as np
+
+    x = np.asarray(x, np.uint32)
+    count = np.zeros(x.shape, np.int32)
+    for shift in range(32):
+        count += ((x >> shift) & 1).astype(np.int32)
+    return count

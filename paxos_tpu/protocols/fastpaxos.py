@@ -34,7 +34,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from paxos_tpu.check.safety import acceptor_invariants, learner_observe
+from paxos_tpu.check.safety import (
+    acceptor_invariants,
+    learner_observe,
+    margin_observe,
+)
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.obs import coverage as cov_mod
@@ -419,6 +423,14 @@ def apply_tick_fast(
         if cfg.stale_k > 0:
             events["stale"] = (rec, rec)
         exp = exp_mod.record(exp, **events)
+    mar = state.margin
+    if mar is not None:
+        # Near-miss margin sketch (obs.margin): slot thresholds are
+        # fast-quorum-aware, matching the learner's chosen test.
+        mar = margin_observe(
+            mar, state.learner, learner, acc.promised, acc.acc_bal,
+            ~equiv, q2, fast_quorum=fquorum,
+        )
 
     state = state.replace(
         acceptor=acc,
@@ -429,6 +441,7 @@ def apply_tick_fast(
         tick=state.tick + 1,
         telemetry=tel,
         exposure=exp,
+        margin=mar,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built.  PRNG-free, like telemetry.

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from paxos_tpu.check.mp_safety import mp_learner_observe
+from paxos_tpu.check.mp_safety import mp_learner_observe, mp_margin_observe
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import streams as streams_mod
 from paxos_tpu.core import telemetry as tel_mod
@@ -41,6 +41,7 @@ from paxos_tpu.core.mp_state import (
     FOLLOW,
     LEAD,
     MultiPaxosState,
+    bv_bal,
     bv_val,
     pack_bv,
 )
@@ -624,6 +625,15 @@ def apply_tick_mp(
         if cfg.stale_k > 0:
             events["stale"] = (rec, rec)
         exp = exp_mod.record(exp, **events)
+    mar = state.margin
+    if mar is not None:
+        # Near-miss margin sketch (obs.margin): one promise fence covers
+        # the whole log, so its slack partner is the per-acceptor max
+        # accepted ballot over the (packed) log.
+        mar = mp_margin_observe(
+            mar, state.learner, learner, acc.promised,
+            bv_bal(acc.log).max(axis=1), ~equiv, quorum,
+        )
 
     state = state.replace(
         acceptor=acc,
@@ -635,6 +645,7 @@ def apply_tick_mp(
         tick=state.tick + 1,
         telemetry=tel,
         exposure=exp,
+        margin=mar,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built (includes `base`, so the same window at a

@@ -43,7 +43,11 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from paxos_tpu.check.safety import acceptor_invariants, learner_observe
+from paxos_tpu.check.safety import (
+    acceptor_invariants,
+    learner_observe,
+    margin_observe,
+)
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import streams as streams_mod
 from paxos_tpu.core import telemetry as tel_mod
@@ -617,6 +621,14 @@ def apply_tick(
             # Every restore rewrites durable state: injected == effective.
             events["stale"] = (rec, rec)
         exp = exp_mod.record(exp, **events)
+    mar = state.margin
+    if mar is not None:
+        # Near-miss margin sketch (obs.margin): distance-to-violation from
+        # the post-observe learner table and the post-tick acceptor fence.
+        mar = margin_observe(
+            mar, state.learner, learner, acc.promised, acc.acc_bal,
+            ~equiv, q2,
+        )
 
     state = state.replace(
         acceptor=acc,
@@ -627,6 +639,7 @@ def apply_tick(
         tick=state.tick + 1,
         telemetry=tel,
         exposure=exp,
+        margin=mar,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built, so host-side digests of returned states

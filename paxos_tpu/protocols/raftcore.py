@@ -37,7 +37,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from paxos_tpu.check.safety import learner_observe, raft_voter_invariants
+from paxos_tpu.check.safety import (
+    learner_observe,
+    margin_observe,
+    raft_voter_invariants,
+)
 from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.obs import coverage as cov_mod
@@ -374,6 +378,14 @@ def apply_tick_raft(
         if cfg.stale_k > 0:
             events["stale"] = (rec, rec)
         exp = exp_mod.record(exp, **events)
+    mar = state.margin
+    if mar is not None:
+        # Near-miss margin sketch (obs.margin): the Raft promise-slack
+        # analog is voted - ent_term (the vote fence vs the stored entry).
+        mar = margin_observe(
+            mar, state.learner, learner, voter.voted, voter.ent_term,
+            ~equiv, quorum,
+        )
 
     state = state.replace(
         acceptor=voter,
@@ -384,6 +396,7 @@ def apply_tick_raft(
         tick=state.tick + 1,
         telemetry=tel,
         exposure=exp,
+        margin=mar,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built.  PRNG-free, like telemetry.

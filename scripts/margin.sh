@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Safety-margin wrapper: distance-to-violation accounting over one
+# campaign — the tightest quorum slack / ballot gap / promise slack the
+# schedule reached, the per-chunk min-slack curve, the tightest-lane
+# ranking, and the correlation of margin tightening against coverage
+# growth and effective-fault deltas.  One report on stdout (--json for
+# machines); exits 2 on safety violations (slack 0 that FIRED).
+#
+# Usage: scripts/margin.sh [paxos_tpu margin flags...]
+#   scripts/margin.sh --config corrupt --n-inst 4096 --ticks 256
+#   scripts/margin.sh --config gray-chaos --coverage --exposure --json
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m paxos_tpu margin "$@"

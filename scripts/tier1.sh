@@ -120,6 +120,53 @@ EOF
     echo EXPOSURE_SMOKE=FAILED; rc=1
   fi
 fi
+# Margin smoke: the distance-to-violation plane's end-to-end acceptance,
+# kept cheap.  A corrupt campaign must drive min quorum slack to 0 at or
+# before the chunk where the safety checker first fires (slack 0 is the
+# violation boundary, not a lagging echo); a default (healthy) campaign
+# must never report slack below 1 (healthy lanes are typically never
+# contested at all, so None — sentinel never folded — also passes); and
+# a margin-off run must prune the state leaf to None (default-off-is-free).
+if [ "$rc" -eq 0 ]; then
+  g=/tmp/_t1_margin.json; rm -f "$g"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxos_tpu margin \
+    --config corrupt --n-inst 512 --ticks 128 --chunk 32 --json \
+    >"$g" 2>/dev/null
+  grc=$?
+  if [ "$grc" -eq 0 ] || [ "$grc" -eq 2 ]; then  # 2 = violations, still a report
+    timeout -k 10 300 env JAX_PLATFORMS=cpu python - "$g" "$grc" <<'EOF' \
+    && echo MARGIN_SMOKE=ok || { echo MARGIN_SMOKE=FAILED; rc=1; }
+import json, sys
+out = json.load(open(sys.argv[1]))
+assert out["violations"] > 0, "corrupt smoke campaign never violated"
+assert int(sys.argv[2]) == 2, "violations present but exit code was not 2"
+assert out["margin"]["min_quorum_slack"] == 0, out["margin"]
+first_viol = next(c for c in out["curve"] if c["violations_delta"] > 0)
+hit = [c for c in out["curve"] if c["tick"] <= first_viol["tick"]
+       and c["min_quorum_slack"] == 0]
+assert hit, f"slack never hit 0 at-or-before first violation chunk: {out['curve']}"
+ranked = out["lane_ranking"]
+assert ranked and ranked[0]["min_quorum_slack"] == 0, ranked
+
+import dataclasses
+from paxos_tpu.harness.config import SimConfig
+from paxos_tpu.harness.run import init_state, make_advance, init_plan, summarize
+from paxos_tpu.obs.margin import MarginConfig
+cfg = SimConfig(n_inst=256, seed=5)
+mcfg = dataclasses.replace(cfg, margin=MarginConfig(counters=True))
+state = init_state(mcfg)
+state = make_advance(mcfg, init_plan(mcfg), "xla")(state, 64)
+rep = summarize(state, log_total=mcfg.fault.log_total)
+assert rep["violations"] == 0, rep
+s = rep["margin"]["min_quorum_slack"]
+assert s is None or s >= 1, f"healthy campaign reported slack {s}"
+off = init_state(cfg)
+assert off.margin is None, "margin-off state leaf not pruned to None"
+EOF
+  else
+    echo MARGIN_SMOKE=FAILED; rc=1
+  fi
+fi
 # Packed-state smoke: the fused engine now carries lane state bit-packed
 # through VMEM (utils/bitops layout tables); this replays one config per
 # protocol through the packed fused kernel (interpret) AND the unpacked

@@ -1,0 +1,442 @@
+"""Near-miss safety-margin plane (PR 12): off is free, on is neutral, honest.
+
+Four contracts guard the margin plane (the exposure plane's template):
+
+1. **Default-off is free**: with margin disabled (the default) the state's
+   ``margin`` leaf is ``None`` (pruned from the pytree), schedules are
+   BIT-IDENTICAL to the established golden digests (re-pinned from
+   tests/test_exposure.py), and the default config fingerprint is
+   unchanged so recorded artifacts keep matching.
+2. **On is outcome-neutral**: the fold draws NO randomness — pure int32
+   reductions over the learner table and acceptor fence the tick already
+   produced — so enabling it leaves the protocol schedule bit-identical
+   on BOTH engines, and the fused Pallas kernel carries the counter
+   arrays bit-exact vs its XLA reference via the packed-word passthrough.
+3. **The counters are honest (the oracle)**: over a 256-tick corrupt
+   campaign the device leaves equal an independent host-side numpy replay
+   of the fold — exactly, per lane, on both engines' schedules, for all
+   four protocols.  And the headline semantics hold: min quorum slack 0
+   iff the safety checker fired, healthy campaigns never dip below 1.
+4. **The plumbing round-trips**: checkpoints restore the margin config
+   and counters bit-exact (pre-margin snapshots default off), run reports
+   embed the margin block plus the ``checker_complete`` gauge, and the
+   metrics registry exports deterministic margin gauges (None minima and
+   list-valued ranking rows are NOT gauges).
+"""
+
+import dataclasses
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paxos_tpu.harness import checkpoint
+from paxos_tpu.harness import config as C
+from paxos_tpu.harness.metrics import MetricsRegistry
+from paxos_tpu.harness.run import (
+    base_key,
+    get_step_fn,
+    init_plan,
+    init_state,
+    run,
+    run_chunk,
+)
+from paxos_tpu.kernels.quorum import fast_quorum, majority
+from paxos_tpu.obs import margin as mar_mod
+
+MAR = mar_mod.MarginConfig(counters=True)
+
+
+def _digest(state) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        h.update(jax.device_get(leaf).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _xla_final(cfg, n_ticks=32):
+    return run_chunk(
+        init_state(cfg), base_key(cfg), init_plan(cfg), cfg.fault, n_ticks,
+        get_step_fn(cfg.protocol),
+    )
+
+
+def _ctr_final(cfg, n_ticks=32):
+    from paxos_tpu.kernels.fused_tick import fused_fns, reference_chunk
+
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    return reference_chunk(
+        init_state(cfg), cfg.seed, init_plan(cfg), cfg.fault, n_ticks,
+        apply_fn=apply_fn, mask_fn=mask_fn, blk_id=0,
+    )
+
+
+# The established goldens (tests/test_exposure.py, n_inst=256, seed=7,
+# 32 ticks, CPU): margin-off must reproduce them, and margin-ON minus the
+# counter leaf must reproduce them too (schedule unperturbed, both engines).
+_GOLDEN_XLA = {
+    "config2": (lambda: C.config2_dueling_drop(256, 7), "83347bc41b16a2aa"),
+    "config3": (lambda: C.config3_multipaxos(256, 7), "93a2dd9d7b8d66e4"),
+    "fastpaxos": (lambda: C.config5_sweep(256, 7)[1], "c43658973b29e73e"),
+    "raftcore": (lambda: C.config5_sweep(256, 7)[2], "4662db6b2c5a39d3"),
+}
+_GOLDEN_CTR = {
+    "config2": (lambda: C.config2_dueling_drop(256, 7), "db6db6f40f16eb7b"),
+    "config3": (lambda: C.config3_multipaxos(256, 7), "4b6525460815d9c5"),
+    "fastpaxos": (lambda: C.config5_sweep(256, 7)[1], "72beea3ccdacab94"),
+    "raftcore": (lambda: C.config5_sweep(256, 7)[2], "eb285905571b709f"),
+}
+
+_FAST_XLA = ("config2",)
+_FAST_CTR = ("config2",)
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        n if n in _FAST_XLA else pytest.param(n, marks=pytest.mark.slow)
+        for n in sorted(_GOLDEN_XLA)
+    ],
+)
+def test_margin_on_schedule_identical_xla(name):
+    mk, want = _GOLDEN_XLA[name]
+    assert _digest(_xla_final(mk())) == want  # off == the pinned golden
+    fin = _xla_final(dataclasses.replace(mk(), margin=MAR))
+    assert fin.margin is not None
+    assert _digest(fin.replace(margin=None)) == want  # on == same schedule
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        n if n in _FAST_CTR else pytest.param(n, marks=pytest.mark.slow)
+        for n in sorted(_GOLDEN_CTR)
+    ],
+)
+def test_margin_on_schedule_identical_counter_stream(name):
+    mk, want = _GOLDEN_CTR[name]
+    assert _digest(_ctr_final(mk())) == want
+    fin = _ctr_final(dataclasses.replace(mk(), margin=MAR))
+    assert _digest(fin.replace(margin=None)) == want
+
+
+def test_default_off_prunes_to_none():
+    """Disabled margin leaves NO trace in the pytree or the fingerprint."""
+    for mk in (C.config1_no_faults, C.config3_multipaxos):
+        cfg = mk(64, 0)
+        state = init_state(cfg)
+        assert state.margin is None
+        assert not cfg.margin.enabled()
+        on = init_state(dataclasses.replace(cfg, margin=MAR))
+        off_n = len(jax.tree_util.tree_leaves(state))
+        on_n = len(jax.tree_util.tree_leaves(on))
+        assert on_n == off_n + 4  # qslack/near_split/bal_gap/promise_slack
+        # All leaves non-scalar int32 instance-minor — the fused engine's
+        # generic packed-word flattening rides them with no kernel edits.
+        for leaf in jax.tree_util.tree_leaves(on.margin):
+            assert leaf.dtype == jnp.int32
+            assert leaf.shape == (64,)
+
+
+def test_fingerprint_unchanged_by_default_margin():
+    """The default (off) MarginConfig is dropped from the fingerprint, so
+    pre-margin artifacts keep matching; a non-default one IS keyed."""
+    cfg = C.config2_dueling_drop(1 << 10)
+    assert (
+        dataclasses.replace(
+            cfg, margin=mar_mod.MarginConfig()
+        ).fingerprint()
+        == cfg.fingerprint()
+    )
+    assert (
+        dataclasses.replace(cfg, margin=MAR).fingerprint()
+        != cfg.fingerprint()
+    )
+
+
+def test_margin_host_report_and_lane_ranking():
+    """SENTINEL minima surface as None; the ranking is tightest-first and
+    stops at the uncontested tail."""
+    S = mar_mod.SENTINEL
+    m = mar_mod.MarginState(
+        qslack_min=jnp.array([S, 0, 2, 1], jnp.int32),
+        near_split=jnp.array([0, 5, 0, 1], jnp.int32),
+        bal_gap_min=jnp.full((4,), S, jnp.int32),
+        promise_slack_min=jnp.array([S, 3, 3, 3], jnp.int32),
+    )
+    rep = mar_mod.margin_report(m)
+    assert rep["min_quorum_slack"] == 0
+    assert rep["min_ballot_gap"] is None  # sentinel never folded
+    assert rep["min_promise_slack"] == 3
+    assert rep["near_miss_lanes"] == 2  # slack <= 1: lanes 1 and 3
+    assert rep["zero_slack_lanes"] == 1
+    assert rep["contested_lanes"] == 3
+    assert rep["near_split_ticks"] == 6
+    assert rep["near_split_lanes"] == 2
+    ranking = mar_mod.lane_ranking(m, top=8)
+    assert [r["lane"] for r in ranking] == [1, 3, 2]  # lane 0 never ranks
+    assert ranking[0] == {
+        "lane": 1, "min_quorum_slack": 0, "near_split_ticks": 5,
+    }
+
+
+def test_correlation_table():
+    chunks = [
+        {"tightened": True, "new_bits": 3, "effective_total": 7,
+         "violations_delta": 1},
+        {"tightened": False, "new_bits": 2},
+        {"tightened": True},
+    ]
+    table = mar_mod.correlation(chunks)
+    assert table["tightened"] == {
+        "chunks": 2, "new_bits": 3, "effective": 7, "violations": 1,
+    }
+    assert table["flat"] == {
+        "chunks": 1, "new_bits": 2, "effective": 0, "violations": 0,
+    }
+
+
+def test_run_report_embeds_margin_and_checker_complete():
+    """A corrupt campaign's report carries slack 0 exactly when the safety
+    checker fired; a healthy campaign never dips below slack 1; margin-off
+    reports have no margin block but always carry checker_complete."""
+    cfg = dataclasses.replace(C.config_corrupt(128, 11), margin=MAR)
+    rep = run(cfg, total_ticks=64, chunk=32)
+    assert rep["violations"] > 0
+    assert rep["margin"]["min_quorum_slack"] == 0
+    assert rep["margin"]["zero_slack_lanes"] > 0
+    assert rep["checker_complete"] == (rep["evictions"] == 0)
+    # Healthy: no violations, so slack never 0 — either >= 1 or None
+    # (healthy lanes are typically never contested at all).
+    rep_h = run(
+        dataclasses.replace(C.config2_dueling_drop(64, 0), margin=MAR),
+        total_ticks=32, chunk=16,
+    )
+    assert rep_h["violations"] == 0
+    s = rep_h["margin"]["min_quorum_slack"]
+    assert s is None or s >= 1
+    rep_off = run(C.config2_dueling_drop(64, 0), total_ticks=16, chunk=8)
+    assert "margin" not in rep_off
+    assert rep_off["checker_complete"] is True
+
+
+# ---------------------------------------------------------------------------
+# The oracle: replay the campaign tick by tick, refold the margins in numpy
+# from device_get'd learner/acceptor snapshots, and match the device leaves
+# bit for bit — per lane, both engines, all four protocols.
+
+_ORACLE_TICKS = 256
+
+
+def _corrupt_cfg(protocol):
+    return dataclasses.replace(
+        C.config_corrupt(128, 11), protocol=protocol, margin=MAR
+    )
+
+
+def _learner_leaves(learner):
+    return {
+        f.name: np.asarray(jax.device_get(getattr(learner, f.name)))
+        for f in dataclasses.fields(learner)
+    }
+
+
+def _np_fold(protocol, cfg, counters, pre, post):
+    """One tick of the margin fold in numpy, mirroring the hook site."""
+    pre_l = _learner_leaves(pre.learner)
+    post_l = _learner_leaves(post.learner)
+    honest = ~np.asarray(jax.device_get(init_plan(cfg).equivocate))
+    q = majority(cfg.n_acc)
+    if protocol == "multipaxos":
+        from paxos_tpu.core.mp_state import bv_bal
+
+        acc_bal = np.asarray(
+            jax.device_get(bv_bal(post.acceptor.log).max(axis=1))
+        )
+        return mar_mod.np_mp_margin_tick(
+            counters, pre_l, post_l,
+            np.asarray(jax.device_get(post.acceptor.promised)),
+            acc_bal, honest, q,
+        )
+    if protocol == "raftcore":
+        promised = np.asarray(jax.device_get(post.acceptor.voted))
+        acc_bal = np.asarray(jax.device_get(post.acceptor.ent_term))
+        kw = {}
+    else:
+        promised = np.asarray(jax.device_get(post.acceptor.promised))
+        acc_bal = np.asarray(jax.device_get(post.acceptor.acc_bal))
+        q = cfg.fault.q2 or q
+        kw = {}
+        if protocol == "fastpaxos":
+            from paxos_tpu.core.ballot import ballot_round
+
+            kw = {
+                "fast_quorum": cfg.fault.q_fast or fast_quorum(cfg.n_acc),
+                "fast_round": np.asarray(
+                    jax.device_get(ballot_round(post.learner.lt_bal))
+                ) == 0,
+            }
+    return mar_mod.np_margin_tick(
+        counters, pre_l, post_l, promised, acc_bal, honest, q, **kw
+    )
+
+
+@pytest.mark.parametrize(
+    "engine,protocol",
+    [
+        ("xla", "paxos"),
+        ("ctr", "paxos"),
+        pytest.param("xla", "multipaxos", marks=pytest.mark.slow),
+        pytest.param("xla", "fastpaxos", marks=pytest.mark.slow),
+        pytest.param("xla", "raftcore", marks=pytest.mark.slow),
+        pytest.param("ctr", "multipaxos", marks=pytest.mark.slow),
+        pytest.param("ctr", "fastpaxos", marks=pytest.mark.slow),
+        pytest.param("ctr", "raftcore", marks=pytest.mark.slow),
+    ],
+)
+def test_margin_counters_vs_numpy_replay(engine, protocol):
+    """The device fold == the numpy fold over the same tick trajectory,
+    bit for bit per lane — and slack 0 co-occurs exactly with checker
+    violations on this corrupt campaign."""
+    cfg = _corrupt_cfg(protocol)
+    plan = init_plan(cfg)
+    state = init_state(cfg)
+    if engine == "xla":
+        key = base_key(cfg)
+        step = get_step_fn(cfg.protocol)
+
+        @jax.jit
+        def advance(st):
+            return run_chunk(st, key, plan, cfg.fault, 1, step)
+    else:  # the fused engine's schedule via its bit-exact XLA reference
+        from paxos_tpu.kernels.fused_tick import fused_fns, reference_chunk
+
+        apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+        seed = jnp.int32(cfg.seed)
+
+        @jax.jit
+        def advance(st):
+            return reference_chunk(
+                st, seed, plan, cfg.fault, 1,
+                apply_fn=apply_fn, mask_fn=mask_fn,
+            )
+
+    counters = mar_mod.np_margin_init(cfg.n_inst)
+    for _ in range(_ORACLE_TICKS):
+        nxt = advance(state)
+        counters = _np_fold(protocol, cfg, counters, state, nxt)
+        state = nxt
+
+    dev = jax.device_get(state.margin)
+    for name, host in counters.items():
+        assert np.array_equal(host, np.asarray(getattr(dev, name))), name
+    # Headline semantics on the real campaign: the corrupt config fires
+    # the checker, and slack 0 is exactly that event (not a lagging echo).
+    viol = np.asarray(jax.device_get(state.learner.violations))
+    rep = mar_mod.margin_report(state.margin)
+    assert viol.sum() > 0
+    assert rep["min_quorum_slack"] == 0
+    assert rep["contested_lanes"] > 0
+
+
+@pytest.mark.parametrize(
+    "protocol",
+    [
+        "paxos",
+        pytest.param("multipaxos", marks=pytest.mark.slow),
+        pytest.param("fastpaxos", marks=pytest.mark.slow),
+        pytest.param("raftcore", marks=pytest.mark.slow),
+    ],
+)
+def test_fused_kernel_carries_margin_bitexact(protocol):
+    """fused_chunk(interpret) == reference_chunk with the counters ON: the
+    packed-word passthrough codec must round-trip them bit-exactly."""
+    from paxos_tpu.kernels.fused_tick import (
+        FUSED_CHUNKS,
+        fused_fns,
+        reference_chunk,
+    )
+    from paxos_tpu.utils.trees import tree_mismatches
+
+    cfg = dataclasses.replace(
+        C.config_corrupt(64, 7), protocol=protocol, margin=MAR
+    )
+    apply_fn, mask_fn, _ = fused_fns(cfg.protocol)
+    plan = init_plan(cfg)
+    sr = reference_chunk(
+        init_state(cfg), jnp.int32(cfg.seed), plan, cfg.fault, 24,
+        apply_fn=apply_fn, mask_fn=mask_fn,
+    )
+    sp = FUSED_CHUNKS[cfg.protocol](
+        init_state(cfg), jnp.int32(cfg.seed), plan, cfg.fault, 24,
+        block=64, interpret=True,
+    )
+    assert tree_mismatches(sp, sr) == []
+    assert mar_mod.margin_report(sp.margin)["contested_lanes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint round-trip and metrics determinism.
+
+
+def test_checkpoint_roundtrip_with_margin(tmp_path):
+    """Save/restore rebuilds the margin config AND the counter arrays, so
+    a resumed campaign's margins are bit-identical."""
+    cfg = dataclasses.replace(C.config2_dueling_drop(64, 3), margin=MAR)
+    step = get_step_fn(cfg.protocol)
+    key, plan = base_key(cfg), init_plan(cfg)
+    state = run_chunk(init_state(cfg), key, plan, cfg.fault, 16, step)
+    checkpoint.save(tmp_path / "ck", state, plan, cfg, engine="xla")
+    st2, pl2, cfg2 = checkpoint.restore(tmp_path / "ck", engine="xla")
+    assert cfg2.margin == MAR
+    assert st2.margin is not None
+    fin_a = run_chunk(state, key, plan, cfg.fault, 16, step)
+    fin_b = run_chunk(st2, base_key(cfg2), pl2, cfg2.fault, 16, step)
+    assert _digest(fin_a) == _digest(fin_b)  # margin leaves included
+
+
+def test_checkpoint_restore_pre_margin_snapshot(tmp_path):
+    """Snapshots written before the margin plane (no key in the JSON)
+    restore with the default-off config and a pruned leaf."""
+    cfg = C.config2_dueling_drop(64, 3)
+    checkpoint.save(tmp_path / "ck", init_state(cfg), init_plan(cfg), cfg)
+    meta_path = tmp_path / "ck" / "simconfig.json"
+    raw = json.loads(meta_path.read_text())
+    raw.pop("margin")
+    meta_path.write_text(json.dumps(raw))
+    st2, _, cfg2 = checkpoint.restore(tmp_path / "ck")
+    assert cfg2.margin == mar_mod.MarginConfig()
+    assert st2.margin is None
+
+
+def test_margin_metrics_gauges_pinned():
+    """Numeric margin fields become gauges; None minima and list-valued
+    ranking rows do NOT (a None is 'never contested', not zero; a list
+    would break the Prometheus rendering)."""
+    rep = {
+        "min_quorum_slack": None,
+        "near_miss_lanes": 3,
+        "zero_slack_lanes": 0,
+        "min_ballot_gap": 2,
+        "seed_ranking": [{"seed": 7, "min_quorum_slack": 1}],
+    }
+    reg = MetricsRegistry()
+    reg.ingest_margin(rep, checker_complete=False)
+    gauges = reg.snapshot()["gauges"]
+    assert list(gauges) == sorted(gauges)  # the JSONL/stats ordering pin
+    assert "margin_min_quorum_slack" not in gauges
+    assert "margin_seed_ranking" not in gauges
+    assert gauges["margin_near_miss_lanes"] == 3
+    assert gauges["margin_zero_slack_lanes"] == 0
+    assert gauges["margin_min_ballot_gap"] == 2
+    assert gauges["checker_complete"] == 0.0
+    prom = reg.to_prometheus()
+    assert "paxos_tpu_margin_near_miss_lanes 3" in prom
+    assert "paxos_tpu_checker_complete 0" in prom
+    # checker_complete omitted -> no gauge claimed either way.
+    reg2 = MetricsRegistry()
+    reg2.ingest_margin({"near_miss_lanes": 1})
+    assert "checker_complete" not in reg2.snapshot()["gauges"]
