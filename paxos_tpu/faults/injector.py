@@ -406,3 +406,247 @@ class FaultPlan:
     def recovering(self, tick: jnp.ndarray) -> jnp.ndarray:
         """(A, I) bool: acceptor comes back up exactly at ``tick`` (for amnesia)."""
         return self.crash_end == tick
+
+
+# ---------------------------------------------------------------------------
+# Atom codec — JSON-stable (de)serialization of a FaultPlan at the atom
+# granularity the shrinker minimizes at (harness/shrink.py), shared by
+# shrink (atom enumeration + replayable repros), replay, and the fuzz
+# mutator (paxos_tpu/fuzz/mutate.py).  An "atom" is one independently
+# removable fault: a crash window, an equivocation flag, a partition
+# episode (with its sides and direction), one flaky link's (drop, dup)
+# thresholds, or one proposer's (timeout, backoff) skew.
+#
+# Stability contract: atoms are plain dicts of ints/lists (thresholds in
+# uint32 value form, never int32 bit patterns), canonically ordered by
+# ``atom_key``, so ``json.dumps(atoms, sort_keys=True)`` is a stable wire
+# format across platforms and sessions.  ``atoms_to_plan(plan_to_atoms(p,
+# cfg), ..., cfg)`` reproduces ``p`` bit-exactly on every schedule-relevant
+# field; ``aside``/``pside``/``part_dir`` are reproduced only in lanes
+# with a partition atom (outside a partition window the sides and the cut
+# direction are dead inputs — ``link_ok`` returns all-True regardless — so
+# sampled values in windowless lanes are deliberately not serialized;
+# tests/test_fuzz.py pins both the exact-field round-trip and the
+# ``link_ok`` equivalence that justifies the exception).
+
+_ATOM_KIND_ORDER = {"crash": 0, "equiv": 1, "partition": 2, "flaky": 3,
+                    "skew": 4}
+
+
+def _u32(x) -> int:
+    """int32 bit pattern -> uint32 value (the JSON threshold form)."""
+    return int(x) & 0xFFFFFFFF
+
+
+def _thr32(rate: float) -> int:
+    """Host-side ``rate_threshold`` in uint32 value form."""
+    return _u32(jax.device_get(rate_threshold(rate)))
+
+
+def atom_key(atom: dict) -> tuple:
+    """Canonical sort key: lane-major, then kind, then sub-targeting."""
+    return (
+        int(atom["lane"]),
+        _ATOM_KIND_ORDER[atom["kind"]],
+        str(atom.get("role", "")),
+        int(atom.get("idx", atom.get("prop", 0))),
+        int(atom.get("acc", 0)),
+    )
+
+
+def canonical_atoms(atoms: list) -> list:
+    """Atoms sorted by :func:`atom_key` (the JSON-stable order)."""
+    return sorted(atoms, key=atom_key)
+
+
+def atom_label(atom: dict) -> str:
+    """The shrinker's human-readable name for an atom."""
+    kind = atom["kind"]
+    if kind == "crash":
+        return f"crash[{atom['role']}={atom['idx']}]"
+    if kind == "equiv":
+        return f"equiv[acceptor={atom['idx']}]"
+    if kind == "partition":
+        return "asym-partition" if atom.get("dir", 0) else "partition"
+    if kind == "flaky":
+        return f"flaky[link=({atom['prop']},{atom['acc']})]"
+    if kind == "skew":
+        return f"skew[proposer={atom['prop']}]"
+    raise ValueError(f"unknown atom kind: {kind!r}")
+
+
+def plan_to_atoms(
+    plan: "FaultPlan", cfg: "FaultConfig | None" = None
+) -> list:
+    """Serialize ``plan`` to its canonical atom list.
+
+    ``cfg`` supplies the healthy-link baselines: a sampled plan's healthy
+    links carry exactly ``rate_threshold(cfg.p_drop/p_dup)`` (see
+    ``FaultPlan.sample``), so with ``cfg`` given only genuinely flaky
+    links become atoms.  Without ``cfg`` the baseline is 0 — any nonzero
+    gray value is an atom, which is what the shrinker's liveness test
+    wants for its lane-isolated plans.
+    """
+    import numpy as np
+
+    host = jax.device_get(plan)
+    atoms: list = []
+    drop_base = _thr32(cfg.p_drop) if cfg is not None else 0
+    dup_base = _thr32(cfg.p_dup) if cfg is not None else 0
+
+    cs = np.asarray(host.crash_start)
+    for a, i in zip(*np.nonzero(cs != NEVER)):
+        atoms.append({
+            "kind": "crash", "role": "acceptor", "idx": int(a),
+            "lane": int(i), "start": int(cs[a, i]),
+            "end": int(np.asarray(host.crash_end)[a, i]),
+        })
+    ps = np.asarray(host.pcrash_start)
+    for p, i in zip(*np.nonzero(ps != NEVER)):
+        atoms.append({
+            "kind": "crash", "role": "proposer", "idx": int(p),
+            "lane": int(i), "start": int(ps[p, i]),
+            "end": int(np.asarray(host.pcrash_end)[p, i]),
+        })
+    eq = np.asarray(host.equivocate)
+    for a, i in zip(*np.nonzero(eq)):
+        atoms.append({"kind": "equiv", "idx": int(a), "lane": int(i)})
+    pst = np.asarray(host.part_start)
+    aside = np.asarray(host.aside)
+    pside = np.asarray(host.pside)
+    pdir = (
+        np.asarray(host.part_dir) if host.part_dir is not None else None
+    )
+    for (i,) in zip(*np.nonzero(pst != NEVER)):
+        atoms.append({
+            "kind": "partition", "lane": int(i), "start": int(pst[i]),
+            "end": int(np.asarray(host.part_end)[i]),
+            "dir": int(pdir[i]) if pdir is not None else 0,
+            "aside": [int(b) for b in aside[:, i]],
+            "pside": [int(b) for b in pside[:, i]],
+        })
+    if host.link_drop is not None:
+        ld = np.asarray(host.link_drop).astype(np.int64) & 0xFFFFFFFF
+        lu = (
+            np.asarray(host.link_dup).astype(np.int64) & 0xFFFFFFFF
+            if host.link_dup is not None
+            else None
+        )
+        dev = ld != drop_base
+        if lu is not None:
+            dev = dev | (lu != dup_base)
+        for p, a, i in zip(*np.nonzero(dev)):
+            atoms.append({
+                "kind": "flaky", "prop": int(p), "acc": int(a),
+                "lane": int(i), "drop": int(ld[p, a, i]),
+                "dup": int(lu[p, a, i]) if lu is not None else None,
+            })
+    if host.ptimeout is not None or host.pboff is not None:
+        pt = (
+            np.asarray(host.ptimeout) if host.ptimeout is not None else None
+        )
+        pb = np.asarray(host.pboff) if host.pboff is not None else None
+        shape = pt.shape if pt is not None else pb.shape
+        for p in range(shape[0]):
+            for i in range(shape[1]):
+                t = int(pt[p, i]) if pt is not None else 0
+                b = int(pb[p, i]) if pb is not None else 1
+                if t != 0 or b != 1:
+                    atoms.append({
+                        "kind": "skew", "prop": int(p), "lane": int(i),
+                        "timeout": t, "boff": b,
+                    })
+    return canonical_atoms(atoms)
+
+
+def atoms_to_plan(
+    atoms: list,
+    n_inst: int,
+    n_acc: int,
+    n_prop: int = 1,
+    cfg: "FaultConfig | None" = None,
+) -> "FaultPlan":
+    """Build a FaultPlan from an atom list (the codec's decode direction).
+
+    Starts from ``FaultPlan.none(cfg=cfg)`` — so the pytree STRUCTURE
+    matches what ``sample(cfg)`` would produce and healthy links carry the
+    cfg baselines — then applies each atom.  Gray fields an atom needs
+    that the cfg doesn't gate on are materialized at their benign
+    baseline; note the step functions only CONSULT gray fields when the
+    matching cfg knob is lit (see protocols/*.py), so callers running a
+    mutated plan must light the knobs its atoms need (the fuzz scheduler's
+    ``campaign_config`` does exactly this).
+    """
+    import numpy as np
+
+    cfg = cfg or FaultConfig()
+    base = jax.device_get(FaultPlan.none(n_inst, n_acc, n_prop, cfg))
+    fields = {
+        k: (np.array(v) if v is not None else None)
+        for k, v in dataclasses.asdict(base).items()
+    }
+    drop_base = _thr32(cfg.p_drop)
+    dup_base = _thr32(cfg.p_dup)
+    edge = (n_prop, n_acc, n_inst)
+
+    def need(name, fill):
+        if fields[name] is None:
+            fields[name] = fill()
+        return fields[name]
+
+    for atom in atoms:
+        kind = atom["kind"]
+        lane = int(atom["lane"])
+        if kind == "crash":
+            pre = "crash" if atom["role"] == "acceptor" else "pcrash"
+            fields[f"{pre}_start"][atom["idx"], lane] = atom["start"]
+            fields[f"{pre}_end"][atom["idx"], lane] = atom["end"]
+        elif kind == "equiv":
+            fields["equivocate"][atom["idx"], lane] = True
+        elif kind == "partition":
+            fields["part_start"][lane] = atom["start"]
+            fields["part_end"][lane] = atom["end"]
+            fields["aside"][:, lane] = [bool(b) for b in atom["aside"]]
+            fields["pside"][:, lane] = [bool(b) for b in atom["pside"]]
+            if atom.get("dir", 0):
+                need(
+                    "part_dir",
+                    lambda: np.zeros((n_inst,), np.int32),
+                )[lane] = atom["dir"]
+        elif kind == "flaky":
+            ld = need(
+                "link_drop",
+                lambda: np.full(
+                    edge, np.uint32(drop_base).astype(np.int32), np.int32
+                ),
+            )
+            ld[atom["prop"], atom["acc"], lane] = np.uint32(
+                atom["drop"]
+            ).astype(np.int32)
+            if atom.get("dup") is not None:
+                lu = need(
+                    "link_dup",
+                    lambda: np.full(
+                        edge, np.uint32(dup_base).astype(np.int32), np.int32
+                    ),
+                )
+                lu[atom["prop"], atom["acc"], lane] = np.uint32(
+                    atom["dup"]
+                ).astype(np.int32)
+        elif kind == "skew":
+            if atom.get("timeout", 0) or fields["ptimeout"] is not None:
+                need(
+                    "ptimeout",
+                    lambda: np.zeros((n_prop, n_inst), np.int32),
+                )[atom["prop"], lane] = atom.get("timeout", 0)
+            if atom.get("boff", 1) != 1 or fields["pboff"] is not None:
+                need(
+                    "pboff",
+                    lambda: np.ones((n_prop, n_inst), np.int32),
+                )[atom["prop"], lane] = atom.get("boff", 1)
+        else:
+            raise ValueError(f"unknown atom kind: {kind!r}")
+    return FaultPlan(**{
+        k: (jnp.asarray(v) if v is not None else None)
+        for k, v in fields.items()
+    })

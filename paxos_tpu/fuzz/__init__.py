@@ -1,0 +1,44 @@
+"""Feedback-directed fuzzing — coverage-guided, exposure-weighted scheduling.
+
+The fuzzer closes the loop the observer planes opened: coverage ``new_bits``
+(obs.coverage, PR 8) says whether a campaign visited novel protocol states,
+fault exposure (obs.exposure, PR 9) says whether its chaos actually touched
+the protocol, and near-miss margins (obs.margin, PR 12) say how close it came
+to a violation.  ``fuzz.corpus`` folds the three into one fitness number per
+corpus entry, ``fuzz.mutate`` grows new entries by deterministic atom-level
+mutations (the shrink machinery run in reverse), and ``fuzz.schedule`` assigns
+energy AFL-style and drives the campaigns through the same soak worker loop
+plain ``soak`` uses.
+
+The fuzzer only chooses WHICH campaigns run, never how a campaign executes:
+every device schedule for a given (config, seed, plan) is bit-identical to
+the unguided build, and with fuzzing disabled nothing here is imported.
+"""
+
+from paxos_tpu.fuzz.corpus import (
+    Corpus,
+    CorpusEntry,
+    atoms_digest,
+    entry_classes,
+    exposure_weight,
+    fitness,
+    margin_boost,
+)
+from paxos_tpu.fuzz.mutate import MUTATION_OPS, SplitMix64, mutate
+from paxos_tpu.fuzz.schedule import FuzzParams, GuidedSource, campaign_config
+
+__all__ = [
+    "Corpus",
+    "CorpusEntry",
+    "atoms_digest",
+    "entry_classes",
+    "exposure_weight",
+    "fitness",
+    "margin_boost",
+    "MUTATION_OPS",
+    "SplitMix64",
+    "mutate",
+    "FuzzParams",
+    "GuidedSource",
+    "campaign_config",
+]

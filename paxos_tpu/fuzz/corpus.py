@@ -1,0 +1,245 @@
+"""Corpus — fitness-ranked (seed, atom-list) entries and their journal.
+
+A corpus entry is everything needed to re-run its campaign exactly: the
+campaign seed, the fault-plan atom list (the ``faults.injector`` codec —
+JSON-stable, canonically ordered), any fault-knob overrides the mutator
+applied, and the campaign config fingerprint recorded at dispatch.  Fitness
+folds the three observer planes into one number:
+
+    fitness = new_bits * exposure_weight * margin_boost
+
+- ``new_bits`` — union bits this entry's campaign contributed against the
+  soak loop's cross-seed Bloom union (obs.coverage): the novelty signal.
+- ``exposure_weight`` — the mean effective/injected fraction over the fault
+  classes this entry's atoms light (obs.exposure).  An entry whose lit
+  classes are ALL vacuous (zero effective events) weighs 0 — vacuous chaos
+  earns no energy, however many bits its baseline dynamics set.  An entry
+  with no gray atoms (crash/equiv only, or none) weighs 1: those faults are
+  applied unconditionally and need no exposure defense.
+- ``margin_boost`` — 1 + 1/(1 + min_quorum_slack) in (1, 2]: campaigns that
+  came within a vote of a safety violation (obs.margin) are worth mutating
+  harder even when they soaked clean.
+
+The journal is an append-only JSONL event stream (``add`` / ``feedback`` /
+``retire``) with NO wall-clock fields, so two runs of the same fuzz command
+produce byte-identical journals — ``digest()`` is the replay-determinism
+pin the FUZZ_SMOKE gate compares.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Optional
+
+# Atom kind -> exposure classes (obs.exposure.CLASSES) its fault events land
+# in — the same map harness/shrink.py uses for repro annotation.  Crash and
+# equivocation atoms are deliberately absent: they are applied
+# unconditionally by every step function (no gating knob, no exposure
+# counter), so they cannot be vacuous.
+ATOM_CLASSES = {
+    "partition": ("partition",),
+    "flaky": ("drop", "dup"),
+    "skew": ("timeout",),
+}
+
+
+def atoms_digest(atoms: list) -> str:
+    """sha256 of the canonical JSON wire form of an atom list."""
+    from paxos_tpu.faults.injector import canonical_atoms
+
+    wire = json.dumps(
+        canonical_atoms(atoms), sort_keys=True, separators=(",", ":")
+    )
+    return hashlib.sha256(wire.encode()).hexdigest()
+
+
+def entry_classes(atoms: list) -> set:
+    """The exposure classes an atom list's gray atoms light."""
+    out: set = set()
+    for atom in atoms:
+        out.update(ATOM_CLASSES.get(atom["kind"], ()))
+    return out
+
+
+def exposure_weight(atoms: list, classes: Optional[dict]) -> float:
+    """Mean effective/injected fraction over the entry's lit classes.
+
+    0.0 when every lit class has zero effective events (vacuous chaos);
+    1.0 when the entry lights no gray class (nothing to defend) or when
+    the exposure plane was off (``classes`` is None — no evidence either
+    way, so novelty alone decides).
+    """
+    lit = sorted(entry_classes(atoms))
+    if not lit or classes is None:
+        return 1.0
+    if all(classes.get(n, {}).get("effective", 0) == 0 for n in lit):
+        return 0.0
+    fracs = []
+    for n in lit:
+        row = classes.get(n, {})
+        inj = row.get("injected", 0)
+        fracs.append(min(1.0, row.get("effective", 0) / inj) if inj else 0.0)
+    return sum(fracs) / len(fracs)
+
+
+def margin_boost(min_quorum_slack: Optional[int]) -> float:
+    """1 + 1/(1 + slack) in (1, 2]; 1.0 when the margin plane saw nothing."""
+    if min_quorum_slack is None:
+        return 1.0
+    return 1.0 + 1.0 / (1.0 + max(int(min_quorum_slack), 0))
+
+
+def fitness(
+    new_bits: int,
+    atoms: list,
+    classes: Optional[dict],
+    min_quorum_slack: Optional[int],
+) -> float:
+    """The corpus fitness formula (see module docstring)."""
+    return round(
+        new_bits * exposure_weight(atoms, classes)
+        * margin_boost(min_quorum_slack),
+        6,
+    )
+
+
+@dataclasses.dataclass
+class CorpusEntry:
+    """One schedulable campaign: identity, recipe, and measured feedback."""
+
+    entry_id: int
+    seed: int
+    atoms: list
+    knobs: dict  # fault-knob overrides the mutator applied (e.g. p_corrupt)
+    parent: Optional[int] = None
+    ops: tuple = ()  # mutation op names that produced this entry
+    root: bool = False  # root entries run the config's own sampled plan
+    # Measured feedback (None until the entry's campaign finalizes).
+    fingerprint: Optional[str] = None
+    new_bits: Optional[int] = None
+    effective: Optional[dict] = None
+    min_quorum_slack: Optional[int] = None
+    violations: int = 0
+    fitness: float = 0.0
+    # Plateau bookkeeping: consecutive low-yield child campaigns.
+    stale: int = 0
+    retired: bool = False
+
+    @property
+    def executed(self) -> bool:
+        return self.new_bits is not None
+
+
+class Corpus:
+    """Entry store + the append-only JSONL journal of every corpus event."""
+
+    def __init__(self) -> None:
+        self.entries: list[CorpusEntry] = []
+        self._events: list[dict] = []
+
+    # -- construction ----------------------------------------------------
+    def add(
+        self,
+        seed: int,
+        atoms: list,
+        knobs: Optional[dict] = None,
+        parent: Optional[int] = None,
+        ops: tuple = (),
+        root: bool = False,
+    ) -> CorpusEntry:
+        entry = CorpusEntry(
+            entry_id=len(self.entries), seed=int(seed), atoms=list(atoms),
+            knobs=dict(knobs or {}), parent=parent, ops=tuple(ops), root=root,
+        )
+        self.entries.append(entry)
+        self._emit({
+            "event": "add", "id": entry.entry_id, "seed": entry.seed,
+            "parent": entry.parent, "ops": list(entry.ops),
+            "root": entry.root, "knobs": entry.knobs, "atoms": entry.atoms,
+            "atoms_digest": atoms_digest(entry.atoms),
+        })
+        return entry
+
+    # -- feedback --------------------------------------------------------
+    def record(
+        self,
+        entry: CorpusEntry,
+        *,
+        new_bits: int,
+        classes: Optional[dict],
+        min_quorum_slack: Optional[int],
+        fingerprint: Optional[str],
+        violations: int,
+    ) -> float:
+        """Fold one finalized campaign's measurements into its entry."""
+        entry.new_bits = int(new_bits)
+        entry.effective = (
+            {n: row["effective"] for n, row in classes.items()}
+            if classes is not None
+            else None
+        )
+        entry.min_quorum_slack = min_quorum_slack
+        entry.fingerprint = fingerprint
+        entry.violations = int(violations)
+        entry.fitness = fitness(
+            entry.new_bits, entry.atoms, classes, min_quorum_slack
+        )
+        self._emit({
+            "event": "feedback", "id": entry.entry_id,
+            "fingerprint": fingerprint, "new_bits": entry.new_bits,
+            "effective": entry.effective,
+            "min_quorum_slack": min_quorum_slack,
+            "violations": entry.violations, "fitness": entry.fitness,
+        })
+        return entry.fitness
+
+    def retire(self, entry: CorpusEntry, reason: str) -> None:
+        if entry.retired:
+            return
+        entry.retired = True
+        self._emit({
+            "event": "retire", "id": entry.entry_id, "reason": reason,
+        })
+
+    # -- queries ---------------------------------------------------------
+    def get(self, entry_id: int) -> CorpusEntry:
+        return self.entries[entry_id]
+
+    def alive(self) -> list[CorpusEntry]:
+        """Executed, unretired entries — the mutation parent pool."""
+        return [e for e in self.entries if e.executed and not e.retired]
+
+    # -- journal ---------------------------------------------------------
+    def _emit(self, event: dict) -> None:
+        self._events.append(event)
+
+    def journal_lines(self) -> list[str]:
+        """Canonical JSONL: one sorted-key compact line per event, in
+        emission order — byte-stable across runs and platforms (no
+        wall-clock, no floats beyond the rounded fitness)."""
+        return [
+            json.dumps(e, sort_keys=True, separators=(",", ":"))
+            for e in self._events
+        ]
+
+    def digest(self) -> str:
+        """sha256 over the journal — the replay-determinism pin."""
+        h = hashlib.sha256()
+        for line in self.journal_lines():
+            h.update(line.encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+    def write_journal(self, path: Any) -> str:
+        """Write the journal JSONL (digest line last); returns the digest."""
+        digest = self.digest()
+        with open(path, "w") as f:
+            for line in self.journal_lines():
+                f.write(line + "\n")
+            f.write(json.dumps(
+                {"event": "digest", "sha256": digest},
+                sort_keys=True, separators=(",", ":"),
+            ) + "\n")
+        return digest

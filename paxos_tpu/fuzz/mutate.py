@@ -1,0 +1,278 @@
+"""Deterministic, stream-registered fault-plan mutations.
+
+The mutator is the shrink machinery run in reverse: where
+``harness/shrink.py`` removes atoms one at a time to minimize a failing
+plan, ``mutate`` adds, removes, retargets, and widens atoms at the SAME
+granularity — the ``faults.injector`` codec — plus two knob-level ops
+(corruption rate when the base config lights it, and ballot-pressure
+timing) that perturb the campaign config rather than the plan.
+
+Determinism contract (pinned by tests/test_fuzz.py against a golden
+digest): mutations draw from a pure-Python splitmix64 stream — integer
+arithmetic only, no platform floats, no ``random`` module, no wall clock —
+so the same (rng seed, corpus entry) yields the identical mutation
+sequence on every run and platform.  Ops are STREAM-REGISTERED like the
+device PRNG streams in ``core/streams.py``: each op owns a stable integer
+id, every op application forks the entry stream by that id, and the
+registry refuses duplicate ids or names at import time — adding an op
+never perturbs the draws of existing ones beyond the op-selection draw.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+_MASK64 = (1 << 64) - 1
+_GOLDEN = 0x9E3779B97F4A7C15
+# Per-link rates are uint32 thresholds (faults.injector.rate_threshold);
+# the mutator draws them on a 1/16 grid — coarse is fine for chaos knobs,
+# and integer grid points keep the wire form platform-independent.
+_THR_STEP = (1 << 32) // 16
+
+
+class SplitMix64:
+    """splitmix64 — the integer-only host PRNG behind every mutation draw."""
+
+    def __init__(self, seed: int) -> None:
+        self.state = seed & _MASK64
+
+    def next_u64(self) -> int:
+        self.state = (self.state + _GOLDEN) & _MASK64
+        z = self.state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK64
+        return z ^ (z >> 31)
+
+    def below(self, n: int) -> int:
+        """Uniform int in [0, n).  Modulo bias at 64 bits is ~2^-59 per
+        draw — irrelevant for mutation choice, and bit-stable everywhere."""
+        return self.next_u64() % max(int(n), 1)
+
+    def fork(self, fold: int) -> "SplitMix64":
+        """An independent child stream keyed by ``fold`` (an op id) —
+        consumes one parent draw, so sibling forks never collide."""
+        return SplitMix64(self.next_u64() ^ ((fold * _GOLDEN) & _MASK64))
+
+
+def entry_stream(rng_seed: int, entry_id: int) -> SplitMix64:
+    """The registered mutation stream for one (rng seed, corpus entry)."""
+    return SplitMix64(((rng_seed & _MASK64) * _GOLDEN ^ entry_id) & _MASK64)
+
+
+@dataclasses.dataclass(frozen=True)
+class Dims:
+    """Targeting bounds for mutation draws (shapes + tick budget)."""
+
+    n_inst: int
+    n_acc: int
+    n_prop: int
+    max_tick: int
+
+
+def _window(rng: SplitMix64, dims: Dims) -> tuple[int, int]:
+    start = rng.below(max(dims.max_tick - 1, 1))
+    length = 1 + rng.below(max(dims.max_tick // 4, 2))
+    return start, min(start + length, dims.max_tick)
+
+
+# --- atom-level ops (the shrinker's vocabulary, in reverse) ---------------
+
+
+def _add_acceptor_crash(rng, atoms, knobs, dims) -> Optional[str]:
+    start, end = _window(rng, dims)
+    atoms.append({
+        "kind": "crash", "role": "acceptor", "idx": rng.below(dims.n_acc),
+        "lane": rng.below(dims.n_inst), "start": start, "end": end,
+    })
+    return "add-acceptor-crash"
+
+
+def _add_proposer_crash(rng, atoms, knobs, dims) -> Optional[str]:
+    start, end = _window(rng, dims)
+    atoms.append({
+        "kind": "crash", "role": "proposer", "idx": rng.below(dims.n_prop),
+        "lane": rng.below(dims.n_inst), "start": start, "end": end,
+    })
+    return "add-proposer-crash"
+
+
+def _add_equiv(rng, atoms, knobs, dims) -> Optional[str]:
+    atoms.append({
+        "kind": "equiv", "idx": rng.below(dims.n_acc),
+        "lane": rng.below(dims.n_inst),
+    })
+    return "add-equiv"
+
+
+def _partition(rng, atoms, dims, direction: int) -> None:
+    start, end = _window(rng, dims)
+    # Sides must actually split the acceptors or the cut is a no-op: put
+    # one drawn acceptor alone on side A, the rest on side B.
+    alone = rng.below(dims.n_acc)
+    atoms.append({
+        "kind": "partition", "lane": rng.below(dims.n_inst),
+        "start": start, "end": end, "dir": direction,
+        "aside": [1 if a == alone else 0 for a in range(dims.n_acc)],
+        "pside": [rng.below(2) for _ in range(dims.n_prop)],
+    })
+
+
+def _add_partition(rng, atoms, knobs, dims) -> Optional[str]:
+    _partition(rng, atoms, dims, 0)
+    return "add-partition"
+
+
+def _add_asym_partition(rng, atoms, knobs, dims) -> Optional[str]:
+    _partition(rng, atoms, dims, 1 + rng.below(2))
+    return "add-asym-partition"
+
+
+def _add_flaky(rng, atoms, knobs, dims) -> Optional[str]:
+    atoms.append({
+        "kind": "flaky", "prop": rng.below(dims.n_prop),
+        "acc": rng.below(dims.n_acc), "lane": rng.below(dims.n_inst),
+        "drop": (1 + rng.below(15)) * _THR_STEP,  # rate in [1/16, 15/16]
+        "dup": rng.below(9) * _THR_STEP,  # rate in [0, 8/16]
+    })
+    return "add-flaky"
+
+
+def _add_skew(rng, atoms, knobs, dims) -> Optional[str]:
+    atoms.append({
+        "kind": "skew", "prop": rng.below(dims.n_prop),
+        "lane": rng.below(dims.n_inst), "timeout": 1 + rng.below(8),
+        "boff": 2 + rng.below(3),
+    })
+    return "add-skew"
+
+
+def _remove_atom(rng, atoms, knobs, dims) -> Optional[str]:
+    if not atoms:
+        return None
+    atoms.pop(rng.below(len(atoms)))
+    return "remove-atom"
+
+
+def _retarget_lane(rng, atoms, knobs, dims) -> Optional[str]:
+    if not atoms:
+        return None
+    atoms[rng.below(len(atoms))]["lane"] = rng.below(dims.n_inst)
+    return "retarget-lane"
+
+
+def _widen_window(rng, atoms, knobs, dims) -> Optional[str]:
+    windowed = [a for a in atoms if "start" in a]
+    if not windowed:
+        return None
+    atom = windowed[rng.below(len(windowed))]
+    atom["end"] = min(
+        atom["end"] + 1 + rng.below(max(dims.max_tick // 2, 2)),
+        dims.max_tick,
+    )
+    return "widen-window"
+
+
+# --- knob-level ops (campaign-config pressure, not plan atoms) ------------
+
+
+def _ballot_pressure(rng, atoms, knobs, dims) -> Optional[str]:
+    # Shorter timeouts and tighter backoff = more dueling ballots per tick
+    # budget (the known high-yield dimension; see README).  Campaign-config
+    # knobs, so this chooses a different campaign, never a different
+    # execution of the same one.
+    knobs["timeout"] = 2 + rng.below(10)
+    knobs["backoff_max"] = 1 + rng.below(8)
+    return "ballot-pressure"
+
+
+def _scale_corrupt(rng, atoms, knobs, dims, base_corrupt=0.0) -> Optional[str]:
+    # Only meaningful when the BASE config already lights the corruption
+    # bug injection — the fuzzer must not silently turn a chaos soak into
+    # a checker-validation run.  Rates live on a 1/32 grid (exact binary
+    # floats, platform-stable).
+    if base_corrupt <= 0.0:
+        return None
+    knobs["p_corrupt"] = (1 + rng.below(32)) / 32.0
+    return "scale-corrupt"
+
+
+@dataclasses.dataclass(frozen=True)
+class MutationOp:
+    """One registered mutation: stable stream id, name, and the op."""
+
+    op_id: int
+    name: str
+    fn: Callable
+
+
+def _register(*ops: MutationOp) -> tuple[MutationOp, ...]:
+    ids = [op.op_id for op in ops]
+    names = [op.name for op in ops]
+    if len(set(ids)) != len(ids) or len(set(names)) != len(names):
+        raise AssertionError(f"duplicate mutation op id/name: {ids} {names}")
+    if any(i <= 0 for i in ids):
+        raise AssertionError("mutation op ids must be positive")
+    return tuple(ops)
+
+
+# Append-only: op ids are part of the determinism contract (they key the
+# stream forks), so never renumber or reuse one — retire by leaving a gap.
+MUTATION_OPS = _register(
+    MutationOp(1, "add-acceptor-crash", _add_acceptor_crash),
+    MutationOp(2, "add-proposer-crash", _add_proposer_crash),
+    MutationOp(3, "add-equiv", _add_equiv),
+    MutationOp(4, "add-partition", _add_partition),
+    MutationOp(5, "add-asym-partition", _add_asym_partition),
+    MutationOp(6, "add-flaky", _add_flaky),
+    MutationOp(7, "add-skew", _add_skew),
+    MutationOp(8, "remove-atom", _remove_atom),
+    MutationOp(9, "retarget-lane", _retarget_lane),
+    MutationOp(10, "widen-window", _widen_window),
+    MutationOp(11, "ballot-pressure", _ballot_pressure),
+    MutationOp(12, "scale-corrupt", _scale_corrupt),
+)
+
+
+def _dedup(atoms: list) -> list:
+    """Canonical order with one atom per targeting key (last write wins —
+    matching ``atoms_to_plan``'s apply order semantics)."""
+    from paxos_tpu.faults.injector import atom_key, canonical_atoms
+
+    by_key = {atom_key(a): a for a in atoms}
+    return canonical_atoms(list(by_key.values()))
+
+
+def mutate(
+    rng: SplitMix64,
+    atoms: list,
+    knobs: dict,
+    dims: Dims,
+    n_ops: int = 2,
+    base_corrupt: float = 0.0,
+) -> tuple[list, dict, tuple]:
+    """Apply ``n_ops`` drawn mutations; returns (atoms', knobs', op names).
+
+    Inputs are never modified.  Each application draws the op uniformly,
+    then scans forward (registry order) past inapplicable ops — e.g.
+    ``remove-atom`` on an empty list — so a draw always lands somewhere
+    and the op count is exact.  Every op runs on its own ``fork(op_id)``
+    stream: its internal draws cannot shift any other op's.
+    """
+    atoms = [dict(a) for a in atoms]
+    knobs = dict(knobs)
+    applied: list[str] = []
+    for _ in range(max(int(n_ops), 1)):
+        pick = rng.below(len(MUTATION_OPS))
+        for step in range(len(MUTATION_OPS)):
+            op = MUTATION_OPS[(pick + step) % len(MUTATION_OPS)]
+            op_rng = rng.fork(op.op_id)
+            if op.fn is _scale_corrupt:
+                desc = op.fn(op_rng, atoms, knobs, dims,
+                             base_corrupt=base_corrupt)
+            else:
+                desc = op.fn(op_rng, atoms, knobs, dims)
+            if desc is not None:
+                applied.append(desc)
+                break
+    return _dedup(atoms), knobs, tuple(applied)

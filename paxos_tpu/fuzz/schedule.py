@@ -1,0 +1,273 @@
+"""AFL-style energy scheduling over the shared soak worker loop.
+
+``GuidedSource`` is a campaign source in the :class:`harness.soak`
+protocol (``next_campaign`` / ``feedback``): plain ``soak`` and
+``paxos_tpu fuzz`` execute campaigns through the SAME worker loop — the
+fuzzer only decides WHICH (config, seed, plan) triples run, never how one
+executes, so every device schedule stays bit-identical to the unguided
+build for the same triple.
+
+Energy policy (AFL-style): after each corpus refill, an executed entry
+with fitness f gets ``clamp(round(f / mean_fitness), 1, energy_max)``
+child campaigns, scheduled fitness-descending.  Entries whose lit fault
+classes are all vacuous (zero effective events — ``fuzz.corpus``) are
+retired immediately with zero energy; entries whose children stop buying
+union bits are retired by the same ``plateau_seeds``/``plateau_min_new``
+detection the soak loop applies to its cross-seed curve.
+
+``campaign_config`` is the knob-lighting step: gray plan fields are only
+CONSULTED when the matching ``FaultConfig`` knob is on (see
+``protocols/*.py``), so a mutated plan's partition/flaky/skew atoms would
+be silently inert without it.  It lights exactly the knobs the entry's
+atoms need (crash/equiv need none — they apply unconditionally) and
+applies the mutator's knob overrides; the resulting config fingerprint is
+recorded per entry in the corpus journal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from paxos_tpu.fuzz.corpus import Corpus, CorpusEntry, exposure_weight
+from paxos_tpu.fuzz.mutate import Dims, entry_stream, mutate
+from paxos_tpu.harness.config import SimConfig
+
+# Campaign-config knobs the mutator may override (fuzz.mutate's knob ops).
+# A whitelist, not a convention: an atom-level concern leaking into knobs
+# would silently bypass the codec's round-trip guarantees.
+KNOB_WHITELIST = ("timeout", "backoff_max", "p_corrupt")
+
+
+@dataclasses.dataclass(frozen=True)
+class FuzzParams:
+    """Scheduler policy — all deterministic, all journal-visible."""
+
+    campaigns: int = 32  # total campaign budget (the uniform-soak unit)
+    seed_entries: int = 2  # root entries: base seed, seed+1, ...
+    mutations: int = 2  # atom mutations per child entry
+    energy_max: int = 4  # per-refill cap on campaigns per entry
+    plateau_seeds: int = 3  # retire a parent after K low-yield children
+    plateau_min_new: int = 1  # ...each adding fewer union bits than this
+    rng_seed: int = 0  # mutation stream root (independent of cfg.seed)
+
+
+def campaign_config(
+    base_cfg: SimConfig, seed: int, atoms: list, knobs: dict
+) -> SimConfig:
+    """The concrete campaign config for one corpus entry.
+
+    Lights the fault knobs the entry's atoms need (never dims one the base
+    config already lit) and applies the whitelisted knob overrides.  The
+    returned config is what fingerprints, compiles, and runs — entries
+    with the same knob needs share one compiled executable across the
+    whole fuzz run (plans are traced values, never compile keys).
+    """
+    f = base_cfg.fault
+    rep: dict = {}
+    kinds = {a["kind"] for a in atoms}
+    if "partition" in kinds and f.p_part <= 0.0:
+        rep["p_part"] = 0.5
+    if any(
+        a["kind"] == "partition" and a.get("dir", 0) for a in atoms
+    ) and f.p_asym <= 0.0:
+        rep["p_asym"] = 0.5
+    if "flaky" in kinds:
+        if f.p_flaky <= 0.0:
+            rep["p_flaky"] = 0.5
+        if any(a.get("dup") for a in atoms if a["kind"] == "flaky") and not (
+            f.p_dup > 0.0 or f.flaky_dup > 0.0
+        ):
+            rep["flaky_dup"] = 0.5
+    skews = [a for a in atoms if a["kind"] == "skew"]
+    if skews:
+        tmax = max(a.get("timeout", 0) for a in skews)
+        if tmax > 0:
+            rep["timeout_skew"] = max(f.timeout_skew, tmax)
+        bmax = max(a.get("boff", 1) for a in skews)
+        if bmax > 1:
+            rep["backoff_skew"] = max(f.backoff_skew, bmax)
+    for k, v in knobs.items():
+        if k not in KNOB_WHITELIST:
+            raise ValueError(f"non-whitelisted fuzz knob: {k!r}")
+        rep[k] = v
+    fault = dataclasses.replace(f, **rep) if rep else f
+    return dataclasses.replace(base_cfg, seed=int(seed), fault=fault)
+
+
+class GuidedSource:
+    """Corpus-driven campaign source for the soak worker loop."""
+
+    def __init__(
+        self,
+        cfg: SimConfig,
+        params: Optional[FuzzParams] = None,
+        ticks_per_seed: int = 256,
+        log=None,
+    ) -> None:
+        from paxos_tpu.obs.exposure import ExposureConfig
+        from paxos_tpu.obs.margin import MarginConfig
+
+        if cfg.coverage is None:
+            raise ValueError(
+                "GuidedSource needs cfg.coverage on — new_bits IS the "
+                "fitness signal (pass a CoverageConfig)"
+            )
+        # Exposure and margin are forced on: the energy policy is defined
+        # in terms of effective-exposure weight and near-miss boost, and
+        # both planes are schedule-identical either way.
+        if cfg.exposure is None:
+            cfg = dataclasses.replace(cfg, exposure=ExposureConfig(counters=True))
+        if cfg.margin is None:
+            cfg = dataclasses.replace(cfg, margin=MarginConfig(counters=True))
+        self.cfg = cfg
+        self.params = params or FuzzParams()
+        self.ticks_per_seed = int(ticks_per_seed)
+        self.say = log or (lambda s: None)
+        self.dims = Dims(
+            n_inst=cfg.n_inst, n_acc=cfg.n_acc, n_prop=cfg.n_prop,
+            max_tick=self.ticks_per_seed,
+        )
+        self.corpus = Corpus()
+        self.scheduled = 0
+        self.finalized = 0
+        # (cfg, plan, entry_id) of violating campaigns — the shrink queue.
+        self.violating: list[tuple] = []
+        self._queue: list[int] = []  # entry ids with energy multiplicity
+        self._children: dict[int, int] = {}  # parent id -> children spawned
+        self._roots_pending: list[int] = []
+        from paxos_tpu.faults.injector import plan_to_atoms
+        from paxos_tpu.harness.run import init_plan
+
+        for i in range(max(self.params.seed_entries, 1)):
+            scfg = dataclasses.replace(cfg, seed=cfg.seed + i)
+            # Root entries record the config's OWN sampled plan as atoms
+            # (the mutation substrate) but dispatch with plan=None, so a
+            # root campaign is bit-identical to the plain-soak campaign
+            # for the same seed.
+            atoms = plan_to_atoms(init_plan(scfg), cfg.fault)
+            entry = self.corpus.add(seed=scfg.seed, atoms=atoms, root=True)
+            self._roots_pending.append(entry.entry_id)
+
+    # -- campaign source protocol ---------------------------------------
+    def next_campaign(self):
+        from paxos_tpu.harness.soak import CampaignSpec
+
+        if self.scheduled >= self.params.campaigns:
+            return None
+        self.scheduled += 1
+        if self._roots_pending:
+            entry = self.corpus.get(self._roots_pending.pop(0))
+        else:
+            parent = self._next_parent()
+            entry = self._spawn_child(parent)
+        ccfg = campaign_config(
+            self.cfg, entry.seed, entry.atoms, entry.knobs
+        )
+        plan = None
+        if not entry.root:
+            from paxos_tpu.faults.injector import atoms_to_plan
+
+            plan = atoms_to_plan(
+                entry.atoms, self.cfg.n_inst, self.cfg.n_acc,
+                self.cfg.n_prop, cfg=ccfg.fault,
+            )
+        return CampaignSpec(
+            cfg=ccfg, plan=plan, meta={"entry_id": entry.entry_id}
+        )
+
+    def feedback(self, spec, report, seed_rec) -> None:
+        entry = self.corpus.get(spec.meta["entry_id"])
+        exp = report.get("exposure")
+        classes = exp.get("classes") if isinstance(exp, dict) else None
+        fit = self.corpus.record(
+            entry,
+            new_bits=seed_rec.get("new_bits", 0),
+            classes=classes,
+            min_quorum_slack=seed_rec.get("min_quorum_slack"),
+            fingerprint=spec.cfg.fingerprint(),
+            violations=report["violations"],
+        )
+        self.finalized += 1
+        if report["violations"]:
+            self.violating.append((spec.cfg, spec.plan, entry.entry_id))
+        if classes is not None and exposure_weight(entry.atoms, classes) == 0.0:
+            # Zero energy, permanently: the entry's chaos never touched
+            # the protocol, so whatever bits it set are baseline dynamics
+            # any entry would have bought.
+            self.corpus.retire(entry, "vacuous")
+            self.say(f"entry {entry.entry_id}: vacuous (retired)")
+        if entry.parent is not None:
+            parent = self.corpus.get(entry.parent)
+            if seed_rec.get("new_bits", 0) < self.params.plateau_min_new:
+                parent.stale += 1
+                if parent.stale >= self.params.plateau_seeds:
+                    self.corpus.retire(parent, "plateau")
+                    self.say(
+                        f"entry {parent.entry_id}: plateaued after "
+                        f"{parent.stale} low-yield children (retired)"
+                    )
+            else:
+                parent.stale = 0
+
+    # -- energy ----------------------------------------------------------
+    def _spawn_child(self, parent: CorpusEntry) -> CorpusEntry:
+        child_idx = self._children.get(parent.entry_id, 0)
+        self._children[parent.entry_id] = child_idx + 1
+        # Stream discipline: one registered stream per (rng seed, parent
+        # entry), forked per child — reordering campaigns never changes
+        # what mutations a given (parent, child_idx) pair draws.
+        rng = entry_stream(
+            self.params.rng_seed, parent.entry_id
+        ).fork(child_idx)
+        atoms, knobs, ops = mutate(
+            rng, parent.atoms, parent.knobs, self.dims,
+            n_ops=self.params.mutations,
+            base_corrupt=self.cfg.fault.p_corrupt,
+        )
+        return self.corpus.add(
+            seed=parent.seed, atoms=atoms, knobs=knobs,
+            parent=parent.entry_id, ops=ops,
+        )
+
+    def _refill(self) -> None:
+        pool = [e for e in self.corpus.alive() if e.fitness > 0]
+        if pool:
+            mean = sum(e.fitness for e in pool) / len(pool)
+            queue: list[int] = []
+            for e in sorted(pool, key=lambda e: (-e.fitness, e.entry_id)):
+                energy = max(
+                    1,
+                    min(self.params.energy_max, round(e.fitness / mean)),
+                )
+                queue.extend([e.entry_id] * energy)
+            self._queue = queue
+            return
+        # Nothing fit yet (all campaigns plateaued at zero new bits):
+        # keep exploring round-robin over whatever is not retired — the
+        # vacuous and plateaued stay excluded via the retired flag.
+        fallback = [e for e in self.corpus.entries if not e.retired]
+        self._queue = [e.entry_id for e in fallback]
+
+    def _next_parent(self) -> CorpusEntry:
+        for _ in range(2):
+            while self._queue:
+                e = self.corpus.get(self._queue.pop(0))
+                if not e.retired:
+                    return e
+            self._refill()
+        # Everything retired: deterministic last resort, lowest id.
+        return self.corpus.entries[0]
+
+    # -- reporting -------------------------------------------------------
+    def summary(self) -> dict[str, Any]:
+        entries = self.corpus.entries
+        return {
+            "campaigns": self.finalized,
+            "entries": len(entries),
+            "roots": sum(1 for e in entries if e.root),
+            "executed": sum(1 for e in entries if e.executed),
+            "retired": sum(1 for e in entries if e.retired),
+            "best_fitness": max((e.fitness for e in entries), default=0.0),
+            "journal_digest": self.corpus.digest(),
+        }

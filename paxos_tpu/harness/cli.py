@@ -268,6 +268,76 @@ def build_parser() -> argparse.ArgumentParser:
         "(default off; the per-seed throughput trend is recorded always)",
     )
 
+    fz = sub.add_parser(
+        "fuzz",
+        help="feedback-directed fuzzing: corpus-driven campaigns, coverage-"
+        "guided and exposure-weighted, through the soak worker loop",
+    )
+    fz.add_argument("--config", choices=sorted(CONFIGS), default="config2")
+    fz.add_argument(
+        "--engine", choices=["xla", "fused"], default="xla",
+        help="defaults to xla (the fuzzer's feedback loop is CPU-friendly "
+        "at small batches); fused needs a TPU, like soak",
+    )
+    fz.add_argument("--n-inst", type=int, default=None)
+    fz.add_argument(
+        "--fault", action="append", default=[], metavar="KEY=VALUE",
+        help="override any FaultConfig knob on the BASE config (repeatable); "
+        "mutated entries light additional knobs per their atoms",
+    )
+    fz.add_argument("--seed", type=int, default=0, help="first root entry seed")
+    fz.add_argument(
+        "--rng-seed", type=int, default=0,
+        help="mutation stream root (fuzz.mutate; independent of --seed so "
+        "the same corpus can be re-mutated differently)",
+    )
+    fz.add_argument(
+        "--campaigns", type=int, default=32,
+        help="total campaign budget — the unit a uniform soak comparison "
+        "must match (one campaign = one (config, seed, plan) run)",
+    )
+    fz.add_argument("--ticks-per-seed", type=int, default=256)
+    fz.add_argument("--chunk", type=int, default=64)
+    fz.add_argument(
+        "--pipeline-depth", type=int, default=1, metavar="K",
+        help="campaign overlap (soak's pipelining); default 1 so energy "
+        "decisions always see the previous campaign's feedback",
+    )
+    fz.add_argument(
+        "--coverage-words", type=int, default=64, metavar="W",
+        help="coverage sketch size in int32 words per lane (the plane is "
+        "always on under fuzz — new_bits IS the fitness signal)",
+    )
+    fz.add_argument(
+        "--seed-entries", type=int, default=2,
+        help="root corpus entries (base seed upward), run unmutated first",
+    )
+    fz.add_argument(
+        "--mutations", type=int, default=2,
+        help="atom mutations per child entry (fuzz.mutate ops)",
+    )
+    fz.add_argument(
+        "--energy-max", type=int, default=4,
+        help="per-refill cap on child campaigns per corpus entry",
+    )
+    fz.add_argument(
+        "--plateau-seeds", type=int, default=3, metavar="K",
+        help="retire a corpus entry after K consecutive low-yield children "
+        "(same detection as soak's cross-seed plateau)",
+    )
+    fz.add_argument(
+        "--plateau-min-new", type=int, default=1, metavar="B",
+        help="new-union-bits threshold a child must reach to reset its "
+        "parent's plateau counter",
+    )
+    fz.add_argument(
+        "--corpus-out", default=None, metavar="PATH",
+        help="write the corpus journal (JSONL, wall-clock-free, digest "
+        "line last) — two runs of the same command produce byte-identical "
+        "journals, the replay-determinism pin",
+    )
+    fz.add_argument("--log", default=None, help="JSONL metrics path")
+
     k = sub.add_parser(
         "shrink",
         help="delta-debug a violating config's fault plan to a minimal repro",
@@ -1240,6 +1310,154 @@ def cmd_soak(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_fuzz(args: argparse.Namespace) -> int:
+    """Corpus-driven guided campaigns; exit 2 on violations (repro shrunk).
+
+    Drives ``fuzz.schedule.GuidedSource`` through the same soak worker
+    loop as ``cmd_soak`` — one code path, two campaign sources.  On any
+    safety violation the violating campaign's plan is delta-debugged to a
+    minimal repro (``harness.shrink`` with the explicit plan) and the
+    repro rides the report margin- and exposure-annotated, exactly like a
+    ``shrink`` invocation would print.
+    """
+    import dataclasses
+
+    import jax
+
+    from paxos_tpu.fuzz.schedule import FuzzParams, GuidedSource
+    from paxos_tpu.harness.soak import soak
+
+    if args.engine == "fused" and jax.devices()[0].platform != "tpu":
+        print("error: --engine fused needs a TPU (the off-TPU interpreter is "
+              "far too slow for fuzz campaigns); use --engine xla",
+              file=sys.stderr)
+        return 1
+    try:
+        depth = config_mod.validate_pipeline_depth(args.pipeline_depth)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    kw = {"seed": args.seed}
+    if args.n_inst:
+        kw["n_inst"] = args.n_inst
+    cfg = CONFIGS[args.config](**kw)
+    try:
+        cfg = config_mod.apply_fault_overrides(cfg, args.fault)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    from paxos_tpu.obs.coverage import CoverageConfig
+
+    try:
+        cfg = dataclasses.replace(
+            cfg, coverage=CoverageConfig(words=args.coverage_words)
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    say = lambda s: print(f"# {s}", file=sys.stderr)  # noqa: E731
+    source = GuidedSource(
+        cfg,
+        FuzzParams(
+            campaigns=args.campaigns,
+            seed_entries=args.seed_entries,
+            mutations=args.mutations,
+            energy_max=args.energy_max,
+            plateau_seeds=args.plateau_seeds,
+            plateau_min_new=args.plateau_min_new,
+            rng_seed=args.rng_seed,
+        ),
+        ticks_per_seed=args.ticks_per_seed,
+        log=say,
+    )
+    from paxos_tpu.harness.metrics import MetricsLog
+
+    with MetricsLog(args.log) as mlog:
+        mlog.emit("start", config=args.config, mode="fuzz",
+                  fingerprint=source.cfg.fingerprint(), n_inst=cfg.n_inst,
+                  protocol=cfg.protocol, engine=args.engine,
+                  campaigns=args.campaigns, rng_seed=args.rng_seed)
+        report = soak(
+            source.cfg,
+            target_rounds=args.campaigns * cfg.n_inst * args.ticks_per_seed,
+            ticks_per_seed=args.ticks_per_seed,
+            chunk=args.chunk,
+            engine=args.engine,
+            log=say,
+            pipeline_depth=depth,
+            plateau_seeds=args.plateau_seeds,
+            plateau_min_new=args.plateau_min_new,
+            on_seed=lambda rec: mlog.emit("seed", **rec),
+            campaigns=source,
+        )
+        report["config"] = args.config
+        report["fuzz"] = source.summary()
+        if args.corpus_out:
+            digest = source.corpus.write_journal(args.corpus_out)
+            say(f"corpus journal: {args.corpus_out} (sha256 {digest[:16]})")
+        if "coverage" in report or "exposure" in report or "margin" in report:
+            from paxos_tpu.harness.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+            if "coverage" in report:
+                registry.ingest_coverage(report["coverage"])
+                registry.gauge(
+                    "coverage_plateau", float(report["coverage"]["plateau"])
+                )
+            if "exposure" in report:
+                from paxos_tpu.faults.injector import exposure_lit
+
+                registry.ingest_exposure(
+                    report["exposure"], lit=exposure_lit(source.cfg.fault)
+                )
+            if "margin" in report:
+                registry.ingest_margin(
+                    report["margin"], report.get("checker_complete")
+                )
+            mlog.emit("metrics", **registry.snapshot())
+        if report["violations"] and source.violating:
+            # Shrink the FIRST violating campaign (deterministic pick) to
+            # a minimal margin- and exposure-annotated repro — the fuzzer
+            # must hand back something replayable, not just a tally.
+            from paxos_tpu.harness.shrink import (
+                exposure_annotation,
+                margin_annotation,
+                replay,
+                shrink,
+            )
+
+            vcfg, vplan, eid = source.violating[0]
+            say(f"violation in corpus entry {eid} (seed {vcfg.seed}); "
+                "shrinking its plan")
+            result = shrink(
+                vcfg, max_ticks=args.ticks_per_seed, chunk=args.chunk,
+                engine=args.engine, log=say, plan=vplan,
+            )
+            if result is not None:
+                report["repro"] = {
+                    "entry": eid,
+                    "config_fingerprint": vcfg.fingerprint(),
+                    "seed": vcfg.seed,
+                    "replays": replay(vcfg, result),
+                    **result.to_json(),
+                    "margin": margin_annotation(vcfg, result),
+                    "exposure": exposure_annotation(vcfg, result),
+                }
+            mlog.emit("violation", violations=report["violations"],
+                      violating_seeds=report.get("violating_seeds"),
+                      entry=eid)
+        _warn_checker_incomplete(report)
+        mlog.emit("final", **report)
+    print(json.dumps(report))
+    if report["violations"]:
+        return 2
+    if "measurement_corrupted" in report:
+        print(f"error: seed {report['measurement_corrupted']} corrupted its "
+              "measurements (see stderr); tally truncated", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_audit(args: argparse.Namespace) -> int:
     """Static determinism audit: exit 0 clean, 2 on findings."""
     from paxos_tpu.analysis import run_audit
@@ -1415,9 +1633,15 @@ def _stats_render(
     if last_perf is not None:
         out["perf"] = last_perf
     if last_seed is not None:
+        # Observer-plane enrichments (new_bits / effective / min quorum
+        # slack) ride the seed events when soak runs with those planes on
+        # — corpus fitness is reconstructable from this stream alone.
         out["last_seed"] = {
             k: last_seed[k]
-            for k in ("seed", "wall_s", "rounds", "rounds_per_sec")
+            for k in (
+                "seed", "wall_s", "rounds", "rounds_per_sec",
+                "new_bits", "effective", "min_quorum_slack",
+            )
             if k in last_seed
         }
     return json.dumps(out), saw_final
@@ -2295,6 +2519,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_sweep(args)
     if args.cmd == "soak":
         return cmd_soak(args)
+    if args.cmd == "fuzz":
+        return cmd_fuzz(args)
     if args.cmd == "shrink":
         return cmd_shrink(args)
     if args.cmd == "check":

@@ -738,8 +738,15 @@ def run(
     liveness: bool = False,
     pipeline_depth: int = 1,
     spans=None,
+    plan=None,
 ):
     """Host loop: init, scan chunks, return the final report.
+
+    ``plan`` overrides the seed-sampled :class:`FaultPlan` (default
+    ``init_plan(cfg)``) — the replay/fuzz path: an explicit plan threads a
+    mutated or deserialized schedule through the same engine dispatch, and
+    for identical ``(cfg, plan)`` the device schedule is bit-identical to
+    the sampled path (the plan is a traced argument, never a compile key).
 
     With ``until_all_chosen`` the loop keeps scanning chunks until every
     instance's learner chose a value (or ``max_ticks``), the batch analog of
@@ -769,7 +776,8 @@ def run(
     depth = validate_pipeline_depth(pipeline_depth)
     check_tick_budget(cfg.protocol, max_ticks if until_all_chosen else total_ticks)
     state = init_state(cfg)
-    plan = init_plan(cfg)
+    if plan is None:
+        plan = init_plan(cfg)
     # Long-log Multi-Paxos (SURVEY.md §6.7): decided prefixes compact out of
     # the window at every chunk boundary (traced into the chunk's dispatch),
     # so HBM stays O(window) while the log grows to cfg.fault.log_total.

@@ -34,7 +34,12 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from paxos_tpu.faults.injector import NEVER, FaultPlan
+from paxos_tpu.faults.injector import (
+    NEVER,
+    FaultPlan,
+    atom_label,
+    plan_to_atoms,
+)
 from paxos_tpu.harness.config import SimConfig
 from paxos_tpu.harness.run import (
     init_plan,
@@ -95,6 +100,10 @@ class ShrinkResult:
             out["exposure"] = self.exposure
         if self.margin is not None:
             out["margin"] = self.margin
+        # The minimized plan itself, in the shared atom codec
+        # (faults.injector.plan_to_atoms): a shrunk repro is replayable
+        # from its JSON alone via atoms_to_plan + _violations_at.
+        out["plan_atoms"] = plan_to_atoms(self.plan)
         return out
 
 
@@ -175,25 +184,39 @@ def _lane_only(plan: FaultPlan, lane: int) -> FaultPlan:
 
 
 def _atom_removals(plan: FaultPlan, lane: int) -> list[tuple[str, Callable]]:
-    """(name, remover) for each live fault atom in ``lane``."""
-    n_acc = plan.equivocate.shape[0]
-    n_prop = plan.pcrash_start.shape[0]
+    """(name, remover) for each live fault atom in ``lane``.
+
+    Atom detection goes through the shared codec
+    (``faults.injector.plan_to_atoms``, zero baselines: any nonzero gray
+    value in the lane-isolated plan is a live atom) so the shrinker, the
+    repro JSON, and the fuzz mutator agree on what an atom IS; the
+    enumeration order below (equiv/crash interleaved per acceptor, then
+    proposer crashes, partition, asymmetry, links, skew) is the greedy
+    removal order earlier builds used and is kept for repro stability.
+    """
+    by_kind: dict[str, list] = {}
+    for atom in plan_to_atoms(plan):
+        if atom["lane"] == lane:
+            by_kind.setdefault(atom["kind"], []).append(atom)
+    acc_crash = {
+        a["idx"] for a in by_kind.get("crash", []) if a["role"] == "acceptor"
+    }
+    prop_crash = sorted(
+        a["idx"] for a in by_kind.get("crash", []) if a["role"] == "proposer"
+    )
+    equiv = {a["idx"] for a in by_kind.get("equiv", [])}
+    part = (by_kind.get("partition") or [None])[0]
     atoms: list[tuple[str, Callable]] = []
 
-    eq = jax.device_get(plan.equivocate[:, lane])
-    cs = jax.device_get(plan.crash_start[:, lane])
-    ps = jax.device_get(plan.pcrash_start[:, lane])
-    part = int(jax.device_get(plan.part_start[lane]))
-
-    for a in range(n_acc):
-        if bool(eq[a]):
+    for a in sorted(equiv | acc_crash):
+        if a in equiv:
             atoms.append((
                 f"equiv[acceptor={a}]",
                 lambda p, a=a: p.replace(
                     equivocate=p.equivocate.at[a, lane].set(False)
                 ),
             ))
-        if int(cs[a]) != NEVER:
+        if a in acc_crash:
             atoms.append((
                 f"crash[acceptor={a}]",
                 lambda p, a=a: p.replace(
@@ -201,16 +224,15 @@ def _atom_removals(plan: FaultPlan, lane: int) -> list[tuple[str, Callable]]:
                     crash_end=p.crash_end.at[a, lane].set(NEVER),
                 ),
             ))
-    for pr in range(n_prop):
-        if int(ps[pr]) != NEVER:
-            atoms.append((
-                f"crash[proposer={pr}]",
-                lambda p, pr=pr: p.replace(
-                    pcrash_start=p.pcrash_start.at[pr, lane].set(NEVER),
-                    pcrash_end=p.pcrash_end.at[pr, lane].set(NEVER),
-                ),
-            ))
-    if part != NEVER:
+    for pr in prop_crash:
+        atoms.append((
+            f"crash[proposer={pr}]",
+            lambda p, pr=pr: p.replace(
+                pcrash_start=p.pcrash_start.at[pr, lane].set(NEVER),
+                pcrash_end=p.pcrash_end.at[pr, lane].set(NEVER),
+            ),
+        ))
+    if part is not None:
         atoms.append((
             "partition",
             lambda p: p.replace(
@@ -221,64 +243,30 @@ def _atom_removals(plan: FaultPlan, lane: int) -> list[tuple[str, Callable]]:
     # Gray atoms: asymmetry -> symmetric, per-link rates -> zero, per-lane
     # timer skew -> neutral.  Each removal is independently revertible by
     # the greedy loop, so only load-bearing gray faults survive.
-    if plan.part_dir is not None and part != NEVER:
-        if int(jax.device_get(plan.part_dir[lane])) != 0:
-            atoms.append((
-                "asym-partition",
-                lambda p: p.replace(part_dir=p.part_dir.at[lane].set(0)),
-            ))
-    if plan.link_drop is not None:
-        ld = jax.device_get(plan.link_drop[:, :, lane])
-        lu = (
-            jax.device_get(plan.link_dup[:, :, lane])
-            if plan.link_dup is not None
-            else None
-        )
-        for pr in range(n_prop):
-            for a in range(n_acc):
-                live = int(ld[pr, a]) != 0 or (
-                    lu is not None and int(lu[pr, a]) != 0
-                )
-                if not live:
-                    continue
+    if part is not None and part["dir"] and plan.part_dir is not None:
+        atoms.append((
+            "asym-partition",
+            lambda p: p.replace(part_dir=p.part_dir.at[lane].set(0)),
+        ))
+    for link in by_kind.get("flaky", []):
 
-                def calm(p, pr=pr, a=a):
-                    p = p.replace(
-                        link_drop=p.link_drop.at[pr, a, lane].set(0)
-                    )
-                    if p.link_dup is not None:
-                        p = p.replace(
-                            link_dup=p.link_dup.at[pr, a, lane].set(0)
-                        )
-                    return p
+        def calm(p, pr=link["prop"], a=link["acc"]):
+            p = p.replace(link_drop=p.link_drop.at[pr, a, lane].set(0))
+            if p.link_dup is not None:
+                p = p.replace(link_dup=p.link_dup.at[pr, a, lane].set(0))
+            return p
 
-                atoms.append((f"flaky[link=({pr},{a})]", calm))
-    if plan.ptimeout is not None or plan.pboff is not None:
-        pt = (
-            jax.device_get(plan.ptimeout[:, lane])
-            if plan.ptimeout is not None
-            else None
-        )
-        pb = (
-            jax.device_get(plan.pboff[:, lane])
-            if plan.pboff is not None
-            else None
-        )
-        for pr in range(n_prop):
-            live = (pt is not None and int(pt[pr]) != 0) or (
-                pb is not None and int(pb[pr]) != 1
-            )
-            if not live:
-                continue
+        atoms.append((atom_label(link), calm))
+    for skw in by_kind.get("skew", []):
 
-            def unskew(p, pr=pr):
-                if p.ptimeout is not None:
-                    p = p.replace(ptimeout=p.ptimeout.at[pr, lane].set(0))
-                if p.pboff is not None:
-                    p = p.replace(pboff=p.pboff.at[pr, lane].set(1))
-                return p
+        def unskew(p, pr=skw["prop"]):
+            if p.ptimeout is not None:
+                p = p.replace(ptimeout=p.ptimeout.at[pr, lane].set(0))
+            if p.pboff is not None:
+                p = p.replace(pboff=p.pboff.at[pr, lane].set(1))
+            return p
 
-            atoms.append((f"skew[proposer={pr}]", unskew))
+        atoms.append((atom_label(skw), unskew))
     return atoms
 
 
@@ -289,6 +277,7 @@ def shrink(
     log: Optional[Callable[[str], None]] = None,
     engine: str = "xla",
     block: Optional[int] = None,
+    plan: Optional[FaultPlan] = None,
 ) -> Optional[ShrinkResult]:
     """Minimize ``cfg``'s sampled fault plan; None if no violation in budget.
 
@@ -297,9 +286,16 @@ def shrink(
     fused seed under the XLA stream explores a different schedule and may not
     reproduce — and ``block`` if the observing fused run used a non-default
     block size (see ``_violations_at``).
+
+    ``plan`` overrides the seed-sampled fault plan — the fuzz scheduler's
+    path, whose violating campaigns run mutated plans the seed alone
+    cannot reconstruct (``fuzz.schedule`` passes the campaign's decoded
+    atom plan here so the repro shrinks the schedule that actually
+    violated).
     """
     say = log or (lambda s: None)
-    plan = init_plan(cfg)
+    if plan is None:
+        plan = init_plan(cfg)
 
     viol = _violations_at(cfg, plan, max_ticks, chunk, engine, block)
     lanes = viol.nonzero()[0]

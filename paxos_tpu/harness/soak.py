@@ -22,6 +22,58 @@ from paxos_tpu.harness.config import SimConfig
 from paxos_tpu.harness.run import MeasurementCorrupted, check_tick_budget, run
 
 
+@dataclasses.dataclass
+class CampaignSpec:
+    """One schedulable campaign for the shared soak worker loop.
+
+    ``cfg`` is the concrete config (seed included); ``plan`` is an
+    explicit fault plan (``None`` = sample from the config seed, the
+    plain-soak path — a non-None plan is the fuzz scheduler threading a
+    mutated schedule through the same loop).  ``meta`` is scheduler-
+    private (e.g. the corpus entry id) and is handed back untouched via
+    ``feedback``.
+    """
+
+    cfg: SimConfig
+    plan: Optional[Any] = None
+    meta: Optional[dict] = None
+
+
+class RotatingSeeds:
+    """Default campaign source: ``cfg.seed + i`` until ``target_rounds``
+    accumulate — exactly the pre-fuzz soak schedule (the planning gate is
+    ``planned * campaign_rounds < target_rounds``, dispatching one final
+    campaign whose tail rounds overshoot the target, as before).
+
+    A campaign source is anything with this shape: ``next_campaign()``
+    returning a :class:`CampaignSpec` or ``None`` (no more work), and
+    ``feedback(spec, report, seed_rec)`` called once per finalized
+    campaign, after the tally (under pipelining, one campaign behind the
+    dispatch — the fuzz CLI defaults to depth 1 for fresh feedback).
+    """
+
+    def __init__(self, cfg: SimConfig, target_rounds: float,
+                 campaign_rounds: int):
+        self.cfg = cfg
+        self.target_rounds = target_rounds
+        self.campaign_rounds = campaign_rounds
+        self.planned = 0
+
+    def next_campaign(self) -> Optional[CampaignSpec]:
+        if self.planned * self.campaign_rounds >= self.target_rounds:
+            return None
+        spec = CampaignSpec(
+            cfg=dataclasses.replace(
+                self.cfg, seed=self.cfg.seed + self.planned
+            )
+        )
+        self.planned += 1
+        return spec
+
+    def feedback(self, spec, report, seed_rec) -> None:
+        pass
+
+
 def _retry_schedule(
     transient_retries: int, base_s: float = 5.0, cap_s: float = 60.0
 ) -> list[float]:
@@ -99,6 +151,7 @@ def soak(
     plateau_stop: bool = False,
     vacuous_seeds: int = 3,
     on_seed: Optional[Callable[[dict], None]] = None,
+    campaigns: Optional[Any] = None,
 ) -> dict[str, Any]:
     """Run campaigns over rotating seeds until ``target_rounds`` accumulate.
 
@@ -106,6 +159,16 @@ def soak(
     place engine dispatch lives).  Returns a report with total
     instance-rounds, violations, evictions, seeds exhausted, and throughput.
     ``cfg.seed`` is the first seed; campaign ``i`` uses ``seed + i``.
+
+    **Campaign source (``campaigns``):** the worker loop pulls its work
+    from a campaign source (:class:`RotatingSeeds` protocol) — ``None``
+    (the default) is the rotating-seed source above, bit-identical to the
+    pre-source loop; the fuzz scheduler (``paxos_tpu.fuzz.schedule``)
+    passes its corpus-driven source so ``paxos_tpu fuzz`` and plain
+    ``soak`` execute campaigns through this one code path.  A spec's
+    explicit ``plan`` rides through dispatch, serial replay, and eviction
+    rechecks; ``feedback`` fires once per finalized campaign after its
+    seed record (coverage/exposure/margin enrichments included) lands.
 
     **Dispatch pipelining (``pipeline_depth > 1``):** campaigns overlap by
     one — seed N+1's fault plan is sampled, its state initialized, and all
@@ -270,16 +333,20 @@ def soak(
     recheck_mark = 0
     corrupted_seed: Optional[int] = None
 
-    def serial_campaign(rcfg):
+    def serial_campaign(rcfg, plan=None):
         # Module-global `run` on purpose: tests monkeypatch soak.run to
         # model transient backend failures, and retries must hit the patch.
+        # The explicit-plan kwarg is only passed when a campaign source
+        # supplied one, so plain-soak replays keep the exact historical
+        # call (and monkeypatched fakes keep their signature).
+        kw = {} if plan is None else {"plan": plan}
         return run(
             rcfg, total_ticks=ticks_per_seed, chunk=chunk,
             engine=engine, liveness=True, pipeline_depth=depth,
-            spans=spans,
+            spans=spans, **kw,
         )
 
-    def dispatch_campaign(scfg):
+    def dispatch_campaign(spec):
         """Enqueue one whole campaign without blocking; returns the async
         report handle (or None if dispatch itself failed — the finalizer
         then replays serially under the retry budget)."""
@@ -293,10 +360,11 @@ def soak(
             make_longlog,
         )
 
+        scfg = spec.cfg
         try:
             with sp.span("campaign_dispatch", seed=scfg.seed):
                 state = init_state(scfg)
-                plan = init_plan(scfg)
+                plan = spec.plan if spec.plan is not None else init_plan(scfg)
                 adv = make_advance_grouped(
                     scfg, plan, engine, compact=bool(make_longlog(scfg))
                 )
@@ -314,43 +382,51 @@ def soak(
                 "replaying serially")
             return None
 
-    def finalize(scfg, handle):
+    def finalize(spec, handle):
         """Block on an async campaign's report.  A transient failure while
         draining it falls back to a serial replay — exact, campaigns being
-        deterministic in (config, seed) — under the normal retry budget."""
+        deterministic in (config, seed[, plan]) — under the normal retry
+        budget."""
         attempt = {"n": 0}
 
         def run_fn():
             attempt["n"] += 1
             if attempt["n"] == 1 and handle is not None:
                 return handle.get()
-            return serial_campaign(scfg)
+            return serial_campaign(spec.cfg, spec.plan)
 
-        with sp.span("campaign_finalize", seed=scfg.seed):
+        with sp.span("campaign_finalize", seed=spec.cfg.seed):
             return _run_with_retries(
                 run_fn, say, transient_retries, retry_backoff_s, spans=spans
             )
 
-    # Overlap-by-one campaign loop: `planned` counts dispatched campaigns
-    # (runs one ahead of `seeds` when pipelined), `pending` is the campaign
-    # currently executing on-device.  Serial mode (depth 1) dispatches and
-    # finalizes in the same iteration — the exact pre-pipeline loop.
+    # Overlap-by-one campaign loop: the source plans campaigns (one ahead
+    # of `seeds` when pipelined), `pending` is the campaign currently
+    # executing on-device.  Serial mode (depth 1) dispatches and finalizes
+    # in the same iteration — the exact pre-pipeline loop.
     overlap = depth > 1
     campaign_rounds = cfg.n_inst * ticks_per_seed
-    planned = 0
+    source = (
+        campaigns
+        if campaigns is not None
+        else RotatingSeeds(cfg, target_rounds, campaign_rounds)
+    )
+    cov_discarded: Optional[int] = None
     pending: "Optional[tuple]" = None
-    while rounds < target_rounds or pending is not None:
+    while True:
         nxt = None
-        if planned * campaign_rounds < target_rounds:
-            scfg = dataclasses.replace(cfg, seed=cfg.seed + planned)
-            planned += 1
-            nxt = (scfg, dispatch_campaign(scfg) if overlap else None)
+        spec = source.next_campaign()
+        if spec is not None:
+            nxt = (spec, dispatch_campaign(spec) if overlap else None)
         fin, pending = (pending, nxt) if overlap else (nxt, None)
         if fin is None:
+            if spec is None and pending is None:
+                break
             continue
-        fscfg, handle = fin
+        fspec, handle = fin
+        fscfg = fspec.cfg
         try:
-            report, used = finalize(fscfg, handle)
+            report, used = finalize(fspec, handle)
         except MeasurementCorrupted as e:
             # One seed's measurements went untrustworthy (e.g. packed-MP
             # ballot overflow): stop the campaign loop but KEEP the tally
@@ -372,7 +448,7 @@ def soak(
                     f"rechecking at k_slots={k}")
                 rcfg = dataclasses.replace(fscfg, k_slots=k)
                 report, used = _run_with_retries(
-                    lambda: serial_campaign(rcfg),
+                    lambda: serial_campaign(rcfg, fspec.plan),
                     say, transient_retries, retry_backoff_s, spans=spans,
                 )
                 retries_used += used
@@ -409,19 +485,20 @@ def soak(
             "rounds": seed_rounds,
             "rounds_per_sec": round(seed_rounds / seed_wall, 1),
         }
-        per_seed.append(seed_rec)
-        seed_mark = now
-        recheck_mark = recheck_rounds
-        if on_seed is not None:
-            on_seed(seed_rec)
-        say(f"seed {fscfg.seed}: {rounds:.3e} rounds, {violations} violations, "
-            f"{report['stuck_lanes']} stuck, "
-            f"{seed_rec['rounds_per_sec']:.3g} rounds/s")
+        # Observer-plane enrichments land in the seed record BEFORE it is
+        # appended/streamed, so corpus fitness (fuzz.corpus) is
+        # reconstructable from the JSONL `seed` event stream alone:
+        # coverage -> new_bits, exposure -> per-class effective totals,
+        # margin -> min quorum slack.  With the planes off (the default)
+        # the record keeps its exact historical four keys.
         exp = report.get("exposure")
         if exp is not None:
             from paxos_tpu.faults.injector import exposure_lit
             from paxos_tpu.obs.exposure import CLASSES
 
+            seed_rec["effective"] = {
+                n: row["effective"] for n, row in exp["classes"].items()
+            }
             if exp_classes is None:
                 exp_classes = {
                     n: {"injected": 0, "effective": 0, "lanes_exposed": 0}
@@ -443,6 +520,7 @@ def soak(
                     exp_vacuous_warned = True
         mar = report.get("margin")
         if mar is not None:
+            seed_rec["min_quorum_slack"] = mar["min_quorum_slack"]
             mar_rows.append({"seed": fscfg.seed, **mar})
         cov = report.get("coverage")
         if cov is not None:
@@ -452,16 +530,35 @@ def soak(
             cov_union_bits += new_bits
             cov_per_seed.append(cov["bits_set"])
             cov_curve.append(new_bits)
+            seed_rec["new_bits"] = new_bits
             cov_below = cov_below + 1 if new_bits < plateau_min_new else 0
             if cov_below >= plateau_seeds and not cov_plateau:
                 cov_plateau = True
                 say(f"coverage plateau: {cov_below} consecutive seeds under "
                     f"{plateau_min_new} new bits ({cov_union_bits} total)")
-            if cov_plateau and plateau_stop:
-                # Stop like the corrupted path: keep the tally, drop an
-                # in-flight next campaign unfinalized.
-                cov_stopped = True
-                break
+        per_seed.append(seed_rec)
+        seed_mark = now
+        recheck_mark = recheck_rounds
+        if on_seed is not None:
+            on_seed(seed_rec)
+        say(f"seed {fscfg.seed}: {rounds:.3e} rounds, {violations} violations, "
+            f"{report['stuck_lanes']} stuck, "
+            f"{seed_rec['rounds_per_sec']:.3g} rounds/s")
+        source.feedback(fspec, report, seed_rec)
+        if cov_plateau and plateau_stop:
+            # Stop like the corrupted path: keep the tally from finalized
+            # seeds.  A pipelined loop has an in-flight next campaign that
+            # cannot be kept without out-running the stop condition — it
+            # is discarded unfinalized, but EXPLICITLY: the discarded seed
+            # and the stop reason land in the report (coverage block) and
+            # on stderr instead of vanishing silently.
+            cov_stopped = True
+            if pending is not None:
+                cov_discarded = pending[0].cfg.seed
+                say(f"plateau stop: discarding in-flight seed "
+                    f"{cov_discarded} unfinalized (its rounds are not in "
+                    "the tally)")
+            break
     dt = time.perf_counter() - t0
     replication: dict[str, Any] = {}
     if rep_rates:
@@ -498,6 +595,12 @@ def soak(
             "plateau_seeds": plateau_seeds,
             "plateau_min_new": plateau_min_new,
             "stopped_early": cov_stopped,
+            # Why the loop ended early and what it cost: a plateau stop
+            # under pipelining discards the one in-flight campaign
+            # unfinalized (its seed recorded here; None when nothing was
+            # in flight or the loop ran to target).
+            "stop_reason": "coverage_plateau" if cov_stopped else None,
+            "discarded_seed": cov_discarded,
         }
     if exp_classes is not None:
         from paxos_tpu.obs.exposure import annotate_lit

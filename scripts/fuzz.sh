@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Feedback-directed fuzzing wrapper: corpus-driven campaigns scheduled
+# AFL-style over the soak worker loop — coverage new_bits weighted by
+# effective fault exposure and boosted by near-miss margins decide which
+# entries earn mutation energy (paxos_tpu/fuzz/).  One report on stdout;
+# --corpus-out records the wall-clock-free corpus journal (two runs of
+# the same command are byte-identical — the replay-determinism pin).
+# Exits 2 on safety violations, with the violating campaign's plan
+# shrunk to a minimal margin- and exposure-annotated repro in the report.
+#
+# Usage: scripts/fuzz.sh [paxos_tpu fuzz flags...]
+#   scripts/fuzz.sh --config config2 --campaigns 64 --corpus-out corpus.jsonl
+#   scripts/fuzz.sh --config gray-chaos --n-inst 4096 --ticks-per-seed 256
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m paxos_tpu fuzz "$@"

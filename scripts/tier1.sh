@@ -326,4 +326,48 @@ assert 0.0 <= p["occupancy"] <= 1.0, p["occupancy"]
 assert {"p50", "p95", "p99"} <= set(p["chunk_latency_us"]), p
 EOF
 fi
+# Feedback-directed fuzzing smoke (fuzz subcommand + paxos_tpu/fuzz/):
+# (a) two identical guided runs must write byte-identical corpus journals
+# (replay determinism — the journal is wall-clock-free by construction);
+# (b) at an EQUAL campaign budget the guided scheduler's cross-seed
+# coverage union must strictly exceed uniform rotating-seed sampling's;
+# (c) a fuzz run over a violating config must exit 2 with the repro
+# shrunk, replay-verified, and margin- + exposure-annotated.
+if [ "$rc" -eq 0 ]; then
+  fj1=/tmp/_t1_fz1.jsonl; fj2=/tmp/_t1_fz2.jsonl
+  fr=/tmp/_t1_fuzz.json; ur=/tmp/_t1_uni.json; vr=/tmp/_t1_fzv.json
+  rm -f "$fj1" "$fj2" "$fr" "$ur" "$vr"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxos_tpu fuzz \
+    --config config1 --n-inst 64 --campaigns 6 --ticks-per-seed 32 \
+    --chunk 16 --coverage-words 64 --corpus-out "$fj1" >"$fr" 2>/dev/null \
+  && timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxos_tpu fuzz \
+    --config config1 --n-inst 64 --campaigns 6 --ticks-per-seed 32 \
+    --chunk 16 --coverage-words 64 --corpus-out "$fj2" >/dev/null 2>&1 \
+  && cmp -s "$fj1" "$fj2" \
+  && timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxos_tpu soak \
+    --config config1 --n-inst 64 --engine xla --target-rounds 12288 \
+    --ticks-per-seed 32 --chunk 16 --pipeline-depth 1 --coverage \
+    --coverage-words 64 >"$ur" 2>/dev/null \
+  && { timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxos_tpu fuzz \
+         --config corrupt --n-inst 128 --campaigns 2 --ticks-per-seed 64 \
+         --chunk 32 >"$vr" 2>/dev/null; [ "$?" -eq 2 ]; } \
+  && timeout -k 10 30 env JAX_PLATFORMS=cpu python - "$fr" "$ur" "$vr" <<'EOF' \
+  && echo FUZZ_SMOKE=ok || { echo FUZZ_SMOKE=FAILED; rc=1; }
+import json, sys
+fuzz = json.load(open(sys.argv[1]))
+uni = json.load(open(sys.argv[2]))
+vio = json.load(open(sys.argv[3]))
+# Equal budget: 6 guided campaigns vs 6 uniform rotating seeds.
+assert fuzz["fuzz"]["campaigns"] == 6 and uni["seeds"] == 6, (
+    fuzz["fuzz"], uni["seeds"])
+gb, ub = fuzz["coverage"]["bits_set"], uni["coverage"]["bits_set"]
+assert gb > ub, f"guided union {gb} must strictly exceed uniform {ub}"
+assert fuzz["violations"] == 0, fuzz["violations"]
+rep = vio.get("repro")
+assert vio["violations"] > 0 and rep, "violating fuzz run carried no repro"
+assert rep["replays"] is True, rep
+assert "plan_atoms" in rep and "margin" in rep and "exposure" in rep, rep
+assert rep["margin"]["min_quorum_slack"] == 0, rep["margin"]
+EOF
+fi
 exit $rc
