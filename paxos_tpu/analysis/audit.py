@@ -16,11 +16,16 @@ from typing import Iterable, Optional
 @dataclasses.dataclass(frozen=True)
 class Finding:
     """One audit violation — ``message`` must name the offending stream /
-    primitive / leaf / file so the fix needs no re-tracing to locate."""
+    primitive / leaf / file so the fix needs no re-tracing to locate.
 
-    check: str  # e.g. "stream-collision", "purity", "structure-golden"
+    ``data`` optionally carries the same facts structured (source leaf,
+    sink, primitive, ...) for machine consumers of ``audit --json`` —
+    the bench/fleet gates parse it instead of regexing ``message``."""
+
+    check: str  # e.g. "stream-collision", "purity", "flow-observer"
     where: str  # "protocol/config trace" or "file:line"
     message: str
+    data: Optional[dict] = None
 
     def __str__(self) -> str:
         return f"[{self.check}] {self.message}"
@@ -75,6 +80,7 @@ def run_audit(
     :mod:`paxos_tpu.analysis.structure`).  ``lint`` runs the AST pass
     over the traced packages (once, not per cell).
     """
+    from paxos_tpu.analysis import flow as flow_mod
     from paxos_tpu.analysis import prng_audit, purity, structure as struct_mod
     from paxos_tpu.analysis import trace as trace_mod
 
@@ -123,7 +129,18 @@ def run_audit(
             findings += purity.audit_jaxpr_purity(
                 f"{protocol}/{config_name} fused tick", ctr
             )
-            checks += 6
+            # Dataflow non-interference theorems (analysis/flow.py) are
+            # ALWAYS on: a leaked observer value or an off-site fault knob
+            # is a silent corruption of every campaign, not a release-gate
+            # concern.  Same for the eqn-count budget — silent trace
+            # blowup taxes every compile and every tick.
+            findings += flow_mod.audit_flow(
+                protocol, config_name, cfg, xla, ctr
+            )
+            findings += flow_mod.audit_eqn_budget(
+                protocol, config_name, xla, ctr
+            )
+            checks += 8
             if structure:
                 findings += struct_mod.audit_default_off_leaves(
                     protocol, config_name, cfg

@@ -1,0 +1,885 @@
+"""Dataflow non-interference auditor: taint analysis over traced jaxprs.
+
+The repo's central contract — observers never influence protocol behavior,
+faults act only through their declared injection sites, lanes are
+independent — has so far been enforced *dynamically*, by bit-identical
+golden schedule digests at a handful of pinned configs.  This pass makes
+the contract *static*: a dataflow proof over the closed jaxprs of every
+(protocol, config) audit cell that holds for all inputs, not just the
+sampled ones.  Three always-on theorems:
+
+1. **Observer non-interference** — taint seeded at the telemetry /
+   coverage / exposure / margin Optional leaves of the step input must
+   never reach a protocol-state output or any PRNG-consuming eqn.
+   Observer leaves may flow into observer outputs (that's their job).
+2. **Fault-channel confinement** — taint seeded at every ``FaultPlan``
+   leaf may reach protocol state only through a *registered injection
+   site*: a ``faults.injector.fault_site(name)`` scope whose name is
+   registered (with the matching fault channel) either globally in
+   ``injector.INJECTOR_FAULT_SITES`` or in the owning protocol's
+   ``*_FAULT_SITES`` table (core/*state.py).  Plan leaves reaching
+   observer outputs (exposure counts faults; telemetry records them) are
+   legitimate and exempt.
+3. **Lane independence** — every eqn touching lane-indexed state (any
+   leaf whose trailing axis is the instance axis) must preserve that
+   axis elementwise/slice/broadcast-wise; cross-lane reductions are
+   accepted only under a ``kernels.quorum.lane_reduce(name)`` scope with
+   ``name`` in :data:`LANE_REDUCE_SITES`.
+
+Plus a checker-isolation corollary of (1): taint seeded at the learner
+(checker) leaves must not reach non-learner protocol state — the checker
+observes, it must not steer.  Multi-Paxos is exempt by design: its
+leader lease legitimately consumes ``learner.chosen`` counts
+(protocols/multipaxos.py ``chosen_count`` -> lease/progress logic).
+
+Sites and allowlists are ``jax.named_scope`` tags — metadata riding each
+eqn's ``source_info.name_stack``, zero device ops, schedules stay
+bit-identical (the goldens pin this).  Findings name the source leaf, the
+sink, and the offending primitive with its file:line, in the PR 4
+auditor's reporting style.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import jax
+
+from paxos_tpu.analysis.audit import Finding
+from paxos_tpu.analysis.jaxpr_tools import Literal, is_prng_eqn
+from paxos_tpu.faults.injector import INJECTOR_FAULT_SITES
+
+# Leaf-path prefixes of the observer planes (theorem 1 seeds; also the
+# exempt sinks for theorems 1 and 2 — observers may read anything).
+OBSERVER_PREFIXES = ("telemetry.", "coverage.", "exposure.", "margin.")
+
+# Leaf-path prefix of the safety checker's state (checker-isolation seeds).
+CHECKER_PREFIX = "learner."
+
+# Protocols whose checker legitimately feeds protocol logic (see module
+# docstring) — checker-isolation is skipped there, the other theorems run.
+CHECKER_EXEMPT = ("multipaxos",)
+
+# FaultPlan leaf -> fault channel.  A registered site absorbs exactly its
+# declared channels, so e.g. the skew site cannot launder a crash window.
+PLAN_CHANNELS = {
+    "crash_start": "crash",
+    "crash_end": "crash",
+    "pcrash_start": "crash",
+    "pcrash_end": "crash",
+    "equivocate": "equiv",
+    "part_start": "partition",
+    "part_end": "partition",
+    "aside": "partition",
+    "pside": "partition",
+    "part_dir": "partition",
+    "link_drop": "flaky",
+    "link_dup": "flaky",
+    "ptimeout": "skew",
+    "pboff": "skew",
+}
+
+# Allowlisted cross-lane reduction regions (kernels.quorum.lane_reduce
+# tags).  "summarize" = report reductions (harness/run.py), "quorum" =
+# future cross-lane quorum-system merges (ROADMAP item 1),
+# "coverage_union" = the union Bloom filter (obs/coverage.py).
+LANE_REDUCE_SITES = frozenset({"summarize", "quorum", "coverage_union"})
+
+_SITE_RE = re.compile(r"__fault_site__([A-Za-z0-9_]+?)(?:/|$)")
+_LANE_RE = re.compile(r"__lane_ok__([A-Za-z0-9_]+?)(?:/|$)")
+
+# Elementwise (shape-preserving, lane-preserving) primitives seen across
+# the audit matrix plus common neighbors.  An unlisted primitive touching
+# lane-indexed data is a finding — extend deliberately, not defensively.
+_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "rem", "pow", "integer_pow", "max", "min",
+    "and", "or", "xor", "not", "neg", "sign", "abs", "exp", "exp2", "log",
+    "log1p", "expm1", "tanh", "logistic", "sqrt", "rsqrt", "cbrt", "floor",
+    "ceil", "round", "eq", "ne", "lt", "le", "gt", "ge", "select_n",
+    "convert_element_type", "bitcast_convert_type", "shift_left",
+    "shift_right_logical", "shift_right_arithmetic", "population_count",
+    "clz", "clamp", "stop_gradient", "copy", "nextafter", "is_finite",
+    "erf", "erf_inv", "erfc", "sin", "cos", "atan2", "square",
+    "reduce_precision", "real", "imag",
+})
+
+_REDUCES = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_and", "reduce_or",
+    "reduce_prod", "reduce_xor", "argmax", "argmin", "reduce",
+})
+
+_CUMULATIVE = frozenset({
+    "cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp",
+})
+
+# Call-like higher-order primitives: one inner jaxpr, invars/outvars 1:1.
+_CALL_PRIMS = frozenset({
+    "pjit", "closed_call", "core_call", "remat", "checkpoint", "custom_jvp_call",
+    "custom_vjp_call", "custom_jvp_call_jaxpr",
+})
+
+
+def _src(eqn) -> str:
+    """"file:line (function)" for an eqn, via jax's own summarizer."""
+    try:
+        from jax._src import source_info_util
+
+        return source_info_util.summarize(eqn.source_info)
+    except Exception:
+        return "<unknown source>"
+
+
+def _scopes(eqn) -> "tuple[tuple[str, ...], tuple[str, ...]]":
+    """(fault-site names, lane-ok names) tagged on this eqn's name stack."""
+    try:
+        stack = str(eqn.source_info.name_stack)
+    except Exception:
+        return (), ()
+    return (
+        tuple(_SITE_RE.findall(stack)),
+        tuple(_LANE_RE.findall(stack)),
+    )
+
+
+def _call_jaxpr(eqn):
+    """The inner jaxpr of a call-like eqn (invars/outvars map 1:1)."""
+    inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+    if inner is None:
+        return None
+    return inner.jaxpr if hasattr(inner, "jaxpr") else inner
+
+
+def fault_sites(protocol: str) -> "dict[str, frozenset[str]]":
+    """Registered site name -> absorbable channels for ``protocol``."""
+    if protocol == "paxos":
+        from paxos_tpu.core.state import PAXOS_FAULT_SITES as table
+    elif protocol == "multipaxos":
+        from paxos_tpu.core.mp_state import MP_FAULT_SITES as table
+    elif protocol == "fastpaxos":
+        from paxos_tpu.core.fp_state import FP_FAULT_SITES as table
+    elif protocol == "raftcore":
+        from paxos_tpu.core.raft_state import RAFT_FAULT_SITES as table
+    else:
+        raise ValueError(f"unknown protocol {protocol!r}")
+    merged = dict(INJECTOR_FAULT_SITES)
+    merged.update(table)
+    return {name: frozenset(chans) for name, chans in merged.items()}
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Label:
+    """One taint mark: ``kind`` in {obs, fault, checker}, the source
+    ``leaf`` path it was seeded at, and (fault only) its ``channel``."""
+
+    kind: str
+    leaf: str
+    channel: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class FlowSpec:
+    """Everything the engines need to know about one traced step program:
+    the invar layout ([state leaves..., middle leaves..., plan leaves...]),
+    the leaf paths, the lane width, and the protocol's site registry."""
+
+    protocol: str
+    state_paths: "tuple[str, ...]"
+    plan_paths: "tuple[str, ...]"
+    n_inst: int
+    sites: "dict[str, frozenset[str]]"
+    check_checker: bool = True
+
+
+def build_spec(protocol: str, cfg) -> FlowSpec:
+    """Spec for ``cfg``'s trace cell (leaf inventory from fresh templates)."""
+    from paxos_tpu.harness.run import init_plan, init_state
+    from paxos_tpu.utils import bitops
+
+    return FlowSpec(
+        protocol=protocol,
+        state_paths=tuple(bitops.leaf_paths(init_state(cfg))),
+        plan_paths=tuple(bitops.leaf_paths(init_plan(cfg))),
+        n_inst=cfg.n_inst,
+        sites=fault_sites(protocol),
+        check_checker=protocol not in CHECKER_EXEMPT,
+    )
+
+
+def _read(env, atom):
+    if isinstance(atom, Literal):
+        return frozenset()
+    return env.get(atom, frozenset())
+
+
+class _TaintEngine:
+    """Theorems 1, 2 and checker isolation: label propagation with
+    site absorption, then sink checks on the state outvars."""
+
+    def __init__(self, spec: FlowSpec, where: str):
+        self.spec = spec
+        self.where = where
+        self.findings: "list[Finding]" = []
+        self._seen: set = set()
+        self._bad_sites: set = set()
+
+    # -- finding helpers ---------------------------------------------------
+
+    def _emit(self, check: str, message: str, data: dict) -> None:
+        key = (check, data.get("source"), data.get("sink"))
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(
+            Finding(check=check, where=self.where, message=message, data=data)
+        )
+
+    def _unregistered(self, name: str, eqn) -> None:
+        if name in self._bad_sites:
+            return
+        self._bad_sites.add(name)
+        self.findings.append(
+            Finding(
+                check="flow-site",
+                where=self.where,
+                message=(
+                    f"{self.where}: fault_site tag {name!r} is not registered"
+                    f" for protocol {self.spec.protocol!r} (at {_src(eqn)})"
+                    " — add it to the protocol's *_FAULT_SITES table or"
+                    " injector.INJECTOR_FAULT_SITES"
+                ),
+                data={"site": name, "primitive": eqn.primitive.name},
+            )
+        )
+
+    # -- propagation -------------------------------------------------------
+
+    def _absorb(self, labels, site_names, eqn):
+        if not labels:
+            return labels
+        out = labels
+        for name in site_names:
+            chans = self.spec.sites.get(name)
+            if chans is None:
+                self._unregistered(name, eqn)
+                continue
+            out = frozenset(
+                l for l in out
+                if not (l.kind == "fault" and l.channel in chans)
+            )
+        return out
+
+    def run(self, closed) -> "list[Finding]":
+        jaxpr = closed.jaxpr
+        spec = self.spec
+        n_state, n_plan = len(spec.state_paths), len(spec.plan_paths)
+        env: dict = {}
+        producer: dict = {}
+        for i, v in enumerate(jaxpr.invars[:n_state]):
+            path = spec.state_paths[i]
+            if path.startswith(OBSERVER_PREFIXES):
+                env[v] = frozenset({Label("obs", path)})
+            elif spec.check_checker and path.startswith(CHECKER_PREFIX):
+                env[v] = frozenset({Label("checker", path)})
+        for i, v in enumerate(jaxpr.invars[len(jaxpr.invars) - n_plan:]):
+            path = spec.plan_paths[i]
+            env[v] = frozenset(
+                {Label("fault", path, PLAN_CHANNELS.get(path, "other"))}
+            )
+        self._walk(jaxpr, env, producer, frozenset())
+        self._check_sinks(jaxpr, env, producer)
+        return self.findings
+
+    def _walk(self, jaxpr, env, producer, inherited) -> None:
+        for eqn in jaxpr.eqns:
+            sites, _ = _scopes(eqn)
+            active = inherited | frozenset(sites)
+            prim = eqn.primitive.name
+            inner = _call_jaxpr(eqn) if prim in _CALL_PRIMS else None
+            if inner is not None and len(inner.invars) == len(eqn.invars):
+                sub_env: dict = {}
+                sub_prod: dict = {}
+                for ov, iv in zip(inner.invars, eqn.invars):
+                    sub_env[ov] = self._absorb(_read(env, iv), active, eqn)
+                self._walk(inner, sub_env, sub_prod, active)
+                for ov_out, ov_in in zip(eqn.outvars, inner.outvars):
+                    env[ov_out] = _read(sub_env, ov_in)
+                    producer[ov_out] = sub_prod.get(ov_in, eqn)
+                continue
+            if prim == "cond":
+                self._walk_cond(eqn, env, producer, active)
+                continue
+            if prim == "scan":
+                self._walk_fixpoint(
+                    eqn, env, producer, active,
+                    eqn.params["jaxpr"].jaxpr, eqn.params["num_carry"],
+                )
+                continue
+            if prim == "while":
+                self._walk_fixpoint(
+                    eqn, env, producer, active,
+                    eqn.params["body_jaxpr"].jaxpr, len(eqn.outvars),
+                    n_skip=eqn.params["cond_nconsts"]
+                    + eqn.params["body_nconsts"],
+                )
+                continue
+            # Default (covers every first-order primitive and any unmapped
+            # higher-order one, conservatively): union of input labels.
+            labels = frozenset().union(
+                *(_read(env, v) for v in eqn.invars)
+            ) if eqn.invars else frozenset()
+            labels = self._absorb(labels, active, eqn)
+            if labels and is_prng_eqn(eqn):
+                for l in sorted(labels):
+                    self._emit(
+                        "flow-prng",
+                        f"{self.where}: {l.kind} leaf {l.leaf!r} feeds"
+                        f" PRNG primitive {prim!r} at {_src(eqn)} — PRNG"
+                        " streams must not depend on"
+                        f" {'observer' if l.kind == 'obs' else l.kind}"
+                        " data",
+                        {
+                            "theorem": "prng",
+                            "source": l.leaf,
+                            "sink": f"prng:{prim}",
+                            "primitive": prim,
+                            "site": _src(eqn),
+                        },
+                    )
+            for ov in eqn.outvars:
+                env[ov] = labels
+                producer[ov] = eqn
+
+    def _walk_cond(self, eqn, env, producer, active) -> None:
+        joined: "list[frozenset]" = [
+            frozenset() for _ in eqn.outvars
+        ]
+        for branch in eqn.params["branches"]:
+            bj = branch.jaxpr if hasattr(branch, "jaxpr") else branch
+            sub_env = {}
+            for ov, iv in zip(bj.invars, eqn.invars[1:]):
+                sub_env[ov] = self._absorb(_read(env, iv), active, eqn)
+            self._walk(bj, sub_env, {}, active)
+            for i, ov_in in enumerate(bj.outvars):
+                joined[i] = joined[i] | _read(sub_env, ov_in)
+        pred = self._absorb(_read(env, eqn.invars[0]), active, eqn)
+        for ov, labels in zip(eqn.outvars, joined):
+            env[ov] = labels | pred
+            producer[ov] = eqn
+
+    def _walk_fixpoint(
+        self, eqn, env, producer, active, body, n_carry, n_skip=None
+    ) -> None:
+        """Label fixpoint over a scan/while carry (labels only grow, so
+        at most len(carry)+1 rounds)."""
+        ins = [self._absorb(_read(env, v), active, eqn) for v in eqn.invars]
+        if n_skip is None:  # scan: consts then carry then xs
+            n_consts = eqn.params["num_consts"]
+            pre, carry, xs = (
+                ins[:n_consts],
+                ins[n_consts:n_consts + n_carry],
+                ins[n_consts + n_carry:],
+            )
+        else:  # while: cond+body consts then carry
+            pre, carry, xs = ins[:n_skip], ins[n_skip:], []
+        for _ in range(len(carry) + 2):
+            sub_env = {}
+            for ov, labels in zip(body.invars, pre + carry + xs):
+                sub_env[ov] = labels
+            self._walk(body, sub_env, {}, active)
+            outs = [_read(sub_env, ov) for ov in body.outvars]
+            new_carry = [
+                c | o for c, o in zip(carry, outs[:len(carry)])
+            ]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        ys = outs[len(carry):] if n_skip is None else []
+        for ov, labels in zip(eqn.outvars, carry + ys):
+            env[ov] = labels
+            producer[ov] = eqn
+
+    # -- sinks -------------------------------------------------------------
+
+    def _check_sinks(self, jaxpr, env, producer) -> None:
+        spec = self.spec
+        for i, ov in enumerate(jaxpr.outvars):
+            if isinstance(ov, Literal) or i >= len(spec.state_paths):
+                continue
+            path = spec.state_paths[i]
+            if path.startswith(OBSERVER_PREFIXES):
+                continue  # observers may read anything
+            eqn = producer.get(ov)
+            via = (
+                f"produced by {eqn.primitive.name!r} at {_src(eqn)}"
+                if eqn is not None
+                else "passed through unchanged"
+            )
+            prim = eqn.primitive.name if eqn is not None else "<passthrough>"
+            site = _src(eqn) if eqn is not None else "<input>"
+            for l in sorted(_read(env, ov)):
+                if l.kind == "obs":
+                    self._emit(
+                        "flow-observer",
+                        f"{self.where}: observer leaf {l.leaf!r} reaches"
+                        f" protocol-state output {path!r} ({via}) —"
+                        " observers must not influence protocol behavior",
+                        {
+                            "theorem": "observer",
+                            "source": l.leaf,
+                            "sink": path,
+                            "primitive": prim,
+                            "site": site,
+                        },
+                    )
+                elif l.kind == "fault":
+                    self._emit(
+                        "flow-fault",
+                        f"{self.where}: fault-plan leaf {l.leaf!r}"
+                        f" (channel {l.channel!r}) reaches protocol-state"
+                        f" output {path!r} outside any registered"
+                        f" injection site ({via})",
+                        {
+                            "theorem": "fault",
+                            "source": l.leaf,
+                            "sink": path,
+                            "channel": l.channel,
+                            "primitive": prim,
+                            "site": site,
+                        },
+                    )
+                elif l.kind == "checker" and not path.startswith(
+                    CHECKER_PREFIX
+                ):
+                    self._emit(
+                        "flow-checker",
+                        f"{self.where}: checker leaf {l.leaf!r} reaches"
+                        f" protocol-state output {path!r} ({via}) — the"
+                        " safety checker observes, it must not steer",
+                        {
+                            "theorem": "checker",
+                            "source": l.leaf,
+                            "sink": path,
+                            "primitive": prim,
+                            "site": site,
+                        },
+                    )
+
+
+class _LaneEngine:
+    """Theorem 3: every eqn touching lane-indexed data must preserve the
+    trailing instance axis; cross-lane mixing only under an allowlisted
+    ``lane_reduce`` tag."""
+
+    def __init__(self, spec: FlowSpec, where: str):
+        self.spec = spec
+        self.where = where
+        self.findings: "list[Finding]" = []
+        self._seen: set = set()
+
+    def _emit(self, eqn, source: Optional[str], reason: str) -> None:
+        prim = eqn.primitive.name
+        key = (prim, source, reason)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(
+            Finding(
+                check="flow-lane",
+                where=self.where,
+                message=(
+                    f"{self.where}: {reason} (primitive {prim!r} at"
+                    f" {_src(eqn)}, lane data from"
+                    f" {source or '<unknown leaf>'!r}) — lanes must stay"
+                    " independent outside allowlisted reductions"
+                ),
+                data={
+                    "theorem": "lane",
+                    "source": source,
+                    "sink": f"eqn:{prim}",
+                    "primitive": prim,
+                    "site": _src(eqn),
+                },
+            )
+        )
+
+    def run(self, closed) -> "list[Finding]":
+        jaxpr = closed.jaxpr
+        spec = self.spec
+        n_state, n_plan = len(spec.state_paths), len(spec.plan_paths)
+        axes: dict = {}
+        src: dict = {}
+        for i, v in enumerate(jaxpr.invars[:n_state]):
+            shape = getattr(v.aval, "shape", ())
+            if shape and shape[-1] == spec.n_inst:
+                axes[v] = len(shape) - 1
+                src[v] = spec.state_paths[i]
+        for i, v in enumerate(jaxpr.invars[len(jaxpr.invars) - n_plan:]):
+            shape = getattr(v.aval, "shape", ())
+            if shape and shape[-1] == spec.n_inst:
+                axes[v] = len(shape) - 1
+                src[v] = spec.plan_paths[i]
+        self._walk(jaxpr, axes, src, frozenset())
+        return self.findings
+
+    def _lane_ok(self, eqn, inherited) -> bool:
+        _, tags = _scopes(eqn)
+        return any(
+            t in LANE_REDUCE_SITES for t in tuple(inherited) + tags
+        )
+
+    def _walk(self, jaxpr, axes, src, inherited) -> None:
+        for eqn in jaxpr.eqns:
+            tracked = [
+                (v, axes[v])
+                for v in eqn.invars
+                if not isinstance(v, Literal) and v in axes
+            ]
+            if not tracked:
+                continue
+            _, tags = _scopes(eqn)
+            ok_here = inherited | frozenset(
+                t for t in tags if t in LANE_REDUCE_SITES
+            )
+            source = next(
+                (src[v] for v, _ in tracked if v in src), None
+            )
+            prim = eqn.primitive.name
+            inner = _call_jaxpr(eqn) if prim in _CALL_PRIMS else None
+            if inner is not None and len(inner.invars) == len(eqn.invars):
+                sub_axes, sub_src = {}, {}
+                for ov, iv in zip(inner.invars, eqn.invars):
+                    if not isinstance(iv, Literal) and iv in axes:
+                        sub_axes[ov] = axes[iv]
+                        if iv in src:
+                            sub_src[ov] = src[iv]
+                self._walk(inner, sub_axes, sub_src, ok_here)
+                for ov_out, ov_in in zip(eqn.outvars, inner.outvars):
+                    if ov_in in sub_axes:
+                        axes[ov_out] = sub_axes[ov_in]
+                        src[ov_out] = sub_src.get(ov_in, source)
+                continue
+            outs = self._rule(eqn, axes, src, source, ok_here)
+            if outs is None:
+                continue
+            for ov, ax in zip(eqn.outvars, outs):
+                if ax is not None:
+                    axes[ov] = ax
+                    src.setdefault(ov, source)
+
+    # -- per-primitive lane rules -----------------------------------------
+
+    def _rule(self, eqn, axes, src, source, ok_here):
+        """Output lane axes for one eqn (None entries = untracked), or
+        ``None`` after emitting a finding / handling outputs itself."""
+        prim = eqn.primitive.name
+        tracked = [
+            (v, axes[v])
+            for v in eqn.invars
+            if not isinstance(v, Literal) and v in axes
+        ]
+        in_ax = tracked[0][1]
+        allowed = self._lane_ok(eqn, ok_here)
+
+        def viol(reason):
+            if not allowed:
+                self._emit(eqn, source, reason)
+            return None
+
+        if prim in _ELEMENTWISE:
+            if any(ax != in_ax for _, ax in tracked):
+                return viol("elementwise op mixes different lane axes")
+            return [in_ax] * len(eqn.outvars)
+
+        if prim == "broadcast_in_dim":
+            dims = eqn.params["broadcast_dimensions"]
+            return [dims[in_ax]]
+
+        if prim in _REDUCES:
+            red_axes = eqn.params.get("axes", eqn.params.get("dimensions"))
+            if red_axes is None:
+                red_axes = ()
+            if in_ax in red_axes:
+                return viol("cross-lane reduction over the instance axis")
+            shift = sum(1 for a in red_axes if a < in_ax)
+            return [in_ax - shift] * len(eqn.outvars)
+
+        if prim in _CUMULATIVE:
+            if eqn.params.get("axis") == in_ax:
+                return viol("cumulative op scans across the instance axis")
+            return [in_ax] * len(eqn.outvars)
+
+        if prim == "squeeze":
+            dims = eqn.params["dimensions"]
+            if in_ax in dims:
+                return viol("squeeze removes the instance axis")
+            return [in_ax - sum(1 for d in dims if d < in_ax)]
+
+        if prim == "reshape":
+            operand = eqn.invars[0]
+            if operand not in axes:
+                return [None]
+            old = operand.aval.shape
+            new = eqn.params["new_sizes"]
+            ax = axes[operand]
+            keep = len(old) - ax  # trailing block that must survive
+            if len(new) >= keep and tuple(new[len(new) - keep:]) == tuple(
+                old[ax:]
+            ):
+                return [len(new) - keep]
+            return viol("reshape folds the instance axis into another")
+
+        if prim == "transpose":
+            perm = eqn.params["permutation"]
+            return [perm.index(in_ax)]
+
+        if prim == "slice":
+            operand = eqn.invars[0]
+            ax = axes[operand]
+            start = eqn.params["start_indices"][ax]
+            limit = eqn.params["limit_indices"][ax]
+            strides = eqn.params["strides"]
+            stride = 1 if strides is None else strides[ax]
+            if start == 0 and limit == operand.aval.shape[ax] and stride == 1:
+                return [ax]
+            return viol("partial slice along the instance axis")
+
+        if prim == "concatenate":
+            if eqn.params["dimension"] == in_ax:
+                return viol("concatenate along the instance axis")
+            if any(ax != in_ax for _, ax in tracked):
+                return viol("concatenate mixes different lane axes")
+            return [in_ax]
+
+        if prim == "pad":
+            cfg = eqn.params["padding_config"][in_ax]
+            if tuple(cfg) != (0, 0, 0):
+                return viol("pad along the instance axis")
+            return [in_ax]
+
+        if prim == "rev":
+            if in_ax in eqn.params["dimensions"]:
+                return viol("reverse permutes the instance axis")
+            return [in_ax]
+
+        if prim == "sort":
+            if eqn.params.get("dimension") == in_ax:
+                return viol("sort along the instance axis")
+            return [axes.get(v) for v in eqn.invars]
+
+        if prim == "dynamic_slice":
+            operand = eqn.invars[0]
+            if operand not in axes:
+                return [None]
+            ax = axes[operand]
+            idx_tracked = any(
+                v in axes
+                for v in eqn.invars[1:]
+                if not isinstance(v, Literal)
+            )
+            full = (
+                eqn.params["slice_sizes"][ax] == operand.aval.shape[ax]
+            )
+            if full and not idx_tracked:
+                return [ax]
+            return viol("dynamic_slice addresses the instance axis")
+
+        if prim == "dynamic_update_slice":
+            operand, update = eqn.invars[0], eqn.invars[1]
+            if operand not in axes and update not in axes:
+                return [None]
+            ax = axes.get(operand, axes.get(update))
+            idx_tracked = any(
+                v in axes
+                for v in eqn.invars[2:]
+                if not isinstance(v, Literal)
+            )
+            shapes_ok = (
+                operand.aval.shape[ax] == update.aval.shape[ax]
+                if ax < min(len(operand.aval.shape), len(update.aval.shape))
+                else False
+            )
+            if shapes_ok and not idx_tracked:
+                return [ax]
+            return viol("dynamic_update_slice addresses the instance axis")
+
+        if prim in ("gather", "scatter", "scatter-add", "scatter_add",
+                    "scatter_mul", "scatter_min", "scatter_max"):
+            return viol(
+                "gather/scatter on lane-indexed data (no lane-preserving"
+                " rule — use elementwise one-hot selects in step code)"
+            )
+
+        if prim == "cond":
+            for branch in eqn.params["branches"]:
+                bj = branch.jaxpr if hasattr(branch, "jaxpr") else branch
+                sub_axes, sub_src = {}, {}
+                for ov, iv in zip(bj.invars, eqn.invars[1:]):
+                    if not isinstance(iv, Literal) and iv in axes:
+                        sub_axes[ov] = axes[iv]
+                        if iv in src:
+                            sub_src[ov] = src[iv]
+                self._walk(bj, sub_axes, sub_src, ok_here)
+                for ov_out, ov_in in zip(eqn.outvars, bj.outvars):
+                    if ov_in in sub_axes:
+                        axes[ov_out] = sub_axes[ov_in]
+                        src.setdefault(ov_out, source)
+            return None
+
+        if prim == "scan":
+            return self._rule_scan(eqn, axes, src, source, ok_here, viol)
+
+        if prim == "while":
+            body = eqn.params["body_jaxpr"].jaxpr
+            n_skip = eqn.params["cond_nconsts"] + eqn.params["body_nconsts"]
+            sub_axes, sub_src = {}, {}
+            for ov, iv in zip(body.invars, eqn.invars[n_skip:]):
+                if not isinstance(iv, Literal) and iv in axes:
+                    sub_axes[ov] = axes[iv]
+                    if iv in src:
+                        sub_src[ov] = src[iv]
+            self._walk(body, sub_axes, sub_src, ok_here)
+            for ov_out, (ov_in, iv) in zip(
+                eqn.outvars, zip(body.outvars, eqn.invars[n_skip:])
+            ):
+                carry_ax = axes.get(iv)
+                if carry_ax is not None:
+                    if sub_axes.get(ov_in) != carry_ax:
+                        viol("lane axis not preserved through while carry")
+                    else:
+                        axes[ov_out] = carry_ax
+                        src.setdefault(ov_out, source)
+            return None
+
+        return viol(f"no lane-propagation rule for primitive {prim!r}")
+
+    def _rule_scan(self, eqn, axes, src, source, ok_here, viol):
+        body = eqn.params["jaxpr"].jaxpr
+        n_consts = eqn.params["num_consts"]
+        n_carry = eqn.params["num_carry"]
+        sub_axes, sub_src = {}, {}
+        for k, (ov, iv) in enumerate(zip(body.invars, eqn.invars)):
+            if isinstance(iv, Literal) or iv not in axes:
+                continue
+            ax = axes[iv]
+            if k >= n_consts + n_carry:  # xs: scan axis 0 stripped
+                if ax == 0:
+                    viol("scan iterates over the instance axis")
+                    continue
+                ax = ax - 1
+            sub_axes[ov] = ax
+            if iv in src:
+                sub_src[ov] = src[iv]
+        self._walk(body, sub_axes, sub_src, ok_here)
+        for i, ov_out in enumerate(eqn.outvars):
+            ov_in = body.outvars[i]
+            if i < n_carry:
+                iv = eqn.invars[n_consts + i]
+                carry_ax = axes.get(iv)
+                if carry_ax is None:
+                    continue
+                if sub_axes.get(ov_in) != carry_ax:
+                    viol("lane axis not preserved through scan carry")
+                else:
+                    axes[ov_out] = carry_ax
+                    src.setdefault(ov_out, source)
+            else:  # ys stack a new leading axis
+                ax = sub_axes.get(ov_in)
+                if ax is not None:
+                    axes[ov_out] = ax + 1
+                    src.setdefault(ov_out, source)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Jaxpr-size budget (satellite): total eqn counts per audit cell, pinned in
+# analysis/goldens.EQN_GOLDENS the way layout/treedef goldens pin structure.
+
+# Unexplained growth tolerance: absolute floor for tiny traces, relative
+# for big ones.  Re-record deliberate changes with `audit --record-goldens`.
+EQN_BUDGET_ABS = 24
+EQN_BUDGET_REL = 0.10
+
+
+def count_eqns(closed) -> int:
+    """Total eqn count of a closed jaxpr, recursing into sub-jaxprs."""
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+
+    def walk(jx) -> int:
+        total = 0
+        for eqn in jx.eqns:
+            total += 1
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (tuple, list)) else (v,)
+                for b in vs:
+                    if hasattr(b, "jaxpr"):
+                        total += walk(b.jaxpr)
+                    elif hasattr(b, "eqns"):
+                        total += walk(b)
+        return total
+
+    return walk(jaxpr)
+
+
+def audit_eqn_budget(
+    protocol: str, config_name: str, xla, ctr
+) -> "list[Finding]":
+    """Compare this cell's recursive eqn counts against EQN_GOLDENS."""
+    from paxos_tpu.analysis.goldens import EQN_GOLDENS
+
+    golden = EQN_GOLDENS.get((protocol, config_name))
+    if golden is None:
+        return []  # cell not pinned (e.g. a future config) — nothing to diff
+    findings = []
+    for kind, closed in (("xla", xla), ("ctr", ctr)):
+        want = golden[kind]
+        got = count_eqns(closed)
+        tol = max(EQN_BUDGET_ABS, int(want * EQN_BUDGET_REL))
+        if abs(got - want) > tol:
+            direction = "grew" if got > want else "shrank"
+            findings.append(
+                Finding(
+                    check="eqn-budget",
+                    where=f"{protocol}/{config_name} {kind} trace",
+                    message=(
+                        f"{protocol}/{config_name} {kind} trace {direction}"
+                        f" to {got} eqns (golden {want}, tolerance"
+                        f" {tol}) — unexplained trace-size drift; if"
+                        " deliberate, re-record with"
+                        " `paxos_tpu audit --record-goldens`"
+                    ),
+                    data={
+                        "kind": kind,
+                        "got": got,
+                        "want": want,
+                        "tolerance": tol,
+                    },
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+
+
+def analyze_step_jaxpr(closed, spec: FlowSpec, where: str) -> "list[Finding]":
+    """All flow theorems over one traced step program."""
+    findings = _TaintEngine(spec, where).run(closed)
+    findings += _LaneEngine(spec, where).run(closed)
+    return findings
+
+
+def audit_flow(
+    protocol: str, config_name: str, cfg, xla, ctr
+) -> "list[Finding]":
+    """Flow pass for one audit cell: both engines' traces, all theorems."""
+    spec = build_spec(protocol, cfg)
+    findings = analyze_step_jaxpr(
+        xla, spec, f"{protocol}/{config_name} xla step"
+    )
+    findings += analyze_step_jaxpr(
+        ctr, spec, f"{protocol}/{config_name} fused tick"
+    )
+    return findings

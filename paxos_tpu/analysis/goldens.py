@@ -370,3 +370,44 @@ LAYOUT_GOLDENS: dict = {
         },
     },
 }
+
+# Recursive eqn counts per (protocol, config) audit cell, both engines —
+# the jaxpr-size budget (PR 14).  A cell drifting past analysis/flow.py's
+# tolerance (max(24, 10%)) fails the always-on `eqn-budget` audit check:
+# trace blowup taxes every compile and usually signals an accidental
+# unfused arm or a lost gate.  Deliberate changes re-record via
+# `paxos_tpu audit --record-goldens` (prints this dict ready to paste).
+EQN_GOLDENS: dict = {
+    ("paxos", "default"): {"xla": 606, "ctr": 594},
+    ("paxos", "gray-chaos"): {"xla": 824, "ctr": 885},
+    ("paxos", "corrupt"): {"xla": 774, "ctr": 881},
+    ("paxos", "stale"): {"xla": 787, "ctr": 883},
+    ("paxos", "telemetry"): {"xla": 756, "ctr": 744},
+    ("paxos", "coverage"): {"xla": 926, "ctr": 914},
+    ("paxos", "exposure"): {"xla": 981, "ctr": 1042},
+    ("paxos", "margin"): {"xla": 680, "ctr": 668},
+    ("multipaxos", "default"): {"xla": 767, "ctr": 739},
+    ("multipaxos", "gray-chaos"): {"xla": 1023, "ctr": 1079},
+    ("multipaxos", "corrupt"): {"xla": 983, "ctr": 1088},
+    ("multipaxos", "stale"): {"xla": 996, "ctr": 1090},
+    ("multipaxos", "telemetry"): {"xla": 920, "ctr": 892},
+    ("multipaxos", "coverage"): {"xla": 1258, "ctr": 1230},
+    ("multipaxos", "exposure"): {"xla": 1175, "ctr": 1231},
+    ("multipaxos", "margin"): {"xla": 845, "ctr": 817},
+    ("fastpaxos", "default"): {"xla": 818, "ctr": 806},
+    ("fastpaxos", "gray-chaos"): {"xla": 1120, "ctr": 1181},
+    ("fastpaxos", "corrupt"): {"xla": 1070, "ctr": 1177},
+    ("fastpaxos", "stale"): {"xla": 1083, "ctr": 1179},
+    ("fastpaxos", "telemetry"): {"xla": 968, "ctr": 956},
+    ("fastpaxos", "coverage"): {"xla": 1138, "ctr": 1126},
+    ("fastpaxos", "exposure"): {"xla": 1279, "ctr": 1340},
+    ("fastpaxos", "margin"): {"xla": 912, "ctr": 900},
+    ("raftcore", "default"): {"xla": 638, "ctr": 626},
+    ("raftcore", "gray-chaos"): {"xla": 856, "ctr": 917},
+    ("raftcore", "corrupt"): {"xla": 806, "ctr": 913},
+    ("raftcore", "stale"): {"xla": 819, "ctr": 915},
+    ("raftcore", "telemetry"): {"xla": 788, "ctr": 776},
+    ("raftcore", "coverage"): {"xla": 958, "ctr": 946},
+    ("raftcore", "exposure"): {"xla": 1011, "ctr": 1072},
+    ("raftcore", "margin"): {"xla": 712, "ctr": 700},
+}

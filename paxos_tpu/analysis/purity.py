@@ -38,9 +38,12 @@ DISALLOWED_PRIMITIVES = frozenset({
 # but held to the same no-entropy/no-clock bar on purpose: span
 # reconstruction must be a pure function of the decoded ring, and its
 # wall clock is INJECTED by the harness (obs.host_spans), never imported.
+# fuzz/ (PR 13) is host-side scheduling but deterministic BY CONTRACT: its
+# splitmix64 energy/mutation streams must stay pure-integer — replayable
+# campaigns and mergeable per-shard corpora both depend on it.
 TRACED_PACKAGES = (
     "protocols", "core", "faults", "kernels", "transport", "check",
-    "utils", "parallel", "obs",
+    "utils", "parallel", "obs", "fuzz",
 )
 
 _BANNED_MODULES = {

@@ -412,12 +412,15 @@ def audit_clamp_hoist(protocol: str) -> list:
 def record_goldens(matrix) -> dict:
     """Compute fresh goldens for ``matrix`` = [(protocol, config_name, cfg)].
 
-    Returns ``{"treedef": {...}, "config": {...}, "layout": {...}}`` with
-    stringified keys, ready to paste into :mod:`paxos_tpu.analysis.goldens`.
+    Returns ``{"treedef": {...}, "config": {...}, "layout": {...},
+    "eqns": {...}}`` with stringified keys, ready to paste into
+    :mod:`paxos_tpu.analysis.goldens`.
     """
+    from paxos_tpu.analysis import flow as flow_mod
+    from paxos_tpu.analysis import trace as trace_mod
     from paxos_tpu.utils import bitops
 
-    tree, conf, layout = {}, {}, {}
+    tree, conf, layout, eqns = {}, {}, {}, {}
     for protocol, config_name, cfg in matrix:
         key = (protocol, config_name)
         tree[key] = treedef_fingerprint(init_state(cfg))
@@ -426,4 +429,12 @@ def record_goldens(matrix) -> dict:
             "version": bitops.layout_version(protocol),
             "fields": bitops.layout_fields(protocol),
         }
-    return {"treedef": tree, "config": conf, "layout": layout}
+        eqns[key] = {
+            "xla": flow_mod.count_eqns(
+                trace_mod.trace_xla_step(protocol, cfg)
+            ),
+            "ctr": flow_mod.count_eqns(
+                trace_mod.trace_counter_tick(protocol, cfg)
+            ),
+        }
+    return {"treedef": tree, "config": conf, "layout": layout, "eqns": eqns}

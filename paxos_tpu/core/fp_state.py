@@ -200,3 +200,12 @@ FP_TICK_WRITES = (
     "learner.*", "requests.*", "replies.*",
     "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )
+
+# Registered fault-injection sites for the dataflow auditor
+# (analysis/flow.py): site name -> fault channels it may absorb; see
+# core/state.py for the registration contract.
+FP_FAULT_SITES = {
+    "equivocate": ("equiv",),
+    "flaky": ("flaky",),
+    "skew": ("skew",),
+}

@@ -339,3 +339,12 @@ MP_TICK_WRITES = (
     "accepted.*",
     "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )
+
+# Registered fault-injection sites for the dataflow auditor
+# (analysis/flow.py): site name -> fault channels it may absorb; see
+# core/state.py for the registration contract.
+MP_FAULT_SITES = {
+    "equivocate": ("equiv",),
+    "flaky": ("flaky",),
+    "skew": ("skew",),
+}

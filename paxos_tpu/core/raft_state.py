@@ -227,3 +227,12 @@ RAFT_TICK_WRITES = (
     "learner.*", "requests.*", "replies.*",
     "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )
+
+# Registered fault-injection sites for the dataflow auditor
+# (analysis/flow.py): site name -> fault channels it may absorb; see
+# core/state.py for the registration contract.
+RAFT_FAULT_SITES = {
+    "equivocate": ("equiv",),
+    "flaky": ("flaky",),
+    "skew": ("skew",),
+}

@@ -282,3 +282,14 @@ PAXOS_TICK_WRITES = (
     "learner.*", "requests.*", "replies.*",
     "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
 )
+
+# Registered fault-injection sites for the dataflow auditor
+# (analysis/flow.py): site name (as tagged by ``faults.injector.fault_site``
+# in protocols/paxos.py) -> fault channels the site may absorb.  The
+# injector's own window queries (alive / prop_alive / recovering / link_ok)
+# are registered globally in ``faults.injector.INJECTOR_FAULT_SITES``.
+PAXOS_FAULT_SITES = {
+    "equivocate": ("equiv",),
+    "flaky": ("flaky",),
+    "skew": ("skew",),
+}

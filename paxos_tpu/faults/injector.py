@@ -44,6 +44,35 @@ from paxos_tpu.core import streams as streams_mod
 
 NEVER = jnp.iinfo(jnp.int32).max
 
+# ---------------------------------------------------------------------------
+# Registered injection sites (PR 14 dataflow auditor).  ``fault_site(name)``
+# is a zero-op ``jax.named_scope`` whose tag lands in every enclosed eqn's
+# name stack, marking the *only* regions where ``FaultPlan`` leaves may touch
+# protocol state.  The taint pass (analysis/flow.py) strips the matching
+# fault channel's labels inside a registered site and reports any plan leaf
+# that reaches protocol state elsewhere.  Metadata only: schedules stay
+# bit-identical (goldens pin this).
+_SITE_TAG = "__fault_site__"
+
+# Sites owned by the injector itself: the plan-window queries every protocol
+# consumes.  name -> fault channels the site is allowed to absorb.
+INJECTOR_FAULT_SITES = {
+    "alive": ("crash",),
+    "prop_alive": ("crash",),
+    "recovering": ("crash",),
+    "link_ok": ("partition",),
+}
+
+
+def fault_site(name: str):
+    """Scope marking a registered fault-injection site named ``name``.
+
+    The name must be registered either in :data:`INJECTOR_FAULT_SITES` or in
+    the owning protocol's ``*_FAULT_SITES`` table (core/*state.py) — the flow
+    auditor reports unregistered site tags as findings.
+    """
+    return jax.named_scope(_SITE_TAG + name)
+
 # Per-link Bernoulli rates are stored as uint32 thresholds in int32 bit
 # patterns (Mosaic has no uint32 vectors): P(bits < t) = rate for uniform
 # bits, compared with the same sign-flip trick as counter_prng.bern.
@@ -374,7 +403,8 @@ class FaultPlan:
 
     def alive(self, tick: jnp.ndarray) -> jnp.ndarray:
         """(A, I) bool: acceptor is up at ``tick``."""
-        return ~((self.crash_start <= tick) & (tick < self.crash_end))
+        with fault_site("alive"):
+            return ~((self.crash_start <= tick) & (tick < self.crash_end))
 
     def link_ok(
         self, tick: jnp.ndarray, direction: "str | None" = None
@@ -392,20 +422,23 @@ class FaultPlan:
         ``part_dir == 2`` cuts replies, 0 cuts both.  ``direction=None``
         (or no ``part_dir`` in the plan) is the symmetric two-way view.
         """
-        cut = (self.part_start <= tick) & (tick < self.part_end)  # (I,)
-        if direction is not None and self.part_dir is not None:
-            spares = jnp.int32(2 if direction == "req" else 1)
-            cut = cut & (self.part_dir != spares)
-        same = self.pside[:, None] == self.aside[None]  # (P, A, I)
-        return same | ~cut[None, None]
+        with fault_site("link_ok"):
+            cut = (self.part_start <= tick) & (tick < self.part_end)  # (I,)
+            if direction is not None and self.part_dir is not None:
+                spares = jnp.int32(2 if direction == "req" else 1)
+                cut = cut & (self.part_dir != spares)
+            same = self.pside[:, None] == self.aside[None]  # (P, A, I)
+            return same | ~cut[None, None]
 
     def prop_alive(self, tick: jnp.ndarray) -> jnp.ndarray:
         """(P, I) bool: proposer is up at ``tick``."""
-        return ~((self.pcrash_start <= tick) & (tick < self.pcrash_end))
+        with fault_site("prop_alive"):
+            return ~((self.pcrash_start <= tick) & (tick < self.pcrash_end))
 
     def recovering(self, tick: jnp.ndarray) -> jnp.ndarray:
         """(A, I) bool: acceptor comes back up exactly at ``tick`` (for amnesia)."""
-        return self.crash_end == tick
+        with fault_site("recovering"):
+            return self.crash_end == tick
 
 
 # ---------------------------------------------------------------------------

@@ -1487,6 +1487,13 @@ def cmd_audit(args: argparse.Namespace) -> int:
             print("        },")
             print("    },")
         print("}")
+        print("EQN_GOLDENS: dict = {")
+        for (p, c), v in g["eqns"].items():
+            print(
+                f'    ("{p}", "{c}"): '
+                f'{{"xla": {v["xla"]}, "ctr": {v["ctr"]}}},'
+            )
+        print("}")
         return 0
     report = run_audit(
         protocols=args.protocols,

@@ -24,6 +24,7 @@ from paxos_tpu.core import streams as streams_mod
 from paxos_tpu.core.state import DONE, PaxosState
 from paxos_tpu.faults.injector import FaultConfig, FaultPlan
 from paxos_tpu.harness.config import SimConfig
+from paxos_tpu.kernels.quorum import lane_reduce
 
 
 class MeasurementCorrupted(RuntimeError):
@@ -540,10 +541,16 @@ def make_longlog(cfg: SimConfig) -> "LongLog | None":
     return None
 
 
+@lane_reduce("summarize")
 def summarize_device(
     state: PaxosState, liveness: bool = False, log_total: int = 0
 ) -> tuple[dict, dict]:
     """Device half of :func:`summarize`: one composite pytree, no transfer.
+
+    Allowlisted cross-lane region: report reductions legitimately mix
+    lanes, so the whole function carries the ``lane_reduce`` tag the
+    dataflow auditor (analysis/flow.py) accepts — the per-tick step
+    itself must stay lane-independent.
 
     Every block of the report — headline scalars, telemetry totals, the
     liveness curve/histogram/stuck block, and long-log replication progress

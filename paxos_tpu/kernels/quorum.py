@@ -15,9 +15,26 @@ in M8) only if profiling shows XLA failed to fuse — SURVEY.md §8.2.5.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from paxos_tpu.utils.bitops import popcount
+
+# ---------------------------------------------------------------------------
+# Lane-reduction allowlist (PR 14 dataflow auditor).  The flow pass
+# (analysis/flow.py) proves every traced step eqn preserves the trailing
+# instance axis; the only legitimate cross-lane mixers live OUTSIDE the
+# per-tick step — summarize reductions, coverage unions, and (future)
+# cross-lane quorum-system merges.  ``lane_reduce(name)`` is a zero-op
+# ``jax.named_scope`` tag marking such a region; the auditor accepts a
+# cross-lane reduction only under a tag whose name is in
+# ``analysis.flow.LANE_REDUCE_SITES``.
+_LANE_TAG = "__lane_ok__"
+
+
+def lane_reduce(name: str):
+    """Scope marking an allowlisted cross-lane reduction region ``name``."""
+    return jax.named_scope(_LANE_TAG + name)
 
 
 def majority(n_acc: int) -> int:

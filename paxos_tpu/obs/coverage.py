@@ -51,6 +51,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from paxos_tpu.kernels.counter_prng import i32, shr
+from paxos_tpu.kernels.quorum import lane_reduce
 
 # Bloom hash count.  Fixed (not a config knob) because the in-tick update
 # runs inside ``apply_tick``, which only sees the FaultConfig — and k=2 is
@@ -283,8 +284,13 @@ def host_sketch_estimate(values, words: int) -> Optional[float]:
 # composite report pytree) and host formatting.
 
 
+@lane_reduce("coverage_union")
 def coverage_device(cov: CoverageState) -> dict:
-    """Device half of the coverage report: reductions only, no transfer."""
+    """Device half of the coverage report: reductions only, no transfer.
+
+    Allowlisted cross-lane region (``lane_reduce`` tag): the union Bloom
+    filter is the one place coverage legitimately mixes lanes.
+    """
     # OR-reduce over lanes -> the union Bloom filter of every visited state.
     union = jax.lax.reduce(
         cov.bitmap, jnp.int32(0), jax.lax.bitwise_or, dimensions=[1]

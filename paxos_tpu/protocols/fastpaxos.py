@@ -52,7 +52,12 @@ from paxos_tpu.core.fp_state import (
     FastPaxosState,
 )
 from paxos_tpu.core.messages import ACCEPT, ACCEPTED, PREPARE, PROMISE
-from paxos_tpu.faults.injector import FaultConfig, FaultPlan, bits_below
+from paxos_tpu.faults.injector import (
+    FaultConfig,
+    FaultPlan,
+    bits_below,
+    fault_site,
+)
 from paxos_tpu.kernels.quorum import fast_quorum, majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
 from paxos_tpu.utils.bitops import popcount
@@ -116,15 +121,16 @@ def apply_tick_fast(
     # Per-link loss/duplication (p_flaky): this tick's raw bits vs the
     # plan's per-link thresholds; p_flaky == 0 is the uniform special case.
     if cfg.p_flaky > 0.0:
-        keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
-        keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
-        keep_p1 = ~bits_below(masks.link_bits[2], plan.link_drop)
-        keep_p2 = ~bits_below(masks.link_bits[3], plan.link_drop)
-        if masks.dup_bits is not None:
-            dup_req = bits_below(masks.dup_bits[0], plan.link_dup[None])
-            dup_rep = bits_below(masks.dup_bits[1], plan.link_dup[None])
-        else:
-            dup_req = dup_rep = None
+        with fault_site("flaky"):
+            keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
+            keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
+            keep_p1 = ~bits_below(masks.link_bits[2], plan.link_drop)
+            keep_p2 = ~bits_below(masks.link_bits[3], plan.link_drop)
+            if masks.dup_bits is not None:
+                dup_req = bits_below(masks.dup_bits[0], plan.link_dup[None])
+                dup_rep = bits_below(masks.dup_bits[1], plan.link_dup[None])
+            else:
+                dup_req = dup_rep = None
     else:
         keep_prom, keep_accd = masks.keep_prom, masks.keep_accd
         keep_p1, keep_p2 = masks.keep_p1, masks.keep_p2
@@ -155,24 +161,28 @@ def apply_tick_fast(
         msg_val = jnp.where(masks.corrupt & is_acc, msg_val ^ 64, msg_val)
         msg_bal = jnp.where(masks.corrupt & is_prep, msg_bal + 1, msg_bal)
 
-    ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
-    ok_prep = ok_prep_h | (is_prep & equiv)
-    # Vote at most once per ballot: with multiple proposers sharing the fast
-    # ballot, an acceptor must not switch values within a round.  Re-accepting
-    # the identical (ballot, value) stays idempotent (duplicate deliveries).
-    revote = (msg_bal > acc.acc_bal) | (
-        (msg_bal == acc.acc_bal) & (msg_val == acc.acc_val)
-    )
-    ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised) & revote
-    ok_acc = ok_acc_h | (is_acc & equiv)
+    with fault_site("equivocate"):
+        ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
+        ok_prep = ok_prep_h | (is_prep & equiv)
+        # Vote at most once per ballot: with multiple proposers sharing the
+        # fast ballot, an acceptor must not switch values within a round.
+        # Re-accepting the identical (ballot, value) stays idempotent
+        # (duplicate deliveries).
+        revote = (msg_bal > acc.acc_bal) | (
+            (msg_bal == acc.acc_bal) & (msg_val == acc.acc_val)
+        )
+        ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised) & revote
+        ok_acc = ok_acc_h | (is_acc & equiv)
 
-    promised = jnp.where(ok_prep_h, msg_bal, acc.promised)
-    promised = jnp.where(ok_acc_h, jnp.maximum(promised, msg_bal), promised)
-    acc_bal = jnp.where(ok_acc, msg_bal, acc.acc_bal)
-    acc_val = jnp.where(ok_acc, msg_val, acc.acc_val)
+        promised = jnp.where(ok_prep_h, msg_bal, acc.promised)
+        promised = jnp.where(
+            ok_acc_h, jnp.maximum(promised, msg_bal), promised
+        )
+        acc_bal = jnp.where(ok_acc, msg_bal, acc.acc_bal)
+        acc_val = jnp.where(ok_acc, msg_val, acc.acc_val)
 
-    prom_payload_bal = jnp.where(equiv, 0, acc.acc_bal)  # pre-update pair
-    prom_payload_val = jnp.where(equiv, 0, acc.acc_val)
+        prom_payload_bal = jnp.where(equiv, 0, acc.acc_bal)  # pre-update
+        prom_payload_val = jnp.where(equiv, 0, acc.acc_val)
     replies = net.send(
         replies, PROMISE,
         send_mask=sel[PREPARE] & ok_prep[None],
@@ -198,7 +208,8 @@ def apply_tick_fast(
             state.learner, ok_acc, msg_bal, msg_val, state.tick, q2,
             fast_quorum=fquorum,
         )
-        inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
+        with fault_site("equivocate"):
+            inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
         learner = learner.replace(violations=learner.violations + inv_viol)
 
     # ---- Proposer half-tick ----
@@ -285,10 +296,17 @@ def apply_tick_fast(
 
     timer = jnp.where(prop.phase == DONE, prop.timer, prop.timer + 1)
     # Timer skew (gray): per-proposer extra patience / backoff multiplier.
-    timeout = cfg.timeout if cfg.timeout_skew <= 0 else cfg.timeout + plan.ptimeout
-    backoff = (
-        masks.backoff if cfg.backoff_skew <= 1 else masks.backoff * plan.pboff
-    )
+    with fault_site("skew"):
+        timeout = (
+            cfg.timeout
+            if cfg.timeout_skew <= 0
+            else cfg.timeout + plan.ptimeout
+        )
+        backoff = (
+            masks.backoff
+            if cfg.backoff_skew <= 1
+            else masks.backoff * plan.pboff
+        )
     expired = (
         (prop.phase != DONE)
         & ~p1_done & ~p2_done & ~fast_done

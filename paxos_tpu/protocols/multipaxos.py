@@ -45,7 +45,13 @@ from paxos_tpu.core.mp_state import (
     bv_val,
     pack_bv,
 )
-from paxos_tpu.faults.injector import FaultConfig, FaultPlan, bits_below, links_dup
+from paxos_tpu.faults.injector import (
+    FaultConfig,
+    FaultPlan,
+    bits_below,
+    fault_site,
+    links_dup,
+)
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
 
@@ -261,15 +267,16 @@ def apply_tick_mp(
     # Per-link loss/duplication: compare this tick's raw bits against the
     # plan's per-(p, a) thresholds; the uniform masks are the off path.
     if cfg.p_flaky > 0.0:
-        keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
-        keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
-        keep_prep = ~bits_below(masks.link_bits[2], plan.link_drop)
-        keep_acc = ~bits_below(masks.link_bits[3], plan.link_drop)
-        dup_req = (
-            bits_below(masks.dup_bits, plan.link_dup[None])
-            if masks.dup_bits is not None
-            else None
-        )
+        with fault_site("flaky"):
+            keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
+            keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
+            keep_prep = ~bits_below(masks.link_bits[2], plan.link_drop)
+            keep_acc = ~bits_below(masks.link_bits[3], plan.link_drop)
+            dup_req = (
+                bits_below(masks.dup_bits, plan.link_dup[None])
+                if masks.dup_bits is not None
+                else None
+            )
     else:
         keep_prom, keep_accd = masks.keep_prom, masks.keep_accd
         keep_prep, keep_acc = masks.keep_prep, masks.keep_acc
@@ -327,10 +334,11 @@ def apply_tick_mp(
         msg_val = jnp.where(masks.corrupt & is_acc, msg_val ^ 64, msg_val)
         msg_bal = jnp.where(masks.corrupt & is_prep, msg_bal + 1, msg_bal)
 
-    ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
-    ok_prep = ok_prep_h | (is_prep & equiv)
-    ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised)
-    ok_acc = ok_acc_h | (is_acc & equiv)
+    with fault_site("equivocate"):
+        ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
+        ok_prep = ok_prep_h | (is_prep & equiv)
+        ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised)
+        ok_acc = ok_acc_h | (is_acc & equiv)
 
     promised = jnp.where(ok_prep_h, msg_bal, acc.promised)
     promised = jnp.where(ok_acc_h, jnp.maximum(promised, msg_bal), promised)
@@ -348,7 +356,8 @@ def apply_tick_mp(
         prom_send = sel[PREPARE] & ok_prep[None]  # (P, A, I)
         if keep_prom is not None:
             prom_send = prom_send & keep_prom
-        payload_bv = jnp.where(equiv[:, None], 0, acc.log)  # (A, L, I)
+        with fault_site("equivocate"):
+            payload_bv = jnp.where(equiv[:, None], 0, acc.log)  # (A, L, I)
         promises = promises.replace(
             present=promises.present | prom_send,
             bal=jnp.where(prom_send, msg_bal[None], promises.bal),
@@ -464,9 +473,12 @@ def apply_tick_mp(
 
     # Candidate timeout: back to follower, retry later with the next ballot.
     # Timeout skew (gray): each proposer lane runs its own deadline.
-    timeout = (
-        cfg.timeout if cfg.timeout_skew <= 0 else cfg.timeout + plan.ptimeout
-    )
+    with fault_site("skew"):
+        timeout = (
+            cfg.timeout
+            if cfg.timeout_skew <= 0
+            else cfg.timeout + plan.ptimeout
+        )
     candidate_timer = jnp.where(prop.phase == CANDIDATE, prop.candidate_timer + 1, 0)
     cand_fail = (prop.phase == CANDIDATE) & (candidate_timer > timeout) & ~p1_done
     # Exposure (obs.exposure): a skewed timeout is EFFECTIVE only where the
@@ -496,9 +508,12 @@ def apply_tick_mp(
     # Failed candidacy / demotion: retreat below the election threshold by a
     # random backoff so rivals separate instead of re-colliding every tick.
     # Backoff skew (gray): per-proposer multiplier stretches the retreat.
-    backoff = (
-        masks.backoff if cfg.backoff_skew <= 1 else masks.backoff * plan.pboff
-    )
+    with fault_site("skew"):
+        backoff = (
+            masks.backoff
+            if cfg.backoff_skew <= 1
+            else masks.backoff * plan.pboff
+        )
     lease_timer = jnp.where(
         cand_fail | demote, cfg.lease_len - backoff, lease_timer
     )

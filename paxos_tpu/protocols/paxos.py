@@ -59,6 +59,7 @@ from paxos_tpu.faults.injector import (
     FaultConfig,
     FaultPlan,
     bits_below,
+    fault_site,
     links_dup,
 )
 from paxos_tpu.kernels.quorum import majority, quorum_reached
@@ -318,15 +319,16 @@ def apply_tick(
     # plan's per-link thresholds; p_flaky == 0 is the uniform special case
     # carried by the scalar-threshold masks.
     if cfg.p_flaky > 0.0:
-        keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
-        keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
-        keep_p1 = ~bits_below(masks.link_bits[2], plan.link_drop)
-        keep_p2 = ~bits_below(masks.link_bits[3], plan.link_drop)
-        if masks.dup_bits is not None:
-            dup_req = bits_below(masks.dup_bits[0], plan.link_dup[None])
-            dup_rep = bits_below(masks.dup_bits[1], plan.link_dup[None])
-        else:
-            dup_req = dup_rep = None
+        with fault_site("flaky"):
+            keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
+            keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
+            keep_p1 = ~bits_below(masks.link_bits[2], plan.link_drop)
+            keep_p2 = ~bits_below(masks.link_bits[3], plan.link_drop)
+            if masks.dup_bits is not None:
+                dup_req = bits_below(masks.dup_bits[0], plan.link_dup[None])
+                dup_rep = bits_below(masks.dup_bits[1], plan.link_dup[None])
+            else:
+                dup_req = dup_rep = None
     else:
         keep_prom, keep_accd = masks.keep_prom, masks.keep_accd
         keep_p1, keep_p2 = masks.keep_p1, masks.keep_p2
@@ -382,20 +384,23 @@ def apply_tick(
 
     # PREPARE(b): honest promise iff b > promised; equivocators "promise"
     # unconditionally, never record it, and hide their accepted pair.
-    ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
-    ok_prep = ok_prep_h | (is_prep & equiv)
-    # ACCEPT(b, v): honest accept iff b >= promised; equivocators accept all.
-    ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised)
-    ok_acc = ok_acc_h | (is_acc & equiv)
+    with fault_site("equivocate"):
+        ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
+        ok_prep = ok_prep_h | (is_prep & equiv)
+        # ACCEPT(b, v): honest iff b >= promised; equivocators accept all.
+        ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised)
+        ok_acc = ok_acc_h | (is_acc & equiv)
 
-    promised = jnp.where(ok_prep_h, msg_bal, acc.promised)
-    promised = jnp.where(ok_acc_h, jnp.maximum(promised, msg_bal), promised)
-    acc_bal = jnp.where(ok_acc, msg_bal, acc.acc_bal)
-    acc_val = jnp.where(ok_acc, msg_val, acc.acc_val)
+        promised = jnp.where(ok_prep_h, msg_bal, acc.promised)
+        promised = jnp.where(
+            ok_acc_h, jnp.maximum(promised, msg_bal), promised
+        )
+        acc_bal = jnp.where(ok_acc, msg_bal, acc.acc_bal)
+        acc_val = jnp.where(ok_acc, msg_val, acc.acc_val)
 
-    # Replies routed back to the selected sender's slot.
-    prom_payload_bal = jnp.where(equiv, 0, acc.acc_bal)  # pre-update pair
-    prom_payload_val = jnp.where(equiv, 0, acc.acc_val)
+        # Replies routed back to the selected sender's slot.
+        prom_payload_bal = jnp.where(equiv, 0, acc.acc_bal)  # pre-update
+        prom_payload_val = jnp.where(equiv, 0, acc.acc_val)
     if "sends" not in ablate:
         replies = net.send(
             replies, PROMISE,
@@ -423,11 +428,15 @@ def apply_tick(
     if "learner" in ablate:
         learner = state.learner
     else:
-        learner = learner_observe(
-            state.learner, ok_acc, msg_bal, msg_val, state.tick, q2
-        )
-        inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
-        learner = learner.replace(violations=learner.violations + inv_viol)
+        with jax.named_scope("learner_check"):
+            learner = learner_observe(
+                state.learner, ok_acc, msg_bal, msg_val, state.tick, q2
+            )
+            with fault_site("equivocate"):
+                inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
+            learner = learner.replace(
+                violations=learner.violations + inv_viol
+            )
 
     if "proposer" in ablate:
         return state.replace(
@@ -483,10 +492,17 @@ def apply_tick(
     timer = jnp.where(prop.phase == DONE, prop.timer, prop.timer + 1)
     # Timer skew (timeout_skew / backoff_skew): per-proposer extra patience
     # and backoff multipliers from the plan; off = the uniform timers.
-    timeout = cfg.timeout if cfg.timeout_skew <= 0 else cfg.timeout + plan.ptimeout
-    backoff = (
-        masks.backoff if cfg.backoff_skew <= 1 else masks.backoff * plan.pboff
-    )
+    with fault_site("skew"):
+        timeout = (
+            cfg.timeout
+            if cfg.timeout_skew <= 0
+            else cfg.timeout + plan.ptimeout
+        )
+        backoff = (
+            masks.backoff
+            if cfg.backoff_skew <= 1
+            else masks.backoff * plan.pboff
+        )
     expired = (
         (prop.phase != DONE) & ~p1_done & ~p2_done & (timer > timeout)
     )

@@ -56,7 +56,12 @@ from paxos_tpu.core.raft_state import (
     VOTE,
     RaftState,
 )
-from paxos_tpu.faults.injector import FaultConfig, FaultPlan, bits_below
+from paxos_tpu.faults.injector import (
+    FaultConfig,
+    FaultPlan,
+    bits_below,
+    fault_site,
+)
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
 
@@ -113,15 +118,16 @@ def apply_tick_raft(
     # Per-link loss/duplication (p_flaky): this tick's raw bits vs the
     # plan's per-link thresholds; p_flaky == 0 is the uniform special case.
     if cfg.p_flaky > 0.0:
-        keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
-        keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
-        keep_p1 = ~bits_below(masks.link_bits[2], plan.link_drop)
-        keep_p2 = ~bits_below(masks.link_bits[3], plan.link_drop)
-        if masks.dup_bits is not None:
-            dup_req = bits_below(masks.dup_bits[0], plan.link_dup[None])
-            dup_rep = bits_below(masks.dup_bits[1], plan.link_dup[None])
-        else:
-            dup_req = dup_rep = None
+        with fault_site("flaky"):
+            keep_prom = ~bits_below(masks.link_bits[0], plan.link_drop)
+            keep_accd = ~bits_below(masks.link_bits[1], plan.link_drop)
+            keep_p1 = ~bits_below(masks.link_bits[2], plan.link_drop)
+            keep_p2 = ~bits_below(masks.link_bits[3], plan.link_drop)
+            if masks.dup_bits is not None:
+                dup_req = bits_below(masks.dup_bits[0], plan.link_dup[None])
+                dup_rep = bits_below(masks.dup_bits[1], plan.link_dup[None])
+            else:
+                dup_req = dup_rep = None
     else:
         keep_prom, keep_accd = masks.keep_prom, masks.keep_accd
         keep_p1, keep_p2 = masks.keep_p1, masks.keep_p2
@@ -154,21 +160,25 @@ def apply_tick_raft(
 
     # RequestVote: one vote per term + election restriction.  Equivocators
     # grant everything and hide their entry (config-4-style double vote).
-    grant_h = is_rv & ~equiv & (msg_bal > voter.voted) & (msg_v1 >= voter.ent_term)
-    grant = grant_h | (is_rv & equiv)
-    # AppendEntries: accept from any term not below the vote fence.
-    ok_ap_h = is_ap & ~equiv & (msg_bal >= voter.voted)
-    ok_ap = ok_ap_h | (is_ap & equiv)
+    with fault_site("equivocate"):
+        grant_h = (
+            is_rv & ~equiv & (msg_bal > voter.voted)
+            & (msg_v1 >= voter.ent_term)
+        )
+        grant = grant_h | (is_rv & equiv)
+        # AppendEntries: accept from any term not below the vote fence.
+        ok_ap_h = is_ap & ~equiv & (msg_bal >= voter.voted)
+        ok_ap = ok_ap_h | (is_ap & equiv)
 
-    voted = jnp.where(grant_h, msg_bal, voter.voted)
-    voted = jnp.where(ok_ap_h, jnp.maximum(voted, msg_bal), voted)
-    ent_term = jnp.where(ok_ap, msg_bal, voter.ent_term)
-    ent_val = jnp.where(ok_ap, msg_v1, voter.ent_val)
+        voted = jnp.where(grant_h, msg_bal, voter.voted)
+        voted = jnp.where(ok_ap_h, jnp.maximum(voted, msg_bal), voted)
+        ent_term = jnp.where(ok_ap, msg_bal, voter.ent_term)
+        ent_val = jnp.where(ok_ap, msg_v1, voter.ent_val)
 
-    # Vote replies go to every solicitor (grant or denial), carrying the
-    # voter's pre-update entry: (ent_term << 1) | granted, entry value.
-    vote_payload_t = jnp.where(equiv, 0, voter.ent_term)  # (A, I)
-    vote_payload_v = jnp.where(equiv, 0, voter.ent_val)
+        # Vote replies go to every solicitor (grant or denial), carrying the
+        # voter's pre-update entry: (ent_term << 1) | granted, entry value.
+        vote_payload_t = jnp.where(equiv, 0, voter.ent_term)  # (A, I)
+        vote_payload_v = jnp.where(equiv, 0, voter.ent_val)
     replies = net.send(
         replies, VOTE,
         send_mask=sel[REQVOTE],
@@ -193,7 +203,8 @@ def apply_tick_raft(
         learner = learner_observe(
             state.learner, ok_ap, msg_bal, msg_v1, state.tick, quorum
         )
-        inv_viol = raft_voter_invariants(voter_pre, voter, honest=~equiv)
+        with fault_site("equivocate"):
+            inv_viol = raft_voter_invariants(voter_pre, voter, honest=~equiv)
         learner = learner.replace(violations=learner.violations + inv_viol)
 
     # ---- Candidate half-tick: fold all delivered replies ----
@@ -238,10 +249,17 @@ def apply_tick_raft(
 
     timer = jnp.where(cand.phase == DONE, cand.timer, cand.timer + 1)
     # Timer skew (gray): per-candidate extra patience / backoff multiplier.
-    timeout = cfg.timeout if cfg.timeout_skew <= 0 else cfg.timeout + plan.ptimeout
-    backoff = (
-        masks.backoff if cfg.backoff_skew <= 1 else masks.backoff * plan.pboff
-    )
+    with fault_site("skew"):
+        timeout = (
+            cfg.timeout
+            if cfg.timeout_skew <= 0
+            else cfg.timeout + plan.ptimeout
+        )
+        backoff = (
+            masks.backoff
+            if cfg.backoff_skew <= 1
+            else masks.backoff * plan.pboff
+        )
     expired = (
         (cand.phase != DONE) & ~elected & ~committed & (timer > timeout)
     )

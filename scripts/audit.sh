@@ -1,8 +1,13 @@
 #!/usr/bin/env bash
 # Full static determinism audit: every protocol x config cell through the
-# jaxpr auditor (PRNG stream registry, purity lint, AST host-entropy pass)
-# plus the default-off structural verifier and golden diffs.  Trace-time
-# only — no campaign executes; a clean tree exits 0, findings exit 2.
+# jaxpr auditor (PRNG stream registry, purity lint, AST host-entropy pass,
+# the dataflow non-interference theorems of analysis/flow.py — observer
+# isolation, fault-channel confinement, checker isolation, lane
+# independence — and the eqn-size budget) plus the default-off structural
+# verifier and golden diffs.  The flow pass is always-on, no flag needed.
+# Trace-time only — no campaign executes; a clean tree exits 0, findings
+# exit 2.  `--json` reports carry each finding's structured `data`
+# (source leaf, sink, primitive) for machine consumers.
 #
 # Usage: scripts/audit.sh [extra `paxos_tpu audit` flags...]
 #   scripts/audit.sh --json            # machine-readable report

@@ -1,0 +1,113 @@
+"""Flow-auditor probe: one audit cell through the taint/lane theorems.
+
+Two modes, mirroring the auditor's exit discipline (0 clean, 2 findings):
+
+  python scripts/flow_probe.py                       # clean cell -> exit 0
+  python scripts/flow_probe.py --plant observer-leak # planted bug -> exit 2
+
+``--plant`` wraps the protocol step with a known violation and expects
+the auditor to name the leaked leaf — the tier-1 FLOW_SMOKE uses both
+modes as the end-to-end acceptance of the dataflow non-interference
+pass (a detector that cannot find a planted leak guards nothing).
+
+Plants: ``observer-leak`` (telemetry counter folded into proposer.bal),
+``fault-offsite`` (plan.equivocate applied outside any fault_site),
+``lane-roll`` (cross-lane jnp.roll of ballot state).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paxos_tpu.analysis import flow
+from paxos_tpu.analysis import trace as trace_mod
+from paxos_tpu.harness.run import base_key, get_step_fn, init_plan, init_state
+
+
+def _plant_observer_leak(step, cfg):
+    def leaky(st, key, pl):
+        out = step(st, key, pl, cfg.fault)
+        leak = out.telemetry.counters[0].astype(jnp.int32)
+        return out.replace(
+            proposer=out.proposer.replace(bal=out.proposer.bal + leak[None])
+        )
+
+    return leaky
+
+
+def _plant_fault_offsite(step, cfg):
+    def offsite(st, key, pl):
+        out = step(st, key, pl, cfg.fault)
+        return out.replace(
+            acceptor=out.acceptor.replace(
+                promised=out.acceptor.promised + pl.equivocate.astype(jnp.int32)
+            )
+        )
+
+    return offsite
+
+
+def _plant_lane_roll(step, cfg):
+    def rolled(st, key, pl):
+        out = step(st, key, pl, cfg.fault)
+        return out.replace(
+            proposer=out.proposer.replace(
+                bal=jnp.roll(out.proposer.bal, 1, axis=-1)
+            )
+        )
+
+    return rolled
+
+
+PLANTS = {
+    "observer-leak": ("telemetry", _plant_observer_leak),
+    "fault-offsite": ("default", _plant_fault_offsite),
+    "lane-roll": ("default", _plant_lane_roll),
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--protocol", default="paxos", choices=trace_mod.PROTOCOLS)
+    ap.add_argument("--config", default="default",
+                    choices=tuple(trace_mod.CONFIG_MATRIX))
+    ap.add_argument("--plant", default=None, choices=tuple(PLANTS))
+    args = ap.parse_args()
+
+    protocol = args.protocol
+    if args.plant is None:
+        cfg = trace_mod.build_config(protocol, args.config)
+        xla = trace_mod.trace_xla_step(protocol, cfg)
+        ctr = trace_mod.trace_counter_tick(protocol, cfg)
+        findings = flow.audit_flow(protocol, args.config, cfg, xla, ctr)
+        where = f"{protocol}/{args.config}"
+    else:
+        config, wrap = PLANTS[args.plant]
+        cfg = trace_mod.build_config(protocol, config)
+        fn = wrap(get_step_fn(protocol), cfg)
+        closed = jax.make_jaxpr(fn)(
+            init_state(cfg), base_key(cfg), init_plan(cfg)
+        )
+        where = f"{protocol}/{config} plant={args.plant}"
+        findings = flow.analyze_step_jaxpr(
+            closed, flow.build_spec(protocol, cfg), where
+        )
+
+    if findings:
+        print(f"flow-probe: {where}: {len(findings)} finding(s)")
+        for f in findings:
+            print(f"  {f}")
+        return 2
+    print(f"flow-probe: {where}: OK (no findings)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

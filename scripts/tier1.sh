@@ -326,6 +326,18 @@ assert 0.0 <= p["occupancy"] <= 1.0, p["occupancy"]
 assert {"p50", "p95", "p99"} <= set(p["chunk_latency_us"]), p
 EOF
 fi
+# Flow smoke: the dataflow non-interference auditor end-to-end.  A clean
+# cell must exit 0; a planted observer leak (telemetry counter folded
+# into ballot state) must exit 2 AND name the leaked leaf — a taint pass
+# that cannot find a planted leak guards nothing.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/flow_probe.py \
+    >/dev/null 2>&1 \
+  && { timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/flow_probe.py \
+         --plant observer-leak >/tmp/_t1_flow.log 2>&1; [ "$?" -eq 2 ]; } \
+  && grep -q "telemetry.counters" /tmp/_t1_flow.log \
+  && echo FLOW_SMOKE=ok || { echo FLOW_SMOKE=FAILED; rc=1; }
+fi
 # Feedback-directed fuzzing smoke (fuzz subcommand + paxos_tpu/fuzz/):
 # (a) two identical guided runs must write byte-identical corpus journals
 # (replay determinism — the journal is wall-clock-free by construction);

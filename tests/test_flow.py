@@ -1,0 +1,291 @@
+"""Dataflow non-interference auditor (paxos_tpu.analysis.flow): clean +
+planted-violation tests.
+
+Mirrors tests/test_audit.py's two halves:
+
+1. **Clean**: the flow theorems (observer non-interference, fault-channel
+   confinement, checker isolation, lane independence) hold over the full
+   8-config x 4-protocol audit matrix, for BOTH engines' traces.  These
+   pin the auditor AND the tree: a leaked observer value or a botched
+   lane rule regresses here first.
+2. **Mutations**: each theorem is fed a planted violation (observer leaf
+   folded into ballot state, observer value steering a PRNG fold,
+   fault-plan leaf applied outside its registered injection site, a
+   cross-lane roll, the checker writing acceptor state, a margin counter
+   read back into timeout logic, an unregistered fault_site tag) and must
+   produce a finding that NAMES the source leaf and the sink — a taint
+   auditor that fires without saying which leaf leaked where is a worse
+   debugging experience than no auditor.
+
+Everything here is trace-time only (no campaign executes), so the whole
+module rides the fast ``-m 'not slow'`` tier.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paxos_tpu.analysis import flow, purity
+from paxos_tpu.analysis import trace as trace_mod
+from paxos_tpu.harness.run import base_key, get_step_fn, init_plan, init_state
+
+PROTOCOLS = trace_mod.PROTOCOLS
+CONFIGS = tuple(trace_mod.CONFIG_MATRIX)
+
+
+def _probe(protocol, config, wrap):
+    """Trace ``wrap``'s mutated step for one cell and run all theorems."""
+    cfg = trace_mod.build_config(protocol, config)
+    step = get_step_fn(protocol)
+    fn = wrap(step, cfg)
+    closed = jax.make_jaxpr(fn)(init_state(cfg), base_key(cfg), init_plan(cfg))
+    return flow.analyze_step_jaxpr(
+        closed, flow.build_spec(protocol, cfg), f"{protocol}/{config} probe"
+    )
+
+
+# ------------------------------------------------------------------- clean
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_clean_flow_full_matrix(protocol):
+    """All four theorems hold for every config cell on both engines."""
+    for config in CONFIGS:
+        cfg = trace_mod.build_config(protocol, config)
+        xla = trace_mod.trace_xla_step(protocol, cfg)
+        ctr = trace_mod.trace_counter_tick(protocol, cfg)
+        findings = flow.audit_flow(protocol, config, cfg, xla, ctr)
+        assert findings == [], (config, [str(f) for f in findings])
+
+
+def test_fault_sites_registered_for_every_protocol():
+    """Every protocol registers the injector sites plus its step sites,
+    each with at least one declared channel."""
+    for protocol in PROTOCOLS:
+        sites = flow.fault_sites(protocol)
+        for name in ("alive", "link_ok", "equivocate", "flaky", "skew"):
+            assert name in sites, (protocol, name)
+            assert sites[name], (protocol, name)
+
+
+def test_eqn_budget_clean_and_drift_detected():
+    """Eqn counts match goldens; a synthetic 2x blowup is flagged."""
+    cfg = trace_mod.build_config("paxos", "default")
+    xla = trace_mod.trace_xla_step("paxos", cfg)
+    ctr = trace_mod.trace_counter_tick("paxos", cfg)
+    assert flow.audit_eqn_budget("paxos", "default", xla, ctr) == []
+
+    def doubled(st, key, pl):
+        step = get_step_fn("paxos")
+        out = step(st, key, pl, cfg.fault)
+        return step(out, key, pl, cfg.fault)
+
+    fat = jax.make_jaxpr(doubled)(
+        init_state(cfg), base_key(cfg), init_plan(cfg)
+    )
+    findings = flow.audit_eqn_budget("paxos", "default", fat, ctr)
+    assert any(
+        f.check == "eqn-budget" and "record-goldens" in f.message
+        for f in findings
+    ), findings
+
+
+# --------------------------------------------------------------- mutations
+
+
+def test_mutation_observer_leak_detected():
+    """Theorem 1: a telemetry counter folded into ballot state is named."""
+
+    def wrap(step, cfg):
+        def leaky(st, key, pl):
+            out = step(st, key, pl, cfg.fault)
+            leak = out.telemetry.counters[0].astype(jnp.int32)
+            return out.replace(
+                proposer=out.proposer.replace(bal=out.proposer.bal + leak[None])
+            )
+
+        return leaky
+
+    findings = _probe("paxos", "telemetry", wrap)
+    assert any(
+        f.check == "flow-observer"
+        and "telemetry.counters" in f.message
+        and "proposer.bal" in f.message
+        and f.data["theorem"] == "observer"
+        for f in findings
+    ), [str(f) for f in findings]
+
+
+def test_mutation_observer_prng_fold_detected():
+    """Theorem 1 (PRNG corollary): a coverage value steering fold_in."""
+
+    def wrap(step, cfg):
+        def prngy(st, key, pl):
+            key = jax.random.fold_in(key, st.coverage.new_bits[0])
+            return step(st, key, pl, cfg.fault)
+
+        return prngy
+
+    findings = _probe("paxos", "coverage", wrap)
+    assert any(
+        f.check == "flow-prng"
+        and "coverage.new_bits" in f.message
+        and "random_fold_in" in f.message
+        for f in findings
+    ), [str(f) for f in findings]
+
+
+def test_mutation_fault_outside_site_detected():
+    """Theorem 2: plan.equivocate applied without a fault_site scope."""
+
+    def wrap(step, cfg):
+        def fleaky(st, key, pl):
+            out = step(st, key, pl, cfg.fault)
+            return out.replace(
+                acceptor=out.acceptor.replace(
+                    promised=out.acceptor.promised
+                    + pl.equivocate.astype(jnp.int32)
+                )
+            )
+
+        return fleaky
+
+    findings = _probe("paxos", "default", wrap)
+    assert any(
+        f.check == "flow-fault"
+        and "'equivocate'" in f.message
+        and "acceptor.promised" in f.message
+        and f.data["channel"] == "equiv"
+        for f in findings
+    ), [str(f) for f in findings]
+
+
+def test_mutation_unregistered_site_detected():
+    """Theorem 2: an unknown fault_site tag is itself a finding."""
+    from paxos_tpu.faults.injector import fault_site
+
+    def wrap(step, cfg):
+        def rogue(st, key, pl):
+            out = step(st, key, pl, cfg.fault)
+            with fault_site("rogue"):
+                promised = out.acceptor.promised + pl.equivocate.astype(
+                    jnp.int32
+                )
+            return out.replace(
+                acceptor=out.acceptor.replace(promised=promised)
+            )
+
+        return rogue
+
+    findings = _probe("paxos", "default", wrap)
+    assert any(
+        f.check == "flow-site" and "'rogue'" in f.message for f in findings
+    ), [str(f) for f in findings]
+
+
+def test_mutation_cross_lane_roll_detected():
+    """Theorem 3: jnp.roll across the instance axis (lowers to partial
+    slices + concatenate) outside any lane_reduce allowlist."""
+
+    def wrap(step, cfg):
+        def rolled(st, key, pl):
+            out = step(st, key, pl, cfg.fault)
+            return out.replace(
+                proposer=out.proposer.replace(
+                    bal=jnp.roll(out.proposer.bal, 1, axis=-1)
+                )
+            )
+
+        return rolled
+
+    findings = _probe("paxos", "default", wrap)
+    assert any(
+        f.check == "flow-lane" and "instance axis" in f.message
+        for f in findings
+    ), [str(f) for f in findings]
+    # The finding names a concrete primitive (roll lowers to slice/concat).
+    lane = [f for f in findings if f.check == "flow-lane"]
+    assert all(f.data["primitive"] for f in lane), lane
+
+
+def test_mutation_checker_steering_detected():
+    """Checker isolation: learner.violations written into acceptor state."""
+
+    def wrap(step, cfg):
+        def steering(st, key, pl):
+            out = step(st, key, pl, cfg.fault)
+            return out.replace(
+                acceptor=out.acceptor.replace(
+                    promised=out.acceptor.promised
+                    + st.learner.violations[None, :]
+                )
+            )
+
+        return steering
+
+    findings = _probe("paxos", "default", wrap)
+    assert any(
+        f.check == "flow-checker"
+        and "learner.violations" in f.message
+        and "acceptor.promised" in f.message
+        for f in findings
+    ), [str(f) for f in findings]
+
+
+def test_mutation_margin_into_timeout_detected():
+    """Theorem 1: a near-miss margin counter read back into timeout logic
+    (the exact feedback loop the margin plane promises never to close)."""
+
+    def wrap(step, cfg):
+        def adaptive(st, key, pl):
+            out = step(st, key, pl, cfg.fault)
+            hot = (st.margin.qslack_min[None, :] < 4).astype(jnp.int32)
+            return out.replace(
+                proposer=out.proposer.replace(timer=out.proposer.timer + hot)
+            )
+
+        return adaptive
+
+    findings = _probe("paxos", "margin", wrap)
+    assert any(
+        f.check == "flow-observer"
+        and "margin.qslack_min" in f.message
+        and "proposer.timer" in f.message
+        for f in findings
+    ), [str(f) for f in findings]
+
+
+def test_checker_exemption_is_multipaxos_only():
+    """Multi-Paxos's lease legitimately reads learner.chosen; the spec
+    disables checker seeding there and ONLY there."""
+    for protocol in PROTOCOLS:
+        cfg = trace_mod.build_config(protocol, "default")
+        spec = flow.build_spec(protocol, cfg)
+        assert spec.check_checker == (protocol != "multipaxos"), protocol
+
+
+# ------------------------------------------------- fuzz purity (satellite)
+
+
+def test_fuzz_package_is_lint_clean():
+    """fuzz/ rides TRACED_PACKAGES: no host entropy or wall clock."""
+    assert "fuzz" in purity.TRACED_PACKAGES
+    findings = [
+        f for f in purity.audit_traced_sources()
+        if "/fuzz/" in f.where or f.where.startswith("paxos_tpu/fuzz")
+    ]
+    assert findings == [], findings
+
+
+def test_splitmix64_streams_are_pure_integer():
+    """Mutation/energy draws are plain Python ints, reproducible, and
+    independent across forks — the replayable-campaign contract."""
+    from paxos_tpu.fuzz.mutate import SplitMix64, entry_stream
+
+    a, b = entry_stream(12345, 7), entry_stream(12345, 7)
+    seq = [a.next_u64() for _ in range(8)]
+    assert seq == [b.next_u64() for _ in range(8)]
+    assert all(type(x) is int and 0 <= x < (1 << 64) for x in seq)
+    c1, c2 = SplitMix64(99).fork(3), SplitMix64(99).fork(3)
+    assert c1.next_u64() == c2.next_u64()
+    assert type(c1.below(10)) is int
