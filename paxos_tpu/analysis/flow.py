@@ -79,6 +79,7 @@ PLAN_CHANNELS = {
     "link_dup": "flaky",
     "ptimeout": "skew",
     "pboff": "skew",
+    "link_delay": "delay",
 }
 
 # Allowlisted cross-lane reduction regions (kernels.quorum.lane_reduce
@@ -161,6 +162,8 @@ def fault_sites(protocol: str) -> "dict[str, frozenset[str]]":
         from paxos_tpu.core.fp_state import FP_FAULT_SITES as table
     elif protocol == "raftcore":
         from paxos_tpu.core.raft_state import RAFT_FAULT_SITES as table
+    elif protocol == "synchpaxos":
+        from paxos_tpu.core.sp_state import SP_FAULT_SITES as table
     else:
         raise ValueError(f"unknown protocol {protocol!r}")
     merged = dict(INJECTOR_FAULT_SITES)

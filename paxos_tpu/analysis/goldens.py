@@ -42,42 +42,66 @@ via the per-protocol ``__reads__``/``__writes__`` globs — since
 re-keyed under the *-packed-v3 versions and every CONFIG cell re-keyed
 through the version fold.  No packed word changed: margin arrays ride
 the generic passthrough codec, like coverage and exposure before them.
+
+Round 15 re-record: the bounded-delay fault dimension plus SynchPaxos.
+``MsgBuf`` (and the Multi-Paxos promise/accepted buffers) gained an
+optional ``until`` delivery-stamp leaf (None when ``p_delay`` is off), so
+every TREEDEF cell re-keyed; ``FaultConfig`` gained the delay/SynchPaxos
+knobs (p_delay / delay_max / delta / sp_unsafe_fast / ballot_stride), so
+every CONFIG cell re-keyed through the fingerprint; the four existing
+layouts bumped to *-packed-v4 (the ``until`` stamps ride the full-int32
+passthrough, no packed word changed) and the synchpaxos rows landed
+(synchpaxos-packed-v1 shares the classic single-decree widths).  The new
+"delay-chaos" audit column pins the delay-lit trace across the matrix.
 """
 
 # (protocol, config_name) -> sha256[:16] of str(tree_structure(init_state))
 TREEDEF_GOLDENS: dict = {
-    ("paxos", "default"): "b944b96eecb6916b",
-    ("paxos", "gray-chaos"): "b944b96eecb6916b",
-    ("paxos", "corrupt"): "b944b96eecb6916b",
-    ("paxos", "stale"): "57701d5e08af921d",
-    ("paxos", "telemetry"): "908380c70bf11357",
-    ("paxos", "coverage"): "020d06ba22d05602",
-    ("paxos", "exposure"): "88c737d571032a75",
-    ("paxos", "margin"): "c947f544922d8dec",
-    ("multipaxos", "default"): "4c14452e0c86cf21",
-    ("multipaxos", "gray-chaos"): "4c14452e0c86cf21",
-    ("multipaxos", "corrupt"): "4c14452e0c86cf21",
-    ("multipaxos", "stale"): "3bd7c26ccfe579f4",
-    ("multipaxos", "telemetry"): "323fcfc3ea7b5a65",
-    ("multipaxos", "coverage"): "f56ad531d82cf7de",
-    ("multipaxos", "exposure"): "8987d6e996265649",
-    ("multipaxos", "margin"): "349ec6b34e3a8e5b",
-    ("fastpaxos", "default"): "dc7bc31711913343",
-    ("fastpaxos", "gray-chaos"): "dc7bc31711913343",
-    ("fastpaxos", "corrupt"): "dc7bc31711913343",
-    ("fastpaxos", "stale"): "d55120263fd2c558",
-    ("fastpaxos", "telemetry"): "6c909576a4254e82",
-    ("fastpaxos", "coverage"): "58d871e93cedb922",
-    ("fastpaxos", "exposure"): "1557839690837a21",
-    ("fastpaxos", "margin"): "eb72261b26b797f0",
-    ("raftcore", "default"): "e3edde71713d0764",
-    ("raftcore", "gray-chaos"): "e3edde71713d0764",
-    ("raftcore", "corrupt"): "e3edde71713d0764",
-    ("raftcore", "stale"): "e8b2170a5e3c9bdd",
-    ("raftcore", "telemetry"): "dc51a7e9f7d6e61d",
-    ("raftcore", "coverage"): "299c2f793394aaa8",
-    ("raftcore", "exposure"): "3207dd7b792d96e6",
-    ("raftcore", "margin"): "2e4b9fcbe2bfeb7b",
+    ("paxos", "default"): "d1b384bdf7c12cb4",
+    ("paxos", "gray-chaos"): "d1b384bdf7c12cb4",
+    ("paxos", "corrupt"): "d1b384bdf7c12cb4",
+    ("paxos", "stale"): "5946cbcfadf07a11",
+    ("paxos", "delay-chaos"): "1373162dd29aeead",
+    ("paxos", "telemetry"): "d0c90bec05168644",
+    ("paxos", "coverage"): "7c39467783b4c11f",
+    ("paxos", "exposure"): "aae1664487efc910",
+    ("paxos", "margin"): "2cf5cd51b89df366",
+    ("multipaxos", "default"): "8b3457ca18d0180b",
+    ("multipaxos", "gray-chaos"): "8b3457ca18d0180b",
+    ("multipaxos", "corrupt"): "8b3457ca18d0180b",
+    ("multipaxos", "stale"): "4aa0b22e5ffd96ba",
+    ("multipaxos", "delay-chaos"): "e7ac97da20e179b5",
+    ("multipaxos", "telemetry"): "bf450a0c3ccf42fd",
+    ("multipaxos", "coverage"): "83619e5cbc764d11",
+    ("multipaxos", "exposure"): "b9e65e6bc2fda4f5",
+    ("multipaxos", "margin"): "e25a26b6ff5c1aa6",
+    ("fastpaxos", "default"): "0f041f362033a791",
+    ("fastpaxos", "gray-chaos"): "0f041f362033a791",
+    ("fastpaxos", "corrupt"): "0f041f362033a791",
+    ("fastpaxos", "stale"): "5ced11eb75c51e60",
+    ("fastpaxos", "delay-chaos"): "4cbd71ea64e4942c",
+    ("fastpaxos", "telemetry"): "739fc9ea50d27d27",
+    ("fastpaxos", "coverage"): "6d74f9a1ad375394",
+    ("fastpaxos", "exposure"): "1517ae82531f1779",
+    ("fastpaxos", "margin"): "089b773e7295f2a6",
+    ("raftcore", "default"): "6369bfbff79b8889",
+    ("raftcore", "gray-chaos"): "6369bfbff79b8889",
+    ("raftcore", "corrupt"): "6369bfbff79b8889",
+    ("raftcore", "stale"): "262e5e8ae320eaf1",
+    ("raftcore", "delay-chaos"): "796562935be87a22",
+    ("raftcore", "telemetry"): "b9ab38074703f5b4",
+    ("raftcore", "coverage"): "a0423ac5b0e247a2",
+    ("raftcore", "exposure"): "b263e47f185d8a99",
+    ("raftcore", "margin"): "fcd96baa3a162c43",
+    ("synchpaxos", "default"): "0b46bc59f360ccc3",
+    ("synchpaxos", "gray-chaos"): "0b46bc59f360ccc3",
+    ("synchpaxos", "corrupt"): "0b46bc59f360ccc3",
+    ("synchpaxos", "stale"): "734fa46e100e5d8e",
+    ("synchpaxos", "delay-chaos"): "5bc9d66d5887f491",
+    ("synchpaxos", "telemetry"): "2d0f7de9dc8167f1",
+    ("synchpaxos", "coverage"): "c2e1d73b586f893e",
+    ("synchpaxos", "exposure"): "903c29bb5ac1dc84",
+    ("synchpaxos", "margin"): "1d1def6ac4d17f80",
 }
 
 # (protocol, config_name) -> SimConfig.fingerprint() of the audit config
@@ -85,38 +109,51 @@ TREEDEF_GOLDENS: dict = {
 # the per-protocol layout version (paxos-packed-v1 / multipaxos-packed-v1 /
 # fastpaxos-packed-v1 / raftcore-packed-v1), re-keying every cell.
 CONFIG_GOLDENS: dict = {
-    ("paxos", "default"): "2f2c18a912fd9d9f",
-    ("paxos", "gray-chaos"): "1ca7815b8ded8f80",
-    ("paxos", "corrupt"): "34b6abbb425004e2",
-    ("paxos", "stale"): "4700921b7f908b7f",
-    ("paxos", "telemetry"): "15fd1a096d103553",
-    ("paxos", "coverage"): "8ac6f2bb875b4564",
-    ("paxos", "exposure"): "c07f92cc60bbf635",
-    ("paxos", "margin"): "e17ce877e256b71c",
-    ("multipaxos", "default"): "a92a094d538d14e8",
-    ("multipaxos", "gray-chaos"): "d2d0078df18f7bdc",
-    ("multipaxos", "corrupt"): "70b8b09fbdab2c0b",
-    ("multipaxos", "stale"): "eb1a07fa0d72ae6f",
-    ("multipaxos", "telemetry"): "889fed636367e055",
-    ("multipaxos", "coverage"): "21ae9e433def7c67",
-    ("multipaxos", "exposure"): "d6ec699879cdc876",
-    ("multipaxos", "margin"): "5457a5841cb263e1",
-    ("fastpaxos", "default"): "1e0a4848f3c6713a",
-    ("fastpaxos", "gray-chaos"): "f23cda06403ec7e2",
-    ("fastpaxos", "corrupt"): "f64e61267636c6c4",
-    ("fastpaxos", "stale"): "5531b38c51d3389b",
-    ("fastpaxos", "telemetry"): "d547af2c3903f6fd",
-    ("fastpaxos", "coverage"): "41bfdaf87b1d61cb",
-    ("fastpaxos", "exposure"): "3d4360e4c1e628df",
-    ("fastpaxos", "margin"): "b975b70c4f9e7b4f",
-    ("raftcore", "default"): "8b3a6800f7c68486",
-    ("raftcore", "gray-chaos"): "c511f800922f6478",
-    ("raftcore", "corrupt"): "cbebe656f68feba2",
-    ("raftcore", "stale"): "aeba76a9df603c7e",
-    ("raftcore", "telemetry"): "8289428af0eba4d7",
-    ("raftcore", "coverage"): "4e059d075c566e47",
-    ("raftcore", "exposure"): "65e509af4be13f0e",
-    ("raftcore", "margin"): "0f9cc700f0b45551",
+    ("paxos", "default"): "d2367d0ccaf4df1e",
+    ("paxos", "gray-chaos"): "9f09bee6a58b0247",
+    ("paxos", "corrupt"): "00576b428f4cdec5",
+    ("paxos", "stale"): "9ca806c50a1fe1b9",
+    ("paxos", "delay-chaos"): "cad3ea76428a3a00",
+    ("paxos", "telemetry"): "526797092404957d",
+    ("paxos", "coverage"): "2d8f71710d52fe5f",
+    ("paxos", "exposure"): "3def41a92aedfc70",
+    ("paxos", "margin"): "555d36a19b0c3b31",
+    ("multipaxos", "default"): "cf1c4abcbad29c64",
+    ("multipaxos", "gray-chaos"): "0ecc0377861dde26",
+    ("multipaxos", "corrupt"): "ed256ed66b19bbf7",
+    ("multipaxos", "stale"): "fd1fcb1dffa8d769",
+    ("multipaxos", "delay-chaos"): "e39169374aab173c",
+    ("multipaxos", "telemetry"): "dccc306fe36d43cd",
+    ("multipaxos", "coverage"): "be71e2b9117cbdd3",
+    ("multipaxos", "exposure"): "d78d94882cfdc4bf",
+    ("multipaxos", "margin"): "d8702c56eb7c03ba",
+    ("fastpaxos", "default"): "d154a3728a21c32c",
+    ("fastpaxos", "gray-chaos"): "26e04659a98a4689",
+    ("fastpaxos", "corrupt"): "e11dfadc0b1bb7e1",
+    ("fastpaxos", "stale"): "afa9b79d3d4c124c",
+    ("fastpaxos", "delay-chaos"): "90f2518ec0118977",
+    ("fastpaxos", "telemetry"): "e6e09fbb82dd00df",
+    ("fastpaxos", "coverage"): "be0e831f1f236579",
+    ("fastpaxos", "exposure"): "abd8b026f01be70d",
+    ("fastpaxos", "margin"): "7ccac7cc9158e4a4",
+    ("raftcore", "default"): "2cfa9a3a96ee74ec",
+    ("raftcore", "gray-chaos"): "7636267dbe764fc8",
+    ("raftcore", "corrupt"): "e34cf38c966c8a95",
+    ("raftcore", "stale"): "6fc365e38059ece0",
+    ("raftcore", "delay-chaos"): "a2430716e6f2bfa5",
+    ("raftcore", "telemetry"): "ad85e3d15e7712e4",
+    ("raftcore", "coverage"): "b02c399b79465535",
+    ("raftcore", "exposure"): "c29538c03042099b",
+    ("raftcore", "margin"): "652762bc86ac291b",
+    ("synchpaxos", "default"): "2eab6bb74daf06c1",
+    ("synchpaxos", "gray-chaos"): "01a9b04108544a5d",
+    ("synchpaxos", "corrupt"): "fb9411399ef3cf70",
+    ("synchpaxos", "stale"): "486822d837a9f317",
+    ("synchpaxos", "delay-chaos"): "975ec41373231359",
+    ("synchpaxos", "telemetry"): "db353533a4be68b1",
+    ("synchpaxos", "coverage"): "52194be2f0538706",
+    ("synchpaxos", "exposure"): "a79f1ab6f217adf3",
+    ("synchpaxos", "margin"): "bdc106defdc4a800",
 }
 
 # protocol -> {"version": layout version string, "fields": canonical per-field
@@ -128,7 +165,7 @@ CONFIG_GOLDENS: dict = {
 # name the version in the commit.
 LAYOUT_GOLDENS: dict = {
     "paxos": {
-        "version": "paxos-packed-v3",
+        "version": "paxos-packed-v4",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.promised', 0))]",
@@ -191,7 +228,7 @@ LAYOUT_GOLDENS: dict = {
         },
     },
     "multipaxos": {
-        "version": "multipaxos-packed-v3",
+        "version": "multipaxos-packed-v4",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.promised', 0))]",
@@ -248,7 +285,7 @@ LAYOUT_GOLDENS: dict = {
         },
     },
     "fastpaxos": {
-        "version": "fastpaxos-packed-v3",
+        "version": "fastpaxos-packed-v4",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.promised', 0))]",
@@ -307,7 +344,7 @@ LAYOUT_GOLDENS: dict = {
         },
     },
     "raftcore": {
-        "version": "raftcore-packed-v3",
+        "version": "raftcore-packed-v4",
         "fields": {
             "__dims__":
                 "[('n_acc', ('acceptor.voted', 0))]",
@@ -369,6 +406,69 @@ LAYOUT_GOLDENS: dict = {
                 "zero like=req",
         },
     },
+    "synchpaxos": {
+        "version": "synchpaxos-packed-v1",
+        "fields": {
+            "__dims__":
+                "[('n_acc', ('acceptor.promised', 0))]",
+            "__reads__":
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'margin.*', 'proposer.*', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+            "__writes__":
+                "('acceptor.*', 'coverage.*', 'exposure.*', 'learner.*', 'margin.*', 'proposer.bal', 'proposer.best_bal', 'proposer.best_val', 'proposer.decided_val', 'proposer.heard', 'proposer.phase', 'proposer.prop_val', 'proposer.timer', 'replies.*', 'requests.*', 'telemetry.*', 'tick')",
+            "acceptor.acc_bal":
+                "word=acc slot=1 bits=15 signed=0 bool=0 bv=None",
+            "acceptor.promised":
+                "word=acc slot=0 bits=15 signed=0 bool=0 bv=None",
+            "acceptor.snap_bal":
+                "word=snap_acc slot=1 bits=15 signed=0 bool=0 bv=None optional",
+            "acceptor.snap_promised":
+                "word=snap_acc slot=0 bits=15 signed=0 bool=0 bv=None optional",
+            "learner.chosen":
+                "word=chosen slot=0 bits=1 signed=0 bool=1 bv=None",
+            "learner.chosen_tick":
+                "word=chosen slot=2 bits=19 signed=1 bool=0 bv=None",
+            "learner.chosen_val":
+                "word=chosen slot=1 bits=12 signed=0 bool=0 bv=None",
+            "learner.lt_bal":
+                "word=lt slot=0 bits=15 signed=0 bool=0 bv=None",
+            "learner.lt_mask":
+                "word=lt slot=2 bits=n_acc signed=0 bool=0 bv=None",
+            "learner.lt_val":
+                "word=lt slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.bal":
+                "word=prop0 slot=0 bits=17 signed=0 bool=0 bv=None",
+            "proposer.best_bal":
+                "word=prop2 slot=1 bits=15 signed=0 bool=0 bv=None",
+            "proposer.best_val":
+                "word=prop3 slot=0 bits=12 signed=0 bool=0 bv=None",
+            "proposer.decided_val":
+                "word=prop3 slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.heard":
+                "word=prop2 slot=0 bits=16 signed=0 bool=0 bv=None",
+            "proposer.own_val":
+                "word=prop1 slot=0 bits=12 signed=0 bool=0 bv=None",
+            "proposer.phase":
+                "word=prop0 slot=1 bits=2 signed=0 bool=0 bv=None",
+            "proposer.prop_val":
+                "word=prop1 slot=1 bits=12 signed=0 bool=0 bv=None",
+            "proposer.timer":
+                "word=prop0 slot=2 bits=13 signed=1 bool=0 bv=None",
+            "replies.bal":
+                "word=rep slot=0 bits=15 signed=0 bool=0 bv=None",
+            "replies.present":
+                "word=rep slot=2 bits=1 signed=0 bool=1 bv=None",
+            "replies.v2":
+                "word=rep slot=1 bits=12 signed=0 bool=0 bv=None",
+            "requests.bal":
+                "word=req slot=0 bits=15 signed=0 bool=0 bv=None",
+            "requests.present":
+                "word=req slot=2 bits=1 signed=0 bool=1 bv=None",
+            "requests.v1":
+                "word=req slot=1 bits=12 signed=0 bool=0 bv=None",
+            "requests.v2":
+                "zero like=req",
+        },
+    },
 }
 
 # Recursive eqn counts per (protocol, config) audit cell, both engines —
@@ -382,6 +482,7 @@ EQN_GOLDENS: dict = {
     ("paxos", "gray-chaos"): {"xla": 824, "ctr": 885},
     ("paxos", "corrupt"): {"xla": 774, "ctr": 881},
     ("paxos", "stale"): {"xla": 787, "ctr": 883},
+    ("paxos", "delay-chaos"): {"xla": 845, "ctr": 957},
     ("paxos", "telemetry"): {"xla": 756, "ctr": 744},
     ("paxos", "coverage"): {"xla": 926, "ctr": 914},
     ("paxos", "exposure"): {"xla": 981, "ctr": 1042},
@@ -390,6 +491,7 @@ EQN_GOLDENS: dict = {
     ("multipaxos", "gray-chaos"): {"xla": 1023, "ctr": 1079},
     ("multipaxos", "corrupt"): {"xla": 983, "ctr": 1088},
     ("multipaxos", "stale"): {"xla": 996, "ctr": 1090},
+    ("multipaxos", "delay-chaos"): {"xla": 1034, "ctr": 1124},
     ("multipaxos", "telemetry"): {"xla": 920, "ctr": 892},
     ("multipaxos", "coverage"): {"xla": 1258, "ctr": 1230},
     ("multipaxos", "exposure"): {"xla": 1175, "ctr": 1231},
@@ -398,6 +500,7 @@ EQN_GOLDENS: dict = {
     ("fastpaxos", "gray-chaos"): {"xla": 1120, "ctr": 1181},
     ("fastpaxos", "corrupt"): {"xla": 1070, "ctr": 1177},
     ("fastpaxos", "stale"): {"xla": 1083, "ctr": 1179},
+    ("fastpaxos", "delay-chaos"): {"xla": 1141, "ctr": 1253},
     ("fastpaxos", "telemetry"): {"xla": 968, "ctr": 956},
     ("fastpaxos", "coverage"): {"xla": 1138, "ctr": 1126},
     ("fastpaxos", "exposure"): {"xla": 1279, "ctr": 1340},
@@ -406,8 +509,18 @@ EQN_GOLDENS: dict = {
     ("raftcore", "gray-chaos"): {"xla": 856, "ctr": 917},
     ("raftcore", "corrupt"): {"xla": 806, "ctr": 913},
     ("raftcore", "stale"): {"xla": 819, "ctr": 915},
+    ("raftcore", "delay-chaos"): {"xla": 877, "ctr": 989},
     ("raftcore", "telemetry"): {"xla": 788, "ctr": 776},
     ("raftcore", "coverage"): {"xla": 958, "ctr": 946},
     ("raftcore", "exposure"): {"xla": 1011, "ctr": 1072},
     ("raftcore", "margin"): {"xla": 712, "ctr": 700},
+    ("synchpaxos", "default"): {"xla": 648, "ctr": 636},
+    ("synchpaxos", "gray-chaos"): {"xla": 865, "ctr": 926},
+    ("synchpaxos", "corrupt"): {"xla": 817, "ctr": 924},
+    ("synchpaxos", "stale"): {"xla": 830, "ctr": 926},
+    ("synchpaxos", "delay-chaos"): {"xla": 893, "ctr": 1005},
+    ("synchpaxos", "telemetry"): {"xla": 799, "ctr": 787},
+    ("synchpaxos", "coverage"): {"xla": 968, "ctr": 956},
+    ("synchpaxos", "exposure"): {"xla": 1030, "ctr": 1091},
+    ("synchpaxos", "margin"): {"xla": 722, "ctr": 710},
 }

@@ -37,6 +37,8 @@ def _allowed_gray_tick_names(cfg: FaultConfig) -> set:
         names.add("DUP_BITS")
     if cfg.p_corrupt > 0.0:
         names.add("CORRUPT")
+    if cfg.p_delay > 0.0:
+        names |= {"DELAY_BITS", "LAT_BITS"}
     return names
 
 
@@ -53,6 +55,8 @@ def expected_plan_folds(cfg: FaultConfig) -> set:
         names.add("PTIMEOUT")
     if cfg.backoff_skew > 1:
         names.add("PBOFF")
+    if cfg.p_delay > 0.0:
+        names.add("LINK_DELAY")
     return {streams_mod.PLAN_FOLDS[n] for n in names}
 
 

@@ -42,6 +42,11 @@ _KNOB_LEAVES = (
         "stale_k == 0",
     ),
     (
+        lambda name: name == "until",
+        lambda cfg: cfg.fault.p_delay > 0.0,
+        "p_delay == 0",
+    ),
+    (
         lambda name: name == "coverage",
         lambda cfg: cfg.coverage.enabled(),
         "coverage disabled",
@@ -58,7 +63,9 @@ _KNOB_LEAVES = (
     ),
 )
 
-_PLAN_GRAY_FIELDS = ("part_dir", "link_drop", "link_dup", "ptimeout", "pboff")
+_PLAN_GRAY_FIELDS = (
+    "part_dir", "link_drop", "link_dup", "ptimeout", "pboff", "link_delay",
+)
 
 
 def treedef_fingerprint(tree) -> str:
@@ -122,6 +129,7 @@ def audit_default_off_leaves(
         and (fault.p_dup > 0.0 or fault.flaky_dup > 0.0),
         "ptimeout": fault.timeout_skew > 0,
         "pboff": fault.backoff_skew > 1,
+        "link_delay": fault.p_delay > 0.0,
     }
     for field in _PLAN_GRAY_FIELDS:
         value = getattr(plan, field)
@@ -275,6 +283,7 @@ _STATE_FILES = {
     "multipaxos": "paxos_tpu/core/mp_state.py",
     "fastpaxos": "paxos_tpu/core/fp_state.py",
     "raftcore": "paxos_tpu/core/raft_state.py",
+    "synchpaxos": "paxos_tpu/core/sp_state.py",
 }
 
 

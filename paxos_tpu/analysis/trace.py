@@ -19,6 +19,7 @@ from paxos_tpu.faults.injector import FaultPlan
 from paxos_tpu.harness.config import (
     SimConfig,
     config_corrupt,
+    config_delay_chaos,
     config_gray_chaos,
     config_stale,
 )
@@ -26,7 +27,7 @@ from paxos_tpu.harness.run import base_key, get_step_fn, init_plan, init_state
 from paxos_tpu.kernels.counter_prng import mix
 from paxos_tpu.kernels.fused_tick import fused_fns
 
-PROTOCOLS = ("paxos", "multipaxos", "fastpaxos", "raftcore")
+PROTOCOLS = ("paxos", "multipaxos", "fastpaxos", "raftcore", "synchpaxos")
 
 _AUDIT_N_INST = 64
 _AUDIT_SEED = 3
@@ -52,6 +53,10 @@ def _corrupt(protocol: str) -> SimConfig:
 
 def _stale(protocol: str) -> SimConfig:
     return _small(config_stale(), protocol)
+
+
+def _delay(protocol: str) -> SimConfig:
+    return _small(config_delay_chaos(), protocol)
 
 
 def _telemetry(protocol: str) -> SimConfig:
@@ -93,6 +98,7 @@ CONFIG_MATRIX: dict[str, Callable[[str], SimConfig]] = {
     "gray-chaos": _gray,
     "corrupt": _corrupt,
     "stale": _stale,
+    "delay-chaos": _delay,
     "telemetry": _telemetry,
     "coverage": _coverage,
     "exposure": _exposure,

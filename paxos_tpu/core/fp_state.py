@@ -111,6 +111,7 @@ class FastPaxosState:
         n_acc: int,
         k: int = 8,
         stale: bool = False,
+        delay: bool = False,
     ) -> "FastPaxosState":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
         from paxos_tpu.utils.bitops import MAX_ACCEPTORS
@@ -126,7 +127,7 @@ class FastPaxosState:
         proposer = FastProposerState.init(n_inst, n_prop)
         # The fast round is in flight at tick 0: every proposer's
         # Accept(fast_bal, own_val) broadcast occupies its ACCEPT slots.
-        requests = MsgBuf.empty(n_inst, n_prop, n_acc)
+        requests = MsgBuf.empty(n_inst, n_prop, n_acc, delay=delay)
         shape = (n_prop, n_acc, n_inst)
         requests = requests.replace(
             bal=requests.bal.at[ACCEPT].set(
@@ -142,7 +143,7 @@ class FastPaxosState:
             proposer=proposer,
             learner=LearnerState.init(n_inst, k),
             requests=requests,
-            replies=MsgBuf.empty(n_inst, n_prop, n_acc),
+            replies=MsgBuf.empty(n_inst, n_prop, n_acc, delay=delay),
             tick=jnp.zeros((), jnp.int32),
         )
 
@@ -157,9 +158,10 @@ class FastPaxosState:
 
 from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
 
-# v3: the margin.* observer plane joined the tick read/write sets (the
-# declarations fold into layout_fields — see core/state.py).
-FP_LAYOUT_VERSION = "fastpaxos-packed-v3"
+# v4: the optional bounded-delay ``until`` stamps (core/messages.py) joined
+# the message buffers — full int32 tick stamps, passed through unpacked
+# like rep_mask (no packing partner at 32 bits).
+FP_LAYOUT_VERSION = "fastpaxos-packed-v4"
 FP_LAYOUT = (
     Word("req", F("requests.bal", 15), F("requests.v1", 12),
          F("requests.present", 1, bool_=True)),
@@ -208,4 +210,5 @@ FP_FAULT_SITES = {
     "equivocate": ("equiv",),
     "flaky": ("flaky",),
     "skew": ("skew",),
+    "delay": ("delay",),
 }

@@ -29,6 +29,8 @@ lanes (measured ~9x step-time difference at 1M instances).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 from flax import struct
 
@@ -52,9 +54,15 @@ class MsgBuf:
     v1: jnp.ndarray  # (2, P, A, I) int32
     v2: jnp.ndarray  # (2, P, A, I) int32
     present: jnp.ndarray  # (2, P, A, I) bool
+    # Bounded-delay stamp (``FaultConfig.p_delay``): a slot is deliverable
+    # only once ``tick >= until``.  None (pruned leaf) when delay is off —
+    # the buffer is then structurally identical to pre-delay builds.
+    until: Optional[jnp.ndarray] = None  # (2, P, A, I) int32
 
     @classmethod
-    def empty(cls, n_inst: int, n_prop: int, n_acc: int) -> "MsgBuf":
+    def empty(
+        cls, n_inst: int, n_prop: int, n_acc: int, delay: bool = False
+    ) -> "MsgBuf":
         shape = (2, n_prop, n_acc, n_inst)
         # Fresh buffer per field: aliased leaves break buffer donation.
         return cls(
@@ -62,4 +70,5 @@ class MsgBuf:
             v1=jnp.zeros(shape, jnp.int32),
             v2=jnp.zeros(shape, jnp.int32),
             present=jnp.zeros(shape, jnp.bool_),
+            until=jnp.zeros(shape, jnp.int32) if delay else None,
         )

@@ -178,13 +178,25 @@ class PromiseBuf:
     present: jnp.ndarray  # (P, A, I) bool
     bal: jnp.ndarray  # (P, A, I) int32 — the promised ballot
     p_bv: jnp.ndarray  # (P, A, L, I) int32 — packed accepted (bal, val) per slot
+    # Bounded-delay delivery stamp (FaultConfig.p_delay): first tick the
+    # slot may be consumed; 0 = deliverable immediately.  None (pruned)
+    # when delay is off — see core/messages.MsgBuf.until.
+    until: Optional[jnp.ndarray] = None  # (P, A, I) int32
 
     @classmethod
-    def empty(cls, n_inst: int, n_prop: int, n_acc: int, log_len: int) -> "PromiseBuf":
+    def empty(
+        cls, n_inst: int, n_prop: int, n_acc: int, log_len: int,
+        delay: bool = False,
+    ) -> "PromiseBuf":
         return cls(
             present=jnp.zeros((n_prop, n_acc, n_inst), jnp.bool_),
             bal=jnp.zeros((n_prop, n_acc, n_inst), jnp.int32),
             p_bv=jnp.zeros((n_prop, n_acc, log_len, n_inst), jnp.int32),
+            until=(
+                jnp.zeros((n_prop, n_acc, n_inst), jnp.int32)
+                if delay
+                else None
+            ),
         )
 
 
@@ -196,14 +208,23 @@ class AcceptedBuf:
     bal: jnp.ndarray  # (P, A, I) int32
     slot: jnp.ndarray  # (P, A, I) int32
     val: jnp.ndarray  # (P, A, I) int32
+    # Bounded-delay delivery stamp; None (pruned) when delay is off.
+    until: Optional[jnp.ndarray] = None  # (P, A, I) int32
 
     @classmethod
-    def empty(cls, n_inst: int, n_prop: int, n_acc: int) -> "AcceptedBuf":
+    def empty(
+        cls, n_inst: int, n_prop: int, n_acc: int, delay: bool = False
+    ) -> "AcceptedBuf":
         return cls(
             present=jnp.zeros((n_prop, n_acc, n_inst), jnp.bool_),
             bal=jnp.zeros((n_prop, n_acc, n_inst), jnp.int32),
             slot=jnp.zeros((n_prop, n_acc, n_inst), jnp.int32),
             val=jnp.zeros((n_prop, n_acc, n_inst), jnp.int32),
+            until=(
+                jnp.zeros((n_prop, n_acc, n_inst), jnp.int32)
+                if delay
+                else None
+            ),
         )
 
 
@@ -241,6 +262,7 @@ class MultiPaxosState:
         k: int = 4,
         lease_init: int = 0,
         stale: bool = False,
+        delay: bool = False,
     ) -> "MultiPaxosState":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
         from paxos_tpu.utils.bitops import MAX_ACCEPTORS
@@ -253,9 +275,10 @@ class MultiPaxosState:
             acceptor=MPAcceptorState.init(n_inst, n_acc, log_len, stale=stale),
             proposer=MPProposerState.init(n_inst, n_prop, log_len, lease_init),
             learner=MPLearnerState.init(n_inst, log_len, k),
-            requests=MsgBuf.empty(n_inst, n_prop, n_acc),
-            promises=PromiseBuf.empty(n_inst, n_prop, n_acc, log_len),
-            accepted=AcceptedBuf.empty(n_inst, n_prop, n_acc),
+            requests=MsgBuf.empty(n_inst, n_prop, n_acc, delay=delay),
+            promises=PromiseBuf.empty(n_inst, n_prop, n_acc, log_len,
+                                      delay=delay),
+            accepted=AcceptedBuf.empty(n_inst, n_prop, n_acc, delay=delay),
             tick=jnp.zeros((), jnp.int32),
             base=jnp.zeros((n_inst,), jnp.int32),
         )
@@ -293,9 +316,10 @@ class MultiPaxosState:
 
 from paxos_tpu.utils.bitops import F, Stream, Word  # noqa: E402
 
-# v3: the margin.* observer plane joined the tick read/write sets (the
-# declarations fold into layout_fields — see core/state.py).
-MP_LAYOUT_VERSION = "multipaxos-packed-v3"
+# v4: the optional bounded-delay ``until`` stamps joined all three message
+# buffers (requests / promises / accepted) — full int32 tick stamps,
+# passed through unpacked.
+MP_LAYOUT_VERSION = "multipaxos-packed-v4"
 MP_LAYOUT = (
     Word("req", F("requests.bal", 12), F("requests.v1", 13),
          F("requests.present", 1, bool_=True)),
@@ -347,4 +371,5 @@ MP_FAULT_SITES = {
     "equivocate": ("equiv",),
     "flaky": ("flaky",),
     "skew": ("skew",),
+    "delay": ("delay",),
 }

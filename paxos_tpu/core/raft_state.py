@@ -140,6 +140,7 @@ class RaftState:
         n_acc: int,
         k: int = 8,
         stale: bool = False,
+        delay: bool = False,
     ) -> "RaftState":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
         from paxos_tpu.utils.bitops import MAX_ACCEPTORS
@@ -154,7 +155,7 @@ class RaftState:
             )
         proposer = CandidateState.init(n_inst, n_prop)
         # Every candidate opens with a RequestVote broadcast in flight.
-        requests = MsgBuf.empty(n_inst, n_prop, n_acc)
+        requests = MsgBuf.empty(n_inst, n_prop, n_acc, delay=delay)
         shape = (n_prop, n_acc, n_inst)
         requests = requests.replace(
             bal=requests.bal.at[REQVOTE].set(
@@ -167,7 +168,7 @@ class RaftState:
             proposer=proposer,
             learner=LearnerState.init(n_inst, k),
             requests=requests,
-            replies=MsgBuf.empty(n_inst, n_prop, n_acc),
+            replies=MsgBuf.empty(n_inst, n_prop, n_acc, delay=delay),
             tick=jnp.zeros((), jnp.int32),
         )
 
@@ -184,9 +185,9 @@ class RaftState:
 
 from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
 
-# v3: the margin.* observer plane joined the tick read/write sets (the
-# declarations fold into layout_fields — see core/state.py).
-RAFT_LAYOUT_VERSION = "raftcore-packed-v3"
+# v4: the optional bounded-delay ``until`` stamps (core/messages.py) joined
+# the message buffers — full int32 tick stamps, passed through unpacked.
+RAFT_LAYOUT_VERSION = "raftcore-packed-v4"
 RAFT_LAYOUT = (
     Word("req", F("requests.bal", 15), F("requests.v1", 15),
          F("requests.present", 1, bool_=True)),
@@ -235,4 +236,5 @@ RAFT_FAULT_SITES = {
     "equivocate": ("equiv",),
     "flaky": ("flaky",),
     "skew": ("skew",),
+    "delay": ("delay",),
 }

@@ -1,0 +1,203 @@
+"""SynchPaxos state — classic Paxos roles plus a synchrony-exploiting leader.
+
+SynchPaxos (after the bounded-delay SMR line of arXiv:2507.12792) is the
+fifth protocol of the sweep: it EXPLOITS the bounded-delay fault dimension
+(``FaultConfig.p_delay`` / ``delta``) instead of merely tolerating it.
+
+Protocol shape, built so safety never depends on the synchrony bet:
+
+- **Fast path (round 0)**: a designated leader (proposer 0) owns the unique
+  round-0 ballot ``sync_ballot() = make_ballot(0, 0)`` and broadcasts
+  ``Accept(sync_bal, own_val)`` at tick 0, skipping phase 1.  It decides
+  when a **majority** of Accepted arrives while its timer is still inside
+  the synchrony window ``delta`` — one round trip when the network honors
+  the bound.  Because round 0 has a single owner, a majority quorum at that
+  ballot is just classic phase 2: the delta guard is a liveness/latency
+  bet, never a safety assumption.
+- **Classic fallback**: the leader abandons the fast attempt when its timer
+  exceeds ``delta`` (followers wait out the normal ``timeout``), then runs
+  ordinary Paxos rounds (>= 1) with phase-1 recovery — which adopts the
+  round-0 value if any acceptor reports it, so a late fast quorum can never
+  contradict a fallback decision.
+- **Followers** start passive in P1 with nothing in flight: their first
+  send is a classic PREPARE after ``timeout`` ticks of no progress.  No
+  follower ever emits a round-0 message, preserving round-0's single owner.
+
+``FaultConfig.sp_unsafe_fast`` is the planted delay-unsafe bug: the leader
+commits its fast value on the FIRST Accepted heard, without the delta
+window or the quorum — the bogus "one ack within the window implies
+everyone got it" synchrony shortcut.  Under delta-violating delays (plus
+loss) the checker must flag it (proposer/learner disagreement).
+
+The state pytree reuses the classic single-decree role dataclasses
+(:class:`~paxos_tpu.core.state.AcceptorState` /
+:class:`~paxos_tpu.core.state.ProposerState` /
+:class:`~paxos_tpu.core.state.LearnerState` and the
+:class:`~paxos_tpu.core.messages.MsgBuf` wire format) — only the init
+differs, so the identical fault plan drives SynchPaxos alongside the other
+four protocols.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import struct
+
+from paxos_tpu.core.ballot import make_ballot
+from paxos_tpu.core.messages import MsgBuf
+from paxos_tpu.core.state import (
+    DONE,
+    P1,
+    P2,
+    AcceptorState,
+    LearnerState,
+    ProposerState,
+)
+from paxos_tpu.core.telemetry import TelemetryState
+from paxos_tpu.obs.coverage import CoverageState
+from paxos_tpu.obs.exposure import FaultExposure
+from paxos_tpu.obs.margin import MarginState
+
+# Proposer phases: P1/P2/DONE match core.state so summarize() is shared;
+# FAST is the leader's round-0 window (fits the layout's 2-bit phase field,
+# same budget as fastpaxos' FAST).
+FAST = 3
+
+# Value encoding: proposer p proposes VALUE_BASE + p (ProposerState.init).
+VALUE_BASE = 100
+
+
+def sync_ballot() -> jnp.ndarray:
+    """The leader-owned round-0 ballot of the fast path."""
+    return make_ballot(0, 0)
+
+
+@struct.dataclass
+class SynchPaxosState:
+    """Full simulator state for SynchPaxos: one pytree, scanned and sharded."""
+
+    acceptor: AcceptorState
+    proposer: ProposerState
+    learner: LearnerState
+    requests: MsgBuf  # proposer -> acceptor (PREPARE / ACCEPT)
+    replies: MsgBuf  # acceptor -> proposer (PROMISE / ACCEPTED)
+    tick: jnp.ndarray  # () int32
+    # Flight recorder / telemetry (core.telemetry): None when disabled.
+    telemetry: Optional[TelemetryState] = None
+    # Coverage sketch (obs.coverage): None when disabled, same contract.
+    coverage: Optional[CoverageState] = None
+    # Fault-exposure counters (obs.exposure): None when disabled, same contract.
+    exposure: Optional[FaultExposure] = None
+    # Near-miss safety-margin sketch (obs.margin): None when disabled, same contract.
+    margin: Optional[MarginState] = None
+
+    @classmethod
+    def init(
+        cls,
+        n_inst: int,
+        n_prop: int,
+        n_acc: int,
+        k: int = 8,
+        stale: bool = False,
+        delay: bool = False,
+    ) -> "SynchPaxosState":
+        from paxos_tpu.core.ballot import MAX_PROPOSERS
+        from paxos_tpu.utils.bitops import MAX_ACCEPTORS
+
+        if not 1 <= n_prop <= MAX_PROPOSERS:
+            raise ValueError(
+                f"n_prop={n_prop} exceeds ballot packing capacity {MAX_PROPOSERS}"
+            )
+        if not 1 <= n_acc <= MAX_ACCEPTORS:
+            raise ValueError(
+                f"n_acc={n_acc} exceeds voter bitmask capacity {MAX_ACCEPTORS}"
+            )
+        proposer = ProposerState.init(n_inst, n_prop)
+        # Leader lane (proposer 0) opens in FAST; the tick function emits its
+        # round-0 Accept broadcast at timer == 0 THROUGH the faulty network
+        # (drop/flaky/delay apply — pre-seeding the buffer here would make
+        # the fast round immune to loss).  Followers idle in P1 with nothing
+        # in flight: their first emit is the post-timeout classic PREPARE.
+        # ProposerState.init already gives row 0 bal == make_ballot(0, 0).
+        leader = (
+            jnp.arange(n_prop, dtype=jnp.int32)[:, None] == 0
+        )  # (P, 1) broadcast against (P, I)
+        proposer = proposer.replace(
+            phase=jnp.broadcast_to(
+                jnp.where(leader, FAST, P1).astype(jnp.int32),
+                (n_prop, n_inst),
+            ),
+        )
+        return cls(
+            acceptor=AcceptorState.init(n_inst, n_acc, stale=stale),
+            proposer=proposer,
+            learner=LearnerState.init(n_inst, k),
+            requests=MsgBuf.empty(n_inst, n_prop, n_acc, delay=delay),
+            replies=MsgBuf.empty(n_inst, n_prop, n_acc, delay=delay),
+            tick=jnp.zeros((), jnp.int32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Packed lane-state layout (utils/bitops) — SynchPaxos shares the classic
+# single-decree widths verbatim (see core/state.py for the rationale); the
+# 2-bit phase field already covers FAST = 3.  Bump the version with ANY
+# table edit.
+
+from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
+
+# v1: born after the bounded-delay plane, so the optional ``until`` stamps
+# (full int32 passthrough lanes) are part of the base layout contract.
+SP_LAYOUT_VERSION = "synchpaxos-packed-v1"
+SP_LAYOUT = (
+    Word("req", F("requests.bal", 15), F("requests.v1", 12),
+         F("requests.present", 1, bool_=True)),
+    Zero("requests.v2", like="req"),
+    Word("rep", F("replies.bal", 15), F("replies.v2", 12),
+         F("replies.present", 1, bool_=True)),
+    Word("acc", F("acceptor.promised", 15), F("acceptor.acc_bal", 15)),
+    Word("snap_acc", F("acceptor.snap_promised", 15),
+         F("acceptor.snap_bal", 15), optional=True),
+    # 17-bit proposer.bal: 2 headroom bits over the 15-bit report threshold
+    # so the chunk-boundary-only ballot clamp (fused_tick) cannot wrap
+    # mid-chunk — see core/state.py.
+    Word("prop0", F("proposer.bal", 17), F("proposer.phase", 2),
+         F("proposer.timer", 13, signed=True)),
+    Word("prop1", F("proposer.own_val", 12), F("proposer.prop_val", 12)),
+    Word("prop2", F("proposer.heard", 16), F("proposer.best_bal", 15)),
+    Word("prop3", F("proposer.best_val", 12), F("proposer.decided_val", 12)),
+    Word("lt", F("learner.lt_bal", 15), F("learner.lt_val", 12),
+         F("learner.lt_mask", "n_acc")),
+    Word("chosen", F("learner.chosen", 1, bool_=True),
+         F("learner.chosen_val", 12),
+         F("learner.chosen_tick", 19, signed=True)),
+)
+SP_LAYOUT_DIMS = {"n_acc": ("acceptor.promised", 0)}
+
+# Tick read/write-set declarations (delta codec + write-set audit — see the
+# read/write-set section of utils/bitops.py).  Identical to classic paxos:
+# the tick writes everything except proposer.own_val.
+SP_TICK_READS = (
+    "acceptor.*", "proposer.*", "learner.*", "requests.*", "replies.*",
+    "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
+)
+SP_TICK_WRITES = (
+    "acceptor.*",
+    "proposer.bal", "proposer.phase", "proposer.timer", "proposer.prop_val",
+    "proposer.heard", "proposer.best_bal", "proposer.best_val",
+    "proposer.decided_val",
+    "learner.*", "requests.*", "replies.*",
+    "telemetry.*", "coverage.*", "exposure.*", "margin.*", "tick",
+)
+
+# Registered fault-injection sites for the dataflow auditor
+# (analysis/flow.py): site name -> fault channels it may absorb; see
+# core/state.py for the registration contract.
+SP_FAULT_SITES = {
+    "equivocate": ("equiv",),
+    "flaky": ("flaky",),
+    "skew": ("skew",),
+    "delay": ("delay",),
+}

@@ -169,6 +169,7 @@ class PaxosState:
         n_acc: int,
         k: int = 8,
         stale: bool = False,
+        delay: bool = False,
     ) -> "PaxosState":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
         from paxos_tpu.utils.bitops import MAX_ACCEPTORS
@@ -185,7 +186,7 @@ class PaxosState:
         # Every proposer opens with a phase-1 broadcast: PREPARE(bal) to all
         # acceptors is in flight at tick 0 (the reference's `forM_ pids $
         # send (Prepare b)` before the first `receiveWait` — SURVEY.md §4.2).
-        requests = MsgBuf.empty(n_inst, n_prop, n_acc)
+        requests = MsgBuf.empty(n_inst, n_prop, n_acc, delay=delay)
         prep_bal = jnp.broadcast_to(
             proposer.bal[:, None, :], (n_prop, n_acc, n_inst)
         )
@@ -198,7 +199,7 @@ class PaxosState:
             proposer=proposer,
             learner=LearnerState.init(n_inst, k),
             requests=requests,
-            replies=MsgBuf.empty(n_inst, n_prop, n_acc),
+            replies=MsgBuf.empty(n_inst, n_prop, n_acc, delay=delay),
             tick=jnp.zeros((), jnp.int32),
         )
 
@@ -233,10 +234,11 @@ class PaxosState:
 
 from paxos_tpu.utils.bitops import F, Word, Zero  # noqa: E402
 
-# v3: the margin.* observer plane joined the tick read/write sets (the
-# declarations fold into layout_fields, so the glob addition re-keys the
-# descriptor even though no packed word changed).
-PAXOS_LAYOUT_VERSION = "paxos-packed-v3"
+# v4: the bounded-delay ``until`` stamps joined the message buffers
+# (requests.until / replies.until, present only under p_delay).  They pass
+# through as full int32 lanes — a delivery tick needs the whole campaign
+# tick range, so packing saves nothing safe.
+PAXOS_LAYOUT_VERSION = "paxos-packed-v4"
 PAXOS_LAYOUT = (
     Word("req", F("requests.bal", 15), F("requests.v1", 12),
          F("requests.present", 1, bool_=True)),
@@ -292,4 +294,5 @@ PAXOS_FAULT_SITES = {
     "equivocate": ("equiv",),
     "flaky": ("flaky",),
     "skew": ("skew",),
+    "delay": ("delay",),
 }

@@ -137,8 +137,12 @@ SINGLE_DECREE = StreamFamily(
         LINK_BITS=10,  # per-link loss raw bits (p_flaky)
         DUP_BITS=11,  # per-link duplication raw bits (p_flaky + dup)
         CORRUPT=12,  # in-flight corruption mask (p_corrupt)
+        DELAY_BITS=13,  # per-edge delay decision raw bits (p_delay)
+        LAT_BITS=14,  # per-edge sampled latency raw bits (delay_max)
     ),
-    gray=frozenset({"LINK_BITS", "DUP_BITS", "CORRUPT"}),
+    gray=frozenset(
+        {"LINK_BITS", "DUP_BITS", "CORRUPT", "DELAY_BITS", "LAT_BITS"}
+    ),
     gray_base=10,
 )
 
@@ -162,8 +166,12 @@ MULTI_PAXOS = StreamFamily(
         LINK_BITS=11,
         DUP_BITS=12,
         CORRUPT=13,
+        DELAY_BITS=14,  # per-edge delay decision raw bits (p_delay)
+        LAT_BITS=15,  # per-edge sampled latency raw bits (delay_max)
     ),
-    gray=frozenset({"LINK_BITS", "DUP_BITS", "CORRUPT"}),
+    gray=frozenset(
+        {"LINK_BITS", "DUP_BITS", "CORRUPT", "DELAY_BITS", "LAT_BITS"}
+    ),
     gray_base=11,
 )
 
@@ -173,6 +181,7 @@ _FAMILY_OF_PROTOCOL = {
     "paxos": SINGLE_DECREE,
     "fastpaxos": SINGLE_DECREE,
     "raftcore": SINGLE_DECREE,
+    "synchpaxos": SINGLE_DECREE,
     "multipaxos": MULTI_PAXOS,
 }
 
@@ -201,6 +210,8 @@ TICK_FOLDS = dict(
     LINK_BITS=100,  # per-link loss raw bits (p_flaky)
     DUP_BITS=101,  # per-link duplication raw bits
     CORRUPT=102,  # in-flight corruption mask (p_corrupt)
+    DELAY_BITS=103,  # per-edge delay decision raw bits (p_delay)
+    LAT_BITS=104,  # per-edge sampled latency raw bits (delay_max)
 )
 
 # Plan domain: fold_in(plan_key, c) inside FaultPlan.sample — gray fields
@@ -213,6 +224,7 @@ PLAN_FOLDS = dict(
     FLAKY_DUP=105,  # per-flaky-link dup rate
     PTIMEOUT=106,  # per-proposer timeout skew (timeout_skew)
     PBOFF=107,  # per-proposer backoff multiplier (backoff_skew)
+    LINK_DELAY=108,  # per-link latency cap (p_delay + delay_max)
 )
 
 

@@ -1,0 +1,275 @@
+"""Bounded exhaustive model checking of SynchPaxos (bounded-delay fast path).
+
+`cpu_ref/exhaustive.py` enumerates every schedule of single-decree Paxos;
+this sibling does the same for **SynchPaxos** (`protocols/synchpaxos.py`,
+after the bounded-delay SMR line of arXiv:2507.12792): the leader-owned
+round-0 fast path plus the classic-ballot fallback.
+
+The model deliberately has NO timer and NO delta: an exhaustive schedule
+space already contains every delay pattern (a delayed message is one
+scheduled late; an infinitely-delayed one is never scheduled), so proving
+the invariants over ALL schedules proves exactly the claim the protocol
+makes — the synchrony window delta is a liveness/latency bet and safety
+never depends on it.  Concretely:
+
+- **Fast path**: proposer 0 owns the unique round-0 ballot
+  ``sync_ballot = make_ballot(0, 0)`` and its ``Accept(sync_bal, own_val)``
+  broadcast is in flight initially.  It decides on a **majority** of
+  Accepted at that ballot — round 0 has a single owner, so this is just
+  classic phase 2 and must be safe under every schedule.
+- **Fallback**: a timeout moves the leader (or activates a follower) onto
+  a classic round >= 1 through ordinary phase-1 recovery, which adopts any
+  reported round-0 value — a late fast quorum can never contradict it.
+  Followers start passive: their PREPAREs enter the net only via timeout,
+  preserving round 0's single owner.
+- **Planted bug** (``unsafe_fast=True``): the leader decides on the FIRST
+  Accepted heard — the "one ack implies synchrony held" shortcut with no
+  quorum.  The checker must find a counterexample schedule (a decided
+  value that is not chosen, or two chosen values after recovery commits a
+  different value classically); tests/test_exhaustive.py asserts both
+  directions.
+
+Same soundness notes as the paxos checker: message loss = never-delivered
+(every prefix explored), duplication left to the fuzzer, GC'd no-op
+deliveries collapse dead-letter orderings.
+"""
+
+from __future__ import annotations
+
+from paxos_tpu.cpu_ref.exhaustive import (
+    CheckResult,
+    explore,
+    make_ballot,
+    make_fair_completion,
+    make_liveness_checker,
+)
+
+# Message kinds (same encoding as the paxos checker).
+PREPARE, PROMISE, ACCEPT, ACCEPTED = 0, 1, 2, 3
+# Proposer phases (core/sp_state.py).
+P1, P2, DONE, FAST = 0, 1, 2, 3
+
+SYNC_BAL = make_ballot(0, 0)  # leader-owned round-0 ballot (sp_state.sync_ballot)
+
+
+def _own_val(pid: int) -> int:
+    return 100 + pid
+
+
+# An acceptor: (promised, acc_bal, acc_val).
+# A proposer: (phase, rnd, heard_mask, best_bal, best_val, prop_val,
+#              decided_val) — the classic paxos tuple; the leader starts in
+#              FAST with prop_val pre-bound to its own value.
+# State: (accs, props, net, voters); net a sorted tuple (multiset); voters a
+# sorted tuple of ((bal, val), acceptor_bitmask) — the learner's vote table.
+
+
+def _init_state(n_prop: int, n_acc: int):
+    accs = tuple((0, 0, 0) for _ in range(n_acc))
+    props = ((FAST, 0, 0, 0, 0, _own_val(0), 0),) + tuple(
+        (P1, 0, 0, 0, 0, 0, 0) for _ in range(1, n_prop)
+    )
+    # Only the leader's fast broadcast is in flight: followers activate via
+    # timeout, so round 0 keeps its single owner.
+    net = tuple(
+        sorted((ACCEPT, 0, a, SYNC_BAL, _own_val(0), 0) for a in range(n_acc))
+    )
+    return (accs, props, net, ())
+
+
+def _merge(net: tuple, out: list) -> tuple:
+    return tuple(sorted(net + tuple(out)))
+
+
+def _chosen(voters: tuple, quorum: int) -> set:
+    return {bv[1] for bv, mask in voters if bin(mask).count("1") >= quorum}
+
+
+def _record_vote(voters: tuple, a: int, bal: int, val: int) -> tuple:
+    d = dict(voters)
+    d[(bal, val)] = d.get((bal, val), 0) | (1 << a)
+    return tuple(sorted(d.items()))
+
+
+def _deliver(state, i: int, quorum: int, n_acc: int, unsafe_fast: bool):
+    """Deliver (and consume) in-flight message ``i``; pure."""
+    accs, props, net, voters = state
+    kind, src, dst, bal, v1, v2 = net[i]
+    net = net[:i] + net[i + 1 :]
+    out = []
+
+    if kind == PREPARE:
+        promised, abal, aval = accs[dst]
+        if bal > promised:
+            accs = accs[:dst] + ((bal, abal, aval),) + accs[dst + 1 :]
+            out.append((PROMISE, dst, src, bal, abal, aval))
+    elif kind == ACCEPT:
+        promised, abal, aval = accs[dst]
+        if bal >= promised:
+            accs = accs[:dst] + ((bal, bal, v1),) + accs[dst + 1 :]
+            voters = _record_vote(voters, dst, bal, v1)
+            out.append((ACCEPTED, dst, src, bal, v1, 0))
+    elif kind == PROMISE:
+        phase, rnd, heard, bb, bv, pv, dec = props[dst]
+        if phase == P1 and bal == make_ballot(rnd, dst):
+            heard |= 1 << src
+            if v1 > bb:
+                bb, bv = v1, v2
+            if bin(heard).count("1") >= quorum:
+                pv = bv if bb > 0 else _own_val(dst)
+                phase, heard = P2, 0
+                out.extend(
+                    (ACCEPT, dst, a, bal, pv, 0) for a in range(n_acc)
+                )
+            props = (
+                props[:dst]
+                + ((phase, rnd, heard, bb, bv, pv, dec),)
+                + props[dst + 1 :]
+            )
+    elif kind == ACCEPTED:
+        phase, rnd, heard, bb, bv, pv, dec = props[dst]
+        if phase in (P2, FAST) and bal == make_ballot(rnd, dst):
+            heard |= 1 << src
+            votes = bin(heard).count("1")
+            # The honest fast decide IS a classic phase-2 quorum at the
+            # single-owner round-0 ballot; the planted bug decides the fast
+            # round on the first ack, no quorum.
+            need = 1 if (unsafe_fast and phase == FAST) else quorum
+            if votes >= need:
+                phase, dec = DONE, pv
+            props = (
+                props[:dst]
+                + ((phase, rnd, heard, bb, bv, pv, dec),)
+                + props[dst + 1 :]
+            )
+
+    return (accs, props, _merge(net, out), voters)
+
+
+def _timeout(state, p: int, n_acc: int):
+    """Proposer ``p`` abandons its attempt (the leader its FAST round) and
+    retries one classic round higher — the delta-expiry fallback and the
+    follower activation collapse to the same action here."""
+    accs, props, net, voters = state
+    phase, rnd, heard, bb, bv, pv, dec = props[p]
+    rnd += 1
+    bal = make_ballot(rnd, p)
+    props = props[:p] + ((P1, rnd, 0, 0, 0, 0, dec),) + props[p + 1 :]
+    out = [(PREPARE, p, a, bal, 0, 0) for a in range(n_acc)]
+    return (accs, props, _merge(net, out), voters)
+
+
+def _gc(state):
+    """Drop in-flight messages whose delivery is provably a no-op (same
+    soundness argument as the paxos checker's ``_gc``; ACCEPTED stays
+    deliverable to a FAST-phase leader)."""
+    accs, props, net, voters = state
+    keep = []
+    for m in net:
+        kind, src, dst, bal, v1, v2 = m
+        if kind == PREPARE:
+            if bal <= accs[dst][0]:
+                continue
+        elif kind == ACCEPT:
+            if bal < accs[dst][0]:
+                continue
+        else:
+            phase, rnd = props[dst][0], props[dst][1]
+            if phase == DONE or bal != make_ballot(rnd, dst):
+                continue
+            if kind == PROMISE and phase != P1:
+                continue
+            if kind == ACCEPTED and phase not in (P2, FAST):
+                continue
+        keep.append(m)
+    return (accs, props, tuple(keep), voters)
+
+
+def check_sp_exhaustive(
+    n_prop: int = 2,
+    n_acc: int = 3,
+    max_round: "int | tuple[int, ...]" = 1,
+    max_states: int = 5_000_000,
+    unsafe_fast: bool = False,
+    liveness_bound: "int | None" = None,
+) -> CheckResult:
+    """Exhaustively explore every SynchPaxos schedule; assert agreement +
+    validity + decided-implies-chosen in every reachable state.
+
+    ``unsafe_fast=True`` injects the delay-unsafe fast commit; the checker
+    must then raise ``AssertionError`` with a counterexample trace.
+    ``liveness_bound`` arms the shared mechanized-liveness leg: from every
+    reachable state the fair completion schedule (deliver-all, then let the
+    designated proposer retry) must decide within the bound.
+    """
+    if n_prop > 8:
+        raise ValueError("n_prop > 8 collides packed ballots (make_ballot)")
+    if isinstance(max_round, int):
+        max_round = (max_round,) * n_prop
+    if len(max_round) != n_prop:
+        raise ValueError(
+            f"max_round has {len(max_round)} bounds for n_prop={n_prop}"
+        )
+    quorum = n_acc // 2 + 1
+    own_vals = {_own_val(p) for p in range(n_prop)}
+    stats = {"decided_states": 0, "chosen_all": set()}
+
+    def check_state(state, trace) -> None:
+        accs, props, net, voters = state
+        chosen = _chosen(voters, quorum)
+        stats["chosen_all"] |= chosen
+        decided = {pr[6] for pr in props if pr[0] == DONE}
+        if decided:
+            stats["decided_states"] += 1
+        ok = (
+            len(chosen) <= 1  # agreement
+            and chosen <= own_vals  # validity
+            and decided <= chosen  # a decided proposer's value was chosen
+        )
+        if not ok:
+            raise AssertionError(
+                f"invariant violated: chosen={chosen} decided={decided} "
+                f"after trace={list(trace)}"
+            )
+
+    live_check, live_stats = (None, None)
+    if liveness_bound is not None:
+        fair_next, is_decided = make_fair_completion(
+            lambda s: (
+                ("d", s[2][0]),
+                _gc(_deliver(s, 0, quorum, n_acc, unsafe_fast)),
+            ),
+            lambda s, p: _gc(_timeout(s, p, n_acc)),
+            done_phase=DONE,
+        )
+        live_check, live_stats = make_liveness_checker(
+            fair_next, is_decided, liveness_bound
+        )
+
+    def check_both(state, trace) -> None:
+        check_state(state, trace)
+        if live_check is not None:
+            live_check(state, trace)
+
+    def successors(state):
+        accs, props, net, voters = state
+        for i in range(len(net)):
+            yield ("d", net[i]), _gc(
+                _deliver(state, i, quorum, n_acc, unsafe_fast)
+            )
+        for p in range(n_prop):
+            if props[p][0] != DONE and props[p][1] < max_round[p]:
+                yield ("t", p), _gc(_timeout(state, p, n_acc))
+
+    states = explore(
+        _init_state(n_prop, n_acc), successors, check_both, max_states
+    )
+    return CheckResult(
+        states=states,
+        decided_states=stats["decided_states"],
+        chosen_values=stats["chosen_all"],
+        counterexample=None,
+        max_completion=(
+            None if live_stats is None else live_stats["max_completion"]
+        ),
+    )

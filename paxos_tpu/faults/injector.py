@@ -157,9 +157,33 @@ class FaultConfig:
     # of stale_k ticks (up to stale_k ticks of accepted state silently
     # lost) instead of losing everything.  0 = off.
     stale_k: int = 0
+    # Bounded-delay channel: slow links (each link is slow with probability
+    # p_delay, sampled once per run into ``FaultPlan.link_delay``) delay
+    # each message send with per-tick probability p_delay by a latency
+    # ~ U[1, cap] extra ticks, cap ~ U[1, delay_max] per link.  Delayed
+    # messages stay in flight (``until`` stamps on the message buffers) and
+    # compose with drop/dup/partition — a delayed message that lands in a
+    # cut stalls until the heal releases it (delivery masks AND).
+    p_delay: float = 0.0
+    delay_max: int = 4  # per-link latency cap ~ U[1, delay_max] ticks
+    # Synchrony window Δ (protocols/synchpaxos): the leader's one-round
+    # fast path may decide only while its round-trips arrived within delta
+    # ticks; past the window it falls back to classic ballots.
+    delta: int = 4
+    # (bug injection) SynchPaxos fast-path commit WITHOUT the Δ guard: the
+    # leader keeps deciding on fast votes after the synchrony window
+    # expired, when a classic ballot may already have chosen a different
+    # value.  The safety checker must flag campaigns run with this on.
+    sp_unsafe_fast: bool = False
     # Proposer timing
     timeout: int = 10  # ticks in a phase before retrying with higher ballot
     backoff_max: int = 8  # retry backoff ~ U[0, backoff_max) extra ticks
+    # Ballot-selection strategy (arxiv 2006.01885): a retrying proposer
+    # advances its ballot round by ballot_stride instead of 1.  Strides
+    # spread contending proposers across rounds, trading per-retry ballot
+    # burn for fewer dueling collisions; 1 is the classic consecutive
+    # strategy (bit-identical to pre-knob builds).
+    ballot_stride: int = 1
     # Flexible Paxos (protocols/paxos + fastpaxos): phase-1 / phase-2 quorum
     # sizes.  0 means the classic majority.  Safe iff q1 + q2 > n_acc —
     # running an unsafe pair is a supported bug-injection mode the checker
@@ -204,6 +228,7 @@ def exposure_lit(cfg: FaultConfig) -> dict:
         "partition": cfg.p_part > 0.0,
         "timeout": cfg.timeout_skew > 0,
         "stale": cfg.stale_k > 0,
+        "delay": cfg.p_delay > 0.0,
     }
 
 
@@ -230,6 +255,8 @@ class FaultPlan:
     link_dup: Optional[jnp.ndarray] = None  # (P, A, I) int32 — dup threshold
     ptimeout: Optional[jnp.ndarray] = None  # (P, I) int32 extra timeout ticks
     pboff: Optional[jnp.ndarray] = None  # (P, I) int32 backoff multiplier >= 1
+    link_delay: Optional[jnp.ndarray] = None  # (P, A, I) int32 — per-link
+    #   latency cap in ticks; 0 = the link never delays (p_delay)
 
     @classmethod
     def none(
@@ -279,6 +306,9 @@ class FaultPlan:
                 jnp.ones((n_prop, n_inst), jnp.int32)
                 if cfg.backoff_skew > 1
                 else None
+            ),
+            link_delay=(
+                jnp.zeros(edge, jnp.int32) if cfg.p_delay > 0.0 else None
             ),
         )
 
@@ -384,6 +414,18 @@ class FaultPlan:
                 cfg.backoff_skew + 1,
             )
 
+        link_delay = None
+        if cfg.p_delay > 0.0:
+            edge = (n_prop, n_acc, n_inst)
+            kd_slow, kd_cap = jax.random.split(
+                streams_mod.plan_fold(key, "LINK_DELAY")
+            )
+            slow = jax.random.uniform(kd_slow, edge) < cfg.p_delay
+            cap = jax.random.randint(
+                kd_cap, edge, 1, max(cfg.delay_max, 1) + 1
+            )
+            link_delay = jnp.where(slow, cap, 0).astype(jnp.int32)
+
         return cls(
             crash_start=crash_start,
             crash_end=crash_end,
@@ -399,6 +441,7 @@ class FaultPlan:
             link_dup=link_dup,
             ptimeout=ptimeout,
             pboff=pboff,
+            link_delay=link_delay,
         )
 
     def alive(self, tick: jnp.ndarray) -> jnp.ndarray:
@@ -448,7 +491,8 @@ class FaultPlan:
 # mutator (paxos_tpu/fuzz/mutate.py).  An "atom" is one independently
 # removable fault: a crash window, an equivocation flag, a partition
 # episode (with its sides and direction), one flaky link's (drop, dup)
-# thresholds, or one proposer's (timeout, backoff) skew.
+# thresholds, one proposer's (timeout, backoff) skew, or one slow link's
+# delay cap.
 #
 # Stability contract: atoms are plain dicts of ints/lists (thresholds in
 # uint32 value form, never int32 bit patterns), canonically ordered by
@@ -463,7 +507,7 @@ class FaultPlan:
 # ``link_ok`` equivalence that justifies the exception).
 
 _ATOM_KIND_ORDER = {"crash": 0, "equiv": 1, "partition": 2, "flaky": 3,
-                    "skew": 4}
+                    "skew": 4, "delay": 5}
 
 
 def _u32(x) -> int:
@@ -505,6 +549,10 @@ def atom_label(atom: dict) -> str:
         return f"flaky[link=({atom['prop']},{atom['acc']})]"
     if kind == "skew":
         return f"skew[proposer={atom['prop']}]"
+    if kind == "delay":
+        return (
+            f"delay[link=({atom['prop']},{atom['acc']}),cap={atom['cap']}]"
+        )
     raise ValueError(f"unknown atom kind: {kind!r}")
 
 
@@ -589,6 +637,13 @@ def plan_to_atoms(
                         "kind": "skew", "prop": int(p), "lane": int(i),
                         "timeout": t, "boff": b,
                     })
+    if host.link_delay is not None:
+        lde = np.asarray(host.link_delay)
+        for p, a, i in zip(*np.nonzero(lde > 0)):
+            atoms.append({
+                "kind": "delay", "prop": int(p), "acc": int(a),
+                "lane": int(i), "cap": int(lde[p, a, i]),
+            })
     return canonical_atoms(atoms)
 
 
@@ -677,6 +732,11 @@ def atoms_to_plan(
                     "pboff",
                     lambda: np.ones((n_prop, n_inst), np.int32),
                 )[atom["prop"], lane] = atom.get("boff", 1)
+        elif kind == "delay":
+            need(
+                "link_delay",
+                lambda: np.zeros(edge, np.int32),
+            )[atom["prop"], atom["acc"], lane] = int(atom["cap"])
         else:
             raise ValueError(f"unknown atom kind: {kind!r}")
     return FaultPlan(**{

@@ -42,6 +42,7 @@ ATOM_CLASSES = {
     "partition": ("partition",),
     "flaky": ("drop", "dup"),
     "skew": ("timeout",),
+    "delay": ("delay",),
 }
 
 

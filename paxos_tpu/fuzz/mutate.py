@@ -147,6 +147,19 @@ def _add_skew(rng, atoms, knobs, dims) -> Optional[str]:
     return "add-skew"
 
 
+def _add_delay(rng, atoms, knobs, dims) -> Optional[str]:
+    # A slow link: per-link latency cap on a 1..8 grid.  campaign_config
+    # lights p_delay so the plan field is consulted; whether the latencies
+    # breach the SynchPaxos window Delta is the protocol's problem — that
+    # boundary is exactly what the fuzzer is probing.
+    atoms.append({
+        "kind": "delay", "prop": rng.below(dims.n_prop),
+        "acc": rng.below(dims.n_acc), "lane": rng.below(dims.n_inst),
+        "cap": 1 + rng.below(8),
+    })
+    return "add-delay"
+
+
 def _remove_atom(rng, atoms, knobs, dims) -> Optional[str]:
     if not atoms:
         return None
@@ -197,6 +210,16 @@ def _scale_corrupt(rng, atoms, knobs, dims, base_corrupt=0.0) -> Optional[str]:
     return "scale-corrupt"
 
 
+def _ballot_stride(rng, atoms, knobs, dims) -> Optional[str]:
+    # Coprime ballot strides (arXiv:2006.01885): proposers advance rounds
+    # by a stride > 1 on retry, de-synchronizing dueling ballots the way
+    # randomized backoff would — but deterministically, so the campaign
+    # stays replayable.  Odd strides only: round numbers then never
+    # re-collide mod a power-of-two backoff horizon.
+    knobs["ballot_stride"] = 1 + 2 * rng.below(4)  # 1, 3, 5, 7
+    return "ballot-stride"
+
+
 @dataclasses.dataclass(frozen=True)
 class MutationOp:
     """One registered mutation: stable stream id, name, and the op."""
@@ -231,6 +254,8 @@ MUTATION_OPS = _register(
     MutationOp(10, "widen-window", _widen_window),
     MutationOp(11, "ballot-pressure", _ballot_pressure),
     MutationOp(12, "scale-corrupt", _scale_corrupt),
+    MutationOp(13, "add-delay", _add_delay),
+    MutationOp(14, "ballot-stride", _ballot_stride),
 )
 
 

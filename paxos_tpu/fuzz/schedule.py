@@ -36,7 +36,7 @@ from paxos_tpu.harness.config import SimConfig
 # Campaign-config knobs the mutator may override (fuzz.mutate's knob ops).
 # A whitelist, not a convention: an atom-level concern leaking into knobs
 # would silently bypass the codec's round-trip guarantees.
-KNOB_WHITELIST = ("timeout", "backoff_max", "p_corrupt")
+KNOB_WHITELIST = ("timeout", "backoff_max", "p_corrupt", "ballot_stride")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +79,17 @@ def campaign_config(
             f.p_dup > 0.0 or f.flaky_dup > 0.0
         ):
             rep["flaky_dup"] = 0.5
+    delays = [a for a in atoms if a["kind"] == "delay"]
+    if delays:
+        # The per-link caps live in plan.link_delay, which the step only
+        # consults when p_delay lights the channel; the per-tick latency
+        # draw is U[1, delay_max] clamped to the link cap, so delay_max
+        # must cover the largest atom cap for it to be reachable.
+        if f.p_delay <= 0.0:
+            rep["p_delay"] = 0.5
+        cmax = max(a["cap"] for a in delays)
+        if cmax > f.delay_max:
+            rep["delay_max"] = cmax
     skews = [a for a in atoms if a["kind"] == "skew"]
     if skews:
         tmax = max(a.get("timeout", 0) for a in skews)

@@ -49,6 +49,11 @@ CONFIGS = {
     "gray-chaos": config_mod.config_gray_chaos,
     "corrupt": config_mod.config_corrupt,
     "stale": config_mod.config_stale,
+    # Bounded-delay chaos on SynchPaxos: latencies within the synchrony
+    # window Delta, so the fast path stays live AND safe (must soak clean
+    # with a nonzero fast-path rate); pass violate_delta=True (scripts/
+    # delay.sh) for the latency>Delta regime the fallback must absorb.
+    "delay-chaos": config_mod.config_delay_chaos,
     # Flexible Paxos: safe (4+2 > 5) and deliberately unsafe (2+2 <= 5)
     # quorum pairs; the unsafe one exists to prove the checker catches it.
     "flex-safe": lambda **kw: config_mod.config_flex(4, 2, **kw),
@@ -507,7 +512,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="inject the accept-below-promise bug (must find a counterexample)",
     )
     c.add_argument(
-        "--protocol", choices=["paxos", "multipaxos", "fastpaxos", "raftcore"],
+        "--protocol",
+        choices=["paxos", "multipaxos", "fastpaxos", "raftcore", "synchpaxos"],
         default="paxos",
         help="which protocol's bounded model to enumerate",
     )
@@ -537,6 +543,12 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument(
         "--q-fast", type=int, default=0,
         help="fastpaxos only: FFP fast quorum (0 = ceil(3n/4))",
+    )
+    c.add_argument(
+        "--unsafe-fast", action="store_true",
+        help="synchpaxos only: inject the delay-unsafe fast commit (decide "
+        "the fast round on the FIRST ack, no quorum — the 'one ack implies "
+        "synchrony held' shortcut; must find a counterexample)",
     )
     c.add_argument(
         "--no-restriction", action="store_true",
@@ -581,14 +593,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     a.add_argument(
         "--protocol", action="append", dest="protocols", metavar="NAME",
-        choices=["paxos", "multipaxos", "fastpaxos", "raftcore"],
-        help="restrict to one protocol (repeatable; default: all four)",
+        choices=["paxos", "multipaxos", "fastpaxos", "raftcore", "synchpaxos"],
+        help="restrict to one protocol (repeatable; default: all five)",
     )
     a.add_argument(
         "--config", action="append", dest="configs", metavar="NAME",
-        choices=["default", "gray-chaos", "corrupt", "stale", "telemetry",
-                 "coverage", "exposure", "margin"],
-        help="restrict to one audit config (repeatable; default: all eight)",
+        choices=["default", "gray-chaos", "corrupt", "stale", "delay-chaos",
+                 "telemetry", "coverage", "exposure", "margin"],
+        help="restrict to one audit config (repeatable; default: all nine)",
     )
     a.add_argument(
         "--structure", action="store_true",
@@ -1810,6 +1822,14 @@ def cmd_check(args: argparse.Namespace) -> int:
         print("error: --no-restriction/--no-adoption require "
               "--protocol raftcore", file=sys.stderr)
         return 1
+    if args.protocol != "synchpaxos" and args.unsafe_fast:
+        print("error: --unsafe-fast applies to --protocol synchpaxos only",
+              file=sys.stderr)
+        return 1
+    if args.protocol == "synchpaxos" and (args.native or args.livelock_bug):
+        print("error: --native/--livelock-bug not yet wired for "
+              "--protocol synchpaxos", file=sys.stderr)
+        return 1
     if args.protocol != "multipaxos" and (args.no_recovery or args.log_len != 2):
         print("error: --no-recovery/--log-len require --protocol multipaxos",
               file=sys.stderr)
@@ -1909,6 +1929,17 @@ def cmd_check(args: argparse.Namespace) -> int:
                 no_adoption=args.no_adoption,
                 liveness_bound=args.liveness_bound,
                 livelock_bug=args.livelock_bug,
+            )
+        elif args.protocol == "synchpaxos":
+            from paxos_tpu.cpu_ref.sp_exhaustive import check_sp_exhaustive
+
+            r = check_sp_exhaustive(
+                n_prop=args.n_prop,
+                n_acc=args.n_acc,
+                max_round=mr,
+                max_states=args.max_states,
+                unsafe_fast=args.unsafe_fast,
+                liveness_bound=args.liveness_bound,
             )
         elif args.protocol == "fastpaxos":
             from paxos_tpu.cpu_ref.fp_exhaustive import check_fp_exhaustive

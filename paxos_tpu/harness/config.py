@@ -272,6 +272,38 @@ def config_gray_chaos(n_inst: int = 65_536, seed: int = 0) -> SimConfig:
     )
 
 
+def config_delay_chaos(
+    n_inst: int = 4096, seed: int = 0, violate_delta: bool = False
+) -> SimConfig:
+    """Bounded-delay chaos: per-link latency queues under loss (chaos, not
+    a bug — delay alone can neither lose nor duplicate a message).
+
+    Most sends take an extra 1..``delay_max`` ticks, capped per link by the
+    plan's sampled ``link_delay`` matrix.  The default cell keeps latencies
+    inside the synchrony window ``delta`` often enough that SynchPaxos'
+    fast path still lands (nonzero fast-path decide rate); the
+    ``violate_delta`` cell caps the window BELOW the sampled latencies —
+    the synchrony bet loses, the honest protocol must fall back with zero
+    safety violations (and the ``sp_unsafe_fast`` planted bug becomes
+    catchable).
+    """
+    return SimConfig(
+        n_inst=n_inst,
+        n_prop=2,
+        n_acc=5,
+        seed=seed,
+        protocol="synchpaxos",
+        fault=FaultConfig(
+            p_drop=0.1,
+            p_idle=0.1,
+            p_delay=0.8 if violate_delta else 0.4,
+            delay_max=8 if violate_delta else 2,
+            delta=4 if violate_delta else 6,
+            timeout=8,
+        ),
+    )
+
+
 def config_corrupt(n_inst: int = 4096, seed: int = 0) -> SimConfig:
     """Message corruption bug injection: in-flight payload bit flips.
 

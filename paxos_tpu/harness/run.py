@@ -52,6 +52,10 @@ def get_step_fn(protocol: str) -> Callable:
         from paxos_tpu.protocols.raftcore import raftcore_step
 
         return raftcore_step
+    if protocol == "synchpaxos":
+        from paxos_tpu.protocols.synchpaxos import synchpaxos_step
+
+        return synchpaxos_step
     raise ValueError(f"unknown protocol: {protocol!r}")
 
 
@@ -137,6 +141,7 @@ def check_tick_budget(protocol: str, ticks: int) -> None:
 
 def _init_protocol_state(cfg: SimConfig):
     stale = cfg.fault.stale_k > 0  # allocate stale-snapshot shadow arrays
+    delay = cfg.fault.p_delay > 0.0  # allocate bounded-delay `until` stamps
     _check_packed_layout_bounds(cfg)
     if cfg.protocol == "multipaxos":
         from paxos_tpu.core.ballot import MAX_PROPOSERS
@@ -175,21 +180,32 @@ def _init_protocol_state(cfg: SimConfig):
             k=cfg.k_slots,
             lease_init=cfg.fault.lease_len,
             stale=stale,
+            delay=delay,
         )
     if cfg.protocol == "fastpaxos":
         from paxos_tpu.core.fp_state import FastPaxosState
 
         return FastPaxosState.init(
-            cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots, stale=stale
+            cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots, stale=stale,
+            delay=delay,
         )
     if cfg.protocol == "raftcore":
         from paxos_tpu.core.raft_state import RaftState
 
         return RaftState.init(
-            cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots, stale=stale
+            cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots, stale=stale,
+            delay=delay,
+        )
+    if cfg.protocol == "synchpaxos":
+        from paxos_tpu.core.sp_state import SynchPaxosState
+
+        return SynchPaxosState.init(
+            cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots, stale=stale,
+            delay=delay,
         )
     return PaxosState.init(
-        cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots, stale=stale
+        cfg.n_inst, cfg.n_prop, cfg.n_acc, cfg.k_slots, stale=stale,
+        delay=delay,
     )
 
 

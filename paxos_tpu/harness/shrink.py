@@ -169,6 +169,8 @@ def _lane_only(plan: FaultPlan, lane: int) -> FaultPlan:
         gray["ptimeout"] = jnp.where(keep[None], plan.ptimeout, 0)
     if plan.pboff is not None:
         gray["pboff"] = jnp.where(keep[None], plan.pboff, 1)
+    if plan.link_delay is not None:
+        gray["link_delay"] = jnp.where(keep[None, None], plan.link_delay, 0)
     return FaultPlan(
         crash_start=jnp.where(keep[None], plan.crash_start, NEVER),
         crash_end=jnp.where(keep[None], plan.crash_end, NEVER),
@@ -267,6 +269,12 @@ def _atom_removals(plan: FaultPlan, lane: int) -> list[tuple[str, Callable]]:
             return p
 
         atoms.append((atom_label(skw), unskew))
+    for dly in by_kind.get("delay", []):
+
+        def undelay(p, pr=dly["prop"], a=dly["acc"]):
+            return p.replace(link_delay=p.link_delay.at[pr, a, lane].set(0))
+
+        atoms.append((atom_label(dly), undelay))
     return atoms
 
 
@@ -369,6 +377,7 @@ ATOM_CLASSES = {
     "asym-partition": ("partition",),
     "flaky": ("drop", "dup"),
     "skew": ("timeout",),
+    "delay": ("delay",),
 }
 
 
@@ -407,7 +416,27 @@ def exposure_annotation(cfg: SimConfig, result: ShrinkResult) -> dict:
             None if mapped is None
             else any(classes[c]["effective"] > 0 for c in mapped)
         )
-    return {"lane_classes": classes, "atoms_effective": atoms}
+    out = {"lane_classes": classes, "atoms_effective": atoms}
+    # Synchrony-window attribution (protocols/synchpaxos): each surviving
+    # slow link is named with its sampled latency cap against the campaign
+    # delta, so a SynchPaxos repro says WHICH link's latency breached the
+    # window the fast path was betting on — not just "delay was involved".
+    delay_atoms = [
+        a for a in plan_to_atoms(result.plan)
+        if a["kind"] == "delay" and a["lane"] == result.lane
+    ]
+    if delay_atoms:
+        delta = int(cfg.fault.delta)
+        out["delta_violations"] = [
+            {
+                "atom": atom_label(a),
+                "latency_cap": int(a["cap"]),
+                "delta": delta,
+                "violates_delta": int(a["cap"]) > delta,
+            }
+            for a in delay_atoms
+        ]
+    return out
 
 
 def margin_annotation(cfg: SimConfig, result: ShrinkResult) -> dict:

@@ -662,6 +662,11 @@ def fused_fns(protocol: str, ablate: frozenset = frozenset()):
         from paxos_tpu.protocols.raftcore import apply_tick_raft
 
         return apply_tick_raft, counter_masks, DEFAULT_BLOCK
+    if protocol == "synchpaxos":
+        from paxos_tpu.protocols.paxos import counter_masks
+        from paxos_tpu.protocols.synchpaxos import apply_tick_sp
+
+        return apply_tick_sp, counter_masks, DEFAULT_BLOCK
     if protocol == "multipaxos":
         from paxos_tpu.protocols.multipaxos import apply_tick_mp, mp_counter_masks
 
@@ -835,7 +840,8 @@ def _make_chunk(protocol: str) -> Callable:
 
 
 FUSED_CHUNKS = {
-    p: _make_chunk(p) for p in ("paxos", "fastpaxos", "raftcore", "multipaxos")
+    p: _make_chunk(p)
+    for p in ("paxos", "fastpaxos", "raftcore", "multipaxos", "synchpaxos")
 }
 fused_paxos_chunk = FUSED_CHUNKS["paxos"]
 fused_fastpaxos_chunk = FUSED_CHUNKS["fastpaxos"]

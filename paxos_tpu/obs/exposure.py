@@ -27,6 +27,9 @@ Class semantics (the injected / effective pair per class):
                  unskewed timer's decision.
 - ``stale``      stale-snapshot restores taken (injected == effective:
                  every restore rewrites durable state).
+- ``delay``      nonzero delay latencies sampled on send edges / in-flight
+                 messages actually stalled behind their ``until`` stamp
+                 this tick.
 
 The default-off-is-free contract (``core.telemetry`` / ``obs.coverage``
 are the templates):
@@ -57,7 +60,8 @@ from paxos_tpu.core.telemetry import lane_count
 
 # Fault classes, in counter-row order.  The order is part of the on-device
 # layout (row c of the packed counters is CLASSES[c]) — append only.
-CLASSES = ("drop", "dup", "corrupt", "partition", "timeout", "stale")
+CLASSES = ("drop", "dup", "corrupt", "partition", "timeout", "stale",
+           "delay")
 
 
 @dataclasses.dataclass(frozen=True)

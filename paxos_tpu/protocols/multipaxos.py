@@ -51,6 +51,7 @@ from paxos_tpu.faults.injector import (
     bits_below,
     fault_site,
     links_dup,
+    rate_threshold,
 )
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
@@ -82,6 +83,10 @@ class MPTickMasks:
     link_bits: Optional[jnp.ndarray] = None  # (4, P, A, I) int32
     dup_bits: Optional[jnp.ndarray] = None  # (2, P, A, I) int32 — request dup
     corrupt: Optional[jnp.ndarray] = None  # (A, I) bool — in-flight bit flip
+    # Bounded-delay (p_delay) raw bits, same kind axis as link_bits:
+    # 0=PROMISE 1=ACCEPTED 2=PREPARE 3=ACCEPT.
+    delay_bits: Optional[jnp.ndarray] = None  # (4, P, A, I) int32
+    lat_bits: Optional[jnp.ndarray] = None  # (4, P, A, I) int32
 
 
 def sample_mp_masks(
@@ -130,6 +135,14 @@ def sample_mp_masks(
             )
             if cfg.p_corrupt > 0.0
             else None
+        ),
+        delay_bits=(
+            raw_bits("DELAY_BITS", (4,) + edge)
+            if cfg.p_delay > 0.0
+            else None
+        ),
+        lat_bits=(
+            raw_bits("LAT_BITS", (4,) + edge) if cfg.p_delay > 0.0 else None
         ),
     )
 
@@ -209,6 +222,16 @@ def mp_counter_masks(
         corrupt=cp.bern(
             tick_seed, s["CORRUPT"], (n_acc, n_inst), cfg.p_corrupt
         ),
+        delay_bits=(
+            cp.counter_bits(tick_seed, s["DELAY_BITS"], (4,) + edge)
+            if cfg.p_delay > 0.0
+            else None
+        ),
+        lat_bits=(
+            cp.counter_bits(tick_seed, s["LAT_BITS"], (4,) + edge)
+            if cfg.p_delay > 0.0
+            else None
+        ),
     )
 
 
@@ -282,12 +305,38 @@ def apply_tick_mp(
         keep_prep, keep_acc = masks.keep_prep, masks.keep_acc
         dup_req = masks.dup_req
 
+    # Bounded delay (p_delay): sample this tick's send latencies, capped by
+    # the plan's per-link budget — the same arithmetic as
+    # protocols.paxos.delay_stamps, inlined over MP's 4-kind edge shapes
+    # (0=PROMISE 1=ACCEPTED 2=PREPARE 3=ACCEPT, matching link_bits).
+    until_prom = until_accd = until_prep = until_acc = None
+    delay_ext = None
+    if cfg.p_delay > 0.0:
+        with fault_site("delay"):
+            lat = jnp.int32(1) + (
+                masks.lat_bits & jnp.int32(0x7FFFFFFF)
+            ) % jnp.int32(max(cfg.delay_max, 1))
+            delay_ext = jnp.where(
+                bits_below(masks.delay_bits, rate_threshold(cfg.p_delay)),
+                jnp.minimum(lat, plan.link_delay[None]),
+                0,
+            )  # (4, P, A, I)
+            stamps = jnp.where(delay_ext > 0, state.tick + 1 + delay_ext, 0)
+            until_prom, until_accd = stamps[0], stamps[1]
+            until_prep, until_acc = stamps[2], stamps[3]
+    rdy_req = net.ready(state.requests, state.tick)  # (2, P, A, I) or None
+    rdy_prom = net.ready(state.promises, state.tick)  # (P, A, I) or None
+    rdy_accd = net.ready(state.accepted, state.tick)  # (P, A, I) or None
+
     prom_del = state.promises.present
     if masks.prom_deliver is not None:
         prom_del = prom_del & masks.prom_deliver
     accd_del = state.accepted.present
     if masks.accd_deliver is not None:
         accd_del = accd_del & masks.accd_deliver
+    if rdy_prom is not None:  # delayed replies stay in flight, undelivered
+        prom_del = prom_del & rdy_prom
+        accd_del = accd_del & rdy_accd
     if link_rep is not None:  # partitioned links stall replies in flight
         prom_del = prom_del & link_rep
         accd_del = accd_del & link_rep
@@ -314,8 +363,11 @@ def apply_tick_mp(
             < 0
         )
     else:
+        req_present = state.requests.present
+        if rdy_req is not None:  # delayed requests are invisible until due
+            req_present = req_present & rdy_req
         sel = net.select_from_scores(
-            state.requests.present, masks.sel_score, masks.busy
+            req_present, masks.sel_score, masks.busy
         )
     sel = sel & alive[None, None]
     if link_req is not None:  # partitioned links stall requests in flight
@@ -358,22 +410,38 @@ def apply_tick_mp(
             prom_send = prom_send & keep_prom
         with fault_site("equivocate"):
             payload_bv = jnp.where(equiv[:, None], 0, acc.log)  # (A, L, I)
+        new_prom_until = promises.until
+        if promises.until is not None:
+            new_prom_until = jnp.where(
+                prom_send,
+                until_prom if until_prom is not None else 0,
+                promises.until,
+            )
         promises = promises.replace(
             present=promises.present | prom_send,
             bal=jnp.where(prom_send, msg_bal[None], promises.bal),
             p_bv=jnp.where(
                 prom_send[:, :, None], payload_bv[None], promises.p_bv
             ),
+            until=new_prom_until,
         )
 
         accd_send = sel[ACCEPT] & ok_acc[None]  # (P, A, I)
         if keep_accd is not None:
             accd_send = accd_send & keep_accd
+        new_accd_until = accepted.until
+        if accepted.until is not None:
+            new_accd_until = jnp.where(
+                accd_send,
+                until_accd if until_accd is not None else 0,
+                accepted.until,
+            )
         accepted = accepted.replace(
             present=accepted.present | accd_send,
             bal=jnp.where(accd_send, msg_bal[None], accepted.bal),
             slot=jnp.where(accd_send, msg_slot[None], accepted.slot),
             val=jnp.where(accd_send, msg_val[None], accepted.val),
+            until=new_accd_until,
         )
 
     if "consume" in ablate:
@@ -469,7 +537,9 @@ def apply_tick_mp(
         & ~log_full
         & (lease_timer > cfg.lease_len + pid * 3 + jitter)
     )
-    new_bal = bal_mod.make_ballot(bal_mod.ballot_round(prop.bal) + 1, pid)
+    new_bal = bal_mod.make_ballot(
+        bal_mod.ballot_round(prop.bal) + cfg.ballot_stride, pid
+    )
 
     # Candidate timeout: back to follower, retry later with the next ballot.
     # Timeout skew (gray): each proposer lane runs its own deadline.
@@ -532,6 +602,7 @@ def apply_tick_mp(
             v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
             v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
             keep=keep_prep,
+            until=until_prep,
         )
     # Leaders re-broadcast the current slot's Accept every tick (idempotent,
     # self-healing under loss).
@@ -556,6 +627,7 @@ def apply_tick_mp(
             v1=pval[:, None],
             v2=ci[:, None],
             keep=keep_acc,
+            until=until_acc,
         )
 
     prop = prop.replace(
@@ -639,6 +711,15 @@ def apply_tick_mp(
             events["timeout"] = (plan.ptimeout != 0, exp_timeout_delta)
         if cfg.stale_k > 0:
             events["stale"] = (rec, rec)
+        if delay_ext is not None:
+            # Effective: in-flight messages whose delivery this tick the
+            # sampled delays actually stalled.
+            events["delay"] = (
+                tel_mod.lane_count(delay_ext > 0),
+                tel_mod.lane_count(state.requests.present & ~rdy_req)
+                + tel_mod.lane_count(state.promises.present & ~rdy_prom)
+                + tel_mod.lane_count(state.accepted.present & ~rdy_accd),
+            )
         exp = exp_mod.record(exp, **events)
     mar = state.margin
     if mar is not None:

@@ -61,6 +61,7 @@ from paxos_tpu.faults.injector import (
     bits_below,
     fault_site,
     links_dup,
+    rate_threshold,
 )
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
@@ -94,6 +95,10 @@ class TickMasks:
     dup_bits: Optional[jnp.ndarray] = None  # (2, 2, P, A, I) int32 raw bits,
     #   leading axis: 0=requests 1=replies
     corrupt: Optional[jnp.ndarray] = None  # (A, I) bool — payload perturbed
+    delay_bits: Optional[jnp.ndarray] = None  # (2, 2, P, A, I) int32 raw
+    #   bits — per-send delay decision (p_delay); axis 0: 0=requests 1=replies
+    lat_bits: Optional[jnp.ndarray] = None  # (2, 2, P, A, I) int32 raw bits
+    #   — sampled latency, reduced mod delay_max and capped per link
 
 
 def sample_masks(
@@ -145,6 +150,12 @@ def sample_masks(
             )
             if cfg.p_corrupt > 0.0
             else None
+        ),
+        delay_bits=(
+            raw_bits("DELAY_BITS", (2,) + slot) if cfg.p_delay > 0.0 else None
+        ),
+        lat_bits=(
+            raw_bits("LAT_BITS", (2,) + slot) if cfg.p_delay > 0.0 else None
         ),
     )
 
@@ -232,7 +243,49 @@ def counter_masks(
         corrupt=cp.bern(
             tick_seed, s["CORRUPT"], (n_acc, n_inst), cfg.p_corrupt
         ),
+        delay_bits=(
+            cp.counter_bits(tick_seed, s["DELAY_BITS"], (2,) + slot)
+            if cfg.p_delay > 0.0
+            else None
+        ),
+        lat_bits=(
+            cp.counter_bits(tick_seed, s["LAT_BITS"], (2,) + slot)
+            if cfg.p_delay > 0.0
+            else None
+        ),
     )
+
+
+def delay_stamps(masks: TickMasks, plan: FaultPlan, cfg: FaultConfig, tick):
+    """Sampled bounded-delay stamps for this tick's sends (p_delay).
+
+    Each send edge is delayed with probability ``p_delay`` by a latency
+    ``1 + lat_bits % delay_max``, capped by the plan's per-link cap
+    (``link_delay``; cap 0 = the link never delays).  Returns
+    ``(until_req, until_rep, ext)``: per-direction (2, P, A, I) int32
+    earliest-delivery ticks (0 = deliverable immediately) and the raw
+    (2, 2, P, A, I) extra-latency draws for exposure accounting — or
+    ``(None, None, None)`` when delay is off (zero traced eqns).
+
+    Shared by paxos / fastpaxos / raftcore / synchpaxos (the single-decree
+    mask shapes); multipaxos inlines the same arithmetic over its shapes.
+    """
+    if cfg.p_delay <= 0.0:
+        return None, None, None
+    with fault_site("delay"):
+        # All-int32 arithmetic (Mosaic-safe): mask the sign bit before the
+        # modulo so the latency draw stays in [1, delay_max].
+        lat = jnp.int32(1) + (
+            masks.lat_bits & jnp.int32(0x7FFFFFFF)
+        ) % jnp.int32(max(cfg.delay_max, 1))
+        ext = jnp.where(
+            bits_below(masks.delay_bits, rate_threshold(cfg.p_delay)),
+            jnp.minimum(lat, plan.link_delay[None, None]),
+            0,
+        )  # (2, 2, P, A, I); axis 0: 0=requests 1=replies
+        until_req = jnp.where(ext[0] > 0, tick + 1 + ext[0], 0)
+        until_rep = jnp.where(ext[1] > 0, tick + 1 + ext[1], 0)
+    return until_req, until_rep, ext
 
 
 def apply_tick(
@@ -334,9 +387,21 @@ def apply_tick(
         keep_p1, keep_p2 = masks.keep_p1, masks.keep_p2
         dup_req, dup_rep = masks.dup_req, masks.dup_rep
 
+    # Bounded delay (p_delay): this tick's send stamps, and readiness gates
+    # over the in-flight buffers.  A stalled slot is invisible to delivery
+    # (requests) and folding (replies) but never cleared — delay alone can
+    # not lose or duplicate a message (tests/test_delay.py pins this).
+    until_req, until_rep, delay_ext = delay_stamps(
+        masks, plan, cfg, state.tick
+    )
+    rdy_req = net.ready(state.requests, state.tick)
+    rdy_rep = net.ready(state.replies, state.tick)
+
     delivered = state.replies.present
     if masks.deliver is not None:
         delivered = delivered & masks.deliver
+    if rdy_rep is not None:  # delayed replies have not arrived yet
+        delivered = delivered & rdy_rep
     if link_rep is not None:  # partitioned links stall replies in flight
         delivered = delivered & link_rep[None]
     if "consume" in ablate:
@@ -357,8 +422,11 @@ def apply_tick(
             < 0
         )
     else:
+        req_present = state.requests.present
+        if rdy_req is not None:  # delayed requests have not arrived yet
+            req_present = req_present & rdy_req
         sel = net.select_from_scores(
-            state.requests.present, masks.sel_score, masks.busy
+            req_present, masks.sel_score, masks.busy
         )
     sel = sel & alive[None, None]  # crashed acceptors process nothing
     if link_req is not None:  # partitioned links stall requests in flight
@@ -409,6 +477,7 @@ def apply_tick(
             v1=prom_payload_bal[None],
             v2=prom_payload_val[None],
             keep=keep_prom,
+            until=None if until_rep is None else until_rep[PROMISE],
         )
         replies = net.send(
             replies, ACCEPTED,
@@ -417,6 +486,7 @@ def apply_tick(
             v1=msg_val[None],
             v2=jnp.zeros_like(msg_val)[None],
             keep=keep_accd,
+            until=None if until_rep is None else until_rep[ACCEPTED],
         )
     if "consume" in ablate:
         requests = state.requests
@@ -517,7 +587,9 @@ def apply_tick(
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
     )
-    new_bal = bal_mod.make_ballot(bal_mod.ballot_round(prop.bal) + 1, pid)
+    new_bal = bal_mod.make_ballot(
+        bal_mod.ballot_round(prop.bal) + cfg.ballot_stride, pid
+    )
 
     phase = jnp.where(p1_done, P2, prop.phase)
     phase = jnp.where(p2_done, DONE, phase)
@@ -540,6 +612,7 @@ def apply_tick(
             v1=prop_val[:, None],
             v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
             keep=keep_p2,
+            until=None if until_req is None else until_req[ACCEPT],
         )
         requests = net.send(
             requests, PREPARE,
@@ -548,6 +621,7 @@ def apply_tick(
             v1=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
             v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
             keep=keep_p1,
+            until=None if until_req is None else until_req[PREPARE],
         )
 
     prop = prop.replace(
@@ -636,6 +710,15 @@ def apply_tick(
         if cfg.stale_k > 0:
             # Every restore rewrites durable state: injected == effective.
             events["stale"] = (rec, rec)
+        if delay_ext is not None:
+            # Injected: delays sampled this tick (nonzero extra latency);
+            # effective: in-flight messages whose delivery tick actually
+            # moved — slots present but stalled behind their stamp.
+            events["delay"] = (
+                tel_mod.lane_count(delay_ext > 0),
+                tel_mod.lane_count(state.requests.present & ~rdy_req)
+                + tel_mod.lane_count(state.replies.present & ~rdy_rep),
+            )
         exp = exp_mod.record(exp, **events)
     mar = state.margin
     if mar is not None:

@@ -63,6 +63,7 @@ from paxos_tpu.faults.injector import (
     fault_site,
 )
 from paxos_tpu.kernels.quorum import majority, quorum_reached
+from paxos_tpu.protocols.paxos import delay_stamps
 from paxos_tpu.transport import inmemory_tpu as net
 
 
@@ -133,15 +134,28 @@ def apply_tick_raft(
         keep_p1, keep_p2 = masks.keep_p1, masks.keep_p2
         dup_req, dup_rep = masks.dup_req, masks.dup_rep
 
+    # Bounded delay (p_delay): send stamps + readiness gates (see
+    # protocols.paxos.delay_stamps; stalled slots stay in flight).
+    until_req, until_rep, delay_ext = delay_stamps(
+        masks, plan, cfg, state.tick
+    )
+    rdy_req = net.ready(state.requests, state.tick)
+    rdy_rep = net.ready(state.replies, state.tick)
+
     delivered = state.replies.present
     if masks.deliver is not None:
         delivered = delivered & masks.deliver
+    if rdy_rep is not None:  # delayed replies have not arrived yet
+        delivered = delivered & rdy_rep
     if link_rep is not None:  # partitioned links stall replies in flight
         delivered = delivered & link_rep[None]
     replies = net.consume(state.replies, delivered, stay=dup_rep)
 
     # ---- Voter half-tick: select one request per (instance, voter) ----
-    sel = net.select_from_scores(state.requests.present, masks.sel_score, masks.busy)
+    req_present = state.requests.present
+    if rdy_req is not None:  # delayed requests have not arrived yet
+        req_present = req_present & rdy_req
+    sel = net.select_from_scores(req_present, masks.sel_score, masks.busy)
     sel = sel & alive[None, None]
     if link_req is not None:  # partitioned links stall requests in flight
         sel = sel & link_req[None]
@@ -186,6 +200,7 @@ def apply_tick_raft(
         v1=(vote_payload_t * 2 + grant.astype(jnp.int32))[None],
         v2=vote_payload_v[None],
         keep=keep_prom,
+        until=None if until_rep is None else until_rep[VOTE],
     )
     replies = net.send(
         replies, ACK,
@@ -194,6 +209,7 @@ def apply_tick_raft(
         v1=msg_v1[None],
         v2=jnp.zeros_like(msg_v1)[None],
         keep=keep_accd,
+        until=None if until_rep is None else until_rep[ACK],
     )
     requests = net.consume(state.requests, sel, stay=dup_req)
     voter = voter.replace(voted=voted, ent_term=ent_term, ent_val=ent_val)
@@ -274,7 +290,9 @@ def apply_tick_raft(
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
     )
-    new_bal = bal_mod.make_ballot(bal_mod.ballot_round(cand.bal) + 1, pid)
+    new_bal = bal_mod.make_ballot(
+        bal_mod.ballot_round(cand.bal) + cfg.ballot_stride, pid
+    )
 
     # A new leader proposes its adopted entry if it has one, else its own
     # value, and records that proposal as its own log entry at its term.
@@ -301,6 +319,7 @@ def apply_tick_raft(
         v1=prop_val[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         keep=keep_p2,
+        until=None if until_req is None else until_req[APPEND],
     )
     requests = net.send(
         requests, REQVOTE,
@@ -309,6 +328,7 @@ def apply_tick_raft(
         v1=ent_term_c[:, None],
         v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
         keep=keep_p1,
+        until=None if until_req is None else until_req[REQVOTE],
     )
 
     cand = cand.replace(
@@ -395,6 +415,12 @@ def apply_tick_raft(
             events["timeout"] = (plan.ptimeout != 0, exp_timeout_delta)
         if cfg.stale_k > 0:
             events["stale"] = (rec, rec)
+        if delay_ext is not None:
+            events["delay"] = (
+                tel_mod.lane_count(delay_ext > 0),
+                tel_mod.lane_count(state.requests.present & ~rdy_req)
+                + tel_mod.lane_count(state.replies.present & ~rdy_rep),
+            )
         exp = exp_mod.record(exp, **events)
     mar = state.margin
     if mar is not None:

@@ -1,32 +1,29 @@
-"""Fast Paxos — fast rounds, collision recovery — as one fused array program.
+"""SynchPaxos — bounded-delay-exploiting consensus as one fused array program.
 
-Reference parity (SURVEY.md §3.3, §8.2 M7; BASELINE config 5): the protocol
-sweep runs different vote kernels through the identical scheduler, transport
-and fault machinery as :mod:`paxos_tpu.protocols.paxos`; this module is the
-Fast Paxos (Lamport, "Fast Paxos", 2006) variant:
+The fifth protocol of the sweep (see :mod:`paxos_tpu.core.sp_state` for the
+protocol story): classic single-decree Paxos plus a leader fast path that
+bets on the bounded-delay synchrony window ``FaultConfig.delta``:
 
-- **Fast round (round 0)**: proposers skip phase 1 and broadcast
-  ``Accept(fast_bal, own_val)`` directly; an acceptor votes for the first
-  value it sees at a ballot (vote-at-most-once-per-round replaces classic
-  Paxos' idempotent re-accept), and a value needs a **fast quorum**
-  ``ceil(3n/4)`` (``kernels.quorum.fast_quorum``) to be chosen.
-- **Collision recovery**: a proposer that times out starts a classic round
-  (>= 1).  Phase-1 value selection implements the coordinated-recovery
-  rule: value ``v`` *could have been chosen* at the highest reported ballot
-  ``k`` iff the acceptors that reported voting ``v`` at ``k`` plus the
-  acceptors not yet heard from could contain a fast quorum —
-  ``count(v) + (n - heard) >= fast_quorum``.  If some value is choosable
-  the proposer must adopt it; otherwise nothing was or can be chosen at
-  ``k`` and its own value is safe.
-- **Fast Flexible Paxos quorums** (arXiv:2008.02671): the classic phase-1 /
-  phase-2 quorums ``q1``/``q2`` and the fast quorum ``q_fast`` are
-  configurable (``FaultConfig``; 0 = the classic majority / ceil(3n/4)
-  defaults).  At most one value is choosable — so recovery is safe — iff
-  ``q1 + q2 > n`` AND ``q1 + 2*q_fast > 2n``; unsafe triples are supported
-  bug-injection modes the checker must catch (tests/test_fastpaxos.py).
+- **Fast path**: proposer 0 owns the unique round-0 ballot and has its
+  ``Accept(sync_bal, own_val)`` broadcast in flight at tick 0.  It decides
+  on a majority of Accepted while ``timer <= delta`` — one round trip when
+  the network honors the bound.  Round 0 has a single owner, so the
+  majority quorum is just classic phase 2: blown synchrony costs latency,
+  never safety.
+- **Fallback**: past ``delta`` the leader abandons the fast round and runs
+  classic rounds (>= 1) through the ordinary P1 -> P2 machinery; phase-1
+  recovery adopts any reported round-0 value, so a late fast quorum can
+  never contradict a fallback decision.  Followers are passive until the
+  normal ``timeout`` expires, then compete classically.
+- **Planted bug** (``FaultConfig.sp_unsafe_fast``): the leader commits on
+  the FIRST Accepted heard — no quorum, no delta window — the bogus
+  synchrony shortcut the checker must flag under delta-violating delays.
 
-The learner applies the per-round-kind threshold (``q_fast`` for round 0,
-``q2`` for classic rounds) via ``learner_observe(..., fast_quorum=...)``.
+Everything else (acceptor rules, learner/checker, fault threading including
+the bounded-delay channel itself) is classic paxos verbatim: SynchPaxos
+shares the single-decree mask shapes, stream family
+(``core.streams.SINGLE_DECREE`` via the ``synchpaxos`` protocol alias) and
+samplers (``protocols.paxos.sample_masks`` / ``counter_masks``).
 """
 
 from __future__ import annotations
@@ -43,42 +40,31 @@ from paxos_tpu.core import ballot as bal_mod
 from paxos_tpu.core import telemetry as tel_mod
 from paxos_tpu.obs import coverage as cov_mod
 from paxos_tpu.obs import exposure as exp_mod
-from paxos_tpu.core.fp_state import (
-    DONE,
-    FAST,
-    P1,
-    P2,
-    VALUE_BASE,
-    FastPaxosState,
-)
 from paxos_tpu.core.messages import ACCEPT, ACCEPTED, PREPARE, PROMISE
+from paxos_tpu.core.sp_state import DONE, FAST, P1, P2, SynchPaxosState, sync_ballot
 from paxos_tpu.faults.injector import (
     FaultConfig,
     FaultPlan,
     bits_below,
     fault_site,
 )
-from paxos_tpu.kernels.quorum import fast_quorum, majority, quorum_reached
+from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.protocols.paxos import delay_stamps
 from paxos_tpu.transport import inmemory_tpu as net
 from paxos_tpu.utils.bitops import popcount
 
 
-def apply_tick_fast(
-    state: FastPaxosState, masks, plan: FaultPlan, cfg: FaultConfig
-) -> FastPaxosState:
-    """The pure Fast-Paxos transition for one tick over pre-sampled masks."""
+def apply_tick_sp(
+    state: SynchPaxosState, masks, plan: FaultPlan, cfg: FaultConfig
+) -> SynchPaxosState:
+    """The pure SynchPaxos transition for one tick over pre-sampled masks."""
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     quorum = majority(n_acc)
-    # Fast Flexible Paxos: explicit classic (q1 phase-1, q2 phase-2) and
-    # fast (q_fast) quorum sizes; 0 = the classic defaults (majority /
-    # ceil(3n/4)).  Safe iff q1 + q2 > n and q1 + 2*q_fast > 2n; unsafe
-    # triples are bug-injection modes the checker must catch
-    # (tests/test_fastpaxos.py).
+    # Flexible quorums as in classic paxos (0 = majority).  The fast path
+    # uses q2: round 0 is single-owner, so its decide IS a phase-2 quorum.
     q1 = cfg.q1 or quorum
     q2 = cfg.q2 or quorum
-    fquorum = cfg.q_fast or fast_quorum(n_acc)
 
     acc = state.acceptor
     alive = plan.alive(state.tick)  # (A, I)
@@ -138,7 +124,7 @@ def apply_tick_fast(
         dup_req, dup_rep = masks.dup_req, masks.dup_rep
 
     # Bounded delay (p_delay): send stamps + readiness gates (see
-    # protocols.paxos.delay_stamps; stalled slots stay in flight).
+    # protocols.paxos.delay_stamps) — the very channel the fast path bets on.
     until_req, until_rep, delay_ext = delay_stamps(
         masks, plan, cfg, state.tick
     )
@@ -154,7 +140,7 @@ def apply_tick_fast(
         delivered = delivered & link_rep[None]
     replies = net.consume(state.replies, delivered, stay=dup_rep)
 
-    # ---- Acceptor half-tick ----
+    # ---- Acceptor half-tick (classic paxos verbatim) ----
     req_present = state.requests.present
     if rdy_req is not None:  # delayed requests have not arrived yet
         req_present = req_present & rdy_req
@@ -178,14 +164,7 @@ def apply_tick_fast(
     with fault_site("equivocate"):
         ok_prep_h = is_prep & ~equiv & (msg_bal > acc.promised)
         ok_prep = ok_prep_h | (is_prep & equiv)
-        # Vote at most once per ballot: with multiple proposers sharing the
-        # fast ballot, an acceptor must not switch values within a round.
-        # Re-accepting the identical (ballot, value) stays idempotent
-        # (duplicate deliveries).
-        revote = (msg_bal > acc.acc_bal) | (
-            (msg_bal == acc.acc_bal) & (msg_val == acc.acc_val)
-        )
-        ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised) & revote
+        ok_acc_h = is_acc & ~equiv & (msg_bal >= acc.promised)
         ok_acc = ok_acc_h | (is_acc & equiv)
 
         promised = jnp.where(ok_prep_h, msg_bal, acc.promised)
@@ -218,11 +197,10 @@ def apply_tick_fast(
     requests = net.consume(state.requests, sel, stay=dup_req)
     acc = acc.replace(promised=promised, acc_bal=acc_bal, acc_val=acc_val)
 
-    # ---- Learner / safety checker (fast-quorum-aware thresholds) ----
+    # ---- Learner / safety checker ----
     with jax.named_scope("learner_check"):
         learner = learner_observe(
-            state.learner, ok_acc, msg_bal, msg_val, state.tick, q2,
-            fast_quorum=fquorum,
+            state.learner, ok_acc, msg_bal, msg_val, state.tick, q2
         )
         with fault_site("equivocate"):
             inv_viol = acceptor_invariants(acc_pre, acc, honest=~equiv)
@@ -251,66 +229,34 @@ def apply_tick_fast(
         | jnp.where(accd_ok, bits, 0).sum(axis=1, dtype=jnp.int32)
     )
 
-    # Phase-1 recovery fold: per-value acceptor bitmask at the highest
-    # reported accepted ballot.  Exact sequential fold over the small
-    # acceptors axis (<= MAX_ACCEPTORS), carried across ticks in rep_mask.
-    best_bal, rep_mask = prop.best_bal, prop.rep_mask
-    vids = jnp.arange(n_prop, dtype=jnp.int32)[None, :, None]  # (1, V, 1)
-    for a in range(n_acc):
-        pb = state.replies.v1[PROMISE, :, a]  # (P, I) prev-accepted ballot
-        pv = state.replies.v2[PROMISE, :, a]  # (P, I) prev-accepted value
-        valid = (
-            prom_ok[:, a]
-            & (pb > 0)
-            & (pv >= VALUE_BASE)
-            & (pv < VALUE_BASE + n_prop)
-        )
-        vid = jnp.clip(pv - VALUE_BASE, 0, n_prop - 1)  # (P, I)
-        higher = valid & (pb > best_bal)
-        rep_mask = jnp.where(higher[:, None], 0, rep_mask)
-        best_bal = jnp.where(higher, pb, best_bal)
-        same = valid & (pb == best_bal)
-        vhot = vid[:, None] == vids  # (P, V, I)
-        rep_mask = rep_mask | jnp.where(
-            same[:, None] & vhot, jnp.asarray(1 << a, jnp.int32), 0
-        )
+    # Phase-1 recovery fold (classic): highest previously-accepted pair.
+    prev_bal = jnp.where(prom_ok, state.replies.v1[PROMISE], 0)  # (P, A, I)
+    cand_bal = prev_bal.max(axis=1)  # (P, I)
+    cand_val = jnp.where(
+        prev_bal == cand_bal[:, None], state.replies.v2[PROMISE], 0
+    ).max(axis=1)
+    upgrade = cand_bal > prop.best_bal
+    best_bal = jnp.where(upgrade, cand_bal, prop.best_bal)
+    best_val = jnp.where(upgrade, cand_val, prop.best_val)
 
-    # Phase transitions.
-    fast_done = (prop.phase == FAST) & (popcount(heard) >= fquorum)
+    # Phase transitions.  The timer advances first so the fast-path window
+    # test sees this tick's age, not last tick's.
+    timer = jnp.where(prop.phase == DONE, prop.timer, prop.timer + 1)
+    in_window = timer <= jnp.int32(max(cfg.delta, 0))
+    if cfg.sp_unsafe_fast:
+        # Planted delay-unsafe bug: commit the fast value on the FIRST
+        # Accepted heard — no quorum, no delta window.  The "one ack inside
+        # the window implies everyone got it" shortcut is bogus once delays
+        # exceed delta; the checker must flag the disagreement.
+        fast_done = (prop.phase == FAST) & (popcount(heard) >= 1)
+    else:
+        fast_done = (
+            (prop.phase == FAST) & quorum_reached(heard, q2) & in_window
+        )
     p1_done = (prop.phase == P1) & quorum_reached(heard, q1)
     p2_done = (prop.phase == P2) & quorum_reached(heard, q2)
+    v_chosen_by_p1 = jnp.where(best_bal > 0, best_val, prop.own_val)
 
-    # Recovery value, by the round kind of the highest reported ballot k:
-    # - k classic (round >= 1): classic Paxos — adopt k's value (unique:
-    #   one owner per classic ballot proposes one value).
-    # - k fast (round 0): adopt the choosable value if one exists, else own.
-    # - nothing reported: own value.
-    unheard = n_acc - popcount(heard)  # (P, I)
-    cnt = popcount(rep_mask)  # (P, V, I)
-    choosable = (rep_mask != 0) & (cnt + unheard[:, None] >= fquorum)
-    any_ch = choosable.any(axis=1)
-    # First-set value id via first_true + masked sum (argmax does not lower
-    # in Mosaic); an all-False column sums to 0 and is guarded by any_ch /
-    # best_bal > 0 downstream, matching argmax's pick-0 behavior.
-    from paxos_tpu.check.safety import first_true
-
-    pick_fast = (
-        jnp.where(first_true(choosable, axis=1), vids, 0).sum(axis=1)
-        + VALUE_BASE
-    )
-    pick_classic = (
-        jnp.where(first_true(rep_mask != 0, axis=1), vids, 0).sum(axis=1)
-        + VALUE_BASE
-    )
-    is_fast_k = bal_mod.ballot_round(best_bal) == 0
-    v_fast = jnp.where(any_ch, pick_fast, prop.own_val)
-    v_recover = jnp.where(
-        best_bal > 0,
-        jnp.where(is_fast_k, v_fast, pick_classic),
-        prop.own_val,
-    )
-
-    timer = jnp.where(prop.phase == DONE, prop.timer, prop.timer + 1)
     # Timer skew (gray): per-proposer extra patience / backoff multiplier.
     with fault_site("skew"):
         timeout = (
@@ -323,20 +269,28 @@ def apply_tick_fast(
             if cfg.backoff_skew <= 1
             else masks.backoff * plan.pboff
         )
+    # The FAST round's deadline is the synchrony window delta, not the
+    # classic timeout: a leader whose fast quorum missed the window falls
+    # back to classic rounds immediately.
+    deadline = jnp.where(
+        prop.phase == FAST, jnp.int32(max(cfg.delta, 0)), timeout
+    )
     expired = (
         (prop.phase != DONE)
         & ~p1_done & ~p2_done & ~fast_done
-        & (timer > timeout)
+        & (timer > deadline)
     )
     # Exposure (obs.exposure): a skewed timeout is EFFECTIVE only where the
-    # expiry decision differs from the unskewed timer's.  Must be taken
-    # here, before `timer` is rebased below.
+    # expiry decision differs from the unskewed deadline's.
     exp_timeout_delta = None
     if state.exposure is not None and cfg.timeout_skew > 0:
+        deadline0 = jnp.where(
+            prop.phase == FAST, jnp.int32(max(cfg.delta, 0)), cfg.timeout
+        )
         exp_timeout_delta = expired ^ (
             (prop.phase != DONE)
             & ~p1_done & ~p2_done & ~fast_done
-            & (timer > cfg.timeout)
+            & (timer > deadline0)
         )
     pid = jnp.broadcast_to(
         jnp.arange(n_prop, dtype=jnp.int32)[:, None], timer.shape
@@ -348,17 +302,33 @@ def apply_tick_fast(
     phase = jnp.where(p1_done, P2, prop.phase)
     phase = jnp.where(p2_done | fast_done, DONE, phase)
     phase = jnp.where(expired, P1, phase)
-    prop_val = jnp.where(p1_done, v_recover, prop.prop_val)
+    prop_val = jnp.where(p1_done, v_chosen_by_p1, prop.prop_val)
     decided_val = jnp.where(p2_done, prop.prop_val, prop.decided_val)
     decided_val = jnp.where(fast_done, prop.own_val, decided_val)
     bal_next = jnp.where(expired, new_bal, prop.bal)
     heard = jnp.where(p1_done | expired, 0, heard)
     best_bal = jnp.where(expired, 0, best_bal)
-    rep_mask = jnp.where(expired[:, None], 0, rep_mask)
+    best_val = jnp.where(expired, 0, best_val)
     timer = jnp.where(p1_done, 0, timer)
     timer = jnp.where(expired, -backoff, timer)
 
-    # Emit: classic ACCEPT on phase-1 completion, PREPARE on retry.
+    # Emit.  The leader's round-0 fast broadcast goes out at timer == 0
+    # THROUGH the faulty network (keep_p2 / delay stamps apply): the fast
+    # round must be as lossy as any other send, or the unsafe-fast planted
+    # bug could never manifest.  Disjoint from p1_done (phase FAST vs P1),
+    # so both ACCEPT sends compose.  Then the classics: ACCEPT on phase-1
+    # completion, PREPARE on expiry (the leader's fast fallback and
+    # follower activation share this path).
+    fast_kick = (prop.phase == FAST) & (prop.timer == 0)
+    requests = net.send(
+        requests, ACCEPT,
+        send_mask=jnp.broadcast_to(fast_kick[:, None], (n_prop, n_acc, n_inst)),
+        bal=prop.bal[:, None],
+        v1=prop.own_val[:, None],
+        v2=jnp.zeros((n_prop, 1, n_inst), jnp.int32),
+        keep=keep_p2,
+        until=None if until_req is None else until_req[ACCEPT],
+    )
     requests = net.send(
         requests, ACCEPT,
         send_mask=jnp.broadcast_to(p1_done[:, None], (n_prop, n_acc, n_inst)),
@@ -384,14 +354,12 @@ def apply_tick_fast(
         prop_val=prop_val,
         heard=heard,
         best_bal=best_bal,
-        rep_mask=rep_mask,
+        best_val=best_val,
         timer=timer,
         decided_val=decided_val,
     )
 
-    # ---- Observers (core.telemetry / obs.exposure): PRNG-free, from ----
-    # signals the tick already produced, so enabling them cannot perturb
-    # the schedule.  The effective-drop/dup counts are shared.
+    # ---- Observers (core.telemetry / obs.exposure): PRNG-free ----
     tel = state.telemetry
     exp = state.exposure
     if tel is not None or exp is not None:
@@ -416,7 +384,7 @@ def apply_tick_fast(
             accept=ok_acc,
             decide=learner.chosen & ~state.learner.chosen,
             conflict=learner.violations - state.learner.violations,
-            leader=p1_done,
+            leader=p1_done | fast_done,
             timeout=expired,
             drop=dropped,
             dup=dups,
@@ -449,8 +417,6 @@ def apply_tick_fast(
                 masks.corrupt & (is_prep | is_acc),
             )
         if link_req is not None:
-            # Effective: in-flight messages the cut actually stalled (the
-            # pre-tick present masks are the honest candidate set).
             events["partition"] = (
                 tel_mod.lane_count(~link_req) + tel_mod.lane_count(~link_rep),
                 tel_mod.lane_count(state.requests.present & ~link_req[None])
@@ -469,11 +435,9 @@ def apply_tick_fast(
         exp = exp_mod.record(exp, **events)
     mar = state.margin
     if mar is not None:
-        # Near-miss margin sketch (obs.margin): slot thresholds are
-        # fast-quorum-aware, matching the learner's chosen test.
         mar = margin_observe(
             mar, state.learner, learner, acc.promised, acc.acc_bal,
-            ~equiv, q2, fast_quorum=fquorum,
+            ~equiv, q2,
         )
 
     state = state.replace(
@@ -487,19 +451,18 @@ def apply_tick_fast(
         exposure=exp,
         margin=mar,
     )
-    # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
-    # replace above just built.  PRNG-free, like telemetry.
+    # ---- Coverage sketch (obs.coverage): hash the post-tick state ----
     if state.coverage is not None:
         state = state.replace(coverage=cov_mod.observe(state.coverage, state))
     return state
 
 
-def fastpaxos_step(
-    state: FastPaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
-) -> FastPaxosState:
+def synchpaxos_step(
+    state: SynchPaxosState, base_key: jax.Array, plan: FaultPlan, cfg: FaultConfig
+) -> SynchPaxosState:
     """Advance every instance by one scheduler tick (XLA engine).
 
-    Fast Paxos shares single-decree paxos' mask shapes, so it reuses its
+    SynchPaxos shares single-decree paxos' mask shapes, so it reuses its
     samplers (`protocols.paxos.sample_masks` / `counter_masks`) and draws
     from the same stream family (`core.streams.SINGLE_DECREE`).
     """
@@ -510,4 +473,18 @@ def fastpaxos_step(
     n_prop = state.proposer.bal.shape[0]
     key = streams_mod.tick_key(base_key, state.tick)
     masks = sample_masks(key, cfg, n_prop, n_acc, n_inst)
-    return apply_tick_fast(state, masks, plan, cfg)
+    return apply_tick_sp(state, masks, plan, cfg)
+
+
+def fast_path_rate(state: SynchPaxosState) -> float:
+    """Fraction of instances the leader decided on the round-0 fast path.
+
+    The leader's ballot only moves on fallback, so phase DONE at the sync
+    ballot identifies a fast-path decide (host-side; one blocking transfer).
+    """
+    import numpy as np
+
+    phase0 = np.asarray(jax.device_get(state.proposer.phase[0]))
+    bal0 = np.asarray(jax.device_get(state.proposer.bal[0]))
+    fast = (phase0 == DONE) & (bal0 == int(sync_ballot()))
+    return float(fast.mean())

@@ -123,6 +123,17 @@ def select_from_scores(
     return sel
 
 
+def ready(buf: MsgBuf, tick: jnp.ndarray) -> Optional[jnp.ndarray]:
+    """(2, P, A, I) bool: slot's delay window has passed.
+
+    None when the ``until`` leaf is pruned (delay off) so callers can skip
+    the gate entirely — delay off adds zero eqns to the traced step.
+    """
+    if buf.until is None:
+        return None
+    return tick >= buf.until
+
+
 def send(
     buf: MsgBuf,
     kind: int,
@@ -131,6 +142,7 @@ def send(
     v1: jnp.ndarray,
     v2: jnp.ndarray,
     keep: Optional[jnp.ndarray] = None,
+    until: Optional[jnp.ndarray] = None,
 ) -> MsgBuf:
     """Write messages of ``kind`` into their slots (overwriting), minus drops.
 
@@ -140,6 +152,9 @@ def send(
       send_mask: (P, A, I) bool — which edges send this tick.
       bal, v1, v2: (P, A, I) int32 payloads (broadcastable).
       keep: optional (P, A, I) bool — send-time survival (False = dropped).
+      until: optional (P, A, I) int32 — earliest delivery tick for the
+        written slots (bounded-delay stamp); requires the buffer to carry
+        an ``until`` leaf.  Omitted = deliverable immediately.
     """
     if keep is not None:
         send_mask = send_mask & keep
@@ -158,11 +173,17 @@ def send(
     # `present` is monotone (old | sent), so its kind-axis update is pure
     # boolean algebra — Mosaic rejects select_n on bool vectors, which rules
     # out jnp.where for the bool leaf.
+    new_until = buf.until
+    if buf.until is not None:
+        new_until = jnp.where(
+            write, until if until is not None else 0, buf.until
+        )
     return buf.replace(
         bal=jnp.where(write, bal, buf.bal),
         v1=jnp.where(write, v1, buf.v1),
         v2=jnp.where(write, v2, buf.v2),
         present=buf.present | write,
+        until=new_until,
     )
 
 

@@ -608,6 +608,10 @@ def protocol_layout(protocol: str):
         from paxos_tpu.core import raft_state as m
 
         return m.RAFT_LAYOUT_VERSION, m.RAFT_LAYOUT, m.RAFT_LAYOUT_DIMS
+    if protocol == "synchpaxos":
+        from paxos_tpu.core import sp_state as m
+
+        return m.SP_LAYOUT_VERSION, m.SP_LAYOUT, m.SP_LAYOUT_DIMS
     raise ValueError(f"unknown protocol: {protocol!r}")
 
 
@@ -635,6 +639,10 @@ def protocol_rw(protocol: str) -> "tuple[tuple, tuple]":
         from paxos_tpu.core import raft_state as m
 
         return m.RAFT_TICK_READS, m.RAFT_TICK_WRITES
+    if protocol == "synchpaxos":
+        from paxos_tpu.core import sp_state as m
+
+        return m.SP_TICK_READS, m.SP_TICK_WRITES
     raise ValueError(f"unknown protocol: {protocol!r}")
 
 

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Bounded-delay wrapper: the delay-chaos config through both synchrony
+# regimes.  First the default cell (latencies capped under the window
+# delta — SynchPaxos' one-round fast path should land), then the
+# violate-delta cell via --fault overrides (latencies sampled ABOVE the
+# window — the synchrony bet loses and the honest protocol must fall back
+# with zero violations).  Extra flags pass through to BOTH runs, so e.g.
+# `scripts/delay.sh --exposure` accounts the delay class's
+# injected-vs-effective ratio in each regime, and
+# `scripts/delay.sh --fault sp_unsafe_fast=true` arms the planted bug the
+# proposer-disagree checker must flag in the violated regime.
+#
+# Usage: scripts/delay.sh [paxos_tpu run flags...]
+#   scripts/delay.sh --n-inst 4096 --ticks 256
+#   scripts/delay.sh --exposure
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail
+echo "== delay-chaos (delta respected: delay_max 2 < delta 6) =="
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m paxos_tpu run \
+  --config delay-chaos "$@" || exit $?
+echo "== delay-chaos (delta violated: delay_max 8 > delta 4) =="
+exec env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m paxos_tpu run \
+  --config delay-chaos --fault p_delay=0.8 --fault delay_max=8 \
+  --fault delta=4 "$@"

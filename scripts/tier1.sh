@@ -5,7 +5,7 @@
 #
 # Usage: scripts/tier1.sh   (from anywhere; cd's to the repo root)
 cd "$(dirname "$0")/.." || exit 1
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 # `stats` smoke: a tiny telemetry-on run must produce a JSONL stream the
 # stats subcommand can summarize (and render as Prometheus text).
 if [ "$rc" -eq 0 ]; then
@@ -380,6 +380,72 @@ assert vio["violations"] > 0 and rep, "violating fuzz run carried no repro"
 assert rep["replays"] is True, rep
 assert "plan_atoms" in rep and "margin" in rep and "exposure" in rep, rep
 assert rep["margin"]["min_quorum_slack"] == 0, rep["margin"]
+EOF
+fi
+# Bounded-delay smoke: the delay fault dimension + SynchPaxos end to end.
+# A delay-chaos campaign must account nonzero EFFECTIVE delay exposure
+# (stamps that actually held messages back, not just sampled latencies);
+# the fused engine must replay the delay-on stream bit-identically to the
+# XLA reference for both a classic protocol and SynchPaxos; SynchPaxos
+# must land its one-round fast path when latencies respect the synchrony
+# window delta, and fall back with ZERO violations when they exceed it.
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF' >/dev/null 2>&1 \
+  && echo DELAY_FAULT_SMOKE=ok || { echo DELAY_FAULT_SMOKE=FAILED; rc=1; }
+import dataclasses
+import hashlib
+import jax
+import jax.numpy as jnp
+import numpy as np
+from paxos_tpu.harness.config import config_delay_chaos
+from paxos_tpu.harness.run import init_plan, init_state, run
+from paxos_tpu.kernels.fused_tick import FUSED_CHUNKS, fused_fns, reference_chunk
+from paxos_tpu.obs.exposure import ExposureConfig, annotate_lit
+from paxos_tpu.protocols.synchpaxos import fast_path_rate
+
+def digest(state):
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+# (a) Lit delay class => nonzero effective exposure, honest soak clean.
+cfg = dataclasses.replace(
+    config_delay_chaos(n_inst=512, seed=3),
+    exposure=ExposureConfig(counters=True),
+)
+report = run(cfg, total_ticks=128, chunk=32)
+assert report["violations"] == 0, report["violations"]
+exposure = annotate_lit(report["exposure"], cfg.fault)
+row = exposure["classes"]["delay"]
+assert 0 < row["effective"] <= row["injected"], row
+assert "delay" in exposure["lit"], exposure["lit"]
+assert "delay" not in exposure["vacuous"], exposure
+
+# (b) Delay-on stream: packed fused kernel (interpret) == XLA reference.
+for protocol, c in (
+    ("paxos", dataclasses.replace(
+        config_delay_chaos(n_inst=256, seed=5), protocol="paxos")),
+    ("synchpaxos", config_delay_chaos(n_inst=256, seed=5)),
+):
+    plan = init_plan(c)
+    seed = jnp.int32(c.seed)
+    fused = FUSED_CHUNKS[protocol](
+        init_state(c), seed, plan, c.fault, 16, block=256, interpret=True)
+    apply_fn, mask_fn, _ = fused_fns(protocol)
+    ref = reference_chunk(
+        init_state(c), seed, plan, c.fault, 16, apply_fn, mask_fn)
+    assert digest(fused) == digest(ref), f"{protocol}: fused != reference"
+
+# (c) The synchrony bet: fast path lands under delta-respecting latencies,
+# honest fallback stays safe when the window is violated.
+_, state = run(config_delay_chaos(n_inst=256, seed=7),
+               until_all_chosen=True, max_ticks=256, return_state=True)
+assert fast_path_rate(state) > 0.0, "fast path never landed under delta"
+report = run(config_delay_chaos(n_inst=256, seed=1, violate_delta=True),
+             total_ticks=256)
+assert report["violations"] == 0, report["violations"]
+assert report["proposer_disagree"] == 0, report["proposer_disagree"]
 EOF
 fi
 exit $rc

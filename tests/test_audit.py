@@ -269,12 +269,22 @@ def test_mutation_wall_clock_import_detected(tmp_path):
 
 
 def test_plan_folds_exact_for_gray_chaos():
+    # gray-chaos draws exactly its expected folds; LINK_DELAY only joins
+    # when p_delay lights, so delay-chaos supplies it and together the two
+    # configs must exercise the full PLAN_FOLDS registry.
     cfg = trace_mod.build_config("paxos", "gray-chaos")
     closed = trace_mod.trace_plan_sample(cfg)
     seen = set(jt.fold_in_constants(closed.jaxpr))
-    assert seen == prng_audit.expected_plan_folds(cfg.fault) == set(
-        streams_mod.PLAN_FOLDS.values()
-    )
+    assert seen == prng_audit.expected_plan_folds(cfg.fault)
+    assert streams_mod.PLAN_FOLDS["LINK_DELAY"] not in seen
+
+    dcfg = trace_mod.build_config("synchpaxos", "delay-chaos")
+    dclosed = trace_mod.trace_plan_sample(dcfg)
+    dseen = set(jt.fold_in_constants(dclosed.jaxpr))
+    assert dseen == prng_audit.expected_plan_folds(dcfg.fault)
+    assert streams_mod.PLAN_FOLDS["LINK_DELAY"] in dseen
+
+    assert seen | dseen == set(streams_mod.PLAN_FOLDS.values())
 
 
 def test_plan_missing_fold_detected():

@@ -1,0 +1,157 @@
+"""Bounded-delay fault channel: stamps, gating, and message conservation.
+
+The delay channel's load-bearing property is CONSERVATION: a delayed
+message is *late*, never *lost*.  ``delay_stamps`` writes ``until`` ticks
+into the message buffers at send time and ``net.ready`` gates visibility
+only — no mask ever clears a stamped slot — so delay composes with
+partitions (a delayed message landing in a cut waits for BOTH the stamp
+and the heal) without inventing a new loss mode.  With loss genuinely off,
+every protocol must therefore still decide every lane; that end-to-end
+check runs for all five protocols on both engines below.
+
+The structural half of default-off-is-free (p_delay = 0 prunes the
+``until`` leaves and ``plan.link_delay``) rides here too; the stream half
+(bit-identical default digests) is pinned by tests/test_gray.py.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paxos_tpu.core.messages import MsgBuf
+from paxos_tpu.faults.injector import FaultConfig, FaultPlan
+from paxos_tpu.harness.config import SimConfig
+from paxos_tpu.harness.run import init_state, run
+from paxos_tpu.obs.exposure import ExposureConfig
+from paxos_tpu.transport import inmemory_tpu as net
+
+PROTOCOLS = ("paxos", "multipaxos", "fastpaxos", "raftcore", "synchpaxos")
+
+
+def delay_cfg(protocol, n_inst=128, seed=0, exposure=False, **fault_kw):
+    fault_kw.setdefault("p_delay", 0.6)
+    fault_kw.setdefault("delay_max", 3)
+    cfg = SimConfig(
+        n_inst=n_inst, n_prop=2, n_acc=5, seed=seed, protocol=protocol,
+        fault=FaultConfig(**fault_kw),
+    )
+    if exposure:
+        cfg = dataclasses.replace(cfg, exposure=ExposureConfig(counters=True))
+    return cfg
+
+
+# --- transport-level semantics -------------------------------------------
+
+
+def test_until_stamp_gates_visibility_only():
+    """A stamped slot is invisible until its tick, present throughout, and
+    delivers unchanged after — the whole conservation argument in one
+    buffer."""
+    buf = MsgBuf.empty(4, 1, 1, delay=True)
+    mask = jnp.ones((1, 1, 4), bool)
+    until = jnp.full((1, 1, 4), 5, jnp.int32)
+    buf = net.send(buf, 0, send_mask=mask, bal=jnp.int32(7),
+                   v1=jnp.int32(1), v2=jnp.int32(0), until=until)
+    for tick in (0, 4):
+        rdy = net.ready(buf, jnp.int32(tick))
+        assert not bool((rdy & buf.present)[0].any())
+    assert bool(buf.present[0].all())  # in flight the whole wait
+    rdy = net.ready(buf, jnp.int32(5))
+    assert bool((rdy & buf.present)[0].all())
+    assert bool((buf.bal[0] == 7).all())  # payload untouched by the wait
+
+
+def test_delay_off_prunes_until_and_plan():
+    """p_delay = 0: no ``until`` leaves anywhere in the state, no
+    ``link_delay`` in the plan — the pre-delay pytree, structurally."""
+    for protocol in PROTOCOLS:
+        cfg = delay_cfg(protocol, n_inst=32, p_delay=0.0)
+        state = init_state(cfg)
+        for buf in jax.tree_util.tree_leaves(
+            state, is_leaf=lambda x: isinstance(x, MsgBuf)
+        ):
+            if isinstance(buf, MsgBuf):
+                assert buf.until is None, protocol
+        assert net.ready(MsgBuf.empty(8, 2, 5), jnp.int32(0)) is None
+    plan = FaultPlan.sample(
+        jax.random.PRNGKey(0), FaultConfig(p_drop=0.1), 32, 5, 2
+    )
+    assert plan.link_delay is None
+
+
+def test_delay_on_materializes_stamps():
+    for protocol in PROTOCOLS:
+        cfg = delay_cfg(protocol, n_inst=32)
+        state = init_state(cfg)
+        bufs = [
+            b for b in jax.tree_util.tree_leaves(
+                state, is_leaf=lambda x: isinstance(x, MsgBuf)
+            ) if isinstance(b, MsgBuf)
+        ]
+        assert bufs, protocol
+        for buf in bufs:
+            assert buf.until is not None, protocol
+    plan = FaultPlan.sample(
+        jax.random.PRNGKey(0), FaultConfig(p_delay=0.6, delay_max=3),
+        32, 5, 2,
+    )
+    caps = jax.device_get(plan.link_delay)
+    assert caps.shape == (2, 5, 32)
+    assert caps.min() >= 0 and caps.max() <= 3
+    assert (caps > 0).any()  # some links actually slow at p=0.6
+
+
+# --- conservation across a partition cut + heal, end to end --------------
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_delay_conservation_across_cut_and_heal_xla(protocol):
+    """Delay + a guaranteed partition episode per lane, loss OFF: every
+    message eventually delivers, so every lane must decide — a delayed
+    message swallowed by the cut/heal would strand its lane below 1.0."""
+    cfg = delay_cfg(
+        protocol, n_inst=128, exposure=True,
+        p_part=1.0, part_max_start=8, part_max_len=8, timeout=6,
+    )
+    report = run(cfg, until_all_chosen=True, max_ticks=768, chunk=64)
+    assert report["violations"] == 0
+    assert report["chosen_frac"] == 1.0, (protocol, report["chosen_frac"])
+    assert report["proposer_disagree"] == 0
+    classes = report["exposure"]["classes"]
+    # Both faults genuinely bit: messages were held by stamps AND by cuts.
+    assert classes["delay"]["effective"] > 0, protocol
+    assert classes["partition"]["effective"] > 0, protocol
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_delay_conservation_across_cut_and_heal_fused(protocol):
+    """Same conservation property under the fused engine's counter-PRNG
+    stream (Pallas interpreter off-TPU) — smaller batch, same invariants."""
+    cfg = delay_cfg(
+        protocol, n_inst=64, seed=3,
+        p_part=1.0, part_max_start=8, part_max_len=8, timeout=6,
+    )
+    report = run(
+        cfg, until_all_chosen=True, max_ticks=384, chunk=64, engine="fused",
+    )
+    assert report["violations"] == 0
+    assert report["chosen_frac"] == 1.0, (protocol, report["chosen_frac"])
+    assert report["proposer_disagree"] == 0
+
+
+def test_delay_composes_with_drop_safely():
+    """Delay + real loss + dup: liveness is no longer guaranteed per lane,
+    but safety and near-full progress are — the chaos regime delay ships
+    in (config_delay_chaos's knob family, paxos side)."""
+    cfg = delay_cfg(
+        "paxos", n_inst=128, seed=1, exposure=True,
+        p_drop=0.15, p_dup=0.1, p_delay=0.5, delay_max=4, timeout=6,
+    )
+    report = run(cfg, total_ticks=256, chunk=64)
+    assert report["violations"] == 0
+    assert report["chosen_frac"] > 0.9
+    classes = report["exposure"]["classes"]
+    assert classes["delay"]["effective"] > 0
+    assert classes["drop"]["effective"] > 0

@@ -35,7 +35,7 @@ from paxos_tpu.obs.coverage import CoverageConfig
 # breaks that invalidate recorded corpus journals, which is exactly what
 # this pin should make loud.
 GOLDEN_MUTATION_DIGEST = (
-    "cb83db386bc9362a5840b96e288ab652c0140746b2b1cc39102705bfcf801d39"
+    "6eea3cf3cb5ab074a199ac0454aa22f01c246367b20e6ad917c3549b01995721"
 )
 
 
@@ -178,6 +178,20 @@ def test_fitness_zero_for_vacuous_chaos():
     assert fitness(10, crash_only, None, 0) == 20.0
 
 
+def test_fitness_zero_for_vacuous_delay_chaos():
+    """Satellite: the vacuous-chaos warning extends to the delay class — a
+    delay-only entry whose slow links never actually held a message back
+    (zero effective delay events) weighs 0, whatever coverage it bought."""
+    slow = [{"kind": "delay", "prop": 0, "acc": 2, "lane": 7, "cap": 6}]
+    assert entry_classes(slow) == {"delay"}
+    vacuous = {"delay": {"injected": 40, "effective": 0}}
+    assert exposure_weight(slow, vacuous) == 0.0
+    assert fitness(1000, slow, vacuous, 0) == 0.0
+    live = {"delay": {"injected": 40, "effective": 10}}
+    assert exposure_weight(slow, live) == 0.25
+    assert fitness(8, slow, live, None) == 2.0
+
+
 def test_zero_energy_for_vacuous_entries():
     """The scheduler retires a vacuous entry on feedback: zero energy,
     never a mutation parent again (acceptance criterion)."""
@@ -251,6 +265,16 @@ def test_campaign_config_lights_exactly_needed_knobs():
     crash = [{"kind": "crash", "role": "acceptor", "idx": 0, "lane": 0,
               "start": 0, "end": 4}]
     assert campaign_config(base, 0, crash, {}).fault == base.fault
+    # Delay atoms light the bounded-delay channel and stretch delay_max to
+    # the largest cap (the per-tick draw is U[1, delay_max] clamped per
+    # link, so an unreachable cap would be silently inert).
+    slow = [{"kind": "delay", "prop": 0, "acc": 1, "lane": 2, "cap": 9}]
+    dly = campaign_config(base, 0, slow, {"ballot_stride": 3}).fault
+    assert dly.p_delay > 0 and dly.delay_max == 9
+    assert dly.ballot_stride == 3  # whitelisted knob override
+    dplan = atoms_to_plan(slow, 64, 3, 1, cfg=dly)
+    assert dplan.link_delay is not None
+    assert int(dplan.link_delay[0, 1, 2]) == 9
     # The decoded plan materializes every field the lit config consults.
     plan = atoms_to_plan(atoms, 64, 3, 1, cfg=f)
     assert plan.link_drop is not None and plan.link_dup is not None

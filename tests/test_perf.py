@@ -259,7 +259,7 @@ def test_bench_case_schema_and_warmup_split():
     assert perf.validate_bench_row(row) == []
     assert row["warmup_groups"] == 1 and len(row["warmup_runs"]) == 1
     assert row["timed_groups"] == 2 and len(row["samples"]) == 2
-    assert row["layout_version"] == "paxos-packed-v3"
+    assert row["layout_version"] == "paxos-packed-v4"
     assert row["ops_per_lane_tick"] > 0
     assert row["perf"]["dispatches"] >= 2
     assert 0.0 <= row["perf"]["occupancy"] <= 1.0

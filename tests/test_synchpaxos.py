@@ -1,0 +1,123 @@
+"""SynchPaxos: the synchrony bet, the fallback, and the planted bug.
+
+SynchPaxos (arXiv 2507.12792) decides in one round trip whenever message
+delays respect the synchrony window Δ, and falls back to classic ballots
+when they don't.  Crucially Δ is a LIVENESS bet, never a safety
+assumption: when the bound is violated the honest protocol merely loses
+its fast path, while the ``sp_unsafe_fast`` planted bug — deciding on the
+first fast ack instead of a quorum — becomes a catchable agreement
+violation (the ``proposer_disagree`` checker plane, since the learner
+itself never sees the premature decide).
+
+The ``ballot_stride`` sweep (arXiv 2006.01885) rides here too: proposers
+that advance retry ballots by an odd stride > 1 still satisfy every
+safety invariant — the knob only has to keep per-proposer ballot
+sequences disjoint, which any stride preserves.
+"""
+
+import dataclasses
+
+import jax
+import pytest
+
+from paxos_tpu.faults.injector import FaultConfig
+from paxos_tpu.harness.config import SimConfig, config_delay_chaos
+from paxos_tpu.harness.run import run
+from paxos_tpu.protocols.synchpaxos import fast_path_rate
+
+
+def _small(cfg, n_inst=256):
+    return dataclasses.replace(cfg, n_inst=n_inst)
+
+
+def test_fast_path_fault_free():
+    """No faults: every instance decides inside Δ on the round-0 ballot."""
+    cfg = SimConfig(n_inst=256, n_prop=2, n_acc=5, protocol="synchpaxos")
+    report, state = run(
+        cfg, until_all_chosen=True, max_ticks=64, return_state=True
+    )
+    assert report["violations"] == 0
+    assert report["proposer_disagree"] == 0
+    assert report["chosen_frac"] == 1.0
+    assert fast_path_rate(state) == 1.0
+
+
+def test_fast_path_survives_delta_respecting_delay():
+    """Latencies capped under Δ: the synchrony bet pays off — the fast
+    path still lands despite real per-link delay queues (and some loss)."""
+    cfg = _small(config_delay_chaos(seed=7))
+    assert cfg.fault.delay_max < cfg.fault.delta  # the regime's premise
+    report, state = run(
+        cfg, until_all_chosen=True, max_ticks=256, return_state=True
+    )
+    assert report["violations"] == 0
+    assert report["proposer_disagree"] == 0
+    assert fast_path_rate(state) > 0.5
+
+
+def test_delta_violation_honest_falls_back_safely():
+    """Latencies above Δ: the bet loses, the honest protocol falls back to
+    classic ballots — slower, but zero safety violations and zero
+    cross-proposer disagreement."""
+    cfg = _small(config_delay_chaos(seed=1, violate_delta=True))
+    assert cfg.fault.delay_max > cfg.fault.delta
+    report = run(cfg, total_ticks=256)
+    assert report["violations"] == 0
+    assert report["proposer_disagree"] == 0
+    assert report["chosen_frac"] > 0.0  # fallback makes progress anyway
+
+
+@pytest.mark.parametrize("seed", [1, 7, 13])
+def test_unsafe_fast_bug_caught_within_one_campaign(seed):
+    """``sp_unsafe_fast`` decides on the first fast ack: under Δ-violating
+    delay + loss, a stale fast decide and a newer fallback decide disagree
+    within a single 256-tick campaign — flagged by ``proposer_disagree``
+    (the learner's own chosen-value plane stays clean, which is exactly
+    why the cross-proposer checker exists)."""
+    cfg = _small(config_delay_chaos(seed=seed, violate_delta=True))
+    # Heavier loss than the soak regime: dropped fast acks force the
+    # fallback re-proposals whose decide the stale fast decide contradicts.
+    cfg = dataclasses.replace(
+        cfg,
+        fault=dataclasses.replace(cfg.fault, sp_unsafe_fast=True, p_drop=0.4),
+    )
+    report = run(cfg, total_ticks=256)
+    assert report["violations"] == 0  # the learner plane alone stays blind
+    assert report["proposer_disagree"] >= 1, seed
+
+
+def test_unsafe_fast_needs_delta_violation_to_fire():
+    """The same bug under Δ-respecting latencies stays latent: every fast
+    ack the buggy decide trusts is also inside the window, so the quorum
+    it skipped would have agreed anyway."""
+    cfg = _small(config_delay_chaos(seed=7))
+    cfg = dataclasses.replace(
+        cfg, fault=dataclasses.replace(cfg.fault, sp_unsafe_fast=True)
+    )
+    report = run(cfg, total_ticks=256)
+    assert report["violations"] == 0
+    assert report["proposer_disagree"] == 0
+
+
+# --- ballot_stride sweep (arXiv 2006.01885) ------------------------------
+
+
+@pytest.mark.parametrize("protocol", ["paxos", "synchpaxos"])
+def test_ballot_stride_sweep_safe_and_live(protocol):
+    """Strides 1/3/7 under dueling-proposer contention: safety and full
+    liveness hold at every stride, and larger strides visibly reach
+    higher ballots (the rounds really do advance by the stride)."""
+    max_bals = {}
+    for stride in (1, 3, 7):
+        cfg = SimConfig(
+            n_inst=128, n_prop=2, n_acc=3, seed=11, protocol=protocol,
+            fault=FaultConfig(p_drop=0.25, timeout=6, ballot_stride=stride),
+        )
+        report, state = run(
+            cfg, until_all_chosen=True, max_ticks=1024, return_state=True
+        )
+        assert report["violations"] == 0, (protocol, stride)
+        assert report["proposer_disagree"] == 0, (protocol, stride)
+        assert report["chosen_frac"] == 1.0, (protocol, stride)
+        max_bals[stride] = int(jax.device_get(state.proposer.bal.max()))
+    assert max_bals[7] > max_bals[1], max_bals
