@@ -1,0 +1,23 @@
+"""Fault-tolerant sharded fuzzing fleet (ROADMAP item 3).
+
+A long-lived fuzzing service built from the pieces the repo already
+trusts: the shared soak worker loop executes campaigns (`harness.soak`),
+the corpus journal and coverage union are wall-clock-free and mergeable
+(`fuzz.corpus`, `obs.coverage.union_hex`), and campaigns are
+deterministic in (config, seed, plan) — so worker loss is recoverable by
+EXACT REPLAY, and the whole fleet's output is byte-identical to an
+uninterrupted run's.  Three layers:
+
+- ``queue``: a durable file-backed campaign queue — atomic-rename
+  enqueue/claim, lease-based ownership with heartbeat renewal, expired-
+  lease reclaim so a dead worker's campaign is re-dispatched.
+- ``worker``: one worker process — claims campaign records, runs them
+  through ``soak()`` with a per-record campaign source, journals
+  per-seed progress crash-safely, and resumes a reclaimed record from
+  its last durable line.
+- ``coordinator``: spawns/monitors N workers, reclaims expired leases,
+  respawns the dead, merges shard corpora and coverage (ordered by
+  record, so the merge is schedule-independent), dedups repros, and
+  gates the run through ``bench-compare``.  ``--chaos`` SIGKILLs workers
+  on a seeded schedule — the fleet's own fault injection.
+"""

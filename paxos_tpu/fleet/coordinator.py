@@ -1,0 +1,432 @@
+"""Fleet coordinator — plan, spawn, monitor, reclaim, merge, gate.
+
+The coordinator turns a campaign budget into durable queue records,
+spawns N worker subprocesses (`paxos_tpu fleet-worker`), and runs the
+monitor loop: reclaim expired leases (a dead worker's record goes back
+to pending with ``attempt + 1``), respawn dead workers while work
+remains, and — under ``--chaos`` — SIGKILL workers on a seeded schedule
+drawn from the same pure-integer stream family as every other schedule
+in the repo (`fuzz.mutate.SplitMix64`).
+
+The merge is where the determinism contract pays off: shard results are
+combined in CANONICAL RECORD ORDER (never completion order), coverage
+unions OR together (`obs.coverage.union_hex` is a mergeable Bloom
+sketch), corpus journals replay-append with dedup by (seed,
+atoms_digest) (`fuzz.corpus.merge_journals`), and shrunk repros dedup by
+(config_fingerprint, seed).  Campaigns are deterministic in (config,
+seed, plan), so however many workers died and however leases bounced,
+the merged journal digest and union_hex are byte-identical to an
+uninterrupted run's — chaos mode exists to keep proving that.
+
+``bench-compare`` runs as the fleet's continuous regression gate
+(`obs.perf.compare_benches` against the committed baseline), so a fleet
+that finishes its budget on a slowed-down build still fails loudly.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from typing import Any, Callable, Optional
+
+from paxos_tpu.fleet.queue import CampaignQueue
+from paxos_tpu.fuzz.mutate import SplitMix64
+from paxos_tpu.harness.retry import run_with_retries
+
+# Chaos kill-schedule stream fold (registry idiom: a fixed lane no other
+# stream uses).
+_CHAOS_FOLD = 0xC4A5
+
+
+def plan_records(
+    *,
+    mode: str,
+    config: str,
+    n_inst: Optional[int],
+    fault: "list[str]",
+    seed: int,
+    records: int,
+    seeds_per_record: int,
+    ticks_per_seed: int,
+    chunk: int,
+    coverage_words: int,
+    engine: str = "xla",
+    seed_stride: int = 10_000,
+    rng_seed: int = 0,
+    campaigns_per_record: int = 8,
+    seed_entries: int = 2,
+    mutations: int = 2,
+    energy_max: int = 4,
+) -> "list[dict]":
+    """Partition a fleet budget into campaign records.
+
+    Soak mode: record ``i`` owns the contiguous seed range
+    ``[seed + i*seeds_per_record, ...)`` — together exactly the rotating
+    seed schedule one big soak would run.  Fuzz mode: record ``i`` is an
+    independent guided-fuzzing shard rooted at ``seed + i*seed_stride``
+    (disjoint seed spaces) with mutation stream ``rng_seed + i`` —
+    shards explore independently and the corpora merge.
+    """
+    out = []
+    for i in range(records):
+        rec: dict = {
+            "campaign": i,
+            "mode": mode,
+            "config": config,
+            "n_inst": n_inst,
+            "fault": list(fault),
+            "ticks_per_seed": ticks_per_seed,
+            "chunk": chunk,
+            "coverage_words": coverage_words,
+            "engine": engine,
+            "attempt": 0,
+        }
+        if mode == "fuzz":
+            rec |= {
+                "seed": seed + i * seed_stride,
+                "rng_seed": rng_seed + i,
+                "campaigns": campaigns_per_record,
+                "seed_entries": seed_entries,
+                "mutations": mutations,
+                "energy_max": energy_max,
+            }
+        else:
+            rec |= {
+                "seed": seed + i * seeds_per_record,
+                "seeds": seeds_per_record,
+            }
+        out.append(rec)
+    return out
+
+
+def chaos_kill_ordinals(
+    chaos_seed: int, kills: int, n_records: int
+) -> "set[int]":
+    """Which claim events (by observation ordinal) get a SIGKILL.
+
+    Drawn from the registered pure-integer stream — same seed, same
+    schedule, every run.  Determinism of the MERGED RESULT never depends
+    on which claims these ordinals land on (that varies with worker
+    interleaving); the seeded schedule makes chaos runs repeatable in
+    *shape*, and the recovery contract makes them identical in *output*.
+    """
+    stream = SplitMix64(chaos_seed).fork(_CHAOS_FOLD)
+    out: "set[int]" = set()
+    want = min(kills, n_records)
+    while len(out) < want:
+        out.add(stream.below(n_records))
+    return out
+
+
+def merge_results(results: "list[dict]") -> dict:
+    """Merge shard results in canonical record order (see module doc)."""
+    from paxos_tpu.fuzz.corpus import merge_journals
+
+    ordered = sorted(results, key=lambda r: r["campaign"])
+    union = 0
+    bits_total = 0
+    rounds = 0
+    seeds = 0
+    resumed = 0
+    violations = 0
+    torn_tails = 0
+    retried = 0
+    violating: "list[int]" = []
+    journals = []
+    repros: "dict[tuple, dict]" = {}
+    repro_dups = 0
+    for r in ordered:
+        union |= int(r.get("union_hex", "0"), 16)
+        bits_total = max(bits_total, int(r.get("bits_total", 0)))
+        rounds += int(r.get("rounds", 0))
+        seeds += int(r.get("seeds", 0))
+        resumed += int(r.get("resumed_seeds", 0))
+        violations += int(r.get("violations", 0))
+        violating += list(r.get("violating_seeds", []))
+        torn_tails += int(bool(r.get("torn_tail")))
+        retried += int(r.get("attempt", 0))
+        if r.get("journal") is not None:
+            journals.append(r["journal"])
+        repro = r.get("repro")
+        if repro is not None:
+            key = (repro.get("config_fingerprint"), repro.get("seed"))
+            if key in repros:
+                repro_dups += 1
+            else:
+                repros[key] = repro
+    out: dict = {
+        "records": len(ordered),
+        "rounds": rounds,
+        "seeds": seeds,
+        "resumed_seeds": resumed,
+        "violations": violations,
+        "violating_seeds": sorted(violating),
+        "union_hex": f"{union:x}",
+        "coverage": {
+            "bits_set": bin(union).count("1"),
+            "bits_total": bits_total,
+            "saturation": round(
+                bin(union).count("1") / max(bits_total, 1), 6
+            ),
+            "union_hex": f"{union:x}",
+        },
+        "torn_tails": torn_tails,
+        "campaigns_retried": retried,
+        "repros": sorted(
+            repros.values(),
+            key=lambda x: (x.get("config_fingerprint") or "",
+                           x.get("seed", 0)),
+        ),
+        "repro_dedup": repro_dups,
+        "merge_dedup": 0,
+    }
+    if journals:
+        merged = merge_journals(journals)
+        out["journal_digest"] = merged["digest"]
+        out["journal_entries"] = merged["entries"]
+        out["merge_dedup"] = merged["dedup"]
+        out["journal_events"] = merged["events"]
+    return out
+
+
+def bench_gate(
+    baseline: str,
+    fresh: Optional[str] = None,
+    tolerance: float = 0.10,
+    noise_k: float = 3.0,
+) -> dict:
+    """The fleet's continuous regression gate: compare_benches on the
+    committed baseline (fresh=None is the self-compare sanity leg, which
+    must pass — same contract as ``bench-compare`` without ``--fresh``)."""
+    from paxos_tpu.obs import perf as perf_mod
+
+    def load(path):
+        with open(path) as f:
+            data = json.load(f)
+        return data if isinstance(data, list) else [data]
+
+    try:
+        base_rows = load(baseline)
+        fresh_rows = base_rows if fresh is None else load(fresh)
+    except (OSError, json.JSONDecodeError) as e:
+        return {"ok": False, "error": str(e)}
+    result = perf_mod.compare_benches(
+        base_rows, fresh_rows, tolerance=tolerance, noise_k=noise_k
+    )
+    return {
+        "ok": bool(result["compared"]) and not result["regressions"],
+        "compared": result["compared"],
+        "regressions": result["regressions"],
+        "baseline": baseline,
+        "fresh": fresh or baseline,
+    }
+
+
+def _spawn_worker(
+    root, worker_id: str, args_ns, say
+) -> subprocess.Popen:
+    """One worker subprocess, dispatched through the shared retry policy
+    (a transient fork/pipe failure must not kill the whole fleet run)."""
+    cmd = [
+        sys.executable, "-m", "paxos_tpu",
+        "--platform", getattr(args_ns, "platform", "default"),
+        "fleet-worker",
+        "--dir", str(root),
+        "--worker-id", worker_id,
+        "--lease-s", str(args_ns.lease_s),
+        "--poll-s", str(args_ns.poll_s),
+        "--hold-s", str(args_ns.hold_s),
+    ]
+    proc, _ = run_with_retries(
+        lambda: subprocess.Popen(cmd, stdout=subprocess.DEVNULL),
+        say, retries=2, backoff_s=0.2, retry_on=(OSError,),
+        describe="worker dispatch error",
+    )
+    say(f"spawned {worker_id} (pid {proc.pid})")
+    return proc
+
+
+def run_fleet(
+    records: "list[dict]",
+    root,
+    args_ns,
+    *,
+    log: Optional[Callable[[str], None]] = None,
+    on_tick: Optional[Callable[[dict], None]] = None,
+) -> "tuple[dict, int]":
+    """Run one fleet to completion; returns (report, exit_code).
+
+    Exit codes mirror the CLI family: 0 clean, 1 operational failure
+    (budget not completed before ``--timeout-s``, unusable bench gate
+    inputs), 2 safety violations or a bench regression.
+    """
+    say = log or (lambda s: None)
+    q = CampaignQueue(root)
+    for rec in records:
+        q.enqueue(rec)
+    n_records = len(records)
+    n_workers = int(args_ns.workers)
+
+    from paxos_tpu.parallel.mesh import partition_devices
+
+    device_plan = [len(s) for s in partition_devices(n_workers)]
+
+    chaos = bool(getattr(args_ns, "chaos", False))
+    kill_set = (
+        chaos_kill_ordinals(
+            int(args_ns.chaos_seed), int(args_ns.chaos_kills), n_records
+        )
+        if chaos else set()
+    )
+    if chaos:
+        say(f"chaos: kill schedule (claim ordinals) = {sorted(kill_set)}")
+
+    procs: "dict[str, subprocess.Popen]" = {}
+    spawned = 0
+
+    def spawn(tag: str) -> None:
+        nonlocal spawned
+        wid = f"w{spawned}{tag}"
+        procs[wid] = _spawn_worker(root, wid, args_ns, say)
+        spawned += 1
+
+    for _ in range(n_workers):
+        spawn("")
+
+    t0 = time.time()
+    deadline = t0 + float(args_ns.timeout_s)
+    claims_seen: "set[tuple]" = set()
+    kills_done = 0
+    workers_killed: "set[str]" = set()
+    leases_reclaimed = 0
+    leases_expired = 0
+    leases_held_peak = 0
+    workers_dead = 0
+    last_emit = 0.0
+
+    def gauges() -> dict:
+        alive = sum(1 for p in procs.values() if p.poll() is None)
+        return {
+            "workers": n_workers,
+            "workers_alive": alive,
+            "workers_dead": workers_dead,
+            "workers_spawned": spawned,
+            "queue_depth": q.pending_count(),
+            "records_total": n_records,
+            "records_done": q.done_count(),
+            "leases_held_peak": leases_held_peak,
+            "leases_expired": leases_expired,
+            "leases_reclaimed": leases_reclaimed,
+        }
+
+    completed = False
+    while time.time() < deadline:
+        if q.done_count() >= n_records:
+            completed = True
+            break
+        now = time.time()
+        # 1. Chaos: watch for new claims; kill on the seeded ordinals.
+        leases = q.leases()
+        leases_held_peak = max(leases_held_peak, len(leases))
+        for rec_id in sorted(leases):
+            lease = leases[rec_id]
+            key = (rec_id, lease.get("worker"), lease.get("attempt", 0))
+            if key in claims_seen:
+                continue
+            ordinal = len(claims_seen)
+            claims_seen.add(key)
+            wid = lease.get("worker")
+            if (chaos and ordinal in kill_set
+                    and kills_done < int(args_ns.chaos_kills)
+                    and wid in procs and procs[wid].poll() is None):
+                say(f"chaos: SIGKILL {wid} (claim #{ordinal} = {rec_id})")
+                procs[wid].kill()
+                workers_killed.add(wid)
+                kills_done += 1
+        # 2. Reclaim expired leases (the recovery path).
+        reclaimed = q.reclaim_expired(now)
+        if reclaimed:
+            leases_expired += len(reclaimed)
+            leases_reclaimed += len(reclaimed)
+            say(f"reclaimed expired leases: {', '.join(reclaimed)}")
+        # 3. Respawn dead workers while work remains.
+        for wid, proc in list(procs.items()):
+            rc = proc.poll()
+            if rc is None:
+                continue
+            del procs[wid]
+            if rc != 0 or wid in workers_killed:
+                workers_dead += 1
+            if (q.pending_count() + q.claimed_count()) > 0:
+                say(f"worker {wid} exited (rc {rc}) with work remaining; "
+                    "respawning")
+                spawn("r")
+        if on_tick is not None and now - last_emit >= 1.0:
+            last_emit = now
+            on_tick(gauges())
+        time.sleep(float(args_ns.poll_s))
+    else:
+        completed = q.done_count() >= n_records
+
+    # Drain: workers exit on their own once the queue is empty.
+    for wid, proc in procs.items():
+        try:
+            proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            say(f"worker {wid} did not exit; terminating")
+            proc.terminate()
+            try:
+                proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    results = q.results()
+    merged = merge_results(list(results.values())) if results else {}
+    fleet_stats = gauges() | {
+        "campaigns_retried": merged.get("campaigns_retried", 0),
+        "merge_dedup": merged.get("merge_dedup", 0),
+        "torn_tails": (
+            merged.get("torn_tails", 0) + q.torn_records
+        ),
+        "resumed_seeds": merged.get("resumed_seeds", 0),
+    }
+    report: dict = {
+        "metric": "fleet",
+        "mode": records[0]["mode"] if records else "soak",
+        "completed": completed,
+        "device_plan": device_plan,
+        "fleet": fleet_stats,
+        "seconds": round(time.time() - t0, 2),
+    }
+    # The merged journal events are working data for tests/tools, not
+    # report noise — summarize in the report, keep digests.
+    merged_public = {
+        k: v for k, v in merged.items() if k != "journal_events"
+    }
+    report |= merged_public
+    if chaos:
+        report["chaos"] = {
+            "kills_planned": sorted(kill_set),
+            "kills_done": kills_done,
+            "workers_killed": sorted(workers_killed),
+            "chaos_seed": int(args_ns.chaos_seed),
+        }
+    rc = 0
+    if not completed:
+        say(f"fleet incomplete: {q.done_count()}/{n_records} records done "
+            f"at timeout")
+        rc = 1
+    if merged.get("violations"):
+        rc = 2
+    baseline = getattr(args_ns, "bench_baseline", None)
+    if baseline:
+        gate = bench_gate(baseline)
+        report["bench_gate"] = gate
+        if "error" in gate:
+            rc = max(rc, 1)
+        elif not gate["ok"]:
+            say("bench gate: regression against the committed baseline")
+            rc = max(rc, 2)
+    return report, rc

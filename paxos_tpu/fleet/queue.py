@@ -1,0 +1,246 @@
+"""Durable file-backed campaign queue with lease-based claims.
+
+The queue is a directory state machine — every transition is one atomic
+``rename`` on the same filesystem, so any observer (worker, coordinator,
+a human with ``ls``) sees each record in exactly one state:
+
+    pending/c00003.json    enqueued, claimable
+    claimed/c00003.json    owned by a worker (leases/c00003.json says who)
+    done/c00003.json       completed (results/c00003.json has the shard
+                           result, progress/c00003.jsonl the seed journal)
+
+Ownership is a LEASE, not a lock: a claim writes ``{worker, expires,
+attempt}`` and the worker must renew before ``expires`` (a heartbeat
+thread in ``fleet.worker``).  A worker that dies — SIGKILL, OOM,
+preemption — simply stops renewing; the coordinator's
+:meth:`CampaignQueue.reclaim_expired` moves the record back to pending
+with ``attempt + 1`` and someone else re-runs it.  Campaigns are
+deterministic in (config, seed, plan), so the re-run produces the same
+bytes the dead worker would have — recovery is exact replay, which is
+what lets the fleet promise a merged output byte-identical to an
+uninterrupted run's.
+
+Every time-dependent method takes ``now`` EXPLICITLY (callers pass
+``time.time()``): lease logic has no hidden clock, so tests drive the
+whole expiry/reclaim state machine with plain floats.  File reads
+tolerate torn JSON (a crash mid-enqueue) by quarantining, mirroring the
+corpus journal's torn-tail contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Any, Optional
+
+from paxos_tpu.harness.retry import run_with_retries
+
+
+class LeaseLost(RuntimeError):
+    """The caller's lease no longer exists or belongs to someone else —
+    the record was reclaimed out from under a worker presumed dead.  The
+    worker must abandon the record (its replacement owns it now)."""
+
+
+_DIRS = ("pending", "claimed", "done", "leases", "results", "progress", "tmp")
+
+
+class CampaignQueue:
+    """One fleet's queue rooted at a directory (see module docstring)."""
+
+    def __init__(self, root, io_retries: int = 2,
+                 io_backoff_s: float = 0.05) -> None:
+        self.root = pathlib.Path(root)
+        self.io_retries = io_retries
+        self.io_backoff_s = io_backoff_s
+        self.torn_records = 0  # unreadable record files quarantined
+        self._tmp_seq = 0
+        for d in _DIRS:
+            (self.root / d).mkdir(parents=True, exist_ok=True)
+
+    # -- paths -----------------------------------------------------------
+    def _p(self, state: str, rec_id: str) -> pathlib.Path:
+        return self.root / state / f"{rec_id}.json"
+
+    def progress_path(self, rec_id: str) -> pathlib.Path:
+        return self.root / "progress" / f"{rec_id}.jsonl"
+
+    # -- primitives ------------------------------------------------------
+    def _write(self, payload: dict, dest: pathlib.Path) -> None:
+        """Atomic durable write: temp file + fsync + rename, retried on
+        transient filesystem errors through the shared retry policy."""
+        self._tmp_seq += 1
+        tmp = self.root / "tmp" / f"{dest.name}.{os.getpid()}.{self._tmp_seq}"
+
+        def attempt():
+            with open(tmp, "w") as f:
+                json.dump(payload, f, sort_keys=True,
+                          separators=(",", ":"))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, dest)
+
+        run_with_retries(
+            attempt, lambda s: None, retries=self.io_retries,
+            backoff_s=self.io_backoff_s, retry_on=(OSError,),
+            describe="queue write error",
+        )
+
+    def _read(self, path: pathlib.Path) -> Optional[dict]:
+        """None on missing or torn (a crash mid-enqueue) — never raises
+        on content."""
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except json.JSONDecodeError:
+            return None
+
+    # -- lifecycle -------------------------------------------------------
+    def enqueue(self, record: dict) -> str:
+        """Durably publish one campaign record; returns its id.
+
+        The id is the zero-padded campaign ordinal so every directory
+        listing is already in canonical merge order.
+        """
+        rec_id = f"c{int(record['campaign']):05d}"
+        self._write(record, self._p("pending", rec_id))
+        return rec_id
+
+    def claim(self, worker: str, now: float,
+              lease_s: float) -> "Optional[tuple[str, dict]]":
+        """Claim the first pending record; None when nothing is claimable.
+
+        The claim IS the rename pending -> claimed: losers of a race get
+        ``FileNotFoundError`` and move on.  The winner then writes its
+        lease.  (A crash between the two leaves a claimed record with no
+        lease — ``reclaim_expired`` treats that as already expired.)
+        """
+        for path in sorted((self.root / "pending").glob("*.json")):
+            rec_id = path.stem
+            dest = self._p("claimed", rec_id)
+            try:
+                os.rename(path, dest)
+            except FileNotFoundError:
+                continue  # another worker won this record
+            record = self._read(dest)
+            if record is None:
+                # Torn enqueue: quarantine rather than crash-loop every
+                # future claimer on the same bytes.
+                self.torn_records += 1
+                os.replace(dest, self.root / "tmp" / f"{rec_id}.torn")
+                continue
+            self._write(
+                {"worker": worker, "expires": now + lease_s,
+                 "attempt": int(record.get("attempt", 0))},
+                self._p("leases", rec_id),
+            )
+            return rec_id, record
+        return None
+
+    def renew(self, rec_id: str, worker: str, now: float,
+              lease_s: float) -> None:
+        """Heartbeat: extend the caller's lease; LeaseLost if reclaimed."""
+        lease = self._read(self._p("leases", rec_id))
+        if lease is None or lease.get("worker") != worker:
+            owner = "gone" if lease is None else (
+                f"owned by {lease.get('worker')}"
+            )
+            raise LeaseLost(f"{rec_id}: lease {owner}")
+        self._write(
+            dict(lease, expires=now + lease_s), self._p("leases", rec_id)
+        )
+
+    def complete(self, rec_id: str, worker: str, result: dict) -> None:
+        """Publish the shard result and retire the record.
+
+        Result first (atomic), then the record moves claimed -> done,
+        then the lease goes away — so ``done`` implies the result file
+        exists, and a crash anywhere in between is recovered by reclaim
+        + re-run (the re-run rewrites the identical result).
+        """
+        lease = self._read(self._p("leases", rec_id))
+        if lease is None or lease.get("worker") != worker:
+            raise LeaseLost(f"{rec_id}: completed after lease loss")
+        self._write(result, self._p("results", rec_id))
+        os.replace(self._p("claimed", rec_id), self._p("done", rec_id))
+        try:
+            os.unlink(self._p("leases", rec_id))
+        except FileNotFoundError:
+            pass
+
+    def reclaim_expired(self, now: float) -> "list[str]":
+        """Move every claimed record whose lease is missing or expired
+        back to pending with ``attempt + 1``; returns the reclaimed ids.
+
+        Coordinator-only by design: one reclaimer means a slow-but-alive
+        worker is told exactly once (its next ``renew`` raises
+        :class:`LeaseLost`) instead of racing N peers.  Write-then-unlink
+        ordering: a crash mid-reclaim can duplicate the record across
+        pending and claimed, never lose it — the next claim's rename
+        simply overwrites the orphan.
+        """
+        out: list[str] = []
+        for path in sorted((self.root / "claimed").glob("*.json")):
+            rec_id = path.stem
+            lease = self._read(self._p("leases", rec_id))
+            if lease is not None and lease.get("expires", 0) > now:
+                continue
+            record = self._read(path)
+            if record is None:
+                self.torn_records += 1
+                os.replace(path, self.root / "tmp" / f"{rec_id}.torn")
+                continue
+            record["attempt"] = int(record.get("attempt", 0)) + 1
+            self._write(record, self._p("pending", rec_id))
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            try:
+                os.unlink(self._p("leases", rec_id))
+            except FileNotFoundError:
+                pass
+            out.append(rec_id)
+        return out
+
+    # -- queries ---------------------------------------------------------
+    def _count(self, state: str) -> int:
+        return len(list((self.root / state).glob("*.json")))
+
+    def pending_count(self) -> int:
+        return self._count("pending")
+
+    def claimed_count(self) -> int:
+        return self._count("claimed")
+
+    def done_count(self) -> int:
+        return self._count("done")
+
+    def leases(self) -> "dict[str, dict]":
+        """Current leases by record id (the coordinator's claim watch)."""
+        out = {}
+        for path in sorted((self.root / "leases").glob("*.json")):
+            lease = self._read(path)
+            if lease is not None:
+                out[path.stem] = lease
+        return out
+
+    def results(self) -> "dict[str, dict]":
+        """Shard results of DONE records, by record id, canonical order."""
+        out = {}
+        for path in sorted((self.root / "done").glob("*.json")):
+            res = self._read(self._p("results", path.stem))
+            if res is not None:
+                out[path.stem] = res
+        return out
+
+    def record(self, rec_id: str) -> Optional[dict]:
+        """The record dict wherever it currently lives (else None)."""
+        for state in ("pending", "claimed", "done"):
+            rec = self._read(self._p(state, rec_id))
+            if rec is not None:
+                return rec
+        return None

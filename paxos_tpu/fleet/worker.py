@@ -1,0 +1,441 @@
+"""One fleet worker — claim, execute, journal, resume.
+
+A worker is the shared soak loop (`harness.soak`) wrapped in lease
+discipline.  It claims campaign RECORDS (a batch of campaigns: soak mode
+runs ``seeds`` rotating seeds, fuzz mode runs one whole ``GuidedSource``
+budget), heartbeats its lease from a background thread so a minutes-long
+XLA compile can't starve the renewal, and writes two crash-safe
+artifacts per record:
+
+- ``progress/<id>.jsonl`` — one `fuzz.corpus.append_event` line per
+  finalized seed (union_hex, violations), headed by the record's
+  schedule-stream lineage (`harness.checkpoint.stream_id`).  A reclaimed
+  soak record RESUMES seed-granular from the last durable line; the
+  header guard (`checkpoint.check_stream`) discards progress written
+  under a different stream instead of silently splicing two schedules.
+- ``results/<id>.json`` — the shard result, written atomically by
+  ``queue.complete``.
+
+Recovery semantics by mode: soak records resume seed-granular (per-seed
+coverage unions OR back together — the Bloom union is associative);
+fuzz records are ATOMIC units — the guided feedback loop is sequential,
+and re-running it from scratch is a byte-exact replay (the corpus
+journal is wall-clock-free), so deterministic replay IS the recovery.
+Either way the merged fleet output is byte-identical to an uninterrupted
+run's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from paxos_tpu.fleet.queue import CampaignQueue, LeaseLost
+from paxos_tpu.harness.retry import run_with_retries
+
+
+class WorkerPreempted(RuntimeError):
+    """Raised by the in-process preemption hook (``stop_after_seeds``):
+    the deterministic stand-in for SIGKILL that tier-1 recovery tests
+    use — progress up to the hook is durable, nothing after it exists,
+    exactly the state a killed worker leaves behind."""
+
+
+# -- config reconstruction -----------------------------------------------
+
+def build_cfg(record: dict):
+    """Reconstruct the campaign config a record describes.
+
+    Records carry the CLI vocabulary (config name + n_inst + fault
+    override strings + seed), not a serialized config — the same
+    reconstruction path ``cmd_soak``/``cmd_fuzz`` use, so a record is
+    replayable by hand from its JSON.  Coverage is always on: the union
+    sketch is what makes shard results mergeable.
+    """
+    from paxos_tpu.harness.cli import CONFIGS
+    from paxos_tpu.harness.config import apply_fault_overrides
+    from paxos_tpu.obs.coverage import CoverageConfig
+
+    kw: dict = {"seed": int(record["seed"])}
+    if record.get("n_inst"):
+        kw["n_inst"] = int(record["n_inst"])
+    cfg = CONFIGS[record["config"]](**kw)
+    cfg = apply_fault_overrides(cfg, list(record.get("fault", [])))
+    return dataclasses.replace(
+        cfg, coverage=CoverageConfig(
+            words=int(record.get("coverage_words", 64))
+        )
+    )
+
+
+# -- per-record campaign source ------------------------------------------
+
+class SeedListSource:
+    """Campaign source over an explicit seed list — the fleet's
+    resumable unit.  A reclaimed record re-runs ONLY the seeds missing
+    from its progress journal; ``on_report`` fires per finalized
+    campaign with the full report (union_hex included), which is where
+    the progress line and the lease heartbeat happen."""
+
+    def __init__(self, cfg, seeds: "list[int]",
+                 on_report: Optional[Callable] = None) -> None:
+        self.cfg = cfg
+        self._seeds = list(seeds)
+        self._i = 0
+        self.on_report = on_report
+
+    def next_campaign(self):
+        from paxos_tpu.harness.soak import CampaignSpec
+
+        if self._i >= len(self._seeds):
+            return None
+        spec = CampaignSpec(
+            cfg=dataclasses.replace(self.cfg, seed=self._seeds[self._i])
+        )
+        self._i += 1
+        return spec
+
+    def feedback(self, spec, report, seed_rec) -> None:
+        if self.on_report is not None:
+            self.on_report(spec, report, seed_rec)
+
+
+# -- progress journal ----------------------------------------------------
+
+def _load_progress(path, stream: dict, fingerprint: str, say) -> dict:
+    """Recover a record's durable per-seed progress.
+
+    Tolerates a torn tail (`corpus.load_journal`); refuses — by
+    discarding, recovery must recover — progress whose header stream or
+    config fingerprint differs from the resuming record's
+    (`checkpoint.check_stream` decides stream compatibility).
+    Returns ``{"seeds": {seed: line}, "union": int, "violations": int,
+    "violating": [...], "torn_tail": bool}``.
+    """
+    from paxos_tpu.fuzz.corpus import load_journal
+
+    out = {"seeds": {}, "union": 0, "violations": 0, "violating": [],
+           "torn_tail": False}
+    try:
+        loaded = load_journal(path)
+    except FileNotFoundError:
+        return out
+    except ValueError as e:
+        say(f"progress journal unreadable ({e}); re-running the record")
+        return out
+    out["torn_tail"] = loaded["torn_tail"]
+    events = loaded["events"]
+    if not events:
+        return out
+    header = events[0] if events[0].get("event") == "header" else None
+    if header is not None:
+        from paxos_tpu.harness.checkpoint import check_stream
+
+        try:
+            check_stream(header.get("stream"), stream, str(path))
+        except ValueError:
+            say("progress journal was written under a different schedule "
+                "stream; discarding it and re-running the record")
+            return dict(out, seeds={}, union=0, violations=0, violating=[])
+        if header.get("fingerprint") not in (None, fingerprint):
+            say("progress journal belongs to a different config "
+                "fingerprint; discarding it")
+            return dict(out, seeds={}, union=0, violations=0, violating=[])
+    for e in events:
+        if e.get("event") != "seed":
+            continue
+        out["seeds"][int(e["seed"])] = e
+        out["union"] |= int(e.get("union_hex", "0"), 16)
+        v = int(e.get("violations", 0))
+        out["violations"] += v
+        if v:
+            out["violating"].append(int(e["seed"]))
+    return out
+
+
+# -- record execution ----------------------------------------------------
+
+def run_record(
+    queue: CampaignQueue,
+    rec_id: str,
+    record: dict,
+    worker_id: str,
+    *,
+    log: Optional[Callable[[str], None]] = None,
+    heartbeat: Optional[Callable[[], None]] = None,
+    stop_after_seeds: Optional[int] = None,
+) -> dict:
+    """Execute one claimed record to a shard result (see module docstring).
+
+    ``stop_after_seeds`` is the deterministic in-process preemption hook:
+    after that many progress lines land durably, :class:`WorkerPreempted`
+    raises — the record is left exactly as a SIGKILL would leave it.
+    """
+    from paxos_tpu.fuzz.corpus import append_event
+    from paxos_tpu.harness.checkpoint import stream_id
+    from paxos_tpu.harness.soak import soak
+
+    say = log or (lambda s: None)
+    cfg = build_cfg(record)
+    engine = record.get("engine", "xla")
+    mode = record.get("mode", "soak")
+    ticks = int(record["ticks_per_seed"])
+    chunk = int(record["chunk"])
+    stream = stream_id(cfg, engine)
+    fingerprint = cfg.fingerprint()
+    prog_path = queue.progress_path(rec_id)
+    progress = _load_progress(prog_path, stream, fingerprint, say)
+    if progress["torn_tail"]:
+        say(f"{rec_id}: torn tail in progress journal (crash mid-append); "
+            "resuming from the last durable line")
+
+    base = {
+        "record": rec_id,
+        "campaign": int(record["campaign"]),
+        "mode": mode,
+        "worker": worker_id,
+        "attempt": int(record.get("attempt", 0)),
+        "engine": engine,
+        "stream": stream,
+        "config_fingerprint": fingerprint,
+        "torn_tail": progress["torn_tail"],
+    }
+
+    prog_fh = open(prog_path, "a")
+    try:
+        if not progress["seeds"]:
+            append_event(prog_fh, {
+                "event": "header", "record": rec_id, "stream": stream,
+                "fingerprint": fingerprint,
+                "attempt": int(record.get("attempt", 0)),
+            })
+        emitted = {"n": 0}
+
+        def on_report(spec, report, seed_rec):
+            cov = report.get("coverage") or {}
+            append_event(prog_fh, {
+                "event": "seed", "seed": spec.cfg.seed,
+                "union_hex": cov.get("union_hex", "0"),
+                "violations": int(report["violations"]),
+                "rounds": spec.cfg.n_inst * ticks,
+            })
+            if heartbeat is not None:
+                heartbeat()
+            emitted["n"] += 1
+            if (stop_after_seeds is not None
+                    and emitted["n"] >= stop_after_seeds):
+                raise WorkerPreempted(
+                    f"{rec_id}: preempted after {emitted['n']} seeds"
+                )
+
+        if mode == "fuzz":
+            # Atomic unit: deterministic full replay IS the recovery —
+            # the guided feedback loop is sequential, so a half-run
+            # corpus can't be spliced; prior progress only tells us the
+            # dead worker got partway.  The per-seed progress lines
+            # still land (lease heartbeats + post-mortem visibility).
+            from paxos_tpu.fuzz.schedule import FuzzParams, GuidedSource
+
+            source = GuidedSource(
+                cfg,
+                FuzzParams(
+                    campaigns=int(record["campaigns"]),
+                    seed_entries=int(record.get("seed_entries", 2)),
+                    mutations=int(record.get("mutations", 2)),
+                    energy_max=int(record.get("energy_max", 4)),
+                    rng_seed=int(record["rng_seed"]),
+                ),
+                ticks_per_seed=ticks,
+                log=say,
+            )
+            inner = source.feedback
+
+            def fuzz_feedback(spec, report, seed_rec):
+                inner(spec, report, seed_rec)
+                on_report(spec, report, seed_rec)
+
+            source.feedback = fuzz_feedback
+            report = soak(
+                source.cfg,
+                target_rounds=(
+                    int(record["campaigns"]) * cfg.n_inst * ticks
+                ),
+                ticks_per_seed=ticks, chunk=chunk, engine=engine,
+                log=say, campaigns=source,
+            )
+            union = int(
+                (report.get("coverage") or {}).get("union_hex", "0"), 16
+            )
+            result = base | {
+                "seeds": report["seeds"],
+                "resumed_seeds": 0,
+                "rounds": report["rounds"],
+                "violations": report["violations"],
+                "violating_seeds": report["violating_seeds"],
+                "union_hex": f"{union:x}",
+                "bits_total": 32 * cfg.coverage.words,
+                "journal": source.corpus.events(),
+                "journal_digest": source.corpus.digest(),
+            }
+            if report["violations"] and source.violating:
+                result["repro"] = _shrink_repro(
+                    source, ticks, chunk, engine, say
+                )
+            return result
+
+        # Soak mode: seed-granular resume.
+        first = int(record["seed"])
+        all_seeds = [first + i for i in range(int(record["seeds"]))]
+        remaining = [s for s in all_seeds if s not in progress["seeds"]]
+        resumed = len(all_seeds) - len(remaining)
+        if resumed:
+            say(f"{rec_id}: resuming — {resumed}/{len(all_seeds)} seeds "
+                "already durable in the progress journal")
+        union = progress["union"]
+        violations = progress["violations"]
+        violating = list(progress["violating"])
+        seeds_run = 0
+        if remaining:
+            source = SeedListSource(cfg, remaining, on_report=on_report)
+            report = soak(
+                cfg, target_rounds=0, ticks_per_seed=ticks, chunk=chunk,
+                engine=engine, log=say, campaigns=source,
+            )
+            union |= int(
+                (report.get("coverage") or {}).get("union_hex", "0"), 16
+            )
+            violations += report["violations"]
+            violating += report["violating_seeds"]
+            seeds_run = report["seeds"]
+        return base | {
+            "seeds": resumed + seeds_run,
+            "resumed_seeds": resumed,
+            "rounds": len(all_seeds) * cfg.n_inst * ticks,
+            "violations": violations,
+            "violating_seeds": sorted(violating),
+            "union_hex": f"{union:x}",
+            "bits_total": 32 * cfg.coverage.words,
+        }
+    finally:
+        prog_fh.close()
+
+
+def _shrink_repro(source, ticks: int, chunk: int, engine: str, say) -> dict:
+    """Shrink the shard's first violating campaign (deterministic pick,
+    like ``cmd_fuzz``) so the coordinator can dedup repros globally."""
+    from paxos_tpu.harness.shrink import (
+        exposure_annotation,
+        margin_annotation,
+        replay,
+        shrink,
+    )
+
+    vcfg, vplan, eid = source.violating[0]
+    say(f"violation in corpus entry {eid} (seed {vcfg.seed}); shrinking")
+    result = shrink(
+        vcfg, max_ticks=ticks, chunk=chunk, engine=engine, log=say,
+        plan=vplan,
+    )
+    repro = {
+        "entry": eid,
+        "config_fingerprint": vcfg.fingerprint(),
+        "seed": vcfg.seed,
+    }
+    if result is not None:
+        repro |= {
+            "replays": replay(vcfg, result),
+            **result.to_json(),
+            "margin": margin_annotation(vcfg, result),
+            "exposure": exposure_annotation(vcfg, result),
+        }
+    return repro
+
+
+# -- worker main loop ----------------------------------------------------
+
+def work_loop(
+    root,
+    worker_id: str,
+    *,
+    lease_s: float = 15.0,
+    poll_s: float = 0.5,
+    hold_s: float = 0.0,
+    log: Optional[Callable[[str], None]] = None,
+    stop_after_seeds: Optional[int] = None,
+    now_fn: Callable[[], float] = time.time,
+) -> dict:
+    """Claim-execute-complete until the queue drains; returns loop stats.
+
+    The lease heartbeat runs in a DAEMON THREAD renewing every
+    ``lease_s / 5`` — pure host I/O, nothing schedule-relevant — so a
+    long XLA compile inside the first campaign cannot let the lease
+    lapse.  Renewals go through the shared retry policy (transient
+    filesystem errors); :class:`LeaseLost` is never retried — it means
+    the coordinator declared this worker dead, and the only correct move
+    is to abandon the record mid-flight and claim fresh work.
+
+    ``hold_s`` pauses between claim and execution — the chaos window the
+    coordinator's seeded SIGKILL schedule aims at.  The loop exits when
+    pending AND claimed are both empty (other workers' in-flight records
+    might yet be reclaimed, so a worker lingers while any claim exists).
+    """
+    say = log or (lambda s: None)
+    q = CampaignQueue(root)
+    stats = {"worker": worker_id, "records_done": 0, "leases_lost": 0}
+    while True:
+        claim = run_with_retries(
+            lambda: q.claim(worker_id, now_fn(), lease_s),
+            say, retries=2, backoff_s=poll_s, retry_on=(OSError,),
+            describe="queue claim error",
+        )[0]
+        if claim is None:
+            if q.pending_count() == 0 and q.claimed_count() == 0:
+                return stats
+            time.sleep(poll_s)
+            continue
+        rec_id, record = claim
+        say(f"{worker_id}: claimed {rec_id} "
+            f"(attempt {record.get('attempt', 0)})")
+        stop = threading.Event()
+        hb_state: dict = {"lost": None}
+
+        def renew_once():
+            q.renew(rec_id, worker_id, now_fn(), lease_s)
+
+        def heartbeat():
+            run_with_retries(
+                renew_once, say, retries=2, backoff_s=0.05,
+                retry_on=(OSError,), describe="lease renewal error",
+            )
+
+        def hb_loop():
+            while not stop.wait(lease_s / 5.0):
+                try:
+                    heartbeat()
+                except LeaseLost as e:
+                    hb_state["lost"] = e
+                    return
+
+        thread = threading.Thread(target=hb_loop, daemon=True)
+        thread.start()
+        try:
+            if hold_s:
+                time.sleep(hold_s)  # chaos window
+            result = run_record(
+                q, rec_id, record, worker_id, log=say,
+                heartbeat=heartbeat, stop_after_seeds=stop_after_seeds,
+            )
+            if hb_state["lost"] is not None:
+                raise hb_state["lost"]
+            q.complete(rec_id, worker_id, result)
+            stats["records_done"] += 1
+            say(f"{worker_id}: completed {rec_id}")
+        except LeaseLost:
+            stats["leases_lost"] += 1
+            say(f"{worker_id}: lost lease on {rec_id}; abandoning it "
+                "(its replacement owns the record now)")
+        finally:
+            stop.set()
+            thread.join(timeout=2.0)

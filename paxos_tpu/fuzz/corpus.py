@@ -31,6 +31,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import os
 from typing import Any, Optional
 
 # Atom kind -> exposure classes (obs.exposure.CLASSES) its fault events land
@@ -133,12 +134,162 @@ class CorpusEntry:
         return self.new_bits is not None
 
 
-class Corpus:
-    """Entry store + the append-only JSONL journal of every corpus event."""
+def event_line(event: dict) -> str:
+    """One canonical journal line: sorted-key compact JSON."""
+    return json.dumps(event, sort_keys=True, separators=(",", ":"))
 
-    def __init__(self) -> None:
+
+def append_event(fh, event: dict) -> None:
+    """Crash-safe journal append: ONE ``write`` of the full line
+    (newline included), then flush + fsync.
+
+    A single write means a crash can only ever truncate the FINAL line —
+    never interleave two — and the fsync means every line before it is
+    durable before the next event exists.  :func:`load_journal` completes
+    the contract by treating an unterminated tail as torn, not corrupt.
+    """
+    fh.write(event_line(event) + "\n")
+    fh.flush()
+    os.fsync(fh.fileno())
+
+
+def load_journal(path: Any) -> dict:
+    """Read a journal JSONL back, tolerating a torn final line.
+
+    A crash mid-append (SIGKILL, power loss) leaves at most one
+    truncated line at the END of the file — the append discipline above
+    guarantees it.  That tail is dropped and REPORTED (``torn_tail``)
+    instead of raising: recovery replays from the last durable event.  A
+    malformed line anywhere else is real corruption and still raises.
+
+    Returns ``{"events", "digest", "torn_tail"}`` — ``digest`` is the
+    value of a trailing ``{"event": "digest"}`` line when present (the
+    ``write_journal`` format), separated out of ``events``.
+    """
+    with open(path, "r") as f:
+        text = f.read()
+    lines = text.split("\n")
+    torn = False
+    if lines and lines[-1] == "":
+        lines.pop()  # clean newline-terminated tail
+    elif lines and lines[-1] != "":
+        # No terminating newline: the final append was cut mid-write.
+        # Even a tail that parses as JSON is dropped — completeness is
+        # "newline landed", not "the prefix happened to parse".
+        lines.pop()
+        torn = True
+    events: list[dict] = []
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            events.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            if i == len(lines) - 1:
+                # Torn tail variant: the line's bytes were cut but the
+                # newline of a later flush survived is impossible under
+                # the single-write discipline, yet a crashed PRE-fix
+                # writer could leave this — tolerate the final line only.
+                torn = True
+                break
+            raise ValueError(
+                f"corpus journal {path}: malformed line {i + 1} (not the "
+                f"tail — real corruption, not a torn append): {e}"
+            ) from e
+    digest = None
+    if events and events[-1].get("event") == "digest":
+        digest = events.pop()["sha256"]
+    return {"events": events, "digest": digest, "torn_tail": torn}
+
+
+def merge_journals(streams: "list[list[dict]]") -> dict:
+    """Replay-append shard journals into one merged journal.
+
+    The fleet merge: shard event streams are appended IN THE GIVEN ORDER
+    (the coordinator passes campaign-record order, never worker
+    completion order), entries dedup by their campaign identity
+    ``(seed, atoms_digest)``, and entry ids are remapped densely.  A
+    duplicate entry's ``feedback``/``retire`` events are dropped —
+    campaigns are deterministic in (config, seed, plan), so the
+    surviving copy's measurements are the same bytes.  Children of a
+    deduped parent re-parent onto the surviving id.  Because the input
+    order is canonical and every event is wall-clock-free, the merged
+    digest is byte-identical however the shards were actually scheduled,
+    interrupted, or recovered — the determinism pin extends through the
+    merge.
+
+    Returns ``{"events", "lines", "digest", "entries", "dedup"}``.
+    """
+    merged: list[dict] = []
+    # (seed, atoms_digest) -> surviving merged id
+    seen: dict[tuple, int] = {}
+    dedup = 0
+    next_id = 0
+    for events in streams:
+        # original id -> (merged id, was_duplicate)
+        idmap: dict[int, tuple] = {}
+        for e in events:
+            kind = e.get("event")
+            if kind == "add":
+                key = (e["seed"], e.get("atoms_digest")
+                       or atoms_digest(e["atoms"]))
+                if key in seen:
+                    idmap[e["id"]] = (seen[key], True)
+                    dedup += 1
+                    continue
+                new = dict(e)
+                new["id"] = next_id
+                parent = e.get("parent")
+                if parent is not None and parent in idmap:
+                    new["parent"] = idmap[parent][0]
+                seen[key] = next_id
+                idmap[e["id"]] = (next_id, False)
+                next_id += 1
+                merged.append(new)
+            elif kind in ("feedback", "retire"):
+                mapped = idmap.get(e["id"])
+                if mapped is None or mapped[1]:
+                    continue  # event of a deduped (or foreign) entry
+                merged.append(dict(e, id=mapped[0]))
+            # Unknown kinds (a future journal schema) are dropped rather
+            # than merged under stale ids.
+    h = hashlib.sha256()
+    lines = [event_line(e) for e in merged]
+    for line in lines:
+        h.update(line.encode())
+        h.update(b"\n")
+    return {
+        "events": merged,
+        "lines": lines,
+        "digest": h.hexdigest(),
+        "entries": next_id,
+        "dedup": dedup,
+    }
+
+
+class Corpus:
+    """Entry store + the append-only JSONL journal of every corpus event.
+
+    With ``journal_path`` the journal is ALSO written through to disk as
+    it happens — each event one crash-safe :func:`append_event` — so a
+    SIGKILLed fuzzing worker loses at most the event being written, and
+    :func:`load_journal` recovers everything before it.  The in-memory
+    journal (and so ``digest()``) is unchanged either way.
+    """
+
+    def __init__(self, journal_path: Optional[Any] = None) -> None:
         self.entries: list[CorpusEntry] = []
         self._events: list[dict] = []
+        self._fh = open(journal_path, "a") if journal_path else None
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def events(self) -> list[dict]:
+        """The journal as events (a copy) — the fleet's merge input."""
+        return list(self._events)
 
     # -- construction ----------------------------------------------------
     def add(
@@ -215,15 +366,14 @@ class Corpus:
     # -- journal ---------------------------------------------------------
     def _emit(self, event: dict) -> None:
         self._events.append(event)
+        if self._fh is not None:
+            append_event(self._fh, event)
 
     def journal_lines(self) -> list[str]:
         """Canonical JSONL: one sorted-key compact line per event, in
         emission order — byte-stable across runs and platforms (no
         wall-clock, no floats beyond the rounded fitness)."""
-        return [
-            json.dumps(e, sort_keys=True, separators=(",", ":"))
-            for e in self._events
-        ]
+        return [event_line(e) for e in self._events]
 
     def digest(self) -> str:
         """sha256 over the journal — the replay-determinism pin."""
@@ -234,13 +384,19 @@ class Corpus:
         return h.hexdigest()
 
     def write_journal(self, path: Any) -> str:
-        """Write the journal JSONL (digest line last); returns the digest."""
+        """Write the journal JSONL (digest line last); returns the digest.
+
+        Written to a sibling temp file and renamed into place, so a crash
+        mid-write can never leave a half journal under the final name —
+        the whole-file twin of the :func:`append_event` discipline.
+        """
         digest = self.digest()
-        with open(path, "w") as f:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             for line in self.journal_lines():
                 f.write(line + "\n")
-            f.write(json.dumps(
-                {"event": "digest", "sha256": digest},
-                sort_keys=True, separators=(",", ":"),
-            ) + "\n")
+            f.write(event_line({"event": "digest", "sha256": digest}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         return digest

@@ -71,6 +71,36 @@ def stream_id(cfg: SimConfig, engine: str, block: Optional[int] = None) -> dict:
     return {"engine": engine, "block": block, "prng_scheme": prng}
 
 
+def check_stream(
+    saved_stream: Optional[dict], want: dict, where: str
+) -> None:
+    """Refuse a resume whose schedule-stream lineage changed.
+
+    The shared guard behind every resume path — checkpoint
+    :func:`restore` and the fleet's per-record progress journals: a
+    recorded stream that differs from the resuming one means the SAME
+    seed would replay a DIFFERENT schedule (engine switch, fused-block
+    default change, PRNG impl change), which silently corrupts the
+    determinism contract.  ``None`` (pre-stream metadata) warns and
+    proceeds; a mismatch raises.
+    """
+    if saved_stream is None:
+        import warnings
+
+        warnings.warn(
+            f"{where} predates stream metadata: cannot verify the resume "
+            f"replays the saved schedule (resuming as {want})",
+            stacklevel=3,
+        )
+    elif saved_stream != want:
+        raise ValueError(
+            f"{where} was written by stream {saved_stream} but this "
+            f"resume would run stream {want}: same seed, DIFFERENT "
+            "schedule.  Pass the saved engine/block explicitly (e.g. "
+            "--block) or re-run from scratch."
+        )
+
+
 def save(
     path: str | pathlib.Path,
     state: PaxosState,
@@ -148,23 +178,10 @@ def restore(
     )
 
     if engine is not None:
-        want = stream_id(cfg, engine, block)
-        if saved_stream is None:
-            import warnings
-
-            warnings.warn(
-                f"checkpoint at {path} predates stream metadata: cannot "
-                f"verify the resume replays the saved schedule (resuming "
-                f"as {want})",
-                stacklevel=2,
-            )
-        elif saved_stream != want:
-            raise ValueError(
-                f"checkpoint at {path} was written by stream {saved_stream}"
-                f" but this resume would run stream {want}: same seed, "
-                "DIFFERENT schedule.  Pass the saved engine/block "
-                "explicitly (e.g. --block) or re-run from scratch."
-            )
+        check_stream(
+            saved_stream, stream_id(cfg, engine, block),
+            f"checkpoint at {path}",
+        )
 
     # Restore against concrete templates so pytree structure (dataclasses,
     # not dicts) and dtypes come back exactly.

@@ -343,6 +343,102 @@ def build_parser() -> argparse.ArgumentParser:
     )
     fz.add_argument("--log", default=None, help="JSONL metrics path")
 
+    fl = sub.add_parser(
+        "fleet",
+        help="fault-tolerant sharded fuzzing fleet: durable campaign "
+        "queue, lease-based worker recovery, merged corpus + coverage "
+        "(fleet.coordinator)",
+    )
+    fl.add_argument("--config", choices=sorted(CONFIGS), default="config2")
+    fl.add_argument(
+        "--engine", choices=["xla", "fused"], default="xla",
+        help="engine each worker campaign runs under (recorded in every "
+        "queue record's stream lineage)",
+    )
+    fl.add_argument("--n-inst", type=int, default=None)
+    fl.add_argument(
+        "--fault", action="append", default=[], metavar="KEY=VALUE",
+        help="override any FaultConfig knob on the base config (repeatable)",
+    )
+    fl.add_argument(
+        "--mode", choices=["soak", "fuzz"], default="soak",
+        help="what each record runs: a rotating-seed soak shard or an "
+        "independent guided-fuzzing shard whose corpora merge",
+    )
+    fl.add_argument(
+        "--dir", required=True, metavar="PATH",
+        help="queue root directory (pending/claimed/done/leases/results/"
+        "progress) — durable across coordinator restarts",
+    )
+    fl.add_argument("--workers", type=int, default=2)
+    fl.add_argument(
+        "--records", type=int, default=4,
+        help="campaign records to enqueue (the re-dispatch granularity)",
+    )
+    fl.add_argument(
+        "--seeds-per-record", type=int, default=4,
+        help="soak mode: rotating seeds per record — together the records "
+        "cover exactly the seed schedule one big soak would run",
+    )
+    fl.add_argument("--seed", type=int, default=0)
+    fl.add_argument(
+        "--seed-stride", type=int, default=10_000,
+        help="fuzz mode: seed-space stride between records (disjoint "
+        "root-seed ranges per shard)",
+    )
+    fl.add_argument("--rng-seed", type=int, default=0)
+    fl.add_argument(
+        "--campaigns-per-record", type=int, default=8,
+        help="fuzz mode: guided campaign budget per record",
+    )
+    fl.add_argument("--seed-entries", type=int, default=2)
+    fl.add_argument("--mutations", type=int, default=2)
+    fl.add_argument("--energy-max", type=int, default=4)
+    fl.add_argument("--ticks-per-seed", type=int, default=256)
+    fl.add_argument("--chunk", type=int, default=64)
+    fl.add_argument("--coverage-words", type=int, default=64, metavar="W")
+    fl.add_argument(
+        "--lease-s", type=float, default=15.0,
+        help="lease duration; a worker silent this long is presumed dead "
+        "and its record re-dispatched (workers heartbeat at lease/5)",
+    )
+    fl.add_argument("--poll-s", type=float, default=0.5)
+    fl.add_argument(
+        "--timeout-s", type=float, default=1800.0,
+        help="wall-clock bound on the whole fleet run (exit 1 if the "
+        "budget is not completed)",
+    )
+    fl.add_argument(
+        "--chaos", action="store_true",
+        help="SIGKILL workers mid-campaign on a seeded schedule, then "
+        "recover — the fleet's own fault injection; the merged output "
+        "must be byte-identical to an uninterrupted run's",
+    )
+    fl.add_argument("--chaos-kills", type=int, default=1)
+    fl.add_argument("--chaos-seed", type=int, default=0)
+    fl.add_argument(
+        "--hold-s", type=float, default=0.0,
+        help="worker pause between claim and execution — the window the "
+        "chaos kill schedule aims at (test/chaos knob)",
+    )
+    fl.add_argument(
+        "--bench-baseline", default=None, metavar="PATH",
+        help="run bench-compare against this committed artifact as the "
+        "fleet's continuous regression gate (exit 2 on regression)",
+    )
+    fl.add_argument("--log", default=None, help="JSONL metrics path")
+
+    fw = sub.add_parser(
+        "fleet-worker",
+        help="internal: one fleet worker process (spawned by `fleet`; "
+        "usable standalone against any queue directory)",
+    )
+    fw.add_argument("--dir", required=True)
+    fw.add_argument("--worker-id", required=True)
+    fw.add_argument("--lease-s", type=float, default=15.0)
+    fw.add_argument("--poll-s", type=float, default=0.5)
+    fw.add_argument("--hold-s", type=float, default=0.0)
+
     k = sub.add_parser(
         "shrink",
         help="delta-debug a violating config's fault plan to a minimal repro",
@@ -1470,6 +1566,74 @@ def cmd_fuzz(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_fleet(args: argparse.Namespace) -> int:
+    """Fault-tolerant sharded fleet over the durable campaign queue.
+
+    Plans the budget into records, spawns ``--workers`` subprocesses
+    (``fleet-worker``), monitors leases (reclaiming a dead worker's
+    record so it re-dispatches), merges shard corpora/coverage in
+    canonical record order, and optionally gates through bench-compare.
+    Exit 0 clean, 1 operational failure (budget incomplete at
+    ``--timeout-s``), 2 safety violations or bench regression.
+    """
+    from paxos_tpu.fleet import coordinator
+
+    say = lambda s: print(f"# {s}", file=sys.stderr)  # noqa: E731
+    # Fail-fast on an unbuildable record BEFORE enqueueing anything: the
+    # same reconstruction every worker will do.
+    kw = {"seed": args.seed}
+    if args.n_inst:
+        kw["n_inst"] = args.n_inst
+    try:
+        cfg = config_mod.apply_fault_overrides(
+            CONFIGS[args.config](**kw), args.fault
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    records = coordinator.plan_records(
+        mode=args.mode, config=args.config, n_inst=args.n_inst,
+        fault=args.fault, seed=args.seed, records=args.records,
+        seeds_per_record=args.seeds_per_record,
+        ticks_per_seed=args.ticks_per_seed, chunk=args.chunk,
+        coverage_words=args.coverage_words, engine=args.engine,
+        seed_stride=args.seed_stride, rng_seed=args.rng_seed,
+        campaigns_per_record=args.campaigns_per_record,
+        seed_entries=args.seed_entries, mutations=args.mutations,
+        energy_max=args.energy_max,
+    )
+    from paxos_tpu.harness.metrics import MetricsLog, MetricsRegistry
+
+    with MetricsLog(args.log) as mlog:
+        mlog.emit("start", mode="fleet", config=args.config,
+                  fingerprint=cfg.fingerprint(), workers=args.workers,
+                  records=len(records), engine=args.engine,
+                  chaos=bool(args.chaos))
+        report, rc = coordinator.run_fleet(
+            records, args.dir, args, log=say,
+            on_tick=lambda g: mlog.emit("fleet", fleet=g),
+        )
+        registry = MetricsRegistry()
+        registry.ingest_fleet(report["fleet"])
+        mlog.emit("metrics", **registry.snapshot())
+        mlog.emit("final", **report)
+    print(json.dumps(report))
+    return rc
+
+
+def cmd_fleet_worker(args: argparse.Namespace) -> int:
+    """One fleet worker: claim records from ``--dir`` until it drains."""
+    from paxos_tpu.fleet.worker import work_loop
+
+    say = lambda s: print(f"# {s}", file=sys.stderr)  # noqa: E731
+    stats = work_loop(
+        args.dir, args.worker_id, lease_s=args.lease_s,
+        poll_s=args.poll_s, hold_s=args.hold_s, log=say,
+    )
+    print(json.dumps(stats))
+    return 0
+
+
 def cmd_audit(args: argparse.Namespace) -> int:
     """Static determinism audit: exit 0 clean, 2 on findings."""
     from paxos_tpu.analysis import run_audit
@@ -1548,6 +1712,7 @@ def _stats_render(
     last_checker = None
     last_perf = None
     last_seed = None
+    last_fleet = None
     for rec in records:
         kind = rec.get("event", "?")
         kinds[kind] = kinds.get(kind, 0) + 1
@@ -1578,6 +1743,11 @@ def _stats_render(
             last_margin = mar
         if "checker_complete" in rec:
             last_checker = rec["checker_complete"]
+        # Fleet gauges ride periodic "fleet" records and the final fleet
+        # report; coordinator-side observations, last one wins.
+        flt = rec.get("fleet")
+        if isinstance(flt, dict) and "records_total" in flt:
+            last_fleet = flt
         # Span-trace aggregates (`trace` subcommand) are whole-campaign
         # summaries; the last record wins for the same reason.
         if kind == "spans" and isinstance(rec.get("aggregates"), dict):
@@ -1606,6 +1776,8 @@ def _stats_render(
         registry.gauge(
             "perf_seed_rounds_per_sec", last_seed.get("rounds_per_sec", 0)
         )
+    if last_fleet is not None:
+        registry.ingest_fleet(last_fleet)
 
     saw_final = final is not None
     if prometheus:
@@ -1651,6 +1823,8 @@ def _stats_render(
         out["span_aggregates"] = last_agg
     if last_perf is not None:
         out["perf"] = last_perf
+    if last_fleet is not None:
+        out["fleet"] = last_fleet
     if last_seed is not None:
         # Observer-plane enrichments (new_bits / effective / min quorum
         # slack) ride the seed events when soak runs with those planes on
@@ -2559,6 +2733,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_soak(args)
     if args.cmd == "fuzz":
         return cmd_fuzz(args)
+    if args.cmd == "fleet":
+        return cmd_fleet(args)
+    if args.cmd == "fleet-worker":
+        return cmd_fleet_worker(args)
     if args.cmd == "shrink":
         return cmd_shrink(args)
     if args.cmd == "check":

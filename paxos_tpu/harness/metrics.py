@@ -269,6 +269,38 @@ class MetricsRegistry:
             for name, v in (perf.get(sub) or {}).items():
                 self.gauge(f"perf_{name}", v)
 
+    def ingest_fleet(self, fleet: dict[str, Any]) -> None:
+        """Fold a fleet coordinator gauges block into the registry.
+
+        Every value is a point-in-time coordinator-side observation of
+        the queue/lease state machine (``fleet.coordinator``), so they
+        all land as gauges under a ``fleet_`` prefix — the plane's
+        namespace stays disjoint like every other ingest.  The keys a
+        scraper alerts on: ``fleet_leases_reclaimed`` climbing means
+        workers are dying (each reclaim is one recovered campaign), and
+        ``fleet_queue_depth`` stuck nonzero with ``fleet_workers_alive``
+        at zero means the fleet stalled.
+        """
+        for name in (
+            "workers",
+            "workers_alive",
+            "workers_dead",
+            "workers_spawned",
+            "queue_depth",
+            "records_total",
+            "records_done",
+            "leases_held_peak",
+            "leases_expired",
+            "leases_reclaimed",
+            "campaigns_retried",
+            "merge_dedup",
+            "torn_tails",
+            "resumed_seeds",
+        ):
+            v = fleet.get(name)
+            if v is not None:
+                self.gauge(f"fleet_{name}", v)
+
     def snapshot(self) -> dict[str, Any]:
         """One JSON-ready dict of everything in the registry."""
         counters: dict[str, Any] = {}

@@ -1,0 +1,104 @@
+"""Shared retry/backoff — exponential schedule with equal jitter.
+
+Extracted from ``harness.soak._run_with_retries`` so every host-side
+actor that talks to flaky infrastructure — the soak loop's campaign
+replays, the fleet coordinator's worker dispatch, a worker's lease
+renewals, the durable queue's file I/O — retries through one tested
+policy instead of four ad-hoc loops.
+
+The jitter is drawn from a REGISTERED pure-integer stream (the same
+splitmix64 the fuzz mutator uses, forked under a fixed fold so it can
+never collide with the mutation streams sharing a root seed) rather than
+``random.random()``: no global-state or time-based randomness anywhere,
+and a test can pin the exact sleep sequence by seed.  The sleep itself
+still goes through ``time.sleep``, so tests patching the module-level
+sleep observe every backoff.  Nothing here is schedule-relevant: retried
+campaigns are deterministic replays, and lease/queue retries are pure
+host I/O — jitter only desyncs concurrent actors sharing a backend.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+from paxos_tpu.fuzz.mutate import SplitMix64
+
+# Stream-registry fold (fuzz.mutate idiom): jitter draws come from
+# SplitMix64(seed).fork(_JITTER_FOLD), a lane the mutation ops never use.
+_JITTER_FOLD = 0x6A17
+
+
+def retry_schedule(
+    retries: int, base_s: float = 5.0, cap_s: float = 60.0
+) -> list[float]:
+    """Planned pre-retry delays: exponential from ``base_s``, capped.
+
+    Doubling per attempt models the two real failure modes: blips (first
+    retry lands) and minutes-long outages (tunnel restart, preemption),
+    where hammering a recovering endpoint every 5 s just extends the
+    outage.  The cap keeps the worst wait ~1 min so a soak never stalls
+    much longer than the thing it waited out.
+    """
+    return [min(base_s * (2.0 ** i), cap_s) for i in range(retries)]
+
+
+def jitter_stream(seed: int) -> SplitMix64:
+    """The registered pure-integer jitter stream for one actor."""
+    return SplitMix64(seed).fork(_JITTER_FOLD)
+
+
+def equal_jitter(delay: float, stream: SplitMix64) -> float:
+    """One sleep drawn from [delay/2, delay] — equal jitter, so
+    concurrent actors sharing a backend desync instead of re-colliding
+    in lockstep."""
+    frac = stream.next_u64() / 2.0 ** 64
+    return delay * (0.5 + frac / 2.0)
+
+
+def run_with_retries(
+    run_fn: Callable[[], Any],
+    say: Callable[[str], None],
+    retries: int,
+    backoff_s: float = 5.0,
+    cap_s: float = 60.0,
+    *,
+    retry_on: tuple = (OSError,),
+    describe: str = "transient error",
+    spans=None,
+    jitter_seed: Optional[int] = None,
+) -> "tuple[Any, int]":
+    """Call ``run_fn``, retrying exceptions in ``retry_on``.
+
+    Delays follow :func:`retry_schedule` with equal jitter from
+    :func:`jitter_stream` — ``jitter_seed=None`` (the default) keys the
+    stream by pid, so co-located actors draw different sequences while a
+    test pinning the seed gets an exactly reproducible one.  Returns
+    ``(result, retries_used)``; re-raises once the budget is exhausted.
+    ``spans`` (an ``obs.host_spans.HostSpanRecorder``) records each
+    backoff wait — purely observational.
+    """
+    from paxos_tpu.obs.host_spans import ensure_recorder
+
+    sp = ensure_recorder(spans)
+    if jitter_seed is None:
+        import os
+
+        jitter_seed = os.getpid()
+    stream = jitter_stream(jitter_seed)
+    schedule = retry_schedule(retries, backoff_s, cap_s)
+    for attempt in range(retries + 1):
+        try:
+            return run_fn(), attempt
+        except retry_on as e:
+            if attempt >= retries:
+                raise
+            delay = schedule[attempt]
+            sleep = equal_jitter(delay, stream)
+            first_line = (str(e).splitlines() or [""])[0][:120]
+            say(f"{describe} (attempt {attempt + 1}/{retries + 1}): "
+                f"{first_line}; retrying in {sleep:.1f}s")
+            with sp.span("retry_backoff", attempt=attempt + 1,
+                         sleep_s=round(sleep, 3)):
+                time.sleep(sleep)
+    raise AssertionError("unreachable")

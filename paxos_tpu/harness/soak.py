@@ -19,6 +19,8 @@ from typing import Any, Callable, Optional
 
 from paxos_tpu.harness.checkpoint import stream_id
 from paxos_tpu.harness.config import SimConfig
+from paxos_tpu.harness.retry import retry_schedule as _retry_schedule
+from paxos_tpu.harness.retry import run_with_retries
 from paxos_tpu.harness.run import MeasurementCorrupted, check_tick_budget, run
 
 
@@ -74,20 +76,6 @@ class RotatingSeeds:
         pass
 
 
-def _retry_schedule(
-    transient_retries: int, base_s: float = 5.0, cap_s: float = 60.0
-) -> list[float]:
-    """Planned pre-retry delays: exponential from ``base_s``, capped.
-
-    Doubling per attempt models the two real failure modes: blips (first
-    retry lands) and minutes-long outages (tunnel restart, preemption),
-    where hammering a recovering endpoint every 5 s just extends the
-    outage.  The cap keeps the worst wait ~1 min so a soak never stalls
-    much longer than the thing it waited out.
-    """
-    return [min(base_s * (2.0 ** i), cap_s) for i in range(transient_retries)]
-
-
 def _run_with_retries(
     run_fn: Callable[[], dict],
     say: Callable[[str], None],
@@ -101,36 +89,22 @@ def _run_with_retries(
     infra errors (remote-compile HTTP 500s, dropped response bodies) that
     have nothing to do with the campaign.  Campaigns are deterministic in
     (config, seed), so re-running one is an exact replay — retrying never
-    changes what is measured.  Delays follow :func:`_retry_schedule`
-    (exponential, capped) with equal jitter — sleep drawn from
-    [delay/2, delay] — so concurrent soaks sharing one backend desync
-    instead of re-colliding in lockstep.  Returns (report, retries_used);
-    re-raises once the budget is exhausted.
+    changes what is measured.  This is ``harness.retry.run_with_retries``
+    specialized to JAX backend errors, kept under the historical name
+    (the campaign loop and tests call it directly): delays follow
+    :func:`~paxos_tpu.harness.retry.retry_schedule` (exponential, capped)
+    with equal jitter — sleep drawn from [delay/2, delay] — so concurrent
+    soaks sharing one backend desync instead of re-colliding in lockstep.
+    Returns (report, retries_used); re-raises once the budget is
+    exhausted.
     """
-    import random
-
     import jax
 
-    from paxos_tpu.obs.host_spans import ensure_recorder
-
-    sp = ensure_recorder(spans)
-    schedule = _retry_schedule(transient_retries, backoff_s)
-    for attempt in range(transient_retries + 1):
-        try:
-            return run_fn(), attempt
-        except jax.errors.JaxRuntimeError as e:
-            if attempt >= transient_retries:
-                raise
-            delay = schedule[attempt]
-            sleep = delay * (0.5 + random.random() / 2)
-            first_line = (str(e).splitlines() or [""])[0][:120]
-            say(f"transient backend error (attempt {attempt + 1}/"
-                f"{transient_retries + 1}): {first_line}; "
-                f"retrying in {sleep:.1f}s")
-            with sp.span("retry_backoff", attempt=attempt + 1,
-                         sleep_s=round(sleep, 3)):
-                time.sleep(sleep)
-    raise AssertionError("unreachable")
+    return run_with_retries(
+        run_fn, say, retries=transient_retries, backoff_s=backoff_s,
+        retry_on=(jax.errors.JaxRuntimeError,),
+        describe="transient backend error", spans=spans,
+    )
 
 
 def soak(
@@ -589,6 +563,10 @@ def soak(
             "bits_total": m,
             "saturation": round(cov_union_bits / max(m, 1), 6),
             "est_states": bloom_estimate(m, K_HASHES, cov_union_bits),
+            # The cross-seed union in its MERGEABLE form (obs.coverage.
+            # union_hex): OR-ing two soaks' values is the Bloom union of
+            # their visited sets — the fleet merges shard coverage this way.
+            "union_hex": f"{cov_union:x}",
             "curve": cov_curve,  # new union bits contributed per seed
             "per_seed_bits": cov_per_seed,
             "plateau": cov_plateau,

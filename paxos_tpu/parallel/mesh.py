@@ -30,6 +30,33 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.asarray(devices), (INSTANCES_AXIS,))
 
 
+def partition_devices(
+    n_workers: int, devices: Optional[Sequence[jax.Device]] = None
+) -> "list[list[jax.Device]]":
+    """Contiguous split of the local devices across fleet workers.
+
+    The fleet coordinator's device plan: worker ``i`` gets the ``i``-th
+    contiguous slice (remainder devices spread over the leading workers),
+    and each worker meshes its slice with :func:`make_mesh` exactly like
+    a standalone run meshes all devices.  With fewer devices than
+    workers — the single-chip and CPU-CI degenerate case — every worker
+    shares device 0: instances are independent, so co-located workers
+    only contend for the one chip's time, never for correctness.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_workers <= 0:
+        raise ValueError(f"n_workers must be positive, got {n_workers}")
+    if len(devices) < n_workers:
+        return [[devices[0]] for _ in range(n_workers)]
+    base, extra = divmod(len(devices), n_workers)
+    out, at = [], 0
+    for i in range(n_workers):
+        step = base + (1 if i < extra else 0)
+        out.append(devices[at:at + step])
+        at += step
+    return out
+
+
 def state_sharding(tree: Any, mesh: Mesh, n_inst: int) -> Any:
     """Per-leaf shardings: trailing ``instances`` axis sharded, scalars replicated.
 

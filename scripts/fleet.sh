@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Fault-tolerant fuzzing fleet wrapper: a durable file-backed campaign
+# queue (paxos_tpu/fleet/) sharded over N worker subprocesses with
+# lease-based crash recovery — a worker that dies (SIGKILL, OOM,
+# preemption) stops renewing its lease and the coordinator re-dispatches
+# its record; campaigns are deterministic in (config, seed, plan), so
+# the merged report (coverage unions OR'd, corpus journals deduped,
+# repros globally deduped) is byte-identical to an uninterrupted run's.
+# --chaos proves exactly that on a seeded SIGKILL schedule.  One merged
+# report on stdout; exits 2 on safety violations or a bench-gate
+# regression, 1 if the budget did not complete before --timeout-s.
+#
+# Usage: scripts/fleet.sh --dir DIR [paxos_tpu fleet flags...]
+#   scripts/fleet.sh --dir /tmp/fleet --config config2 --mode soak \
+#     --workers 4 --records 8 --seeds-per-record 4
+#   scripts/fleet.sh --dir /tmp/fleet --mode fuzz --records 4 --chaos \
+#     --bench-baseline BENCH_SWEEP.json
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m paxos_tpu fleet "$@"

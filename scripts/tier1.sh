@@ -448,4 +448,38 @@ assert report["violations"] == 0, report["violations"]
 assert report["proposer_disagree"] == 0, report["proposer_disagree"]
 EOF
 fi
+# Fleet smoke: the fault-tolerant sharded fleet end to end, chaos ON.  A
+# 2-worker CPU fleet over 4 soak records takes one seeded SIGKILL
+# mid-claim, reclaims EXACTLY that one expired lease, re-dispatches the
+# record, completes the whole budget with zero violations, and passes
+# the built-in bench self-gate — crash recovery as a release criterion,
+# not a best effort.
+if [ "$rc" -eq 0 ]; then
+  fd=/tmp/_t1_fleet; fo=/tmp/_t1_fleet.json; rm -rf "$fd" "$fo"
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m paxos_tpu fleet \
+    --config config2 --n-inst 64 --mode soak --records 4 \
+    --seeds-per-record 2 --ticks-per-seed 32 --chunk 16 \
+    --coverage-words 64 --workers 2 --dir "$fd" --lease-s 6 \
+    --poll-s 0.2 --timeout-s 420 --chaos --chaos-kills 1 \
+    --chaos-seed 7 --hold-s 1.5 --bench-baseline BENCH_SWEEP.json \
+    >"$fo" 2>/dev/null \
+  && timeout -k 10 30 env JAX_PLATFORMS=cpu python - "$fo" <<'EOF' \
+  && echo FLEET_SMOKE=ok || { echo FLEET_SMOKE=FAILED; rc=1; }
+import json, sys
+out = json.load(open(sys.argv[1]))
+fleet = out["fleet"]
+assert out["completed"] is True, fleet
+assert fleet["records_done"] == fleet["records_total"] == 4, fleet
+assert fleet["leases_reclaimed"] == 1, (
+    f"chaos killed one worker, so exactly one lease must be reclaimed: "
+    f"{fleet}")
+assert out["chaos"]["kills_done"] == 1, out["chaos"]
+assert fleet["workers_spawned"] > fleet["workers"], (
+    "the killed worker was never respawned")
+assert out["violations"] == 0, out["violations"]
+assert int(out["union_hex"], 16) != 0, "merged coverage union is empty"
+assert out["seeds"] == 8, out["seeds"]  # every planned seed accounted
+assert out["bench_gate"]["ok"] is True, out["bench_gate"]
+EOF
+fi
 exit $rc

@@ -1,0 +1,336 @@
+"""Fleet: durable queue, lease recovery, and merge determinism.
+
+The load-bearing contract under test: campaigns are deterministic in
+(config, seed, plan) and all fleet artifacts are wall-clock-free, so a
+worker crash + lease reclaim + re-run produces a merged output
+byte-identical to an uninterrupted run's.  The tier-1 tests drive the
+whole state machine in-process with the deterministic preemption hook
+(`WorkerPreempted`); the slow test does it for real with subprocess
+workers and a seeded SIGKILL.
+"""
+
+import argparse
+import json
+
+import pytest
+
+from paxos_tpu.fleet.coordinator import (
+    chaos_kill_ordinals,
+    merge_results,
+    plan_records,
+)
+from paxos_tpu.fleet.queue import CampaignQueue, LeaseLost
+from paxos_tpu.fleet.worker import WorkerPreempted, run_record
+from paxos_tpu.harness.retry import (
+    equal_jitter,
+    jitter_stream,
+    retry_schedule,
+    run_with_retries,
+)
+
+
+# -- queue state machine (no jax, explicit clocks) ------------------------
+
+def _rec(campaign, **kw):
+    return {"campaign": campaign, "mode": "soak", "attempt": 0} | kw
+
+
+def test_queue_lifecycle(tmp_path):
+    q = CampaignQueue(tmp_path / "q")
+    ids = [q.enqueue(_rec(i)) for i in range(3)]
+    assert ids == ["c00000", "c00001", "c00002"]
+    assert q.pending_count() == 3
+
+    got = q.claim("w0", now=100.0, lease_s=10.0)
+    assert got is not None
+    rec_id, record = got
+    assert rec_id == "c00000"  # canonical (sorted) claim order
+    assert record["campaign"] == 0
+    assert q.pending_count() == 2 and q.claimed_count() == 1
+    assert q.leases()[rec_id]["worker"] == "w0"
+
+    q.renew(rec_id, "w0", now=105.0, lease_s=10.0)
+    assert q.leases()[rec_id]["expires"] == 115.0
+    with pytest.raises(LeaseLost):
+        q.renew(rec_id, "w1", now=105.0, lease_s=10.0)  # not the owner
+
+    q.complete(rec_id, "w0", {"campaign": 0, "ok": True})
+    assert q.done_count() == 1 and q.claimed_count() == 0
+    assert rec_id not in q.leases()
+    assert q.results() == {"c00000": {"campaign": 0, "ok": True}}
+
+
+def test_queue_expiry_reclaim_and_lease_loss(tmp_path):
+    q = CampaignQueue(tmp_path / "q")
+    q.enqueue(_rec(0))
+    rec_id, _ = q.claim("w0", now=0.0, lease_s=10.0)
+
+    # A live lease is never reclaimed; an expired one goes back to
+    # pending with attempt + 1, and the presumed-dead owner learns of it
+    # exactly once — at its next renewal.
+    assert q.reclaim_expired(now=5.0) == []
+    assert q.reclaim_expired(now=10.1) == [rec_id]
+    assert q.pending_count() == 1 and q.claimed_count() == 0
+    assert q.record(rec_id)["attempt"] == 1
+    with pytest.raises(LeaseLost):
+        q.renew(rec_id, "w0", now=10.2, lease_s=10.0)
+    with pytest.raises(LeaseLost):
+        q.complete(rec_id, "w0", {"campaign": 0})
+
+    # The replacement claims the same record at attempt 1.
+    rec_id2, record2 = q.claim("w1", now=11.0, lease_s=10.0)
+    assert rec_id2 == rec_id and record2["attempt"] == 1
+    assert q.leases()[rec_id]["attempt"] == 1
+
+
+def test_queue_claimed_without_lease_is_reclaimable(tmp_path):
+    """A crash between the claim rename and the lease write leaves a
+    claimed record with no lease — reclaim treats that as expired."""
+    q = CampaignQueue(tmp_path / "q")
+    q.enqueue(_rec(0))
+    rec_id, _ = q.claim("w0", now=0.0, lease_s=10.0)
+    (q.root / "leases" / f"{rec_id}.json").unlink()
+    assert q.reclaim_expired(now=0.0) == [rec_id]
+
+
+def test_queue_torn_record_is_quarantined(tmp_path):
+    """Torn JSON (crash mid-enqueue) must not crash-loop every claimer:
+    the bytes are quarantined and the claim moves on."""
+    q = CampaignQueue(tmp_path / "q")
+    (q.root / "pending" / "c00000.json").write_text('{"campaign": 0, "mo')
+    q.enqueue(_rec(1))
+    rec_id, _ = q.claim("w0", now=0.0, lease_s=10.0)
+    assert rec_id == "c00001"
+    assert q.torn_records == 1
+    assert (q.root / "tmp" / "c00000.torn").exists()
+
+
+# -- retry: pure-integer jitter ------------------------------------------
+
+def test_retry_jitter_is_seeded_and_bounded():
+    sched = retry_schedule(4, base_s=1.0, cap_s=4.0)
+    assert sched == [1.0, 2.0, 4.0, 4.0]
+    a = [equal_jitter(d, jitter_stream(9)) for d in sched]
+    b = [equal_jitter(d, jitter_stream(9)) for d in sched]
+    c = [equal_jitter(d, jitter_stream(10)) for d in sched]
+    assert a == b, "same seed must pin the exact sleep sequence"
+    assert a != c
+    for delay, sleep in zip(sched, a):
+        assert delay / 2.0 <= sleep <= delay  # equal jitter band
+
+
+def test_run_with_retries_sleeps_deterministically(monkeypatch):
+    from paxos_tpu.harness import retry as retry_mod
+
+    slept = []
+    monkeypatch.setattr(retry_mod.time, "sleep", slept.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("blip")
+        return "ok"
+
+    out, used = run_with_retries(
+        flaky, lambda s: None, retries=3, backoff_s=1.0, jitter_seed=9
+    )
+    assert (out, used) == ("ok", 2)
+    stream = jitter_stream(9)
+    expected = [
+        equal_jitter(d, stream) for d in retry_schedule(2, base_s=1.0)
+    ]
+    assert slept == expected
+
+    with pytest.raises(ValueError):  # not in retry_on: no retry, no sleep
+        run_with_retries(
+            lambda: (_ for _ in ()).throw(ValueError("no")),
+            lambda s: None, retries=3, jitter_seed=9,
+        )
+
+
+# -- chaos schedule and merge --------------------------------------------
+
+def test_chaos_kill_ordinals_deterministic():
+    a = chaos_kill_ordinals(7, kills=2, n_records=8)
+    assert a == chaos_kill_ordinals(7, kills=2, n_records=8)
+    assert len(a) == 2 and all(0 <= k < 8 for k in a)
+    assert chaos_kill_ordinals(8, kills=2, n_records=8) != a
+    assert len(chaos_kill_ordinals(0, kills=5, n_records=3)) == 3
+
+
+def test_merge_results_order_union_and_repro_dedup():
+    shard = lambda c, u, **kw: {
+        "campaign": c, "union_hex": u, "bits_total": 8, "rounds": 10,
+        "seeds": 1, "resumed_seeds": 0, "violations": 0,
+        "violating_seeds": [], "attempt": 0,
+    } | kw
+    a = shard(1, "f0", attempt=1,
+              repro={"config_fingerprint": "x", "seed": 3, "entry": 1})
+    b = shard(0, "0f", violations=1, violating_seeds=[5],
+              repro={"config_fingerprint": "x", "seed": 3, "entry": 9})
+    merged = merge_results([a, b])           # completion order b-after-a
+    merged2 = merge_results([b, a])
+    assert merged == merged2, "merge must be canonical-order, not arrival"
+    assert merged["union_hex"] == "ff"
+    assert merged["coverage"]["bits_set"] == 8
+    assert merged["violations"] == 1 and merged["violating_seeds"] == [5]
+    assert merged["campaigns_retried"] == 1
+    assert len(merged["repros"]) == 1 and merged["repro_dedup"] == 1
+    assert merged["repros"][0]["entry"] == 9  # canonical-first (campaign
+    # 0's shard) survives, regardless of which shard finished first
+
+
+def test_partition_devices_contiguous():
+    import jax
+
+    from paxos_tpu.parallel.mesh import partition_devices
+
+    devs = jax.devices()
+    parts = partition_devices(3, devs)
+    assert [d for part in parts for d in part] == devs  # contiguous cover
+    sizes = [len(p) for p in parts]
+    assert max(sizes) - min(sizes) <= 1
+    solo = partition_devices(len(devs) + 2, devs)
+    assert all(p == [devs[0]] for p in solo[len(devs):]) or all(
+        p == [devs[0]] for p in solo
+    )
+    with pytest.raises(ValueError):
+        partition_devices(0, devs)
+
+
+# -- recovery determinism (in-process fake workers, tier-1) ---------------
+
+_SOAK_KW = dict(
+    config="config2", n_inst=64, fault=[], seed=0, records=2,
+    seeds_per_record=2, ticks_per_seed=32, chunk=16, coverage_words=64,
+)
+
+
+def _run_all(queue, records, worker="w0", preempt_first=None):
+    """Drain a queue in-process.  ``preempt_first`` kills the first
+    record after N durable seeds, then reclaims and re-runs it — the
+    deterministic stand-in for SIGKILL + coordinator recovery."""
+    for rec in records:
+        queue.enqueue(rec)
+    results = []
+    preempted = False
+    wid = worker
+    while True:
+        claim = queue.claim(wid, now=0.0, lease_s=10.0)
+        if claim is None:
+            break
+        rec_id, record = claim
+        if preempt_first is not None and not preempted:
+            preempted = True
+            with pytest.raises(WorkerPreempted):
+                run_record(queue, rec_id, record, wid,
+                           stop_after_seeds=preempt_first)
+            assert queue.reclaim_expired(now=1e9) == [rec_id]
+            wid = "w1"  # the replacement claims it next pass
+            continue
+        res = run_record(queue, rec_id, record, wid)
+        queue.complete(rec_id, wid, res)
+        results.append(res)
+    return merge_results(results)
+
+
+def test_soak_recovery_matches_uninterrupted_baseline(tmp_path):
+    """A soak record killed after one durable seed, reclaimed, and
+    resumed by another worker must merge to the byte-identical coverage
+    union and violation tally of an uninterrupted fleet — and the resume
+    must actually be a resume (seed-granular, not a re-run)."""
+    records = plan_records(mode="soak", **_SOAK_KW)
+    base = _run_all(CampaignQueue(tmp_path / "base"), records)
+    rec = _run_all(CampaignQueue(tmp_path / "rec"), records,
+                   preempt_first=1)
+    assert int(base["union_hex"], 16) != 0
+    assert rec["union_hex"] == base["union_hex"]
+    assert rec["violations"] == base["violations"] == 0
+    assert rec["seeds"] == base["seeds"] == 4
+    assert rec["resumed_seeds"] == 1 and base["resumed_seeds"] == 0
+    assert rec["campaigns_retried"] == 1
+
+
+def test_fuzz_recovery_matches_uninterrupted_baseline(tmp_path):
+    """Fuzz records are atomic recovery units: the guided feedback loop
+    is sequential, so recovery is deterministic FULL replay — the merged
+    corpus journal digest must equal the uninterrupted baseline's."""
+    records = plan_records(
+        mode="fuzz", config="config2", n_inst=64, fault=[], seed=0,
+        records=2, seeds_per_record=0, ticks_per_seed=32, chunk=16,
+        coverage_words=64, seed_stride=100, rng_seed=0,
+        campaigns_per_record=3,
+    )
+    base = _run_all(CampaignQueue(tmp_path / "base"), records)
+    rec = _run_all(CampaignQueue(tmp_path / "rec"), records,
+                   preempt_first=2)
+    assert int(base["union_hex"], 16) != 0
+    assert base["journal_entries"] > 0
+    assert rec["journal_digest"] == base["journal_digest"]
+    assert rec["journal_entries"] == base["journal_entries"]
+    assert rec["union_hex"] == base["union_hex"]
+    assert rec["violations"] == base["violations"]
+    assert rec["campaigns_retried"] == 1
+
+
+def test_stale_progress_journal_is_discarded(tmp_path):
+    """Progress written under a different schedule stream (same record
+    id, different config) must be discarded, not spliced: the re-run
+    starts from scratch and still matches the clean baseline."""
+    records = plan_records(mode="soak", **_SOAK_KW)[:1]
+    base_q = CampaignQueue(tmp_path / "base")
+    base = _run_all(base_q, records)
+
+    q = CampaignQueue(tmp_path / "poisoned")
+    from paxos_tpu.fuzz.corpus import append_event
+
+    with open(q.progress_path("c00000"), "a") as fh:
+        append_event(fh, {"event": "header", "record": "c00000",
+                          "stream": {"algo": "other", "root": 1},
+                          "fingerprint": "bogus", "attempt": 0})
+        append_event(fh, {"event": "seed", "seed": 0,
+                          "union_hex": "ffff", "violations": 7,
+                          "rounds": 1})
+    rec = _run_all(q, records)
+    assert rec["resumed_seeds"] == 0, "stale progress must not resume"
+    assert rec["union_hex"] == base["union_hex"]
+    assert rec["violations"] == base["violations"]
+
+
+# -- the real thing: subprocess workers + seeded SIGKILL ------------------
+
+def _fleet_ns(**kw):
+    ns = argparse.Namespace(
+        workers=2, lease_s=6.0, poll_s=0.2, hold_s=0.0, timeout_s=420.0,
+        chaos=False, chaos_kills=1, chaos_seed=7, platform="cpu",
+        bench_baseline=None,
+    )
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+@pytest.mark.slow
+def test_chaos_fleet_matches_uninterrupted_baseline(tmp_path):
+    """End to end with real subprocess workers: a chaos fleet (seeded
+    SIGKILL mid-hold, lease reclaim, respawn) must complete its budget
+    and produce the same merged union and violation tally as an
+    in-process uninterrupted run of the same records."""
+    from paxos_tpu.fleet.coordinator import run_fleet
+
+    records = plan_records(mode="soak", **_SOAK_KW)
+    base = _run_all(CampaignQueue(tmp_path / "base"), records)
+
+    report, rc = run_fleet(
+        records, tmp_path / "fleet",
+        _fleet_ns(chaos=True, hold_s=1.5),
+        log=lambda s: None,
+    )
+    assert rc == 0
+    assert report["completed"]
+    assert report["chaos"]["kills_done"] == 1
+    assert report["fleet"]["leases_reclaimed"] >= 1
+    assert report["fleet"]["records_done"] == len(records)
+    assert report["union_hex"] == base["union_hex"]
+    assert report["violations"] == base["violations"] == 0

@@ -7,6 +7,7 @@ import json
 
 import jax
 import numpy as np
+import pytest
 
 from paxos_tpu.faults.injector import (
     FaultConfig,
@@ -22,6 +23,7 @@ from paxos_tpu.fuzz.corpus import (
     entry_classes,
     exposure_weight,
     fitness,
+    load_journal,
     margin_boost,
 )
 from paxos_tpu.fuzz.mutate import Dims, entry_stream, mutate
@@ -240,6 +242,72 @@ def test_corpus_journal_deterministic_and_wall_clock_free():
     for line in a.journal_lines():
         rec = json.loads(line)
         assert not any(k in rec for k in ("wall_s", "t_wall", "time"))
+
+
+def _journaled_corpus(path):
+    c = Corpus(journal_path=path)
+    root = c.add(seed=3, atoms=[], root=True)
+    c.record(root, new_bits=12, classes=None, min_quorum_slack=None,
+             fingerprint="abc", violations=0)
+    child = c.add(seed=3, atoms=[{"kind": "equiv", "idx": 0, "lane": 1}],
+                  parent=root.entry_id, ops=("add-equiv",))
+    c.retire(child, "plateau")
+    c.close()
+    return c
+
+
+def test_crash_safe_journal_matches_in_memory(tmp_path):
+    """The write-through journal on disk is byte-for-byte the in-memory
+    journal — crash-safety costs no canonical-form drift."""
+    path = tmp_path / "corpus.jsonl"
+    c = _journaled_corpus(path)
+    loaded = load_journal(path)
+    assert not loaded["torn_tail"]
+    assert loaded["events"] == [json.loads(l) for l in c.journal_lines()]
+    disk = hashlib.sha256(path.read_bytes()).hexdigest()
+    mem = hashlib.sha256(
+        ("".join(l + "\n" for l in c.journal_lines())).encode()
+    ).hexdigest()
+    assert disk == mem
+
+
+def test_journal_torn_tail_tolerated_mid_file_corruption_raises(tmp_path):
+    """Regression for the crash-mid-append contract: truncating the
+    FINAL line (with or without its newline) loads as torn_tail=True
+    with every complete event intact; a malformed line anywhere else is
+    real corruption and raises."""
+    path = tmp_path / "corpus.jsonl"
+    _journaled_corpus(path)
+    whole = load_journal(path)
+    complete = whole["events"]
+    assert len(complete) >= 3
+
+    raw = path.read_text()
+    lines = raw.splitlines(keepends=True)
+
+    # Crash mid-final-append: the tail line loses its newline and half
+    # its bytes.  Recovery keeps every durable event and reports it.
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text("".join(lines[:-1]) + lines[-1][: len(lines[-1]) // 2])
+    loaded = load_journal(torn)
+    assert loaded["torn_tail"] is True
+    assert loaded["events"] == complete[:-1]
+
+    # Even a tail that still parses is torn if its newline never landed:
+    # completeness is "newline durable", not "prefix happens to parse".
+    unterm = tmp_path / "unterm.jsonl"
+    unterm.write_text(raw.rstrip("\n"))
+    loaded = load_journal(unterm)
+    assert loaded["torn_tail"] is True
+    assert loaded["events"] == complete[:-1]
+
+    # Mid-file damage is NOT a torn append — single-write discipline
+    # can't produce it — so it must raise, never silently drop events.
+    corrupt = tmp_path / "corrupt.jsonl"
+    corrupt.write_text(lines[0] + '{"event": "add", "seed"\n' +
+                       "".join(lines[2:]))
+    with pytest.raises(ValueError, match="malformed line 2"):
+        load_journal(corrupt)
 
 
 # --- knob lighting --------------------------------------------------------

@@ -149,6 +149,46 @@ def test_registry_ingest_coverage_gauges():
     assert "paxos_tpu_coverage_bits_set 256" in text
 
 
+def test_registry_ingest_fleet_gauges():
+    """Fleet coordinator gauges land under the fleet_ prefix — the exact
+    set is pinned so a renamed gauge breaks a test, not a dashboard."""
+    reg = MetricsRegistry()
+    reg.ingest_fleet({
+        "workers": 2, "workers_alive": 1, "workers_dead": 1,
+        "workers_spawned": 3, "queue_depth": 4, "records_total": 8,
+        "records_done": 4, "leases_held_peak": 2, "leases_expired": 1,
+        "leases_reclaimed": 1, "campaigns_retried": 1, "merge_dedup": 0,
+        "torn_tails": 0, "resumed_seeds": 2,
+    })
+    assert reg.snapshot()["gauges"] == {
+        "fleet_workers": 2,
+        "fleet_workers_alive": 1,
+        "fleet_workers_dead": 1,
+        "fleet_workers_spawned": 3,
+        "fleet_queue_depth": 4,
+        "fleet_records_total": 8,
+        "fleet_records_done": 4,
+        "fleet_leases_held_peak": 2,
+        "fleet_leases_expired": 1,
+        "fleet_leases_reclaimed": 1,
+        "fleet_campaigns_retried": 1,
+        "fleet_merge_dedup": 0,
+        "fleet_torn_tails": 0,
+        "fleet_resumed_seeds": 2,
+    }
+    # Later ticks overwrite (point-in-time gauges); partial blocks only
+    # touch the keys they carry.
+    reg.ingest_fleet({"queue_depth": 0, "records_done": 8,
+                      "workers_alive": 0})
+    g = reg.snapshot()["gauges"]
+    assert g["fleet_queue_depth"] == 0
+    assert g["fleet_records_done"] == 8
+    assert g["fleet_leases_reclaimed"] == 1
+    text = reg.to_prometheus()
+    assert "# TYPE paxos_tpu_fleet_leases_reclaimed gauge" in text
+    assert "paxos_tpu_fleet_queue_depth 0" in text
+
+
 def _tiny_state(protocol: str):
     from paxos_tpu.harness import config as C
     from paxos_tpu.harness.run import (
