@@ -238,6 +238,7 @@ def _spawn_worker(
         "--lease-s", str(args_ns.lease_s),
         "--poll-s", str(args_ns.poll_s),
         "--hold-s", str(args_ns.hold_s),
+        "--sample-every", str(getattr(args_ns, "sample_every", 0)),
     ]
     proc, _ = run_with_retries(
         lambda: subprocess.Popen(cmd, stdout=subprocess.DEVNULL),
@@ -285,17 +286,33 @@ def run_fleet(
 
     procs: "dict[str, subprocess.Popen]" = {}
     spawned = 0
+    t0 = time.time()
+    # The unified-timeline capture: coordinator-observed fleet events
+    # (spawn/claim/SIGKILL/reclaim/lease-renew/respawn), lease-held
+    # windows as spans, and ~1 Hz gauge snapshots.  Pure host-side list
+    # appends; obs.export.fleet_chrome_trace renders it.
+    timeline: dict = {"t0": t0, "instants": [], "spans": [], "gauges": []}
+    open_spans: "dict[tuple, dict]" = {}
+    lease_expiry: "dict[tuple, float]" = {}
+
+    def instant(name: str, worker=None, **args) -> None:
+        ev: dict = {"t": time.time(), "name": name}
+        if worker is not None:
+            ev["worker"] = worker
+        if args:
+            ev["args"] = args
+        timeline["instants"].append(ev)
 
     def spawn(tag: str) -> None:
         nonlocal spawned
         wid = f"w{spawned}{tag}"
         procs[wid] = _spawn_worker(root, wid, args_ns, say)
         spawned += 1
+        instant("respawn" if tag else "spawn", worker=wid)
 
     for _ in range(n_workers):
         spawn("")
 
-    t0 = time.time()
     deadline = t0 + float(args_ns.timeout_s)
     claims_seen: "set[tuple]" = set()
     kills_done = 0
@@ -330,14 +347,31 @@ def run_fleet(
         # 1. Chaos: watch for new claims; kill on the seeded ordinals.
         leases = q.leases()
         leases_held_peak = max(leases_held_peak, len(leases))
+        for key in [k for k in open_spans if k[0] not in leases]:
+            # Lease gone (completed or reclaimed) — close its span.
+            open_spans.pop(key)["t_end"] = now
+            lease_expiry.pop(key, None)
         for rec_id in sorted(leases):
             lease = leases[rec_id]
             key = (rec_id, lease.get("worker"), lease.get("attempt", 0))
+            expires = float(lease.get("expires", 0.0))
             if key in claims_seen:
+                if expires > lease_expiry.get(key, expires):
+                    instant("lease_renew", worker=key[1], record=rec_id)
+                lease_expiry[key] = expires
                 continue
             ordinal = len(claims_seen)
             claims_seen.add(key)
+            lease_expiry[key] = expires
             wid = lease.get("worker")
+            instant("claim", worker=wid, record=rec_id,
+                    attempt=key[2], ordinal=ordinal)
+            span = {
+                "worker": wid, "record": rec_id, "attempt": key[2],
+                "t_start": now, "t_end": None,
+            }
+            open_spans[key] = span
+            timeline["spans"].append(span)
             if (chaos and ordinal in kill_set
                     and kills_done < int(args_ns.chaos_kills)
                     and wid in procs and procs[wid].poll() is None):
@@ -345,12 +379,16 @@ def run_fleet(
                 procs[wid].kill()
                 workers_killed.add(wid)
                 kills_done += 1
+                instant("sigkill", worker=wid, record=rec_id,
+                        ordinal=ordinal)
         # 2. Reclaim expired leases (the recovery path).
         reclaimed = q.reclaim_expired(now)
         if reclaimed:
             leases_expired += len(reclaimed)
             leases_reclaimed += len(reclaimed)
             say(f"reclaimed expired leases: {', '.join(reclaimed)}")
+            for rec_id in reclaimed:
+                instant("reclaim", record=rec_id)
         # 3. Respawn dead workers while work remains.
         for wid, proc in list(procs.items()):
             rc = proc.poll()
@@ -363,9 +401,12 @@ def run_fleet(
                 say(f"worker {wid} exited (rc {rc}) with work remaining; "
                     "respawning")
                 spawn("r")
-        if on_tick is not None and now - last_emit >= 1.0:
+        if now - last_emit >= 1.0:
             last_emit = now
-            on_tick(gauges())
+            g = gauges()
+            timeline["gauges"].append({"t": now, "gauges": g})
+            if on_tick is not None:
+                on_tick(g)
         time.sleep(float(args_ns.poll_s))
     else:
         completed = q.done_count() >= n_records
@@ -381,6 +422,11 @@ def run_fleet(
                 proc.wait(timeout=5.0)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+    t_end = time.time()
+    for span in open_spans.values():
+        span["t_end"] = t_end
+    timeline["gauges"].append({"t": t_end, "gauges": gauges()})
 
     results = q.results()
     merged = merge_results(list(results.values())) if results else {}
@@ -413,6 +459,9 @@ def run_fleet(
             "workers_killed": sorted(workers_killed),
             "chaos_seed": int(args_ns.chaos_seed),
         }
+    # Per-worker drill-down: what each worker id actually delivered.
+    report["workers"] = worker_stats(list(results.values()))
+
     rc = 0
     if not completed:
         say(f"fleet incomplete: {q.done_count()}/{n_records} records done "
@@ -420,6 +469,67 @@ def run_fleet(
         rc = 1
     if merged.get("violations"):
         rc = 2
+
+    # Observatory: merge per-worker time-series journals into the
+    # canonical fleet series and run the trend gate over the raw rows.
+    # Auto-armed — if no worker journaled (sampling off), nothing runs.
+    raw_rows = _collect_series(q, say)
+    if raw_rows:
+        from paxos_tpu.obs.timeseries import (
+            compare_series,
+            merge_series,
+            write_series,
+        )
+
+        merged_series = merge_series([raw_rows])
+        series_path = q.root / "merged_series.jsonl"
+        write_series(series_path, merged_series)
+        report["series"] = {
+            "samples": merged_series["samples"],
+            "dedup": merged_series["dedup"],
+            "digest": merged_series["digest"],
+            "workers": merged_series["workers"],
+            "path": str(series_path),
+        }
+        gate = compare_series(raw_rows)
+        report["series_gate"] = gate
+        if not gate["ok"]:
+            for f in gate["findings"]:
+                say(f"trend gate: {f['kind']} — worker {f['worker']} "
+                    f"record {f['record']}")
+            rc = max(rc, 2)
+
+    # Corpus lineage roll-up (fuzz mode: the merged journal exists).
+    if merged.get("journal_events"):
+        from paxos_tpu.fuzz.lineage import build_lineage, lineage_summary
+
+        report["lineage"] = lineage_summary(
+            build_lineage(merged["journal_events"])
+        )
+
+    corpus_out = getattr(args_ns, "corpus_out", None)
+    if corpus_out and merged.get("journal_events") is not None:
+        _write_journal(corpus_out, merged["journal_events"],
+                       merged["journal_digest"])
+        report["corpus_out"] = str(corpus_out)
+        say(f"merged corpus journal -> {corpus_out}")
+
+    timeline_out = getattr(args_ns, "timeline", None)
+    if timeline_out:
+        from paxos_tpu.obs.export import fleet_chrome_trace
+
+        trace = fleet_chrome_trace(timeline, raw_rows, meta={
+            "metric": "fleet", "records": n_records,
+            "workers": n_workers, "chaos": chaos,
+        })
+        with open(timeline_out, "w") as fh:
+            json.dump(trace, fh)
+        report["timeline"] = {
+            "path": str(timeline_out),
+            "events": len(trace["traceEvents"]),
+        }
+        say(f"fleet timeline -> {timeline_out}")
+
     baseline = getattr(args_ns, "bench_baseline", None)
     if baseline:
         gate = bench_gate(baseline)
@@ -430,3 +540,58 @@ def run_fleet(
             say("bench gate: regression against the committed baseline")
             rc = max(rc, 2)
     return report, rc
+
+
+def worker_stats(results: "list[dict]") -> dict:
+    """Aggregate shard results by the worker that completed them."""
+    out: "dict[str, dict]" = {}
+    for r in sorted(results, key=lambda r: r.get("campaign", 0)):
+        w = str(r.get("worker", "?"))
+        s = out.setdefault(w, {
+            "records": 0, "seeds": 0, "rounds": 0, "violations": 0,
+            "resumed_seeds": 0,
+        })
+        s["records"] += 1
+        s["seeds"] += int(r.get("seeds", 0))
+        s["rounds"] += int(r.get("rounds", 0))
+        s["violations"] += int(r.get("violations", 0))
+        s["resumed_seeds"] += int(r.get("resumed_seeds", 0))
+    return dict(sorted(out.items()))
+
+
+def _collect_series(q: CampaignQueue, say) -> "list[dict]":
+    """Load every worker time-series journal under the queue root.
+
+    Sorted filename order (deterministic), torn tails tolerated per the
+    journal contract, unreadable journals skipped loudly — observability
+    must never take the fleet down with it.
+    """
+    from paxos_tpu.obs.timeseries import load_series
+
+    rows: "list[dict]" = []
+    for path in sorted((q.root / "series").glob("*.jsonl")):
+        try:
+            loaded = load_series(path)
+        except (OSError, ValueError) as e:
+            say(f"series journal {path.name} unreadable ({e}); skipping")
+            continue
+        if loaded["torn_tail"]:
+            say(f"series journal {path.name}: torn tail dropped")
+        rows.extend(loaded["rows"])
+    return rows
+
+
+def _write_journal(path, events: "list[dict]", digest: str) -> None:
+    """Write the merged corpus journal (digest line last) atomically."""
+    import os
+
+    from paxos_tpu.fuzz.corpus import event_line
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for e in events:
+            f.write(event_line(e) + "\n")
+        f.write(event_line({"event": "digest", "sha256": digest}) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -9,6 +9,11 @@ a human with ``ls``) sees each record in exactly one state:
     done/c00003.json       completed (results/c00003.json has the shard
                            result, progress/c00003.jsonl the seed journal)
 
+``series/<worker>.jsonl`` sits beside the record states: one append-only
+metrics time-series journal per worker process (``obs.timeseries``),
+keyed by worker rather than record because it spans every record the
+worker runs.
+
 Ownership is a LEASE, not a lock: a claim writes ``{worker, expires,
 attempt}`` and the worker must renew before ``expires`` (a heartbeat
 thread in ``fleet.worker``).  A worker that dies — SIGKILL, OOM,
@@ -43,7 +48,8 @@ class LeaseLost(RuntimeError):
     worker must abandon the record (its replacement owns it now)."""
 
 
-_DIRS = ("pending", "claimed", "done", "leases", "results", "progress", "tmp")
+_DIRS = ("pending", "claimed", "done", "leases", "results", "progress",
+         "series", "tmp")
 
 
 class CampaignQueue:
@@ -65,6 +71,11 @@ class CampaignQueue:
 
     def progress_path(self, rec_id: str) -> pathlib.Path:
         return self.root / "progress" / f"{rec_id}.jsonl"
+
+    def series_path(self, worker_id: str) -> pathlib.Path:
+        """Per-WORKER metrics time-series journal (one per process
+        lifetime, append-only — see ``obs.timeseries``)."""
+        return self.root / "series" / f"{worker_id}.jsonl"
 
     # -- primitives ------------------------------------------------------
     def _write(self, payload: dict, dest: pathlib.Path) -> None:

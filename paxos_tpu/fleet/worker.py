@@ -166,12 +166,25 @@ def run_record(
     log: Optional[Callable[[str], None]] = None,
     heartbeat: Optional[Callable[[], None]] = None,
     stop_after_seeds: Optional[int] = None,
+    sampler=None,
 ) -> dict:
     """Execute one claimed record to a shard result (see module docstring).
 
     ``stop_after_seeds`` is the deterministic in-process preemption hook:
     after that many progress lines land durably, :class:`WorkerPreempted`
     raises — the record is left exactly as a SIGKILL would leave it.
+
+    ``sampler`` is an ``obs.timeseries.SeriesSampler`` (or None = off);
+    every finalized campaign writes one time-series row on an injected
+    logical clock — the seed index for soak records, the campaign ordinal
+    for fuzz records — BEFORE the progress line lands, so a crash between
+    the two re-runs the seed and re-emits a byte-identical sample that
+    merge dedup absorbs (the reverse order would lose the clock forever
+    and break the chaos byte-identity contract).  The deterministic
+    gauges (``worker_union_bits`` / ``worker_violations`` /
+    ``worker_seeds`` / ``worker_rounds``) are cumulative per-record state
+    seeded from resumed progress, so a resumed record samples exactly the
+    values its uninterrupted twin would have at the same clock.
     """
     from paxos_tpu.fuzz.corpus import append_event
     from paxos_tpu.harness.checkpoint import stream_id
@@ -212,9 +225,42 @@ def run_record(
                 "attempt": int(record.get("attempt", 0)),
             })
         emitted = {"n": 0}
+        # Sampling context, configured per mode below: clock_of maps a
+        # finalized campaign to its logical clock; cum is deterministic
+        # cumulative per-record state (resume-seeded for soak).
+        sample_ctx: dict = {
+            "clock_of": None,
+            "cum": {"union": 0, "violations": 0, "seeds": 0, "rounds": 0},
+        }
+        reg = None
+        if sampler is not None:
+            from paxos_tpu.harness.metrics import MetricsRegistry
+
+            reg = MetricsRegistry()
 
         def on_report(spec, report, seed_rec):
             cov = report.get("coverage") or {}
+            if reg is not None and sample_ctx["clock_of"] is not None:
+                cum = sample_ctx["cum"]
+                cum["union"] |= int(cov.get("union_hex", "0"), 16)
+                cum["violations"] += int(report["violations"])
+                cum["seeds"] += 1
+                cum["rounds"] += spec.cfg.n_inst * ticks
+                reg.gauge("worker_union_bits",
+                          bin(cum["union"]).count("1"))
+                reg.gauge("worker_violations", cum["violations"])
+                reg.gauge("worker_seeds", cum["seeds"])
+                reg.gauge("worker_rounds", cum["rounds"])
+                sampler.sample(
+                    record=rec_id,
+                    attempt=int(record.get("attempt", 0)),
+                    clock=sample_ctx["clock_of"](spec),
+                    registry=reg,
+                    wall={
+                        "t": round(time.time(), 3),
+                        "rps": seed_rec.get("rounds_per_sec"),
+                    },
+                )
             append_event(prog_fh, {
                 "event": "seed", "seed": spec.cfg.seed,
                 "union_hex": cov.get("union_hex", "0"),
@@ -257,6 +303,10 @@ def run_record(
                 on_report(spec, report, seed_rec)
 
             source.feedback = fuzz_feedback
+            # Fuzz clock = campaign ordinal within the (atomic) record;
+            # a replayed attempt restarts at 0 and re-emits identical
+            # rows, which merge dedup collapses.
+            sample_ctx["clock_of"] = lambda spec: emitted["n"]
             report = soak(
                 source.cfg,
                 target_rounds=(
@@ -297,6 +347,18 @@ def run_record(
         violations = progress["violations"]
         violating = list(progress["violating"])
         seeds_run = 0
+        # Soak clock = seed index in the record's full seed list; the
+        # cumulative gauges start from the resumed progress so clock k
+        # always carries the union of seeds 0..k.
+        sample_ctx["clock_of"] = (
+            lambda spec: all_seeds.index(spec.cfg.seed)
+        )
+        sample_ctx["cum"] = {
+            "union": progress["union"],
+            "violations": progress["violations"],
+            "seeds": resumed,
+            "rounds": resumed * cfg.n_inst * ticks,
+        }
         if remaining:
             source = SeedListSource(cfg, remaining, on_report=on_report)
             report = soak(
@@ -365,8 +427,14 @@ def work_loop(
     log: Optional[Callable[[str], None]] = None,
     stop_after_seeds: Optional[int] = None,
     now_fn: Callable[[], float] = time.time,
+    sample_every: int = 0,
 ) -> dict:
     """Claim-execute-complete until the queue drains; returns loop stats.
+
+    ``sample_every`` > 0 turns on the metrics time-series: one
+    ``obs.timeseries.SeriesSampler`` per worker process appending to
+    ``series/<worker>.jsonl`` at that logical-clock cadence.  Off (the
+    default) opens no file and writes nothing — default-off-is-free.
 
     The lease heartbeat runs in a DAEMON THREAD renewing every
     ``lease_s / 5`` — pure host I/O, nothing schedule-relevant — so a
@@ -384,6 +452,40 @@ def work_loop(
     say = log or (lambda s: None)
     q = CampaignQueue(root)
     stats = {"worker": worker_id, "records_done": 0, "leases_lost": 0}
+    sampler = None
+    series_fh = None
+    if int(sample_every) > 0:
+        from paxos_tpu.obs.timeseries import SeriesSampler
+
+        series_fh = open(q.series_path(worker_id), "a")
+        sampler = SeriesSampler(series_fh, worker_id,
+                                every=int(sample_every))
+    try:
+        return _work_loop(
+            q, worker_id, stats, say, sampler,
+            lease_s=lease_s, poll_s=poll_s, hold_s=hold_s,
+            stop_after_seeds=stop_after_seeds, now_fn=now_fn,
+        )
+    finally:
+        if sampler is not None:
+            stats["samples"] = sampler.samples
+        if series_fh is not None:
+            series_fh.close()
+
+
+def _work_loop(
+    q: CampaignQueue,
+    worker_id: str,
+    stats: dict,
+    say,
+    sampler,
+    *,
+    lease_s: float,
+    poll_s: float,
+    hold_s: float,
+    stop_after_seeds: Optional[int],
+    now_fn: Callable[[], float],
+) -> dict:
     while True:
         claim = run_with_retries(
             lambda: q.claim(worker_id, now_fn(), lease_s),
@@ -426,6 +528,7 @@ def work_loop(
             result = run_record(
                 q, rec_id, record, worker_id, log=say,
                 heartbeat=heartbeat, stop_after_seeds=stop_after_seeds,
+                sampler=sampler,
             )
             if hb_state["lost"] is not None:
                 raise hb_state["lost"]

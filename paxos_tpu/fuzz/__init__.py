@@ -24,6 +24,13 @@ from paxos_tpu.fuzz.corpus import (
     fitness,
     margin_boost,
 )
+from paxos_tpu.fuzz.lineage import (
+    build_lineage,
+    lineage_summary,
+    op_attribution,
+    render_op_table,
+    render_tree,
+)
 from paxos_tpu.fuzz.mutate import MUTATION_OPS, SplitMix64, mutate
 from paxos_tpu.fuzz.schedule import FuzzParams, GuidedSource, campaign_config
 
@@ -41,4 +48,9 @@ __all__ = [
     "FuzzParams",
     "GuidedSource",
     "campaign_config",
+    "build_lineage",
+    "lineage_summary",
+    "op_attribution",
+    "render_op_table",
+    "render_tree",
 ]

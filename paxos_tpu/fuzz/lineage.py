@@ -1,0 +1,250 @@
+"""Corpus lineage — the mutation family tree and per-op payoff attribution.
+
+The corpus journal already records everything genealogy needs: every
+``add`` event carries its parent link, the mutation ops that produced the
+entry, and the canonical ``atoms_digest``; every ``feedback`` event
+carries the measured payoff (coverage ``new_bits``, per-class effective
+exposure, margin slack, violations, fitness).  PR 16's merge even
+re-parents deduped entries.  What was never built is the READ side: this
+module reconstructs the family tree from any journal (live worker, merged
+fleet, ``--corpus-out`` artifact) and answers the question the energy
+scheduler's design begs — *which of the 14 registered mutation ops
+actually pay?*
+
+Attribution formula: each executed entry's measured feedback is credited
+to the ops that produced it, **split equally** across the entry's op
+chain (exact ``fractions.Fraction`` arithmetic, so the per-op columns sum
+to the journal's recorded feedback totals *exactly* — no double counting,
+no rounding drift).  Root entries carry no ops and credit the pseudo-op
+``root``: the baseline the mutations are measured against.
+``margin_tightened`` credits an entry whose ``min_quorum_slack`` is
+strictly tighter than its parent's (or which is contested at all, for a
+root) — the near-miss payoff the fitness boost rewards.
+
+Pure host-side decode over journal events: no device ops, no PRNG, no
+clock — importable and runnable anywhere a journal file exists.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Iterable
+
+ROOT_OP = "root"
+
+
+def build_lineage(events: "Iterable[dict]") -> dict:
+    """Reconstruct the family tree from corpus journal events.
+
+    Tolerates merged journals (dense re-mapped ids, re-parented
+    children) and partial ones (entries with no feedback yet).  Unknown
+    event kinds are ignored, matching the merge's forward-compat rule.
+
+    Returns ``{"nodes", "roots", "order", "depth_max"}`` — ``nodes``
+    maps id -> node dict (children list included), ``roots`` is the list
+    of parentless ids in id order, ``order`` every id in add order.
+    """
+    nodes: "dict[int, dict]" = {}
+    order: "list[int]" = []
+    for e in events:
+        kind = e.get("event")
+        if kind == "add":
+            nid = int(e["id"])
+            node = {
+                "id": nid,
+                "seed": e.get("seed"),
+                "parent": e.get("parent"),
+                "ops": tuple(e.get("ops") or ()),
+                "root": bool(e.get("root")),
+                "atoms_digest": e.get("atoms_digest"),
+                "children": [],
+                "executed": False,
+                "new_bits": None,
+                "effective": None,
+                "min_quorum_slack": None,
+                "violations": 0,
+                "fitness": 0.0,
+                "retired": None,
+            }
+            nodes[nid] = node
+            order.append(nid)
+            parent = e.get("parent")
+            if parent is not None and int(parent) in nodes:
+                nodes[int(parent)]["children"].append(nid)
+        elif kind == "feedback":
+            node = nodes.get(int(e["id"]))
+            if node is None:
+                continue
+            node["executed"] = True
+            node["new_bits"] = int(e.get("new_bits", 0))
+            node["effective"] = e.get("effective")
+            node["min_quorum_slack"] = e.get("min_quorum_slack")
+            node["violations"] = int(e.get("violations", 0))
+            node["fitness"] = float(e.get("fitness", 0.0))
+        elif kind == "retire":
+            node = nodes.get(int(e["id"]))
+            if node is not None:
+                node["retired"] = e.get("reason", "?")
+    depth: "dict[int, int]" = {}
+    for nid in order:  # parents precede children in add order
+        parent = nodes[nid]["parent"]
+        depth[nid] = (
+            0 if parent is None or int(parent) not in depth
+            else depth[int(parent)] + 1
+        )
+        nodes[nid]["depth"] = depth[nid]
+    return {
+        "nodes": nodes,
+        "roots": [n for n in order if nodes[n]["parent"] is None],
+        "order": order,
+        "depth_max": max(depth.values(), default=0),
+    }
+
+
+def margin_tightened(node: dict, nodes: "dict[int, dict]") -> bool:
+    """Did this entry tighten the near-miss margin vs its parent?
+
+    Contested at all (slack not None) counts for a parentless entry;
+    a child must be STRICTLY tighter than its parent (an uncontested
+    parent tightens on any contested child).
+    """
+    slack = node.get("min_quorum_slack")
+    if slack is None:
+        return False
+    parent = node.get("parent")
+    if parent is None or int(parent) not in nodes:
+        return True
+    pslack = nodes[int(parent)].get("min_quorum_slack")
+    return pslack is None or int(slack) < int(pslack)
+
+
+def _effective_sum(node: dict) -> int:
+    eff = node.get("effective")
+    return sum(int(v) for v in eff.values()) if isinstance(eff, dict) else 0
+
+
+def op_attribution(lineage: dict) -> dict:
+    """Per-mutation-op payoff table + exact journal feedback totals.
+
+    ``totals`` counts every executed entry ONCE (it equals independent
+    sums over the journal's feedback events — the cross-check the tests
+    pin); ``ops`` maps op name -> equally-split credit whose columns sum
+    back to ``totals`` exactly (Fraction arithmetic internally, floats
+    rounded to 6 on the way out).
+    """
+    nodes = lineage["nodes"]
+    cols = ("campaigns", "new_bits", "effective", "violations",
+            "margin_tightened", "fitness")
+    acc: "dict[str, dict[str, Fraction]]" = {}
+    totals_f = {c: Fraction(0) for c in cols}
+    for nid in lineage["order"]:
+        node = nodes[nid]
+        if not node["executed"]:
+            continue
+        row = {
+            "campaigns": Fraction(1),
+            "new_bits": Fraction(int(node["new_bits"] or 0)),
+            "effective": Fraction(_effective_sum(node)),
+            "violations": Fraction(int(node["violations"])),
+            "margin_tightened": Fraction(
+                int(margin_tightened(node, nodes))
+            ),
+            "fitness": Fraction(node["fitness"]).limit_denominator(10**9),
+        }
+        ops = list(node["ops"]) or [ROOT_OP]
+        share = Fraction(1, len(ops))
+        for op in ops:
+            dst = acc.setdefault(op, {c: Fraction(0) for c in cols})
+            for c in cols:
+                dst[c] += row[c] * share
+        for c in cols:
+            totals_f[c] += row[c]
+    totals = {
+        c: (float(v) if c == "fitness" else int(v))
+        for c, v in totals_f.items()
+    }
+    ops_out = {
+        op: {c: round(float(v), 6) for c, v in sorted(vals.items())}
+        for op, vals in acc.items()
+    }
+    return {"ops": ops_out, "totals": totals, "_exact": acc,
+            "_exact_totals": totals_f}
+
+
+def lineage_summary(lineage: dict) -> dict:
+    """The gauge-ready roll-up (``lineage_*`` metrics vocabulary)."""
+    nodes = list(lineage["nodes"].values())
+    return {
+        "entries": len(nodes),
+        "roots": len(lineage["roots"]),
+        "executed": sum(1 for n in nodes if n["executed"]),
+        "retired": sum(1 for n in nodes if n["retired"]),
+        "depth_max": lineage["depth_max"],
+        "best_fitness": max((n["fitness"] for n in nodes), default=0.0),
+    }
+
+
+def render_tree(lineage: dict) -> str:
+    """ASCII family tree in add order — the ``paxos_tpu lineage`` view."""
+    nodes = lineage["nodes"]
+    out: "list[str]" = []
+
+    def fmt(node: dict) -> str:
+        bits = (
+            f" bits={node['new_bits']}" if node["executed"] else " (pending)"
+        )
+        ops = ",".join(node["ops"]) if node["ops"] else ROOT_OP
+        extra = ""
+        if node["min_quorum_slack"] is not None:
+            extra += f" slack={node['min_quorum_slack']}"
+        if node["violations"]:
+            extra += f" VIOLATIONS={node['violations']}"
+        if node["retired"]:
+            extra += f" [retired: {node['retired']}]"
+        return (
+            f"#{node['id']} seed={node['seed']} ops={ops}"
+            f" fit={node['fitness']}{bits}{extra}"
+        )
+
+    def walk(nid: int, prefix: str, last: bool, top: bool) -> None:
+        node = nodes[nid]
+        if top:
+            out.append(fmt(node))
+            child_prefix = ""
+        else:
+            branch = "`-- " if last else "|-- "
+            out.append(prefix + branch + fmt(node))
+            child_prefix = prefix + ("    " if last else "|   ")
+        kids = node["children"]
+        for i, kid in enumerate(kids):
+            walk(kid, child_prefix, i == len(kids) - 1, False)
+
+    for rid in lineage["roots"]:
+        walk(rid, "", True, True)
+    return "\n".join(out)
+
+
+def render_op_table(attribution: dict) -> str:
+    """Per-op payoff table, best-paying ops first."""
+    header = (
+        f"{'op':<20}{'campaigns':>10}{'new_bits':>10}{'effective':>11}"
+        f"{'violations':>12}{'tightened':>11}{'fitness':>10}"
+    )
+    lines = [header]
+    rows = sorted(
+        attribution["ops"].items(),
+        key=lambda kv: (-kv[1]["new_bits"], kv[0]),
+    )
+    for op, row in rows:
+        lines.append(
+            f"{op:<20}{row['campaigns']:>10g}{row['new_bits']:>10g}"
+            f"{row['effective']:>11g}{row['violations']:>12g}"
+            f"{row['margin_tightened']:>11g}{row['fitness']:>10g}"
+        )
+    t = attribution["totals"]
+    lines.append(
+        f"{'TOTAL':<20}{t['campaigns']:>10g}{t['new_bits']:>10g}"
+        f"{t['effective']:>11g}{t['violations']:>12g}"
+        f"{t['margin_tightened']:>11g}{t['fitness']:>10g}"
+    )
+    return "\n".join(lines)

@@ -426,6 +426,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="run bench-compare against this committed artifact as the "
         "fleet's continuous regression gate (exit 2 on regression)",
     )
+    fl.add_argument(
+        "--sample-every", type=int, default=0, metavar="N",
+        help="fleet observatory: each worker samples its gauges into a "
+        "crash-safe time-series journal every N logical-clock ticks "
+        "(seed index / campaign ordinal); the coordinator merges the "
+        "journals canonically and runs the trend gate (exit 2 on "
+        "discovery stall / rps degradation / heartbeat gaps). 0 = off "
+        "(no journal, nothing written)",
+    )
+    fl.add_argument(
+        "--timeline", default=None, metavar="PATH",
+        help="write the unified fleet timeline (Chrome trace JSON, "
+        "Perfetto-loadable): a track per worker with claim/SIGKILL/"
+        "reclaim/lease events and record spans, per-worker coverage and "
+        "rounds/sec counter tracks, fleet-aggregate counters",
+    )
+    fl.add_argument(
+        "--corpus-out", default=None, metavar="PATH",
+        help="fuzz mode: write the merged corpus journal (JSONL, digest "
+        "line last) — the artifact `paxos_tpu lineage` reads",
+    )
     fl.add_argument("--log", default=None, help="JSONL metrics path")
 
     fw = sub.add_parser(
@@ -438,6 +459,29 @@ def build_parser() -> argparse.ArgumentParser:
     fw.add_argument("--lease-s", type=float, default=15.0)
     fw.add_argument("--poll-s", type=float, default=0.5)
     fw.add_argument("--hold-s", type=float, default=0.0)
+    fw.add_argument("--sample-every", type=int, default=0)
+
+    ln = sub.add_parser(
+        "lineage",
+        help="corpus lineage: reconstruct the mutation family tree from "
+        "a corpus journal and attribute payoff to each mutation op "
+        "(fuzz.lineage)",
+    )
+    ln.add_argument(
+        "journal", metavar="JOURNAL",
+        help="corpus journal path (fuzz --corpus-out, fleet --corpus-out, "
+        "or a worker's raw journal)",
+    )
+    ln.add_argument(
+        "--tree", action="store_true",
+        help="render the ASCII family tree (default shows the per-op "
+        "payoff table only)",
+    )
+    ln.add_argument(
+        "--json", action="store_true",
+        help="machine-readable: summary + per-op attribution + totals",
+    )
+    ln.add_argument("--log", default=None, help="JSONL metrics path")
 
     k = sub.add_parser(
         "shrink",
@@ -544,10 +588,25 @@ def build_parser() -> argparse.ArgumentParser:
         "stats",
         help="summarize a JSONL metrics stream written by run/soak --log",
     )
-    st.add_argument("path", help="JSONL metrics file")
+    st.add_argument(
+        "path", nargs="?", default=None,
+        help="JSONL metrics file (omit when using --fleet-root)",
+    )
     st.add_argument(
         "--prometheus", action="store_true",
         help="print the Prometheus text exposition instead of a JSON summary",
+    )
+    st.add_argument(
+        "--fleet-root", default=None, metavar="DIR",
+        help="fleet observatory mode: read the time-series journals under "
+        "a fleet queue root (series/*.jsonl), rendering per-worker "
+        "last-sample rows + the fleet aggregate; with --follow, tails "
+        "them until the coordinator's merged_series.jsonl lands",
+    )
+    st.add_argument(
+        "--series-gate", action="store_true",
+        help="with --fleet-root: run the trend gate (obs.timeseries."
+        "compare_series) over the collected rows and exit 2 on findings",
     )
     st.add_argument(
         "--follow", action="store_true",
@@ -1615,6 +1674,12 @@ def cmd_fleet(args: argparse.Namespace) -> int:
         )
         registry = MetricsRegistry()
         registry.ingest_fleet(report["fleet"])
+        # Per-worker drill-down as labeled series beside the aggregate
+        # (the collision fix: N workers = N series, not one overwrite).
+        for wid, block in (report.get("workers") or {}).items():
+            registry.ingest_fleet(block, worker=wid)
+        if report.get("lineage"):
+            registry.ingest_lineage(report["lineage"])
         mlog.emit("metrics", **registry.snapshot())
         mlog.emit("final", **report)
     print(json.dumps(report))
@@ -1629,8 +1694,58 @@ def cmd_fleet_worker(args: argparse.Namespace) -> int:
     stats = work_loop(
         args.dir, args.worker_id, lease_s=args.lease_s,
         poll_s=args.poll_s, hold_s=args.hold_s, log=say,
+        sample_every=getattr(args, "sample_every", 0),
     )
     print(json.dumps(stats))
+    return 0
+
+
+def cmd_lineage(args: argparse.Namespace) -> int:
+    """Corpus lineage: family tree + per-op payoff from a journal.
+
+    Exit 0 on a readable journal, 1 on an unreadable one; a torn tail is
+    tolerated (reported on stderr) per the journal contract.
+    """
+    from paxos_tpu.fuzz.corpus import load_journal
+    from paxos_tpu.fuzz.lineage import (
+        build_lineage,
+        lineage_summary,
+        op_attribution,
+        render_op_table,
+        render_tree,
+    )
+    from paxos_tpu.harness.metrics import MetricsLog, MetricsRegistry
+
+    try:
+        loaded = load_journal(args.journal)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if loaded["torn_tail"]:
+        print("# torn tail dropped (crash mid-append)", file=sys.stderr)
+    lineage = build_lineage(loaded["events"])
+    summary = lineage_summary(lineage)
+    attribution = op_attribution(lineage)
+    with MetricsLog(args.log) as mlog:
+        registry = MetricsRegistry()
+        registry.ingest_lineage(summary, attribution["ops"])
+        mlog.emit("metrics", **registry.snapshot())
+        mlog.emit("final", metric="lineage", summary=summary,
+                  ops=attribution["ops"], totals=attribution["totals"])
+    if args.json:
+        print(json.dumps({
+            "metric": "lineage", "summary": summary,
+            "ops": attribution["ops"], "totals": attribution["totals"],
+        }))
+        return 0
+    print(f"# entries={summary['entries']} roots={summary['roots']} "
+          f"executed={summary['executed']} retired={summary['retired']} "
+          f"depth_max={summary['depth_max']} "
+          f"best_fitness={summary['best_fitness']}")
+    if args.tree:
+        print(render_tree(lineage))
+        print()
+    print(render_op_table(attribution))
     return 0
 
 
@@ -1865,9 +1980,22 @@ def cmd_stats(args: argparse.Namespace) -> int:
     A closed stdout (``stats ... | head``, ``| grep -q``) ends the
     command cleanly instead of tracebacking — the reader deciding it has
     seen enough is a normal way for a tailing pipeline to stop.
+
+    ``--fleet-root`` switches the source to a fleet queue root's
+    time-series journals (``series/*.jsonl``): per-worker last-sample
+    rows plus a fleet aggregate, the same follow/interval machinery
+    (tailing stops when the coordinator's ``merged_series.jsonl``
+    lands), and optionally the trend gate (``--series-gate``, exit 2 on
+    findings).
     """
     import pathlib
 
+    if args.fleet_root:
+        return _stats_fleet(args, pathlib.Path(args.fleet_root))
+    if args.path is None:
+        print("error: a metrics file path is required without "
+              "--fleet-root", file=sys.stderr)
+        return 1
     path = pathlib.Path(args.path)
     if not args.follow:
         if not path.exists():
@@ -1906,6 +2034,106 @@ def cmd_stats(args: argparse.Namespace) -> int:
         if args.max_renders and renders >= args.max_renders:
             return 0
         time.sleep(max(args.interval, 0.05))
+
+
+def _stats_fleet_rows(root) -> "list[dict]":
+    """Collect every sample row under a fleet root (torn tails dropped
+    per the journal contract, unreadable journals skipped)."""
+    from paxos_tpu.obs.timeseries import load_series
+
+    rows: "list[dict]" = []
+    for p in sorted((root / "series").glob("*.jsonl")):
+        try:
+            rows.extend(load_series(p)["rows"])
+        except (OSError, ValueError):
+            continue
+    return rows
+
+
+def _stats_fleet_render(rows: "list[dict]", root,
+                        prometheus: bool) -> str:
+    """Per-worker last-sample rows + the fleet aggregate."""
+    last: "dict[str, dict]" = {}
+    counts: "dict[str, int]" = {}
+    for r in rows:
+        w = str(r.get("worker", "?"))
+        counts[w] = counts.get(w, 0) + 1
+        prev = last.get(w)
+        if prev is None or int(r.get("seq", 0)) >= int(prev.get("seq", 0)):
+            last[w] = r
+    agg = {"workers": len(last), "samples": len(rows),
+           "seeds": 0, "rounds": 0, "violations": 0}
+    for w, r in last.items():
+        g = r.get("gauges", {})
+        agg["seeds"] += int(g.get("worker_seeds", 0))
+        agg["rounds"] += int(g.get("worker_rounds", 0))
+        agg["violations"] += int(g.get("worker_violations", 0))
+    if prometheus:
+        from paxos_tpu.harness.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
+        for name in ("workers", "samples", "seeds", "rounds",
+                     "violations"):
+            registry.gauge(f"fleet_series_{name}", agg[name])
+        for w, r in sorted(last.items()):
+            for name, v in sorted(r.get("gauges", {}).items()):
+                if isinstance(v, (int, float)):
+                    registry.gauge(name, v, worker=w)
+        return registry.to_prometheus()
+    return json.dumps({
+        "metric": "fleet_series",
+        "root": str(root),
+        "fleet": agg,
+        "workers": {
+            w: {
+                "samples": counts[w],
+                "record": r.get("record"),
+                "clock": r.get("clock"),
+                "seq": r.get("seq"),
+                "gauges": r.get("gauges", {}),
+            }
+            for w, r in sorted(last.items())
+        },
+    })
+
+
+def _stats_fleet(args: argparse.Namespace, root) -> int:
+    """The ``stats --fleet-root`` observatory view (see cmd_stats)."""
+    import time
+
+    renders = 0
+    while True:
+        rows = _stats_fleet_rows(root)
+        done = (root / "merged_series.jsonl").exists()
+        if rows:
+            try:
+                print(_stats_fleet_render(rows, root, args.prometheus),
+                      flush=True)
+            except BrokenPipeError:
+                _devnull_stdout()
+                return 0
+            renders += 1
+        elif not args.follow:
+            print(f"error: no time-series journals under {root}/series "
+                  "(was the fleet run with --sample-every?)",
+                  file=sys.stderr)
+            return 1
+        if (not args.follow or done
+                or (args.max_renders and renders >= args.max_renders)):
+            break
+        time.sleep(max(args.interval, 0.05))
+    if args.series_gate:
+        from paxos_tpu.obs.timeseries import compare_series
+
+        gate = compare_series(_stats_fleet_rows(root))
+        print(json.dumps({"metric": "series_gate", **gate}))
+        if not gate["ok"]:
+            for f in gate["findings"]:
+                print(f"# trend gate: {f['kind']} — worker "
+                      f"{f['worker']} record {f['record']}",
+                      file=sys.stderr)
+            return 2
+    return 0
 
 
 def cmd_bench_compare(args: argparse.Namespace) -> int:
@@ -2737,6 +2965,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_fleet(args)
     if args.cmd == "fleet-worker":
         return cmd_fleet_worker(args)
+    if args.cmd == "lineage":
+        return cmd_lineage(args)
     if args.cmd == "shrink":
         return cmd_shrink(args)
     if args.cmd == "check":

@@ -269,7 +269,9 @@ class MetricsRegistry:
             for name, v in (perf.get(sub) or {}).items():
                 self.gauge(f"perf_{name}", v)
 
-    def ingest_fleet(self, fleet: dict[str, Any]) -> None:
+    def ingest_fleet(
+        self, fleet: dict[str, Any], worker: Optional[str] = None
+    ) -> None:
         """Fold a fleet coordinator gauges block into the registry.
 
         Every value is a point-in-time coordinator-side observation of
@@ -280,6 +282,13 @@ class MetricsRegistry:
         workers are dying (each reclaim is one recovered campaign), and
         ``fleet_queue_depth`` stuck nonzero with ``fleet_workers_alive``
         at zero means the fleet stalled.
+
+        ``worker`` adds a label dimension: per-worker blocks (the
+        coordinator's ``report["workers"]``) land as
+        ``fleet_<name>{worker=...}`` series beside — never overwriting —
+        the unlabeled fleet-aggregate gauges.  Gauge keys include sorted
+        labels, so N workers are N distinct series (the PR 16 collision,
+        where the last-ingested block won, cannot recur).
         """
         for name in (
             "workers",
@@ -296,10 +305,38 @@ class MetricsRegistry:
             "merge_dedup",
             "torn_tails",
             "resumed_seeds",
+            "records",
+            "seeds",
+            "rounds",
+            "violations",
         ):
             v = fleet.get(name)
-            if v is not None:
+            if v is None:
+                continue
+            if worker is not None:
+                self.gauge(f"fleet_{name}", v, worker=str(worker))
+            else:
                 self.gauge(f"fleet_{name}", v)
+
+    def ingest_lineage(self, summary: dict[str, Any],
+                       ops: Optional[dict[str, Any]] = None) -> None:
+        """Fold a ``fuzz.lineage`` roll-up into ``lineage_*`` gauges.
+
+        ``ops`` (the ``op_attribution`` per-op table) lands as
+        ``lineage_op_<column>{op=...}`` labeled series — one series per
+        mutation op, the per-op payoff a scraper can rank.
+        """
+        for name in ("entries", "roots", "executed", "retired",
+                     "depth_max", "best_fitness"):
+            v = summary.get(name)
+            if v is not None:
+                self.gauge(f"lineage_{name}", v)
+        for op, row in sorted((ops or {}).items()):
+            for col in ("campaigns", "new_bits", "effective",
+                        "violations", "margin_tightened", "fitness"):
+                v = row.get(col)
+                if v is not None:
+                    self.gauge(f"lineage_op_{col}", v, op=str(op))
 
     def snapshot(self) -> dict[str, Any]:
         """One JSON-ready dict of everything in the registry."""

@@ -33,6 +33,15 @@ them in formats standard tooling loads:
   behind ``paxos_tpu bench-compare``.  Like the rest of the package it
   is pure decode over injected-clock spans — no clock, no IO, no device
   ops.
+- :mod:`timeseries` — the fleet observatory (layer 9): a crash-safe
+  append-only metrics time-series journal per worker (the ``fuzz.corpus``
+  single-write + flush + fsync discipline, torn-tail-tolerant load),
+  canonical ``(record, clock)``-ordered ``merge_series`` so the
+  coordinator assembles one byte-deterministic fleet-wide series, and the
+  ``compare_series`` trend gate (discovery stall, rounds/sec degradation,
+  heartbeat gaps) beside the bench gate.  Clocks are injected logical
+  clocks; the wall sidecar is diagnostic and stripped from the canonical
+  merged form.
 
 Everything here is host-side decode: zero new device ops, zero PRNG
 draws, schedules bit-identical (the PR 4 auditor and the golden digests

@@ -153,6 +153,117 @@ def write_chrome_trace(
     return obj
 
 
+FLEET_PID = 1  # the coordinator reuses the host (wall clock) pid slot
+_WORKER_PID0 = 2  # worker process tracks start here, one pid per worker
+
+
+def fleet_chrome_trace(
+    timeline: dict,
+    series_rows: "Iterable[dict]" = (),
+    meta: Optional[dict] = None,
+) -> dict:
+    """One Perfetto trace for a whole fleet run — a track per worker.
+
+    ``timeline`` is the coordinator's capture (all times epoch seconds):
+
+    - ``t0`` — trace origin (everything renders relative to it),
+    - ``instants`` — ``{"t", "name", "worker"?, "args"?}`` (spawn, claim,
+      SIGKILL, reclaim, respawn, lease renewals); coordinator-side events
+      (no worker) land on the coordinator track,
+    - ``spans`` — ``{"worker", "record", "attempt", "t_start", "t_end"}``
+      lease-held windows as async begin/end pairs (cat ``record``),
+    - ``gauges`` — ``{"t", "gauges"}`` monitor-loop snapshots rendered as
+      fleet-aggregate counter tracks (records_done / queue_depth /
+      workers_alive).
+
+    ``series_rows`` are RAW worker time-series rows (``obs.timeseries``,
+    wall sidecar included): each worker gets ``union_bits`` and
+    ``rounds_per_sec`` counter tracks stamped at the sidecar wall time.
+    Output passes :func:`validate_chrome_trace`.
+    """
+    t0 = float(timeline.get("t0", 0.0))
+
+    def ts(t: Any) -> int:
+        return max(0, round((float(t) - t0) * 1e6))
+
+    instants = list(timeline.get("instants", ()))
+    spans = list(timeline.get("spans", ()))
+    rows = [r for r in series_rows if r.get("event") == "sample"]
+    workers = sorted(
+        {str(e["worker"]) for e in instants if e.get("worker")}
+        | {str(s["worker"]) for s in spans}
+        | {str(r.get("worker", "?")) for r in rows}
+    )
+    pid_of = {w: _WORKER_PID0 + i for i, w in enumerate(workers)}
+
+    events: list[dict] = [
+        _meta("process_name", FLEET_PID, label="fleet coordinator"),
+        _meta("thread_name", FLEET_PID, 0, "monitor loop"),
+    ]
+    for w in workers:
+        events.append(_meta("process_name", pid_of[w], label=f"worker {w}"))
+        events.append(_meta("thread_name", pid_of[w], 0, "lifecycle"))
+
+    for snap in timeline.get("gauges", ()):
+        g = snap.get("gauges", {})
+        for key in ("records_done", "queue_depth", "workers_alive"):
+            if key in g:
+                events.append({
+                    "ph": "C", "cat": "counter", "name": f"fleet_{key}",
+                    "pid": FLEET_PID, "ts": ts(snap["t"]),
+                    "args": {"value": g[key]},
+                })
+
+    for s in spans:
+        w = str(s["worker"])
+        b_ts = ts(s["t_start"])
+        e_ts = max(b_ts, ts(s.get("t_end", s["t_start"])))
+        common = {
+            "cat": "record",
+            "id": f"{w}/{s['record']}#{s.get('attempt', 0)}",
+            "pid": pid_of[w], "tid": 0,
+            "name": str(s["record"]),
+        }
+        events.append({
+            "ph": "b", "ts": b_ts,
+            "args": {"attempt": int(s.get("attempt", 0))}, **common,
+        })
+        events.append({"ph": "e", "ts": e_ts, **common})
+
+    for ins in instants:
+        w = ins.get("worker")
+        events.append({
+            "ph": "i", "s": "t", "cat": "fleet", "name": str(ins["name"]),
+            "pid": pid_of[str(w)] if w else FLEET_PID, "tid": 0,
+            "ts": ts(ins["t"]), "args": dict(ins.get("args", {})),
+        })
+
+    for r in rows:
+        wall = r.get("wall")
+        if not isinstance(wall, dict) or wall.get("t") is None:
+            continue
+        pid = pid_of[str(r.get("worker", "?"))]
+        bits = r.get("gauges", {}).get("worker_union_bits")
+        if bits is not None:
+            events.append({
+                "ph": "C", "cat": "counter", "name": "union_bits",
+                "pid": pid, "ts": ts(wall["t"]), "args": {"value": bits},
+            })
+        if wall.get("rps") is not None:
+            events.append({
+                "ph": "C", "cat": "counter", "name": "rounds_per_sec",
+                "pid": pid, "ts": ts(wall["t"]),
+                "args": {"value": wall["rps"]},
+            })
+
+    events.sort(key=lambda e: e["ts"])
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": dict(meta or {}),
+    }
+
+
 def spans_jsonl(spans: Iterable[RoundSpan]) -> str:
     """Compact one-span-per-line JSONL — the programmatic-diff format."""
     return "".join(

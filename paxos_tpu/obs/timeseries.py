@@ -1,0 +1,319 @@
+"""Fleet observatory layer 9 — durable metrics time-series + trend gate.
+
+PR 16's fleet gauges are snapshots: the coordinator's monitor loop emits
+the *current* queue/lease state and a dead worker takes its metrics with
+it.  This module makes worker metrics a durable TIME-SERIES with the same
+crash-safety contract as every other fleet artifact:
+
+- :class:`SeriesSampler` appends one ``sample`` row per logical-clock
+  tick to a per-worker JSONL journal using the proven ``fuzz.corpus``
+  append discipline (ONE write of the full line, then flush + fsync), so
+  a SIGKILL can only ever truncate the final line and
+  :func:`load_series` recovers everything before it.
+- Each row is ``(worker, record, attempt, seq, clock, gauges[, wall])``.
+  ``clock`` is an INJECTED logical clock (the seed index of a soak
+  record, the campaign ordinal of a fuzz record) and ``gauges`` is the
+  worker's :class:`harness.metrics.MetricsRegistry` gauge snapshot —
+  the sampler reads the registry exactly the way ``stats`` does and
+  never touches a wall clock or PRNG itself.  The optional ``wall``
+  sidecar (epoch seconds, rounds/sec) is diagnostic only and is
+  STRIPPED from the canonical merged form.
+- :func:`merge_series` assembles one fleet-wide series from N worker
+  journals in canonical ``(record, clock)`` order with dedup — the same
+  merge contract as the PR 16 corpus merge (ordered by record, never by
+  completion), so a chaos run's merged series is byte-identical to an
+  uninterrupted run's: a re-run record re-emits the same clocks with
+  the same deterministic gauges and dedup keeps one copy.
+- :func:`compare_series` is the TREND gate beside the bench gate:
+  discovery-rate stall, per-worker rounds/sec degradation, and
+  heartbeat-gap anomalies, each finding naming the worker and record.
+
+Like the rest of ``obs``: host-side only — zero new device ops, zero
+PRNG draws, schedules bit-identical (sampling off writes nothing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any, Iterable, Optional
+
+from paxos_tpu.fuzz.corpus import append_event, event_line, load_journal
+
+SERIES_SCHEMA = 1
+
+
+def sample_row(
+    *,
+    worker: str,
+    record: str,
+    attempt: int,
+    seq: int,
+    clock: int,
+    gauges: dict,
+    wall: Optional[dict] = None,
+) -> dict:
+    """One time-series journal row (the worker-journal wire form)."""
+    row: dict[str, Any] = {
+        "event": "sample", "schema": SERIES_SCHEMA, "worker": str(worker),
+        "record": str(record), "attempt": int(attempt), "seq": int(seq),
+        "clock": int(clock), "gauges": dict(gauges),
+    }
+    if wall is not None:
+        row["wall"] = dict(wall)
+    return row
+
+
+def canonical_sample(row: dict) -> dict:
+    """The merge-canonical form of a sample row.
+
+    Worker identity, sequence number, attempt, and the wall sidecar are
+    all *delivery* facts — which process happened to run the record, and
+    when — so they are stripped; what remains (``record``, ``clock``,
+    deterministic ``gauges``) is identical however the record was
+    scheduled, killed, or replayed.  This is what makes the merged
+    series byte-deterministic under chaos.
+    """
+    return {
+        "event": "sample", "record": str(row["record"]),
+        "clock": int(row["clock"]), "gauges": dict(row["gauges"]),
+    }
+
+
+class SeriesSampler:
+    """Per-worker time-series sampler over an open journal file handle.
+
+    The handle, the worker id, and every clock value are injected by the
+    fleet layer; the sampler itself is pure bookkeeping + the crash-safe
+    append.  ``seq`` increases monotonically per worker across records —
+    the per-journal integrity check :func:`merge_series` verifies.
+    """
+
+    def __init__(self, fh, worker: str, every: int = 1) -> None:
+        self.fh = fh
+        self.worker = str(worker)
+        self.every = int(every)
+        self.seq = 0
+        self.samples = 0
+
+    def sample(
+        self,
+        *,
+        record: str,
+        attempt: int,
+        clock: int,
+        registry,
+        wall: Optional[dict] = None,
+    ) -> bool:
+        """Append one row when ``clock`` lands on the sampling cadence.
+
+        The cadence test is ``clock % every == 0`` — a function of the
+        logical clock alone, so a resumed record samples exactly the
+        clocks its uninterrupted twin would have.  Returns whether a row
+        was written.
+        """
+        if self.every <= 0 or int(clock) % self.every != 0:
+            return False
+        gauges = registry.snapshot().get("gauges", {})
+        append_event(self.fh, sample_row(
+            worker=self.worker, record=record, attempt=attempt,
+            seq=self.seq, clock=clock, gauges=gauges, wall=wall,
+        ))
+        self.seq += 1
+        self.samples += 1
+        return True
+
+
+def load_series(path: Any) -> dict:
+    """Read one worker journal back, tolerating a torn final line.
+
+    Same contract as ``fuzz.corpus.load_journal`` (it IS that loader):
+    a truncated tail is dropped and reported, mid-file corruption still
+    raises.  Returns ``{"rows", "torn_tail"}`` with non-sample events
+    filtered out.
+    """
+    loaded = load_journal(path)
+    return {
+        "rows": [
+            e for e in loaded["events"] if e.get("event") == "sample"
+        ],
+        "torn_tail": loaded["torn_tail"],
+    }
+
+
+def merge_series(streams: "Iterable[list[dict]]") -> dict:
+    """Merge N worker sample streams into one canonical fleet series.
+
+    Rows canonicalize (:func:`canonical_sample`), dedup by ``(record,
+    clock)`` — a record killed after a durable sample and replayed by
+    its replacement re-emits the same clock with the same deterministic
+    gauges, and the first copy wins — and sort by ``(record, clock)``:
+    record order, never completion order.  The digest over the canonical
+    lines is the series determinism pin (chaos == uninterrupted).
+
+    Returns ``{"events", "lines", "digest", "samples", "dedup",
+    "workers"}`` where ``workers`` maps each worker id to its raw sample
+    count, last ``seq``, and whether its journal's ``seq`` was strictly
+    monotone (the per-journal integrity bit).
+    """
+    canon: "dict[tuple, dict]" = {}
+    dedup = 0
+    workers: "dict[str, dict]" = {}
+    for rows in streams:
+        for r in rows:
+            if r.get("event") != "sample":
+                continue
+            w = str(r.get("worker", "?"))
+            stats = workers.setdefault(
+                w, {"samples": 0, "last_seq": None, "seq_monotone": True}
+            )
+            stats["samples"] += 1
+            seq = r.get("seq")
+            if seq is not None:
+                if (stats["last_seq"] is not None
+                        and int(seq) <= stats["last_seq"]):
+                    stats["seq_monotone"] = False
+                stats["last_seq"] = int(seq)
+            key = (str(r["record"]), int(r["clock"]))
+            if key in canon:
+                dedup += 1
+                continue
+            canon[key] = canonical_sample(r)
+    events = [canon[k] for k in sorted(canon)]
+    lines = [event_line(e) for e in events]
+    h = hashlib.sha256()
+    for line in lines:
+        h.update(line.encode())
+        h.update(b"\n")
+    return {
+        "events": events,
+        "lines": lines,
+        "digest": h.hexdigest(),
+        "samples": len(events),
+        "dedup": dedup,
+        "workers": {w: dict(s) for w, s in sorted(workers.items())},
+    }
+
+
+def write_series(path: Any, merged: dict) -> str:
+    """Write a merged canonical series (digest line last); returns the
+    digest.  Temp file + fsync + rename — the whole-file twin of the
+    per-row append discipline, same as ``Corpus.write_journal``."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for line in merged["lines"]:
+            f.write(line + "\n")
+        f.write(event_line(
+            {"event": "digest", "sha256": merged["digest"]}
+        ) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return merged["digest"]
+
+
+# -- the trend gate -------------------------------------------------------
+
+def _median(xs: "list[float]") -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def compare_series(
+    rows: "Iterable[dict]",
+    *,
+    stall_samples: int = 5,
+    rps_floor: float = 0.25,
+    gap_k: float = 4.0,
+    gap_min_s: float = 120.0,
+) -> dict:
+    """Trend-gate a fleet's RAW sample rows; mirrors ``compare_benches``.
+
+    Three detectors, each finding naming the worker and record:
+
+    - **discovery_stall** — a ``(worker, record)`` group with at least
+      ``stall_samples`` samples whose coverage union never grew past its
+      first sample: the worker kept burning campaigns without
+      discovering a single new state bit.
+    - **rps_degradation** — a worker whose LAST rounds/sec sample fell
+      below ``rps_floor`` x its own median (>= 4 samples): the shard
+      ended an order slower than it ran, which a fleet-total average
+      would hide.
+    - **heartbeat_gap** — a worker whose largest inter-sample wall gap
+      exceeds both ``gap_k`` x its median gap and the ``gap_min_s``
+      absolute floor: the worker went dark mid-record (the floor keeps
+      honest compile stalls on slow CI out of the findings).
+
+    The rps and gap detectors read the non-canonical ``wall`` sidecar,
+    so they see real delivery behaviour; the stall detector reads only
+    deterministic gauges.  Returns ``{"ok", "compared", "findings",
+    "params"}`` — ``ok`` iff no findings over a nonzero sample set.
+    """
+    groups: "dict[tuple, list[dict]]" = {}
+    by_worker: "dict[str, list[dict]]" = {}
+    compared = 0
+    for r in rows:
+        if r.get("event") != "sample":
+            continue
+        compared += 1
+        w = str(r.get("worker", "?"))
+        groups.setdefault((w, str(r["record"])), []).append(r)
+        by_worker.setdefault(w, []).append(r)
+    findings: "list[dict]" = []
+    union_key = "worker_union_bits"
+    for (w, rec), g in sorted(groups.items()):
+        g = sorted(g, key=lambda r: int(r["clock"]))
+        bits = [r.get("gauges", {}).get(union_key) for r in g]
+        bits = [b for b in bits if b is not None]
+        if len(bits) >= stall_samples and max(bits) <= bits[0]:
+            findings.append({
+                "kind": "discovery_stall", "worker": w, "record": rec,
+                "samples": len(bits), "union_bits": bits[0],
+            })
+    for w, g in sorted(by_worker.items()):
+        g = sorted(g, key=lambda r: int(r.get("seq", 0)))
+        rps = [
+            (r["wall"].get("rps"), r)
+            for r in g
+            if isinstance(r.get("wall"), dict)
+            and r["wall"].get("rps") is not None
+        ]
+        if len(rps) >= 4:
+            med = _median([v for v, _ in rps])
+            last_v, last_r = rps[-1]
+            if med > 0 and last_v < rps_floor * med:
+                findings.append({
+                    "kind": "rps_degradation", "worker": w,
+                    "record": str(last_r["record"]),
+                    "last_rps": round(last_v, 3), "median_rps": round(med, 3),
+                })
+        ts = [
+            (r["wall"]["t"], r)
+            for r in g
+            if isinstance(r.get("wall"), dict) and r["wall"].get("t") is not None
+        ]
+        if len(ts) >= 4:
+            gaps = [
+                (b[0] - a[0], b[1])
+                for a, b in zip(ts, ts[1:])
+            ]
+            med_gap = _median([d for d, _ in gaps])
+            worst, after = max(gaps, key=lambda x: x[0])
+            if worst > gap_min_s and med_gap > 0 and worst > gap_k * med_gap:
+                findings.append({
+                    "kind": "heartbeat_gap", "worker": w,
+                    "record": str(after["record"]),
+                    "gap_s": round(worst, 2),
+                    "median_gap_s": round(med_gap, 2),
+                })
+    return {
+        "ok": compared > 0 and not findings,
+        "compared": compared,
+        "findings": findings,
+        "params": {
+            "stall_samples": stall_samples, "rps_floor": rps_floor,
+            "gap_k": gap_k, "gap_min_s": gap_min_s,
+        },
+    }

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Fleet observatory wrapper: run a sampled chaos fleet, then read back
+# every observatory artifact — the merged metrics time-series, the
+# Perfetto fleet timeline, the corpus lineage table — and finish on the
+# trend gate (`stats --series-gate`), mirroring perf.sh's
+# record-then-gate pattern.  Exit codes follow the fleet family: 0 =
+# clean, 1 = operational failure (budget incomplete, unreadable
+# artifacts), 2 = safety violations or a trend-gate finding
+# (discovery stall / rounds-per-sec degradation / heartbeat gap).
+#
+# Usage: scripts/observatory.sh [DIR] [fleet flags...]
+#   scripts/observatory.sh                    # CPU chaos fuzz fleet in /tmp
+#   scripts/observatory.sh /tmp/obs --records 4 --workers 3
+#
+# Artifacts land under DIR: q/ (the queue root, q/merged_series.jsonl
+# inside), trace.json (load in https://ui.perfetto.dev), corpus.jsonl
+# (feed to `paxos_tpu lineage`).
+cd "$(dirname "$0")/.." || exit 1
+dir="${1:-/tmp/paxos_observatory}"
+case "$dir" in
+  --*) dir="/tmp/paxos_observatory" ;;  # first arg is a fleet flag
+  *) shift ;;
+esac
+rm -rf "$dir"
+mkdir -p "$dir"
+
+python -m paxos_tpu fleet \
+  --config config2 --n-inst 64 --mode fuzz --records 2 \
+  --campaigns-per-record 4 --ticks-per-seed 32 --chunk 16 \
+  --coverage-words 64 --workers 2 --dir "$dir/q" --lease-s 6 \
+  --poll-s 0.2 --timeout-s 420 --chaos --chaos-kills 1 --chaos-seed 7 \
+  --hold-s 1.0 --sample-every 1 --timeline "$dir/trace.json" \
+  --corpus-out "$dir/corpus.jsonl" "$@" >"$dir/report.json"
+fleet_rc=$?
+[ "$fleet_rc" -eq 1 ] && exit 1
+
+echo "# merged time-series ($dir/q/merged_series.jsonl)"
+python -m paxos_tpu stats --fleet-root "$dir/q" || exit 1
+echo "# corpus lineage ($dir/corpus.jsonl)"
+python -m paxos_tpu lineage "$dir/corpus.jsonl" --tree || exit 1
+echo "# trend gate"
+python -m paxos_tpu stats --fleet-root "$dir/q" --series-gate >/dev/null
+gate_rc=$?
+[ "$gate_rc" -ne 0 ] && exit "$gate_rc"
+exit "$fleet_rc"

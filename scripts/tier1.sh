@@ -482,4 +482,77 @@ assert out["seeds"] == 8, out["seeds"]  # every planned seed accounted
 assert out["bench_gate"]["ok"] is True, out["bench_gate"]
 EOF
 fi
+# Observatory smoke: the fleet observatory end to end, chaos ON.  A
+# 2-worker CPU fuzz fleet with per-campaign sampling must produce a
+# merged time-series with monotone seq per worker, a valid Perfetto
+# fleet trace, a lineage tree whose root count equals the planned roots
+# (records x seed_entries, disjoint seed spaces), and a clean trend
+# gate; a hand-planted flat-coverage fixture must exit 2 through
+# `stats --series-gate` naming the stalled worker.
+if [ "$rc" -eq 0 ]; then
+  od=/tmp/_t1_obs; oo=/tmp/_t1_obs.json; rm -rf "$od" "$oo"
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m paxos_tpu fleet \
+    --config config2 --n-inst 64 --mode fuzz --records 2 \
+    --campaigns-per-record 4 --ticks-per-seed 32 --chunk 16 \
+    --coverage-words 64 --workers 2 --dir "$od/q" --lease-s 6 \
+    --poll-s 0.2 --timeout-s 420 --chaos --chaos-kills 1 \
+    --chaos-seed 7 --hold-s 1.0 --sample-every 1 \
+    --timeline "$od/trace.json" --corpus-out "$od/corpus.jsonl" \
+    >"$oo" 2>/dev/null \
+  && timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$oo" "$od" <<'EOF' \
+  && echo OBSERVATORY_SMOKE=ok || { echo OBSERVATORY_SMOKE=FAILED; rc=1; }
+import json, subprocess, sys
+out = json.load(open(sys.argv[1]))
+od = sys.argv[2]
+assert out["completed"] is True and out["chaos"]["kills_done"] == 1, out
+# (a) Merged time-series: every planned campaign sampled once, seq
+# strictly monotone per worker journal.
+series = out["series"]
+assert series["samples"] == 8, series  # 2 records x 4 campaigns
+assert all(w["seq_monotone"] for w in series["workers"].values()), series
+# (b) Clean trend gate on a healthy chaos run.
+assert out["series_gate"]["ok"] is True, out["series_gate"]
+# (c) Perfetto fleet trace: schema-valid, a track per worker plus the
+# fleet-aggregate counter tracks.
+from paxos_tpu.obs.export import validate_chrome_trace
+trace = json.load(open(f"{od}/trace.json"))
+assert validate_chrome_trace(trace) == []
+procs = {e["args"]["name"] for e in trace["traceEvents"]
+         if e["ph"] == "M" and e["name"] == "process_name"}
+workers = {p for p in procs if p.startswith("worker ")}
+assert len(workers) >= 2 and "fleet coordinator" in procs, procs
+counters = {e["name"] for e in trace["traceEvents"] if e["ph"] == "C"}
+assert {"fleet_records_done", "fleet_queue_depth",
+        "union_bits"} <= counters, counters
+# (d) Lineage: root count equals planned roots (disjoint seed spaces,
+# so the merge dedups nothing), attribution sums match the journal.
+assert out["lineage"]["roots"] == 4, out["lineage"]  # 2 recs x 2 entries
+p = subprocess.run(
+    [sys.executable, "-m", "paxos_tpu", "lineage", f"{od}/corpus.jsonl",
+     "--json"], capture_output=True, text=True)
+assert p.returncode == 0, p.stderr
+lin = json.loads(p.stdout)
+assert lin["summary"]["roots"] == 4, lin["summary"]
+fb = [e for e in map(json.loads, open(f"{od}/corpus.jsonl"))
+      if e.get("event") == "feedback"]
+assert lin["totals"]["new_bits"] == sum(e["new_bits"] for e in fb), lin
+# (e) Planted stall fixture: flat coverage for 6 samples must exit 2
+# through the stats trend gate, naming the worker.
+import pathlib
+from paxos_tpu.fuzz.corpus import append_event
+from paxos_tpu.obs.timeseries import sample_row
+fake = pathlib.Path(od) / "fake"
+(fake / "series").mkdir(parents=True)
+with open(fake / "series" / "w0.jsonl", "a") as fh:
+    for clock in range(6):
+        append_event(fh, sample_row(
+            worker="w0", record="c00000", attempt=0, seq=clock,
+            clock=clock, gauges={"worker_union_bits": 64}))
+p = subprocess.run(
+    [sys.executable, "-m", "paxos_tpu", "stats", "--fleet-root",
+     str(fake), "--series-gate"], capture_output=True, text=True)
+assert p.returncode == 2, (p.returncode, p.stdout, p.stderr)
+assert "w0" in p.stderr and "discovery_stall" in p.stderr, p.stderr
+EOF
+fi
 exit $rc

@@ -189,6 +189,57 @@ def test_registry_ingest_fleet_gauges():
     assert "paxos_tpu_fleet_queue_depth 0" in text
 
 
+def test_registry_ingest_fleet_worker_label_no_collision():
+    """The PR 16 collision fix, pinned: per-worker blocks land as
+    worker-labeled series BESIDE the unlabeled aggregate — N workers are
+    N series, the last-ingested block no longer wins."""
+    reg = MetricsRegistry()
+    reg.ingest_fleet({"records_done": 4, "queue_depth": 0})  # aggregate
+    reg.ingest_fleet({"records": 3, "seeds": 12, "rounds": 600,
+                      "violations": 1}, worker="w0")
+    reg.ingest_fleet({"records": 1, "seeds": 4, "rounds": 200,
+                      "violations": 0}, worker="w1r")
+    assert reg.snapshot()["gauges"] == {
+        "fleet_records_done": 4,
+        "fleet_queue_depth": 0,
+        "fleet_records{worker=w0}": 3,
+        "fleet_records{worker=w1r}": 1,
+        "fleet_seeds{worker=w0}": 12,
+        "fleet_seeds{worker=w1r}": 4,
+        "fleet_rounds{worker=w0}": 600,
+        "fleet_rounds{worker=w1r}": 200,
+        "fleet_violations{worker=w0}": 1,
+        "fleet_violations{worker=w1r}": 0,
+    }
+    text = reg.to_prometheus()
+    assert 'paxos_tpu_fleet_seeds{worker="w0"} 12' in text
+    assert 'paxos_tpu_fleet_seeds{worker="w1r"} 4' in text
+    # Label values go through the exposition escaping (reused helper).
+    reg.ingest_fleet({"records": 1}, worker='w"x')
+    assert 'paxos_tpu_fleet_records{worker="w\\"x"} 1' in reg.to_prometheus()
+
+
+def test_registry_ingest_lineage_gauges():
+    """Lineage roll-up + per-op attribution land as lineage_* gauges
+    with op-labeled payoff series."""
+    reg = MetricsRegistry()
+    reg.ingest_lineage(
+        {"entries": 8, "roots": 4, "executed": 8, "retired": 1,
+         "depth_max": 2, "best_fitness": 99.5},
+        ops={"add-skew": {"campaigns": 0.5, "new_bits": 59,
+                          "effective": 0, "violations": 0,
+                          "margin_tightened": 0, "fitness": 59.0}},
+    )
+    g = reg.snapshot()["gauges"]
+    assert g["lineage_entries"] == 8
+    assert g["lineage_roots"] == 4
+    assert g["lineage_best_fitness"] == 99.5
+    assert g["lineage_op_new_bits{op=add-skew}"] == 59
+    assert 'paxos_tpu_lineage_op_new_bits{op="add-skew"} 59' in (
+        reg.to_prometheus()
+    )
+
+
 def _tiny_state(protocol: str):
     from paxos_tpu.harness import config as C
     from paxos_tpu.harness.run import (

@@ -1,0 +1,572 @@
+"""Fleet observatory: time-series journal, merge, trend gate, lineage.
+
+The load-bearing contracts under test:
+
+- the time-series journal has the corpus journal's crash-safety (torn
+  final line dropped, everything before it recovered, mid-file
+  corruption loud);
+- ``merge_series`` is canonical — shuffled worker completion order and
+  replayed (duplicate-clock) rows produce byte-identical merged series;
+- sampled fleet recovery is byte-identical: a preempted + resumed/
+  replayed record's merged series equals the uninterrupted baseline's;
+- ``compare_series`` names the worker and record for every finding and
+  stays quiet on healthy runs;
+- the lineage plane reconstructs the family tree (re-parented and
+  retired entries included) and its per-op attribution sums match the
+  journal's feedback totals EXACTLY;
+- the fleet Chrome trace passes ``validate_chrome_trace`` with a track
+  per worker plus fleet-aggregate counters.
+"""
+
+import json
+
+import pytest
+
+from paxos_tpu.fuzz.corpus import append_event, event_line, load_journal
+from paxos_tpu.fuzz.lineage import (
+    build_lineage,
+    lineage_summary,
+    margin_tightened,
+    op_attribution,
+    render_op_table,
+    render_tree,
+)
+from paxos_tpu.obs.export import fleet_chrome_trace, validate_chrome_trace
+from paxos_tpu.obs.timeseries import (
+    SeriesSampler,
+    compare_series,
+    load_series,
+    merge_series,
+    sample_row,
+    write_series,
+)
+
+
+class _Reg:
+    """Stand-in for MetricsRegistry.snapshot() (no jax import needed)."""
+
+    def __init__(self, gauges):
+        self.gauges = gauges
+
+    def snapshot(self):
+        return {"gauges": dict(self.gauges)}
+
+
+# -- journal crash-safety -------------------------------------------------
+
+def _write_samples(path, worker, n, every=1, record="c00000"):
+    with open(path, "a") as fh:
+        s = SeriesSampler(fh, worker, every=every)
+        for clock in range(n):
+            s.sample(record=record, attempt=0, clock=clock,
+                     registry=_Reg({"worker_union_bits": 10 + clock}))
+    return s
+
+
+def test_sampler_cadence_and_seq(tmp_path):
+    p = tmp_path / "w0.jsonl"
+    s = _write_samples(p, "w0", 6, every=2)
+    assert s.samples == 3 and s.seq == 3  # clocks 0, 2, 4
+    loaded = load_series(p)
+    assert not loaded["torn_tail"]
+    rows = loaded["rows"]
+    assert [r["clock"] for r in rows] == [0, 2, 4]
+    assert [r["seq"] for r in rows] == [0, 1, 2]
+    assert all(r["worker"] == "w0" and r["record"] == "c00000"
+               for r in rows)
+    assert rows[0]["gauges"] == {"worker_union_bits": 10}
+
+
+def test_sampler_off_writes_nothing(tmp_path):
+    p = tmp_path / "w0.jsonl"
+    with open(p, "a") as fh:
+        s = SeriesSampler(fh, "w0", every=0)
+        assert not s.sample(record="c00000", attempt=0, clock=0,
+                            registry=_Reg({}))
+    assert p.read_text() == ""
+
+
+def test_torn_tail_mid_line_recovers(tmp_path):
+    """A crash mid-append tears the final line at an arbitrary byte —
+    every earlier row must survive, at every possible tear point."""
+    p = tmp_path / "w0.jsonl"
+    _write_samples(p, "w0", 3)
+    whole = p.read_text()
+    lines = whole.splitlines(keepends=True)
+    last = lines[-1]
+    for cut in range(len(last) - 1):  # tear anywhere inside the record
+        torn = tmp_path / f"torn{cut}.jsonl"
+        torn.write_text("".join(lines[:-1]) + last[:cut])
+        loaded = load_series(torn)
+        assert loaded["torn_tail"] == (cut > 0)
+        assert [r["clock"] for r in loaded["rows"]] == [0, 1]
+
+
+def test_torn_tail_mid_record_boundary(tmp_path):
+    """Truncation exactly at a line boundary is a clean (shorter)
+    journal, not a torn tail."""
+    p = tmp_path / "w0.jsonl"
+    _write_samples(p, "w0", 3)
+    lines = p.read_text().splitlines(keepends=True)
+    p.write_text("".join(lines[:2]))
+    loaded = load_series(p)
+    assert not loaded["torn_tail"]
+    assert [r["clock"] for r in loaded["rows"]] == [0, 1]
+
+
+def test_mid_file_corruption_raises(tmp_path):
+    p = tmp_path / "w0.jsonl"
+    _write_samples(p, "w0", 3)
+    lines = p.read_text().splitlines(keepends=True)
+    p.write_text(lines[0] + "{garbage\n" + lines[2])
+    with pytest.raises(ValueError):
+        load_series(p)
+
+
+# -- merge determinism ----------------------------------------------------
+
+def _rows(worker, record, clocks, seq0=0, bits=None):
+    return [
+        sample_row(worker=worker, record=record, attempt=0, seq=seq0 + i,
+                   clock=c,
+                   gauges={"worker_union_bits": (bits or {}).get(c, c)})
+        for i, c in enumerate(clocks)
+    ]
+
+
+def test_merge_shuffled_streams_byte_identical():
+    """Stream order is completion order — the merge must not care."""
+    a = _rows("w0", "c00000", [0, 1, 2])
+    b = _rows("w1", "c00001", [0, 1, 2])
+    c = _rows("w2", "c00002", [0, 1])
+    m1 = merge_series([a, b, c])
+    m2 = merge_series([c, a, b])
+    m3 = merge_series([b, c, a])
+    assert m1["digest"] == m2["digest"] == m3["digest"]
+    assert m1["lines"] == m2["lines"]
+    assert m1["samples"] == 8 and m1["dedup"] == 0
+    # Canonical order: by record then clock, never by arrival.
+    keys = [(e["record"], e["clock"]) for e in m1["events"]]
+    assert keys == sorted(keys)
+
+
+def test_merge_dedups_replayed_clocks():
+    """A killed worker's durable samples + its replacement's full replay
+    carry duplicate (record, clock) keys with identical deterministic
+    gauges — one copy survives and the digest matches a clean run."""
+    clean = merge_series([_rows("w0", "c00000", [0, 1, 2, 3])])
+    dead = _rows("w0", "c00000", [0, 1])  # killed after clock 1
+    replay = _rows("w1r", "c00000", [0, 1, 2, 3])  # atomic re-run
+    chaos = merge_series([dead, replay])
+    assert chaos["dedup"] == 2
+    assert chaos["digest"] == clean["digest"]
+    assert chaos["workers"]["w0"]["samples"] == 2
+    assert chaos["workers"]["w1r"]["seq_monotone"] is True
+
+
+def test_merge_flags_non_monotone_seq():
+    bad = _rows("w0", "c00000", [0, 1]) + _rows("w0", "c00001", [0])
+    # Third row restarts seq at 0 — a corrupted or spliced journal.
+    assert merge_series([bad])["workers"]["w0"]["seq_monotone"] is False
+    good = _rows("w0", "c00000", [0, 1]) + _rows(
+        "w0", "c00001", [0], seq0=2
+    )
+    assert merge_series([good])["workers"]["w0"]["seq_monotone"] is True
+
+
+def test_write_series_roundtrip(tmp_path):
+    merged = merge_series([_rows("w0", "c00000", [0, 1])])
+    out = tmp_path / "merged.jsonl"
+    digest = write_series(out, merged)
+    loaded = load_journal(out)
+    assert not loaded["torn_tail"]
+    assert loaded["digest"] == digest  # trailing digest line, separated
+    canon = [e for e in loaded["events"] if e["event"] == "sample"]
+    assert [event_line(e) for e in canon] == merged["lines"]
+    assert "worker" not in canon[0] and "seq" not in canon[0]
+
+
+# -- the trend gate -------------------------------------------------------
+
+def test_compare_series_clean_run_is_ok():
+    rows = _rows("w0", "c00000", list(range(6)),
+                 bits={c: 100 + 10 * c for c in range(6)})
+    gate = compare_series(rows)
+    assert gate["ok"] and gate["compared"] == 6
+    assert gate["findings"] == []
+
+
+def test_compare_series_discovery_stall_names_worker_and_record():
+    flat = {c: 64 for c in range(6)}
+    rows = _rows("w0", "c00000", list(range(6)), bits=flat)
+    rows += _rows("w1", "c00001", list(range(6)),
+                  bits={c: 10 * (c + 1) for c in range(6)})
+    gate = compare_series(rows)
+    assert not gate["ok"]
+    assert [f["kind"] for f in gate["findings"]] == ["discovery_stall"]
+    f = gate["findings"][0]
+    assert f["worker"] == "w0" and f["record"] == "c00000"
+    # Below the sample threshold the same flat series is not a finding
+    # (a short record legitimately plateaus).
+    short = _rows("w0", "c00000", list(range(4)), bits=flat)
+    assert compare_series(short)["findings"] == []
+
+
+def _wall_rows(worker, record, walls):
+    rows = _rows(worker, record, list(range(len(walls))),
+                 bits={c: 10 * (c + 1) for c in range(len(walls))})
+    for r, w in zip(rows, walls):
+        r["wall"] = w
+    return rows
+
+
+def test_compare_series_rps_degradation():
+    rows = _wall_rows("w0", "c00000", [
+        {"t": 0.0, "rps": 100.0}, {"t": 1.0, "rps": 110.0},
+        {"t": 2.0, "rps": 90.0}, {"t": 3.0, "rps": 10.0},
+    ])
+    gate = compare_series(rows)
+    assert [f["kind"] for f in gate["findings"]] == ["rps_degradation"]
+    f = gate["findings"][0]
+    assert f["worker"] == "w0" and f["record"] == "c00000"
+    assert f["last_rps"] == 10.0
+
+
+def test_compare_series_heartbeat_gap():
+    rows = _wall_rows("w0", "c00000", [
+        {"t": 0.0, "rps": 100.0}, {"t": 10.0, "rps": 100.0},
+        {"t": 20.0, "rps": 100.0}, {"t": 300.0, "rps": 100.0},
+    ])
+    gate = compare_series(rows)
+    assert [f["kind"] for f in gate["findings"]] == ["heartbeat_gap"]
+    f = gate["findings"][0]
+    assert f["worker"] == "w0" and f["gap_s"] == 280.0
+    # The absolute floor keeps small-scale gaps (slow CI) quiet even
+    # when they dwarf the median.
+    calm = _wall_rows("w0", "c00000", [
+        {"t": 0.0, "rps": 1.0}, {"t": 1.0, "rps": 1.0},
+        {"t": 2.0, "rps": 1.0}, {"t": 60.0, "rps": 1.0},
+    ])
+    assert compare_series(calm)["findings"] == []
+
+
+def test_compare_series_empty_is_not_ok():
+    gate = compare_series([])
+    assert not gate["ok"] and gate["compared"] == 0
+
+
+# -- unified fleet timeline ----------------------------------------------
+
+def test_fleet_chrome_trace_validates():
+    timeline = {
+        "t0": 1000.0,
+        "instants": [
+            {"t": 1000.0, "name": "spawn", "worker": "w0"},
+            {"t": 1000.1, "name": "spawn", "worker": "w1"},
+            {"t": 1001.0, "name": "claim", "worker": "w0",
+             "args": {"record": "c00000"}},
+            {"t": 1001.5, "name": "sigkill", "worker": "w1"},
+            {"t": 1002.0, "name": "reclaim"},
+            {"t": 1002.5, "name": "lease_renew", "worker": "w0"},
+        ],
+        "spans": [
+            {"worker": "w0", "record": "c00000", "attempt": 0,
+             "t_start": 1001.0, "t_end": 1004.0},
+            {"worker": "w1", "record": "c00001", "attempt": 0,
+             "t_start": 1001.2, "t_end": 1001.5},
+        ],
+        "gauges": [
+            {"t": 1001.0, "gauges": {"records_done": 0, "queue_depth": 2,
+                                     "workers_alive": 2}},
+            {"t": 1004.0, "gauges": {"records_done": 2, "queue_depth": 0,
+                                     "workers_alive": 1}},
+        ],
+    }
+    rows = _wall_rows("w0", "c00000", [
+        {"t": 1001.5, "rps": 50.0}, {"t": 1002.5, "rps": 60.0},
+    ])
+    trace = fleet_chrome_trace(timeline, rows, meta={"records": 2})
+    assert validate_chrome_trace(trace) == []
+    events = trace["traceEvents"]
+    procs = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert procs == {"fleet coordinator", "worker w0", "worker w1"}
+    counters = {(e["pid"], e["name"]) for e in events if e["ph"] == "C"}
+    assert ("fleet_records_done" in {n for _, n in counters})
+    assert any(n == "union_bits" for _, n in counters)
+    # Worker tracks are distinct pids; spans live on their worker's pid.
+    span_pids = {e["pid"] for e in events if e["ph"] == "b"}
+    assert len(span_pids) == 2 and 1 not in span_pids
+
+
+def test_fleet_chrome_trace_clamps_degenerate_spans():
+    """A span whose end precedes its start (clock skew between observer
+    ticks) must clamp, not produce a negative-duration pair."""
+    timeline = {"t0": 100.0, "instants": [], "gauges": [], "spans": [
+        {"worker": "w0", "record": "c00000", "attempt": 1,
+         "t_start": 101.0, "t_end": 100.5},
+    ]}
+    trace = fleet_chrome_trace(timeline)
+    assert validate_chrome_trace(trace) == []
+    b = next(e for e in trace["traceEvents"] if e["ph"] == "b")
+    e = next(e for e in trace["traceEvents"] if e["ph"] == "e")
+    assert e["ts"] == b["ts"]
+
+
+# -- corpus lineage -------------------------------------------------------
+
+_J = [
+    {"event": "add", "id": 0, "seed": 5, "parent": None, "ops": [],
+     "root": True, "atoms_digest": "a0"},
+    {"event": "feedback", "id": 0, "fingerprint": "f0", "new_bits": 100,
+     "effective": {"crash": 2}, "min_quorum_slack": None,
+     "violations": 0, "fitness": 100.0},
+    {"event": "add", "id": 1, "seed": 5, "parent": 0,
+     "ops": ["add-partition", "add-skew"], "root": False,
+     "atoms_digest": "a1"},
+    {"event": "feedback", "id": 1, "fingerprint": "f1", "new_bits": 30,
+     "effective": {"partition": 4}, "min_quorum_slack": 2,
+     "violations": 0, "fitness": 60.5},
+    {"event": "add", "id": 2, "seed": 5, "parent": 1,
+     "ops": ["ballot-pressure"], "root": False, "atoms_digest": "a2"},
+    {"event": "feedback", "id": 2, "fingerprint": "f2", "new_bits": 7,
+     "effective": {"partition": 4}, "min_quorum_slack": 1,
+     "violations": 1, "fitness": 33.25},
+    {"event": "retire", "id": 1, "reason": "plateau"},
+    # A merge-re-parented entry: its original parent deduped away, the
+    # merge re-linked it onto the surviving id 0.
+    {"event": "add", "id": 3, "seed": 9, "parent": 0,
+     "ops": ["add-delay", "add-skew"], "root": False,
+     "atoms_digest": "a3"},
+]
+
+
+def test_build_lineage_tree_reconstruction():
+    lin = build_lineage(_J)
+    assert lin["roots"] == [0]
+    assert lin["order"] == [0, 1, 2, 3]
+    n = lin["nodes"]
+    assert n[0]["children"] == [1, 3]  # re-parented child linked
+    assert n[1]["children"] == [2]
+    assert [n[i]["depth"] for i in (0, 1, 2, 3)] == [0, 1, 2, 1]
+    assert lin["depth_max"] == 2
+    assert n[1]["retired"] == "plateau"
+    assert n[3]["executed"] is False and n[3]["new_bits"] is None
+    s = lineage_summary(lin)
+    assert s == {"entries": 4, "roots": 1, "executed": 3, "retired": 1,
+                 "depth_max": 2, "best_fitness": 100.0}
+
+
+def test_margin_tightened_semantics():
+    lin = build_lineage(_J)
+    n = lin["nodes"]
+    assert margin_tightened(n[0], n) is False  # uncontested
+    assert margin_tightened(n[1], n) is True  # parent uncontested
+    assert margin_tightened(n[2], n) is True  # 1 < 2, strictly tighter
+    equal = dict(n[2], min_quorum_slack=2)
+    assert margin_tightened(equal, n) is False  # equal is not tighter
+
+
+def test_op_attribution_sums_match_feedback_totals_exactly():
+    """The acceptance cross-check: per-op columns sum back to totals
+    computed independently from the raw feedback events — exactly."""
+    lin = build_lineage(_J)
+    att = op_attribution(lin)
+    fb = [e for e in _J if e["event"] == "feedback"]
+    assert att["totals"]["campaigns"] == len(fb)
+    assert att["totals"]["new_bits"] == sum(e["new_bits"] for e in fb)
+    assert att["totals"]["violations"] == sum(e["violations"] for e in fb)
+    assert att["totals"]["effective"] == sum(
+        sum(e["effective"].values()) for e in fb
+    )
+    assert att["totals"]["fitness"] == sum(e["fitness"] for e in fb)
+    # Exact column sums via the Fraction ledger — no rounding drift.
+    for col, total in att["_exact_totals"].items():
+        assert sum(v[col] for v in att["_exact"].values()) == total
+    # Equal split: entry 1's feedback halves across its two ops.
+    assert att["ops"]["add-partition"]["new_bits"] == 15
+    assert att["ops"]["add-skew"]["new_bits"] == 15
+    assert att["ops"]["ballot-pressure"]["new_bits"] == 7
+    assert att["ops"]["root"]["campaigns"] == 1
+    # The unexecuted re-parented entry contributes nothing.
+    assert "add-delay" not in att["ops"]
+
+
+def test_lineage_renders():
+    lin = build_lineage(_J)
+    tree = render_tree(lin)
+    assert "#0 seed=5 ops=root" in tree
+    assert "[retired: plateau]" in tree
+    assert "(pending)" in tree
+    table = render_op_table(op_attribution(lin))
+    assert table.splitlines()[0].startswith("op")
+    assert "TOTAL" in table.splitlines()[-1]
+    assert "add-skew" in table
+
+
+# -- sampled fleet recovery (in-process, jax) -----------------------------
+
+from paxos_tpu.fleet.coordinator import plan_records  # noqa: E402
+from paxos_tpu.fleet.queue import CampaignQueue  # noqa: E402
+from paxos_tpu.fleet.worker import WorkerPreempted, run_record  # noqa: E402
+
+_SOAK_KW = dict(
+    config="config2", n_inst=64, fault=[], seed=0, records=2,
+    seeds_per_record=2, ticks_per_seed=32, chunk=16, coverage_words=64,
+)
+
+
+def _run_all_sampled(queue, records, preempt_first=None):
+    """Drain a queue in-process with per-worker samplers attached (the
+    test_fleet _run_all pattern + the observatory), returning the merged
+    series over every worker journal written."""
+    for rec in records:
+        queue.enqueue(rec)
+    fhs, samplers = {}, {}
+
+    def sampler_for(w):
+        if w not in samplers:
+            fhs[w] = open(queue.series_path(w), "a")
+            samplers[w] = SeriesSampler(fhs[w], w, every=1)
+        return samplers[w]
+
+    preempted = False
+    wid = "w0"
+    try:
+        while True:
+            claim = queue.claim(wid, now=0.0, lease_s=10.0)
+            if claim is None:
+                break
+            rec_id, record = claim
+            if preempt_first is not None and not preempted:
+                preempted = True
+                with pytest.raises(WorkerPreempted):
+                    run_record(queue, rec_id, record, wid,
+                               stop_after_seeds=preempt_first,
+                               sampler=sampler_for(wid))
+                assert queue.reclaim_expired(now=1e9) == [rec_id]
+                wid = "w1"  # the replacement claims it next pass
+                continue
+            res = run_record(queue, rec_id, record, wid,
+                             sampler=sampler_for(wid))
+            queue.complete(rec_id, wid, res)
+    finally:
+        for fh in fhs.values():
+            fh.close()
+    streams = [
+        load_series(p)["rows"]
+        for p in sorted((queue.root / "series").glob("*.jsonl"))
+    ]
+    return merge_series(streams)
+
+
+def test_soak_recovery_series_matches_uninterrupted(tmp_path):
+    """A soak record preempted after one durable (sample, progress)
+    pair and resumed by another worker yields a merged time-series
+    byte-identical to the uninterrupted baseline's: the resumed record
+    skips already-sampled clocks and its cumulative gauges pick up from
+    the durable progress."""
+    records = plan_records(mode="soak", **_SOAK_KW)
+    base = _run_all_sampled(CampaignQueue(tmp_path / "base"), records)
+    rec = _run_all_sampled(CampaignQueue(tmp_path / "rec"), records,
+                           preempt_first=1)
+    assert base["samples"] == 4  # 2 records x 2 seeds, every=1
+    assert rec["digest"] == base["digest"]
+    assert rec["lines"] == base["lines"]
+    assert all(w["seq_monotone"] for w in rec["workers"].values())
+
+
+def test_fuzz_recovery_series_matches_uninterrupted(tmp_path):
+    """Fuzz records replay atomically: the replacement re-emits the dead
+    worker's clocks with identical deterministic gauges, merge dedup
+    collapses them, and the digest matches the clean baseline."""
+    records = plan_records(
+        mode="fuzz", config="config2", n_inst=64, fault=[], seed=0,
+        records=2, seeds_per_record=0, ticks_per_seed=32, chunk=16,
+        coverage_words=64, seed_stride=100, rng_seed=0,
+        campaigns_per_record=3,
+    )
+    base = _run_all_sampled(CampaignQueue(tmp_path / "base"), records)
+    rec = _run_all_sampled(CampaignQueue(tmp_path / "rec"), records,
+                           preempt_first=2)
+    assert base["samples"] == 6  # 2 records x 3 campaigns
+    assert rec["dedup"] == 2  # the preempted attempt's durable clocks
+    assert rec["digest"] == base["digest"]
+
+
+def test_work_loop_sampling_off_writes_no_journal(tmp_path):
+    """Default-off-is-free: sample_every=0 opens no file and the series
+    directory stays empty; turning it on writes the journal."""
+    from paxos_tpu.fleet.worker import work_loop
+
+    records = plan_records(mode="soak", **dict(_SOAK_KW, records=1,
+                                               seeds_per_record=1))
+    q = CampaignQueue(tmp_path / "off")
+    for r in records:
+        q.enqueue(r)
+    stats = work_loop(tmp_path / "off", "w0", lease_s=30.0, poll_s=0.05)
+    assert stats["records_done"] == 1
+    assert "samples" not in stats
+    assert list((tmp_path / "off" / "series").glob("*")) == []
+
+    q2 = CampaignQueue(tmp_path / "on")
+    for r in records:
+        q2.enqueue(r)
+    stats = work_loop(tmp_path / "on", "w0", lease_s=30.0, poll_s=0.05,
+                      sample_every=1)
+    assert stats["samples"] == 1
+    rows = load_series(q2.series_path("w0"))["rows"]
+    assert len(rows) == 1 and rows[0]["worker"] == "w0"
+
+
+def test_planted_stall_fixture_exits_2_via_stats(tmp_path):
+    """The satellite wiring end to end: a hand-planted fleet root with a
+    flat-coverage worker drives `stats --fleet-root --series-gate` to
+    exit 2 naming the worker (the tier-1 smoke's negative leg uses the
+    same fixture shape)."""
+    root = tmp_path / "fake"
+    (root / "series").mkdir(parents=True)
+    with open(root / "series" / "w0.jsonl", "a") as fh:
+        for clock in range(6):
+            append_event(fh, sample_row(
+                worker="w0", record="c00000", attempt=0, seq=clock,
+                clock=clock, gauges={"worker_union_bits": 64,
+                                     "worker_seeds": clock + 1},
+            ))
+    rows = load_series(root / "series" / "w0.jsonl")["rows"]
+    gate = compare_series(rows)
+    assert not gate["ok"]
+    assert gate["findings"][0]["kind"] == "discovery_stall"
+    assert gate["findings"][0]["worker"] == "w0"
+
+    from paxos_tpu.harness.cli import main
+
+    rc = main(["--platform", "cpu", "stats", "--fleet-root", str(root),
+               "--series-gate"])
+    assert rc == 2
+
+
+def test_stats_fleet_root_renders_last_samples(tmp_path, capsys):
+    root = tmp_path / "fleet"
+    (root / "series").mkdir(parents=True)
+    for w, bits in (("w0", 10), ("w1", 20)):
+        with open(root / "series" / f"{w}.jsonl", "a") as fh:
+            for clock in range(2):
+                append_event(fh, sample_row(
+                    worker=w, record="c00000", attempt=0, seq=clock,
+                    clock=clock,
+                    gauges={"worker_union_bits": bits + clock,
+                            "worker_seeds": clock + 1,
+                            "worker_rounds": 100 * (clock + 1),
+                            "worker_violations": 0},
+                ))
+    from paxos_tpu.harness.cli import main
+
+    assert main(["--platform", "cpu", "stats",
+                 "--fleet-root", str(root)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["metric"] == "fleet_series"
+    assert out["fleet"]["workers"] == 2
+    assert out["fleet"]["seeds"] == 4 and out["fleet"]["rounds"] == 400
+    assert out["workers"]["w0"]["clock"] == 1
+    assert out["workers"]["w1"]["gauges"]["worker_union_bits"] == 21
