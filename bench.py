@@ -144,12 +144,23 @@ def _configs(platform: str):
     mar_cfg = dataclasses.replace(
         config2_dueling_drop(n_inst=n), margin=MarginConfig(counters=True)
     )
+    # Workload-overhead row: flagship config with the client-workload
+    # plane on (one arrival draw per tick plus the ring/histogram folds).
+    # OFF is gated free by the base row; this row prices ON — the only
+    # plane whose ON cost includes a PRNG draw.
+    from paxos_tpu.workload.generator import WorkloadConfig
+
+    wl_cfg = dataclasses.replace(
+        config2_dueling_drop(n_inst=n),
+        workload=WorkloadConfig(mix="mixed", rate=0.1),
+    )
     cases = [
         ("config2-paxos", config2_dueling_drop(n_inst=n), 1024, 1),
         ("config2-paxos-telemetry", tel_cfg, 1024, 1),
         ("config2-paxos-coverage", cov_cfg, 1024, 1),
         ("config2-paxos-exposure", exp_cfg, 1024, 1),
         ("config2-paxos-margin", mar_cfg, 1024, 1),
+        ("config2-paxos-workload", wl_cfg, 1024, 1),
         ("config5-fastpaxos", sweep["fastpaxos"], 256, 1),
         ("config5-raftcore", sweep["raftcore"], 256, 1),
         ("config3-multipaxos", config3_multipaxos(n_inst=n), 256, 1),

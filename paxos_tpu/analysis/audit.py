@@ -115,9 +115,12 @@ def run_audit(
             plan = trace_mod.trace_plan_sample(cfg)
             traces[config_name] = (xla, ctr)
             f = cfg.fault
-            findings += prng_audit.audit_xla_folds(protocol, config_name, xla, f)
+            wload_on = cfg.workload.enabled()
+            findings += prng_audit.audit_xla_folds(
+                protocol, config_name, xla, f, wload_on=wload_on
+            )
             findings += prng_audit.audit_counter_streams(
-                protocol, config_name, ctr, f
+                protocol, config_name, ctr, f, wload_on=wload_on
             )
             findings += prng_audit.audit_dead_draws(protocol, config_name, xla)
             findings += prng_audit.audit_plan_folds(
@@ -140,7 +143,13 @@ def run_audit(
             findings += flow_mod.audit_eqn_budget(
                 protocol, config_name, xla, ctr
             )
-            checks += 8
+            # The arrival-sampling/queue scope must appear exactly when
+            # the workload plane is on (both engines fold the queue under
+            # workload.generator.WLOAD_SCOPE).
+            findings += flow_mod.audit_wload_scope(
+                protocol, config_name, wload_on, xla, ctr
+            )
+            checks += 9
             if structure:
                 findings += struct_mod.audit_default_off_leaves(
                     protocol, config_name, cfg
@@ -166,6 +175,16 @@ def run_audit(
                 protocol,
                 traces["default"][0], traces["margin"][0],
                 traces["default"][1], traces["margin"][1],
+            )
+            checks += 1
+        if "default" in traces and "workload" in traces:
+            # Not a pure observer: the workload plane legitimately draws
+            # the arrival stream, so parity means "exactly that draw and
+            # nothing else" (see prng_audit.audit_workload_parity).
+            findings += prng_audit.audit_workload_parity(
+                protocol,
+                traces["default"][0], traces["workload"][0],
+                traces["default"][1], traces["workload"][1],
             )
             checks += 1
         if "gray-chaos" in traces and "exposure" in traces:

@@ -52,8 +52,13 @@ from paxos_tpu.analysis.jaxpr_tools import Literal, is_prng_eqn
 from paxos_tpu.faults.injector import INJECTOR_FAULT_SITES
 
 # Leaf-path prefixes of the observer planes (theorem 1 seeds; also the
-# exempt sinks for theorems 1 and 2 — observers may read anything).
-OBSERVER_PREFIXES = ("telemetry.", "coverage.", "exposure.", "margin.")
+# exempt sinks for theorems 1 and 2 — observers may read anything).  The
+# client-workload queue counts here too: its arrival RANDOMNESS rides a
+# registered stream (audited by prng_audit.audit_workload_parity), but
+# its STATE must never steer the protocol — open-loop means the queue
+# observes the commit edge, it does not gate proposals.
+OBSERVER_PREFIXES = ("telemetry.", "coverage.", "exposure.", "margin.",
+                     "wload.")
 
 # Leaf-path prefix of the safety checker's state (checker-isolation seeds).
 CHECKER_PREFIX = "learner."
@@ -860,6 +865,62 @@ def audit_eqn_budget(
                     },
                 )
             )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Client-workload scope registration: both engines fold the queue under
+# workload.generator.WLOAD_SCOPE (a jax.named_scope, zero device ops), so
+# the tag's presence in a traced step is exactly "the queue fold traced".
+
+
+def _has_scope(closed, tag: str) -> bool:
+    from paxos_tpu.analysis.jaxpr_tools import iter_eqns
+
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    for eqn in iter_eqns(jaxpr):
+        try:
+            if tag in str(eqn.source_info.name_stack):
+                return True
+        except Exception:
+            continue
+    return False
+
+
+def audit_wload_scope(
+    protocol: str, config_name: str, wload_on: bool, xla, ctr
+) -> "list[Finding]":
+    """The arrival-sampling/queue scope appears iff the workload is on.
+
+    On with the tag absent = the queue fold silently no-oped (the SLO
+    report would read all-zero and look like a perfectly idle system);
+    off with the tag present = default-off is violated structurally even
+    if the PRNG half happened to stay clean."""
+    from paxos_tpu.workload.generator import WLOAD_SCOPE
+
+    findings = []
+    for kind, closed in (("xla step", xla), ("fused tick", ctr)):
+        where = f"{protocol}/{config_name} {kind}"
+        present = _has_scope(closed, WLOAD_SCOPE)
+        if wload_on and not present:
+            findings.append(Finding(
+                check="wload-scope", where=where,
+                message=(
+                    f"workload plane is ON for {where} but the "
+                    f"{WLOAD_SCOPE!r} scope never traced: the client-queue "
+                    f"fold silently no-oped (wload leaf missing or the "
+                    f"protocol's observe() hook was dropped)"
+                ),
+            ))
+        elif not wload_on and present:
+            findings.append(Finding(
+                check="wload-scope", where=where,
+                message=(
+                    f"{WLOAD_SCOPE!r} scope traced in {where} although the "
+                    f"workload plane is off: the queue fold must trace "
+                    f"away when cfg.workload.mix == 'off'"
+                ),
+            ))
     return findings
 
 

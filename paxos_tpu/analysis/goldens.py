@@ -53,55 +53,70 @@ layouts bumped to *-packed-v4 (the ``until`` stamps ride the full-int32
 passthrough, no packed word changed) and the synchpaxos rows landed
 (synchpaxos-packed-v1 shares the classic single-decree widths).  The new
 "delay-chaos" audit column pins the delay-lit trace across the matrix.
+
+Round 20 re-record: the client-workload plane (workload.generator) added
+an Optional ``wload`` leaf to every protocol state, so every TREEDEF cell
+re-keyed (same contract as the coverage/exposure/margin rounds — the leaf
+prunes to None by default) and the new "workload" audit column landed.
+CONFIG_GOLDENS kept every existing cell (the fingerprint drops a
+default-off WorkloadConfig), EQN_GOLDENS kept every existing cell (the
+queue fold traces away when off), and LAYOUT_GOLDENS are byte-identical:
+the queue's all-int32 instance-minor leaves ride the fused engine's
+generic passthrough codec, touching no packed word and no version.
 """
 
 # (protocol, config_name) -> sha256[:16] of str(tree_structure(init_state))
 TREEDEF_GOLDENS: dict = {
-    ("paxos", "default"): "d1b384bdf7c12cb4",
-    ("paxos", "gray-chaos"): "d1b384bdf7c12cb4",
-    ("paxos", "corrupt"): "d1b384bdf7c12cb4",
-    ("paxos", "stale"): "5946cbcfadf07a11",
-    ("paxos", "delay-chaos"): "1373162dd29aeead",
-    ("paxos", "telemetry"): "d0c90bec05168644",
-    ("paxos", "coverage"): "7c39467783b4c11f",
-    ("paxos", "exposure"): "aae1664487efc910",
-    ("paxos", "margin"): "2cf5cd51b89df366",
-    ("multipaxos", "default"): "8b3457ca18d0180b",
-    ("multipaxos", "gray-chaos"): "8b3457ca18d0180b",
-    ("multipaxos", "corrupt"): "8b3457ca18d0180b",
-    ("multipaxos", "stale"): "4aa0b22e5ffd96ba",
-    ("multipaxos", "delay-chaos"): "e7ac97da20e179b5",
-    ("multipaxos", "telemetry"): "bf450a0c3ccf42fd",
-    ("multipaxos", "coverage"): "83619e5cbc764d11",
-    ("multipaxos", "exposure"): "b9e65e6bc2fda4f5",
-    ("multipaxos", "margin"): "e25a26b6ff5c1aa6",
-    ("fastpaxos", "default"): "0f041f362033a791",
-    ("fastpaxos", "gray-chaos"): "0f041f362033a791",
-    ("fastpaxos", "corrupt"): "0f041f362033a791",
-    ("fastpaxos", "stale"): "5ced11eb75c51e60",
-    ("fastpaxos", "delay-chaos"): "4cbd71ea64e4942c",
-    ("fastpaxos", "telemetry"): "739fc9ea50d27d27",
-    ("fastpaxos", "coverage"): "6d74f9a1ad375394",
-    ("fastpaxos", "exposure"): "1517ae82531f1779",
-    ("fastpaxos", "margin"): "089b773e7295f2a6",
-    ("raftcore", "default"): "6369bfbff79b8889",
-    ("raftcore", "gray-chaos"): "6369bfbff79b8889",
-    ("raftcore", "corrupt"): "6369bfbff79b8889",
-    ("raftcore", "stale"): "262e5e8ae320eaf1",
-    ("raftcore", "delay-chaos"): "796562935be87a22",
-    ("raftcore", "telemetry"): "b9ab38074703f5b4",
-    ("raftcore", "coverage"): "a0423ac5b0e247a2",
-    ("raftcore", "exposure"): "b263e47f185d8a99",
-    ("raftcore", "margin"): "fcd96baa3a162c43",
-    ("synchpaxos", "default"): "0b46bc59f360ccc3",
-    ("synchpaxos", "gray-chaos"): "0b46bc59f360ccc3",
-    ("synchpaxos", "corrupt"): "0b46bc59f360ccc3",
-    ("synchpaxos", "stale"): "734fa46e100e5d8e",
-    ("synchpaxos", "delay-chaos"): "5bc9d66d5887f491",
-    ("synchpaxos", "telemetry"): "2d0f7de9dc8167f1",
-    ("synchpaxos", "coverage"): "c2e1d73b586f893e",
-    ("synchpaxos", "exposure"): "903c29bb5ac1dc84",
-    ("synchpaxos", "margin"): "1d1def6ac4d17f80",
+    ("paxos", "default"): "5b68067ec67cd8f3",
+    ("paxos", "gray-chaos"): "5b68067ec67cd8f3",
+    ("paxos", "corrupt"): "5b68067ec67cd8f3",
+    ("paxos", "stale"): "214005225c4b30d7",
+    ("paxos", "delay-chaos"): "8040a2d86b0e3922",
+    ("paxos", "telemetry"): "e81814bfe41f2847",
+    ("paxos", "coverage"): "59d9e2ade2a41040",
+    ("paxos", "exposure"): "617fb904a1d2de58",
+    ("paxos", "margin"): "dd3bfa617441f218",
+    ("paxos", "workload"): "172db31596257348",
+    ("multipaxos", "default"): "25446d485a187cc6",
+    ("multipaxos", "gray-chaos"): "25446d485a187cc6",
+    ("multipaxos", "corrupt"): "25446d485a187cc6",
+    ("multipaxos", "stale"): "93373ccf87ddf28b",
+    ("multipaxos", "delay-chaos"): "623cc58e1b5fdd5a",
+    ("multipaxos", "telemetry"): "ff3b5cbfa90590fa",
+    ("multipaxos", "coverage"): "42f0149f3a8459aa",
+    ("multipaxos", "exposure"): "dc6abbea27d4739d",
+    ("multipaxos", "margin"): "b509ab92222e5e1c",
+    ("multipaxos", "workload"): "fe5c46c3d2a23b53",
+    ("fastpaxos", "default"): "33b0c6cd94ba8f10",
+    ("fastpaxos", "gray-chaos"): "33b0c6cd94ba8f10",
+    ("fastpaxos", "corrupt"): "33b0c6cd94ba8f10",
+    ("fastpaxos", "stale"): "ac7a7fbec5816693",
+    ("fastpaxos", "delay-chaos"): "6b6fde4537283781",
+    ("fastpaxos", "telemetry"): "efc13861f431ffe2",
+    ("fastpaxos", "coverage"): "f5d6f3e70e0e7681",
+    ("fastpaxos", "exposure"): "7a57c110b828c3a9",
+    ("fastpaxos", "margin"): "dfeeb43853dae9f1",
+    ("fastpaxos", "workload"): "a2e3ae26318df6ff",
+    ("raftcore", "default"): "effd9ee1f4606c8a",
+    ("raftcore", "gray-chaos"): "effd9ee1f4606c8a",
+    ("raftcore", "corrupt"): "effd9ee1f4606c8a",
+    ("raftcore", "stale"): "66b6cf1fd6351a98",
+    ("raftcore", "delay-chaos"): "e2b3eb86baea1890",
+    ("raftcore", "telemetry"): "e109e6520e22dca3",
+    ("raftcore", "coverage"): "0715366f9e84b225",
+    ("raftcore", "exposure"): "4e9e8115fa03d799",
+    ("raftcore", "margin"): "c1901f2e1d945707",
+    ("raftcore", "workload"): "ec26d3d0b419ef69",
+    ("synchpaxos", "default"): "6de0d059f2d0f1e7",
+    ("synchpaxos", "gray-chaos"): "6de0d059f2d0f1e7",
+    ("synchpaxos", "corrupt"): "6de0d059f2d0f1e7",
+    ("synchpaxos", "stale"): "fbe06abc599bfddb",
+    ("synchpaxos", "delay-chaos"): "e30590e38bc17f25",
+    ("synchpaxos", "telemetry"): "08951d730a500c22",
+    ("synchpaxos", "coverage"): "18766842f67347bb",
+    ("synchpaxos", "exposure"): "4b68a12f326b06cf",
+    ("synchpaxos", "margin"): "bf9b0703ba86227f",
+    ("synchpaxos", "workload"): "cb3fdf53e74abda9",
 }
 
 # (protocol, config_name) -> SimConfig.fingerprint() of the audit config
@@ -118,6 +133,7 @@ CONFIG_GOLDENS: dict = {
     ("paxos", "coverage"): "2d8f71710d52fe5f",
     ("paxos", "exposure"): "3def41a92aedfc70",
     ("paxos", "margin"): "555d36a19b0c3b31",
+    ("paxos", "workload"): "93d13ab24e8b5726",
     ("multipaxos", "default"): "cf1c4abcbad29c64",
     ("multipaxos", "gray-chaos"): "0ecc0377861dde26",
     ("multipaxos", "corrupt"): "ed256ed66b19bbf7",
@@ -127,6 +143,7 @@ CONFIG_GOLDENS: dict = {
     ("multipaxos", "coverage"): "be71e2b9117cbdd3",
     ("multipaxos", "exposure"): "d78d94882cfdc4bf",
     ("multipaxos", "margin"): "d8702c56eb7c03ba",
+    ("multipaxos", "workload"): "9dbf46690801b92a",
     ("fastpaxos", "default"): "d154a3728a21c32c",
     ("fastpaxos", "gray-chaos"): "26e04659a98a4689",
     ("fastpaxos", "corrupt"): "e11dfadc0b1bb7e1",
@@ -136,6 +153,7 @@ CONFIG_GOLDENS: dict = {
     ("fastpaxos", "coverage"): "be0e831f1f236579",
     ("fastpaxos", "exposure"): "abd8b026f01be70d",
     ("fastpaxos", "margin"): "7ccac7cc9158e4a4",
+    ("fastpaxos", "workload"): "09d47f881bcceb81",
     ("raftcore", "default"): "2cfa9a3a96ee74ec",
     ("raftcore", "gray-chaos"): "7636267dbe764fc8",
     ("raftcore", "corrupt"): "e34cf38c966c8a95",
@@ -145,6 +163,7 @@ CONFIG_GOLDENS: dict = {
     ("raftcore", "coverage"): "b02c399b79465535",
     ("raftcore", "exposure"): "c29538c03042099b",
     ("raftcore", "margin"): "652762bc86ac291b",
+    ("raftcore", "workload"): "8d74a01a7d5c4778",
     ("synchpaxos", "default"): "2eab6bb74daf06c1",
     ("synchpaxos", "gray-chaos"): "01a9b04108544a5d",
     ("synchpaxos", "corrupt"): "fb9411399ef3cf70",
@@ -154,6 +173,7 @@ CONFIG_GOLDENS: dict = {
     ("synchpaxos", "coverage"): "52194be2f0538706",
     ("synchpaxos", "exposure"): "a79f1ab6f217adf3",
     ("synchpaxos", "margin"): "bdc106defdc4a800",
+    ("synchpaxos", "workload"): "e781e75ed94943c4",
 }
 
 # protocol -> {"version": layout version string, "fields": canonical per-field
@@ -487,6 +507,7 @@ EQN_GOLDENS: dict = {
     ("paxos", "coverage"): {"xla": 926, "ctr": 914},
     ("paxos", "exposure"): {"xla": 981, "ctr": 1042},
     ("paxos", "margin"): {"xla": 680, "ctr": 668},
+    ("paxos", "workload"): {"xla": 747, "ctr": 744},
     ("multipaxos", "default"): {"xla": 767, "ctr": 739},
     ("multipaxos", "gray-chaos"): {"xla": 1023, "ctr": 1079},
     ("multipaxos", "corrupt"): {"xla": 983, "ctr": 1088},
@@ -496,6 +517,7 @@ EQN_GOLDENS: dict = {
     ("multipaxos", "coverage"): {"xla": 1258, "ctr": 1230},
     ("multipaxos", "exposure"): {"xla": 1175, "ctr": 1231},
     ("multipaxos", "margin"): {"xla": 845, "ctr": 817},
+    ("multipaxos", "workload"): {"xla": 908, "ctr": 889},
     ("fastpaxos", "default"): {"xla": 818, "ctr": 806},
     ("fastpaxos", "gray-chaos"): {"xla": 1120, "ctr": 1181},
     ("fastpaxos", "corrupt"): {"xla": 1070, "ctr": 1177},
@@ -505,6 +527,7 @@ EQN_GOLDENS: dict = {
     ("fastpaxos", "coverage"): {"xla": 1138, "ctr": 1126},
     ("fastpaxos", "exposure"): {"xla": 1279, "ctr": 1340},
     ("fastpaxos", "margin"): {"xla": 912, "ctr": 900},
+    ("fastpaxos", "workload"): {"xla": 960, "ctr": 957},
     ("raftcore", "default"): {"xla": 638, "ctr": 626},
     ("raftcore", "gray-chaos"): {"xla": 856, "ctr": 917},
     ("raftcore", "corrupt"): {"xla": 806, "ctr": 913},
@@ -514,6 +537,7 @@ EQN_GOLDENS: dict = {
     ("raftcore", "coverage"): {"xla": 958, "ctr": 946},
     ("raftcore", "exposure"): {"xla": 1011, "ctr": 1072},
     ("raftcore", "margin"): {"xla": 712, "ctr": 700},
+    ("raftcore", "workload"): {"xla": 779, "ctr": 776},
     ("synchpaxos", "default"): {"xla": 648, "ctr": 636},
     ("synchpaxos", "gray-chaos"): {"xla": 865, "ctr": 926},
     ("synchpaxos", "corrupt"): {"xla": 817, "ctr": 924},
@@ -523,4 +547,5 @@ EQN_GOLDENS: dict = {
     ("synchpaxos", "coverage"): {"xla": 968, "ctr": 956},
     ("synchpaxos", "exposure"): {"xla": 1030, "ctr": 1091},
     ("synchpaxos", "margin"): {"xla": 722, "ctr": 710},
+    ("synchpaxos", "workload"): {"xla": 790, "ctr": 787},
 }

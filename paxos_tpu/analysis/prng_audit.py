@@ -61,7 +61,8 @@ def expected_plan_folds(cfg: FaultConfig) -> set:
 
 
 def audit_counter_streams(
-    protocol: str, config_name: str, closed, cfg: FaultConfig
+    protocol: str, config_name: str, closed, cfg: FaultConfig,
+    wload_on: bool = False,
 ) -> list:
     """Audit a fused-tick trace's counter-PRNG stream ids."""
     findings = []
@@ -104,6 +105,16 @@ def audit_counter_streams(
                     f"must trace away when disabled (default-off-is-free)"
                 ),
             ))
+        if sid in family.wload_ids() and not wload_on:
+            findings.append(Finding(
+                check="wload-gating", where=where,
+                message=(
+                    f"workload stream {sid} ({family.name}.{name}) drawn "
+                    f"in {where} although the client-workload plane is "
+                    f"off: arrival draws must trace away when "
+                    f"cfg.workload.mix == 'off' (default-off-is-free)"
+                ),
+            ))
     # The fused engine must never touch jax.random machinery: key-array
     # primitives have no Mosaic lowering and would fork the schedule from
     # the reference replay.
@@ -120,7 +131,8 @@ def audit_counter_streams(
 
 
 def audit_xla_folds(
-    protocol: str, config_name: str, closed, cfg: FaultConfig
+    protocol: str, config_name: str, closed, cfg: FaultConfig,
+    wload_on: bool = False,
 ) -> list:
     """Audit an XLA-step trace's fold_in constants and split widths."""
     findings = []
@@ -130,6 +142,9 @@ def audit_xla_folds(
     allowed = {
         streams_mod.TICK_FOLDS[n] for n in _allowed_gray_tick_names(cfg)
     }
+    wload_fold = streams_mod.TICK_FOLDS["ARRIVAL_BITS"]
+    if wload_on:
+        allowed.add(wload_fold)
     for const, count in sorted(jt.fold_in_constants(closed.jaxpr).items()):
         if const not in tick_by_const:
             findings.append(Finding(
@@ -152,13 +167,23 @@ def audit_xla_folds(
                 ),
             ))
         if const not in allowed:
-            findings.append(Finding(
-                check="gray-gating", where=where,
-                message=(
-                    f"gray fold_in({const}) (TICK_FOLDS.{name}) traced in "
-                    f"{where} although its fault knob is off"
-                ),
-            ))
+            if const == wload_fold:
+                findings.append(Finding(
+                    check="wload-gating", where=where,
+                    message=(
+                        f"workload fold_in({const}) (TICK_FOLDS.{name}) "
+                        f"traced in {where} although the client-workload "
+                        f"plane is off (default-off-is-free)"
+                    ),
+                ))
+            else:
+                findings.append(Finding(
+                    check="gray-gating", where=where,
+                    message=(
+                        f"gray fold_in({const}) (TICK_FOLDS.{name}) traced "
+                        f"in {where} although its fault knob is off"
+                    ),
+                ))
     widths = jt.split_widths(closed.jaxpr)
     fam_width = family.gray_base
     if widths.get(fam_width, 0) != 1:
@@ -319,6 +344,62 @@ def audit_exposure_parity(
         protocol, "exposure-parity", "exposure",
         base_xla, exp_xla, base_ctr, exp_ctr,
     )
+
+
+def audit_workload_parity(
+    protocol: str, default_xla, wl_xla, default_ctr, wl_ctr
+) -> list:
+    """The client-workload plane draws EXACTLY the arrival stream — no more.
+
+    Unlike the pure observers, the workload plane legitimately consumes
+    randomness (one Bernoulli arrival draw per tick), so plain signature
+    identity is the wrong contract.  The right one: the workload-on trace
+    must differ from default by exactly one ``fold_in(ARRIVAL_BITS)`` +
+    one bits draw on the XLA engine (key wrap/unwrap machinery rides
+    along, literal-free) and exactly one ``ARRIVAL`` counter-stream draw
+    on the fused engine — anything else is a schedule perturbation the
+    default-off goldens cannot see."""
+    findings = []
+    family = streams_mod.family_of(protocol)
+    arrival_fold = streams_mod.TICK_FOLDS["ARRIVAL_BITS"]
+    sig_d = jt.prng_signature(default_xla.jaxpr)
+    sig_w = jt.prng_signature(wl_xla.jaxpr)
+    removed = sig_d - sig_w
+    added = sig_w - sig_d
+    bad_extra = {
+        k: n for k, n in added.items()
+        if k != ("random_fold_in", arrival_fold)
+        and not (k[1] is None and k[0] != "random_fold_in")
+    }
+    if (
+        removed
+        or added.get(("random_fold_in", arrival_fold), 0) != 1
+        or added.get(("random_bits", None), 0) != 1
+        or bad_extra
+    ):
+        findings.append(Finding(
+            check="workload-parity", where=f"{protocol} xla step",
+            message=(
+                f"workload-on xla trace for {protocol} must add exactly "
+                f"one fold_in({arrival_fold}) (TICK_FOLDS.ARRIVAL_BITS) + "
+                f"one bits draw over default; saw added "
+                f"{dict(added)}, removed {dict(removed)}"
+            ),
+        ))
+    str_d = jt.counter_salt_streams(default_ctr.jaxpr)
+    str_w = jt.counter_salt_streams(wl_ctr.jaxpr)
+    arrival_sid = family.streams["ARRIVAL"]
+    if dict(str_w - str_d) != {arrival_sid: 1} or (str_d - str_w):
+        findings.append(Finding(
+            check="workload-parity", where=f"{protocol} fused tick",
+            message=(
+                f"workload-on fused trace for {protocol} must add exactly "
+                f"one draw of counter stream {arrival_sid} "
+                f"({family.name}.ARRIVAL) over default; saw added "
+                f"{dict(str_w - str_d)}, removed {dict(str_d - str_w)}"
+            ),
+        ))
+    return findings
 
 
 def audit_margin_parity(

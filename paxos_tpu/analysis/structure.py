@@ -61,6 +61,11 @@ _KNOB_LEAVES = (
         lambda cfg: cfg.margin.enabled(),
         "margin disabled",
     ),
+    (
+        lambda name: name == "wload",
+        lambda cfg: cfg.workload.enabled(),
+        "workload disabled",
+    ),
 )
 
 _PLAN_GRAY_FIELDS = (

@@ -93,6 +93,17 @@ def _margin(protocol: str) -> SimConfig:
     )
 
 
+def _workload(protocol: str) -> SimConfig:
+    from paxos_tpu.workload.generator import WorkloadConfig
+
+    # "mixed" on purpose: all three arrival-class arms must trace (a
+    # single-class cell would audit a partially-dead threshold select).
+    return dataclasses.replace(
+        _default(protocol),
+        workload=WorkloadConfig(mix="mixed", slo_p99_ticks=64),
+    )
+
+
 CONFIG_MATRIX: dict[str, Callable[[str], SimConfig]] = {
     "default": _default,
     "gray-chaos": _gray,
@@ -103,6 +114,7 @@ CONFIG_MATRIX: dict[str, Callable[[str], SimConfig]] = {
     "coverage": _coverage,
     "exposure": _exposure,
     "margin": _margin,
+    "workload": _workload,
 }
 
 

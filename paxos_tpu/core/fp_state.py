@@ -35,6 +35,7 @@ from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
 from paxos_tpu.obs.exposure import FaultExposure
 from paxos_tpu.obs.margin import MarginState
+from paxos_tpu.workload.generator import WloadState
 
 # Proposer phases (P1/P2/DONE match core.state so summarize() is shared).
 P1 = 0  # classic recovery: prepare sent, collecting promises
@@ -102,6 +103,10 @@ class FastPaxosState:
     exposure: Optional[FaultExposure] = None
     # Near-miss safety-margin sketch (obs.margin): None when disabled, same contract.
     margin: Optional[MarginState] = None
+    # Client-workload queue (workload.generator): None when disabled, same
+    # contract; carried by the fused engine's passthrough codec (no
+    # layout-table entry — see core/state.py).
+    wload: Optional[WloadState] = None
 
     @classmethod
     def init(

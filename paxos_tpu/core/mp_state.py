@@ -33,6 +33,7 @@ from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
 from paxos_tpu.obs.exposure import FaultExposure
 from paxos_tpu.obs.margin import MarginState
+from paxos_tpu.workload.generator import WloadState
 
 # Proposer phases
 FOLLOW = 0  # passive: watching progress, lease ticking
@@ -251,6 +252,10 @@ class MultiPaxosState:
     exposure: Optional[FaultExposure] = None
     # Near-miss safety-margin sketch (obs.margin): None when disabled, same contract.
     margin: Optional[MarginState] = None
+    # Client-workload queue (workload.generator): None when disabled, same
+    # contract; carried by the fused engine's passthrough codec (no
+    # layout-table entry — see core/state.py).
+    wload: Optional[WloadState] = None
 
     @classmethod
     def init(

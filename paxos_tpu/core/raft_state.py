@@ -30,6 +30,7 @@ from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
 from paxos_tpu.obs.exposure import FaultExposure
 from paxos_tpu.obs.margin import MarginState
+from paxos_tpu.workload.generator import WloadState
 
 # Candidate phases (values match core.state.P1/P2/DONE so summarize() and
 # liveness stats are shared across protocols).
@@ -131,6 +132,10 @@ class RaftState:
     exposure: Optional[FaultExposure] = None
     # Near-miss safety-margin sketch (obs.margin): None when disabled, same contract.
     margin: Optional[MarginState] = None
+    # Client-workload queue (workload.generator): None when disabled, same
+    # contract; carried by the fused engine's passthrough codec (no
+    # layout-table entry — see core/state.py).
+    wload: Optional[WloadState] = None
 
     @classmethod
     def init(

@@ -59,6 +59,7 @@ from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
 from paxos_tpu.obs.exposure import FaultExposure
 from paxos_tpu.obs.margin import MarginState
+from paxos_tpu.workload.generator import WloadState
 
 # Proposer phases: P1/P2/DONE match core.state so summarize() is shared;
 # FAST is the leader's round-0 window (fits the layout's 2-bit phase field,
@@ -92,6 +93,10 @@ class SynchPaxosState:
     exposure: Optional[FaultExposure] = None
     # Near-miss safety-margin sketch (obs.margin): None when disabled, same contract.
     margin: Optional[MarginState] = None
+    # Client-workload queue (workload.generator): None when disabled, same
+    # contract; carried by the fused engine's passthrough codec (no
+    # layout-table entry — see core/state.py).
+    wload: Optional[WloadState] = None
 
     @classmethod
     def init(

@@ -32,6 +32,7 @@ from paxos_tpu.core.telemetry import TelemetryState
 from paxos_tpu.obs.coverage import CoverageState
 from paxos_tpu.obs.exposure import FaultExposure
 from paxos_tpu.obs.margin import MarginState
+from paxos_tpu.workload.generator import WloadState
 
 # Proposer phases
 P1 = 0  # prepare sent, collecting promises
@@ -160,6 +161,12 @@ class PaxosState:
     exposure: Optional[FaultExposure] = None
     # Near-miss safety-margin sketch (obs.margin): None when disabled, same contract.
     margin: Optional[MarginState] = None
+    # Client-workload queue (workload.generator): None when disabled, same
+    # contract.  Deliberately NOT declared in the tick read/write tables
+    # below — all leaves are non-scalar trailing-I int32, so the fused
+    # engine's passthrough codec (utils/bitops) carries them without any
+    # layout-table edit, keeping the packed LAYOUT goldens byte-identical.
+    wload: Optional[WloadState] = None
 
     @classmethod
     def init(

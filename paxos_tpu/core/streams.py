@@ -49,12 +49,14 @@ __all__ = [
     "family_of",
     "ROOT_STEP",
     "ROOT_PLAN",
+    "ROOT_WLOAD",
     "GRAY_FOLD_BASE",
     "TICK_FOLDS",
     "PLAN_FOLDS",
     "tick_key",
     "root_step_key",
     "root_plan_key",
+    "root_wload_key",
     "tick_fold",
     "plan_fold",
 ]
@@ -65,17 +67,20 @@ class StreamFamily:
     """One counter-PRNG stream allocation (one mask-sampler lineage).
 
     ``streams`` maps mask names to ``kernels/counter_prng`` stream ids;
-    ``gray`` names the streams drawn only when a gray-failure knob is on.
-    Invariant (checked by :meth:`validate`): protocol streams are all
-    ``< gray_base`` and gray streams all ``>= gray_base``, so a
-    default-config trace containing any stream ``>= gray_base`` is a
-    determinism bug by construction.
+    ``gray`` names the streams drawn only when a gray-failure knob is on,
+    and ``wload`` the streams drawn only when the client-workload plane is
+    on (``harness.config.WorkloadConfig``).  Invariant (checked by
+    :meth:`validate`): protocol streams are all ``< gray_base`` and
+    gray/wload streams all ``>= gray_base``, so a default-config trace
+    containing any stream ``>= gray_base`` is a determinism bug by
+    construction.
     """
 
     name: str
     streams: Mapping[str, int]
     gray: frozenset
     gray_base: int
+    wload: frozenset = frozenset()
 
     def validate(self) -> None:
         ids = list(self.streams.values())
@@ -88,23 +93,33 @@ class StreamFamily:
             raise ValueError(
                 f"stream family {self.name!r}: duplicate stream ids {dup}"
             )
-        unknown = self.gray - set(self.streams)
+        unknown = (self.gray | self.wload) - set(self.streams)
         if unknown:
             raise ValueError(
-                f"stream family {self.name!r}: gray names {sorted(unknown)} "
-                "not in the stream table"
+                f"stream family {self.name!r}: gray/wload names "
+                f"{sorted(unknown)} not in the stream table"
+            )
+        overlap = self.gray & self.wload
+        if overlap:
+            raise ValueError(
+                f"stream family {self.name!r}: streams {sorted(overlap)} "
+                "claimed by both gray and wload"
             )
         for mask, sid in self.streams.items():
             if sid < 0:
                 raise ValueError(
                     f"stream family {self.name!r}: negative id {mask}={sid}"
                 )
-            if mask in self.gray and sid < self.gray_base:
+            if mask in (self.gray | self.wload) and sid < self.gray_base:
                 raise ValueError(
-                    f"stream family {self.name!r}: gray stream {mask}={sid} "
+                    f"stream family {self.name!r}: gated stream {mask}={sid} "
                     f"below gray_base={self.gray_base}"
                 )
-            if mask not in self.gray and sid >= self.gray_base:
+            if (
+                mask not in self.gray
+                and mask not in self.wload
+                and sid >= self.gray_base
+            ):
                 raise ValueError(
                     f"stream family {self.name!r}: protocol stream "
                     f"{mask}={sid} at or above gray_base={self.gray_base}"
@@ -116,6 +131,9 @@ class StreamFamily:
 
     def gray_ids(self) -> frozenset:
         return frozenset(self.streams[m] for m in self.gray)
+
+    def wload_ids(self) -> frozenset:
+        return frozenset(self.streams[m] for m in self.wload)
 
 
 # The single-decree family: paxos, fastpaxos and raftcore all draw their
@@ -139,11 +157,13 @@ SINGLE_DECREE = StreamFamily(
         CORRUPT=12,  # in-flight corruption mask (p_corrupt)
         DELAY_BITS=13,  # per-edge delay decision raw bits (p_delay)
         LAT_BITS=14,  # per-edge sampled latency raw bits (delay_max)
+        ARRIVAL=15,  # client-arrival raw bits (workload plane)
     ),
     gray=frozenset(
         {"LINK_BITS", "DUP_BITS", "CORRUPT", "DELAY_BITS", "LAT_BITS"}
     ),
     gray_base=10,
+    wload=frozenset({"ARRIVAL"}),
 )
 
 # The multipaxos family: BACKOFF landed on 10 before the gray layer
@@ -168,11 +188,13 @@ MULTI_PAXOS = StreamFamily(
         CORRUPT=13,
         DELAY_BITS=14,  # per-edge delay decision raw bits (p_delay)
         LAT_BITS=15,  # per-edge sampled latency raw bits (delay_max)
+        ARRIVAL=16,  # client-arrival raw bits (workload plane)
     ),
     gray=frozenset(
         {"LINK_BITS", "DUP_BITS", "CORRUPT", "DELAY_BITS", "LAT_BITS"}
     ),
     gray_base=11,
+    wload=frozenset({"ARRIVAL"}),
 )
 
 FAMILIES = {f.name: f for f in (SINGLE_DECREE, MULTI_PAXOS)}
@@ -196,9 +218,10 @@ def family_of(protocol: str) -> StreamFamily:
 
 # --- fold_in domains (XLA engine, jax.random keys) ---
 
-# Root domain: fold_in(PRNGKey(seed), c) — the two top-level lineages.
+# Root domain: fold_in(PRNGKey(seed), c) — the top-level lineages.
 ROOT_STEP = 0  # per-tick mask stream (harness.run.base_key)
 ROOT_PLAN = 1  # fault-plan sampling (harness.run.init_plan)
+ROOT_WLOAD = 2  # workload-plan sampling (workload.generator.sample_plan)
 
 # Gray fold_in constants sit at or above this in the tick and plan domains,
 # keeping them visibly disjoint from the split-derived pre-gray draws.
@@ -212,6 +235,7 @@ TICK_FOLDS = dict(
     CORRUPT=102,  # in-flight corruption mask (p_corrupt)
     DELAY_BITS=103,  # per-edge delay decision raw bits (p_delay)
     LAT_BITS=104,  # per-edge sampled latency raw bits (delay_max)
+    ARRIVAL_BITS=105,  # client-arrival raw bits (workload plane)
 )
 
 # Plan domain: fold_in(plan_key, c) inside FaultPlan.sample — gray fields
@@ -261,6 +285,16 @@ def root_step_key(seed: int) -> jax.Array:
 def root_plan_key(seed: int) -> jax.Array:
     """The plan-sampling lineage root (fold const :data:`ROOT_PLAN`)."""
     return jax.random.fold_in(jax.random.PRNGKey(seed), ROOT_PLAN)
+
+
+def root_wload_key(seed: int) -> jax.Array:
+    """The workload-plan lineage root (fold const :data:`ROOT_WLOAD`).
+
+    Folded only when the workload plane is on — a default config must
+    never touch this lineage (the step/plan lineages stay bit-identical
+    either way because fold_in lineages are independent).
+    """
+    return jax.random.fold_in(jax.random.PRNGKey(seed), ROOT_WLOAD)
 
 
 def tick_fold(key: jax.Array, name: str) -> jax.Array:

@@ -507,7 +507,7 @@ class FaultPlan:
 # ``link_ok`` equivalence that justifies the exception).
 
 _ATOM_KIND_ORDER = {"crash": 0, "equiv": 1, "partition": 2, "flaky": 3,
-                    "skew": 4, "delay": 5}
+                    "skew": 4, "delay": 5, "wload": 6}
 
 
 def _u32(x) -> int:
@@ -553,6 +553,8 @@ def atom_label(atom: dict) -> str:
         return (
             f"delay[link=({atom['prop']},{atom['acc']}),cap={atom['cap']}]"
         )
+    if kind == "wload":
+        return f"wload[mix={atom['mix']},rate={atom['rate']}]"
     raise ValueError(f"unknown atom kind: {kind!r}")
 
 
@@ -737,6 +739,12 @@ def atoms_to_plan(
                 "link_delay",
                 lambda: np.zeros(edge, np.int32),
             )[atom["prop"], atom["acc"], lane] = int(atom["cap"])
+        elif kind == "wload":
+            # Config-level, not plan-level: the open-loop client workload
+            # rides SimConfig.workload, which the fuzz scheduler's
+            # campaign_config lights from this atom (workload.generator).
+            # Nothing to write into the plan.
+            pass
         else:
             raise ValueError(f"unknown atom kind: {kind!r}")
     return FaultPlan(**{

@@ -59,6 +59,9 @@ def plan_records(
     seed_entries: int = 2,
     mutations: int = 2,
     energy_max: int = 4,
+    workload: "Optional[str]" = None,
+    workload_rate: float = 0.05,
+    slo_p99: int = 0,
 ) -> "list[dict]":
     """Partition a fleet budget into campaign records.
 
@@ -83,6 +86,16 @@ def plan_records(
             "engine": engine,
             "attempt": 0,
         }
+        if workload:
+            # Client-workload plane per record: every shard runs the same
+            # mix, so per-seed slo_p99_ticks gauges land in the sampled
+            # series and the slo_degradation trend detector covers the
+            # fleet.
+            rec |= {
+                "workload": workload,
+                "workload_rate": workload_rate,
+                "slo_p99": slo_p99,
+            }
         if mode == "fuzz":
             rec |= {
                 "seed": seed + i * seed_stride,

@@ -63,11 +63,20 @@ def build_cfg(record: dict):
         kw["n_inst"] = int(record["n_inst"])
     cfg = CONFIGS[record["config"]](**kw)
     cfg = apply_fault_overrides(cfg, list(record.get("fault", [])))
-    return dataclasses.replace(
+    cfg = dataclasses.replace(
         cfg, coverage=CoverageConfig(
             words=int(record.get("coverage_words", 64))
         )
     )
+    if record.get("workload"):
+        from paxos_tpu.workload.generator import WorkloadConfig
+
+        cfg = dataclasses.replace(cfg, workload=WorkloadConfig(
+            mix=str(record["workload"]),
+            rate=float(record.get("workload_rate", WorkloadConfig().rate)),
+            slo_p99_ticks=int(record.get("slo_p99", 0)),
+        ))
+    return cfg
 
 
 # -- per-record campaign source ------------------------------------------
@@ -251,6 +260,15 @@ def run_record(
                 reg.gauge("worker_violations", cum["violations"])
                 reg.gauge("worker_seeds", cum["seeds"])
                 reg.gauge("worker_rounds", cum["rounds"])
+                # Workload-on records ride their campaign p99 into the
+                # series so compare_series's slo_degradation detector
+                # covers the fleet for free; a deterministic function of
+                # (record, clock) like every other gauge.  Unserved
+                # campaigns (-1) export nothing, mirroring ingest_slo.
+                slo = report.get("slo")
+                if slo is not None and slo["p99_ticks"] >= 0:
+                    reg.gauge("slo_p99_ticks", slo["p99_ticks"])
+                    reg.gauge("slo_queue_depth", slo["queue_depth"])
                 sampler.sample(
                     record=rec_id,
                     attempt=int(record.get("attempt", 0)),

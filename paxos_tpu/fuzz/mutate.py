@@ -210,6 +210,24 @@ def _scale_corrupt(rng, atoms, knobs, dims, base_corrupt=0.0) -> Optional[str]:
     return "scale-corrupt"
 
 
+def _set_workload(rng, atoms, knobs, dims) -> Optional[str]:
+    # Config-level atom, not a plan field: ``campaign_config`` lights
+    # ``SimConfig.workload`` from it and ``atoms_to_plan`` skips the kind.
+    # Open-loop traffic changes which lanes have retirable client work, so
+    # it is a campaign dimension exactly like a chaos knob — and because
+    # the plane is an extra state leaf, entries with a wload atom compile
+    # a separate executable (one per workload shape, shared across seeds).
+    # Rates ride the 1/16 uint32 grid; ``atom_key`` ignores the payload,
+    # so dedup keeps one workload per campaign (last write wins).
+    mixes = ("poisson", "bursty", "diurnal", "mixed")
+    atoms.append({
+        "kind": "wload", "lane": 0,
+        "mix": mixes[rng.below(len(mixes))],
+        "rate": (1 + rng.below(8)) * _THR_STEP,  # rate in [1/16, 8/16]
+    })
+    return "set-workload"
+
+
 def _ballot_stride(rng, atoms, knobs, dims) -> Optional[str]:
     # Coprime ballot strides (arXiv:2006.01885): proposers advance rounds
     # by a stride > 1 on retry, de-synchronizing dueling ballots the way
@@ -256,6 +274,7 @@ MUTATION_OPS = _register(
     MutationOp(12, "scale-corrupt", _scale_corrupt),
     MutationOp(13, "add-delay", _add_delay),
     MutationOp(14, "ballot-stride", _ballot_stride),
+    MutationOp(15, "set-workload", _set_workload),
 )
 
 

@@ -103,7 +103,26 @@ def campaign_config(
             raise ValueError(f"non-whitelisted fuzz knob: {k!r}")
         rep[k] = v
     fault = dataclasses.replace(f, **rep) if rep else f
-    return dataclasses.replace(base_cfg, seed=int(seed), fault=fault)
+    out = dataclasses.replace(base_cfg, seed=int(seed), fault=fault)
+    wls = [a for a in atoms if a["kind"] == "wload"]
+    if wls:
+        # Config-level lighting, same doctrine as the fault knobs: the
+        # atom decides the arrival shape, the base config keeps its other
+        # workload knobs (queue_cap, SLO target, ...).  The rate rides the
+        # mutator's uint32 grid — /2^32 is an exact binary float, so the
+        # fingerprint is platform-stable.  atoms_to_plan skips the kind.
+        from paxos_tpu.workload.generator import WorkloadConfig
+
+        wl = wls[-1]
+        out = dataclasses.replace(
+            out,
+            workload=dataclasses.replace(
+                base_cfg.workload or WorkloadConfig(),
+                mix=wl["mix"],
+                rate=wl["rate"] / float(1 << 32),
+            ),
+        )
+    return out
 
 
 class GuidedSource:

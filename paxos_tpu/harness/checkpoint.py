@@ -157,16 +157,18 @@ def restore(
     saved_stream = raw.pop("stream", None)
     fault = raw.pop("fault")
     # Tolerate snapshots predating an observer plane (no key for
-    # telemetry / coverage / exposure / margin): default off.
+    # telemetry / coverage / exposure / margin / workload): default off.
     tel = raw.pop("telemetry", None)
     cov = raw.pop("coverage", None)
     exp = raw.pop("exposure", None)
     mar = raw.pop("margin", None)
+    wl = raw.pop("workload", None)
     from paxos_tpu.core.telemetry import TelemetryConfig
     from paxos_tpu.faults.injector import FaultConfig
     from paxos_tpu.obs.coverage import CoverageConfig
     from paxos_tpu.obs.exposure import ExposureConfig
     from paxos_tpu.obs.margin import MarginConfig
+    from paxos_tpu.workload.generator import WorkloadConfig
 
     cfg = SimConfig(
         **raw,
@@ -175,6 +177,7 @@ def restore(
         coverage=CoverageConfig(**cov) if cov else CoverageConfig(),
         exposure=ExposureConfig(**exp) if exp else ExposureConfig(),
         margin=MarginConfig(**mar) if mar else MarginConfig(),
+        workload=WorkloadConfig(**wl) if wl else WorkloadConfig(),
     )
 
     if engine is not None:

@@ -174,6 +174,25 @@ def build_parser() -> argparse.ArgumentParser:
         "schedule-identical)",
     )
     r.add_argument(
+        "--workload", choices=["poisson", "bursty", "diurnal", "mixed"],
+        default=None, metavar="MIX",
+        help="open-loop client arrivals per proposer lane with on-device "
+        "queue accounting and end-to-end latency histograms "
+        "(workload.generator + obs.slo; default off — off is free and "
+        "schedule-identical)",
+    )
+    r.add_argument(
+        "--workload-rate", type=float, default=0.05, metavar="P",
+        help="per-tick arrival probability per lane (only read with "
+        "--workload; bursty/diurnal peaks use 10x via burst_rate)",
+    )
+    r.add_argument(
+        "--slo-p99", type=int, default=0, metavar="T",
+        help="configured p99 SLO in ticks, exported as the "
+        "slo_target_p99_ticks gauge (only read with --workload; 0 = no "
+        "SLO configured)",
+    )
+    r.add_argument(
         "--perf", action="store_true",
         help="host-side performance plane (obs.perf): rounds/sec, pipeline "
         "occupancy, chunk-latency percentiles, compile-vs-steady split in "
@@ -264,6 +283,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="on-device near-miss margin counters per campaign: the report "
         "gains cross-seed minima and a per-seed near-miss ranking — which "
         "seeds came closest to a violation (obs.margin)",
+    )
+    so.add_argument(
+        "--workload", choices=["poisson", "bursty", "diurnal", "mixed"],
+        default=None, metavar="MIX",
+        help="open-loop client workload per campaign: the report gains the "
+        "cross-seed client-latency tally (summed histograms, recomputed "
+        "percentiles) and per-seed slo_p99_ticks trend (workload.generator "
+        "+ obs.slo; default off — off is free and schedule-identical)",
+    )
+    so.add_argument(
+        "--workload-rate", type=float, default=0.05, metavar="P",
+        help="per-tick arrival probability per lane (only read with "
+        "--workload)",
+    )
+    so.add_argument(
+        "--slo-p99", type=int, default=0, metavar="T",
+        help="configured p99 SLO in ticks, exported as the "
+        "slo_target_p99_ticks gauge (only read with --workload)",
     )
     so.add_argument(
         "--perf", action="store_true",
@@ -397,6 +434,23 @@ def build_parser() -> argparse.ArgumentParser:
     fl.add_argument("--ticks-per-seed", type=int, default=256)
     fl.add_argument("--chunk", type=int, default=64)
     fl.add_argument("--coverage-words", type=int, default=64, metavar="W")
+    fl.add_argument(
+        "--workload", choices=["poisson", "bursty", "diurnal", "mixed"],
+        default=None,
+        help="light the client-workload plane on every record: per-seed "
+        "slo_p99_ticks gauges ride the sampled series, so the "
+        "slo_degradation trend detector covers the fleet",
+    )
+    fl.add_argument(
+        "--workload-rate", type=float, default=0.05, metavar="P",
+        help="base per-tick arrival probability (only read with "
+        "--workload)",
+    )
+    fl.add_argument(
+        "--slo-p99", type=int, default=0, metavar="T",
+        help="p99 SLO in ticks recorded in each record's workload config "
+        "(only read with --workload; 0 = report only)",
+    )
     fl.add_argument(
         "--lease-s", type=float, default=15.0,
         help="lease duration; a worker silent this long is presumed dead "
@@ -583,6 +637,19 @@ def build_parser() -> argparse.ArgumentParser:
         "boundary and draw min_quorum_slack / near_miss_lanes Perfetto "
         "counter tracks (obs.margin; forces the serial per-chunk loop)",
     )
+    tr.add_argument(
+        "--workload", choices=["poisson", "bursty", "diurnal", "mixed"],
+        default=None, metavar="MIX",
+        help="also run the open-loop client workload and draw "
+        "slo_p99_ticks / queue_depth Perfetto counter tracks "
+        "(workload.generator + obs.slo; forces the serial per-chunk loop; "
+        "default off — off is free and schedule-identical)",
+    )
+    tr.add_argument(
+        "--workload-rate", type=float, default=0.05, metavar="P",
+        help="per-tick arrival probability per lane (only read with "
+        "--workload)",
+    )
 
     st = sub.add_parser(
         "stats",
@@ -754,8 +821,8 @@ def build_parser() -> argparse.ArgumentParser:
     a.add_argument(
         "--config", action="append", dest="configs", metavar="NAME",
         choices=["default", "gray-chaos", "corrupt", "stale", "delay-chaos",
-                 "telemetry", "coverage", "exposure", "margin"],
-        help="restrict to one audit config (repeatable; default: all nine)",
+                 "telemetry", "coverage", "exposure", "margin", "workload"],
+        help="restrict to one audit config (repeatable; default: all ten)",
     )
     a.add_argument(
         "--structure", action="store_true",
@@ -911,6 +978,54 @@ def build_parser() -> argparse.ArgumentParser:
         "--json", action="store_true", dest="as_json",
         help="emit the full report as JSON instead of the text tables",
     )
+
+    sl = sub.add_parser(
+        "slo",
+        help="client-workload SLO plane: sweep offered load over a "
+        "campaign, print the per-class client-latency table and the "
+        "goodput-vs-offered curve, locate the overload knee, and gate "
+        "the configured p99 SLO (exit 2 on breach; obs.slo)",
+    )
+    sl.add_argument("--config", choices=sorted(CONFIGS), default="config2")
+    sl.add_argument("--engine", choices=["xla", "fused"], default="xla")
+    sl.add_argument("--n-inst", type=int, default=None)
+    sl.add_argument(
+        "--fault", action="append", default=[], metavar="KEY=VALUE",
+        help="override any FaultConfig knob by name (repeatable)",
+    )
+    sl.add_argument("--seed", type=int, default=0)
+    sl.add_argument("--ticks", type=int, default=256)
+    sl.add_argument("--chunk", type=int, default=64)
+    sl.add_argument(
+        "--mix", choices=["poisson", "bursty", "diurnal", "mixed"],
+        default="mixed",
+        help="arrival-class mix for every sweep point (mixed = lanes "
+        "sample their class from the workload stream)",
+    )
+    sl.add_argument(
+        "--rate", type=float, default=0.05, metavar="P",
+        help="base per-tick arrival probability at sweep scale 1.0",
+    )
+    sl.add_argument(
+        "--sweep", type=float, nargs="+", metavar="S",
+        default=[0.25, 0.5, 1.0, 2.0, 4.0],
+        help="offered-load scale factors: one campaign per factor at "
+        "rate*S (clamped to 1.0), the goodput curve's x axis",
+    )
+    sl.add_argument(
+        "--knee-floor", type=float, default=0.9, metavar="F",
+        help="overload knee = first sweep point with done/offered < F",
+    )
+    sl.add_argument(
+        "--slo-p99", type=int, default=0, metavar="T",
+        help="p99 SLO in ticks, gated at sweep scale 1.0: any class "
+        "whose served p99 exceeds T exits 2 (0 = report only)",
+    )
+    sl.add_argument("--log", default=None, help="JSONL metrics path")
+    sl.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the full report as JSON instead of the text tables",
+    )
     return p
 
 
@@ -956,6 +1071,26 @@ def _margin_from_args(args: argparse.Namespace):
     from paxos_tpu.obs.margin import MarginConfig
 
     return MarginConfig(counters=True)
+
+
+def _workload_from_args(args: argparse.Namespace):
+    """The --workload knobs as a WorkloadConfig (or None when off)."""
+    mix = getattr(args, "workload", None)
+    if not mix:
+        return None
+    from paxos_tpu.workload.generator import WorkloadConfig
+
+    wl = WorkloadConfig(
+        mix=mix,
+        rate=args.workload_rate,
+        slo_p99_ticks=getattr(args, "slo_p99", 0),
+    )
+    try:
+        wl.validate()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    return wl
 
 
 def _warn_checker_incomplete(report: dict) -> None:
@@ -1043,6 +1178,7 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
     cov_cfg = _coverage_from_args(args)
     expo_cfg = _exposure_from_args(args)
     mar_cfg = _margin_from_args(args)
+    wl_cfg = _workload_from_args(args)
     registry = MetricsRegistry()
     registry.gauge("pipeline_depth_effective", depth)
     # Host span recorder (--span-trace / --perf): the CLI owns the wall
@@ -1081,6 +1217,11 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                   "counters' arrays are part of the checkpointed state "
                   "structure; same rule as --telemetry)", file=sys.stderr)
             return 1
+        if wl_cfg is not None:
+            print("error: --workload cannot be combined with --resume (the "
+                  "queue's arrays are part of the checkpointed state "
+                  "structure; same rule as --telemetry)", file=sys.stderr)
+            return 1
         # Stream-lineage guard (VERDICT r4 weak#3): refuse to resume under
         # a different engine/block than the one that wrote the snapshot.
         state, plan, cfg = ckpt.restore(
@@ -1105,6 +1246,8 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
             cfg = dataclasses.replace(cfg, exposure=expo_cfg)
         if mar_cfg is not None:
             cfg = dataclasses.replace(cfg, margin=mar_cfg)
+        if wl_cfg is not None:
+            cfg = dataclasses.replace(cfg, workload=wl_cfg)
         state, plan = init_state(cfg), init_plan(cfg)
 
     if args.shard:
@@ -1191,6 +1334,10 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
                     registry.ingest_margin(
                         rep["margin"], rep.get("checker_complete")
                     )
+                if "slo" in rep:
+                    registry.ingest_slo(
+                        rep["slo"], cfg.workload.slo_p99_ticks
+                    )
                 if args.events:
                     # Registry-routed (and into the JSONL stream), with the
                     # historical stderr line kept for eyeball debugging.
@@ -1240,6 +1387,8 @@ def _cmd_run_logged(args: argparse.Namespace, log) -> int:
         registry.ingest_margin(
             report["margin"], report.get("checker_complete")
         )
+    if "slo" in report:
+        registry.ingest_slo(report["slo"], cfg.workload.slo_p99_ticks)
     _warn_checker_incomplete(report)
     if args.perf:
         from paxos_tpu.obs import perf as perf_mod
@@ -1356,6 +1505,11 @@ def cmd_soak(args: argparse.Namespace) -> int:
         import dataclasses
 
         cfg = dataclasses.replace(cfg, margin=mar_cfg)
+    wl_cfg = _workload_from_args(args)
+    if wl_cfg is not None:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, workload=wl_cfg)
     band = args.min_replication
     if band is None:
         rec = config_mod.REPLICATION_RATES.get(args.config)
@@ -1420,7 +1574,7 @@ def cmd_soak(args: argparse.Namespace) -> int:
 
             report["perf"] = perf_mod.perf_summary(recorder, cfg.n_inst)
         if ("coverage" in report or "exposure" in report
-                or "margin" in report or args.perf):
+                or "margin" in report or "slo" in report or args.perf):
             # Cross-seed coverage/exposure/margin/perf as gauges, so `stats
             # --prometheus` over this JSONL stream exposes the curve's
             # endpoint, the plateau, per-class exposure totals, the
@@ -1442,6 +1596,10 @@ def cmd_soak(args: argparse.Namespace) -> int:
             if "margin" in report:
                 registry.ingest_margin(
                     report["margin"], report.get("checker_complete")
+                )
+            if "slo" in report:
+                registry.ingest_slo(
+                    report["slo"], cfg.workload.slo_p99_ticks
                 )
             if args.perf:
                 registry.ingest_perf(report["perf"])
@@ -1659,7 +1817,8 @@ def cmd_fleet(args: argparse.Namespace) -> int:
         seed_stride=args.seed_stride, rng_seed=args.rng_seed,
         campaigns_per_record=args.campaigns_per_record,
         seed_entries=args.seed_entries, mutations=args.mutations,
-        energy_max=args.energy_max,
+        energy_max=args.energy_max, workload=args.workload,
+        workload_rate=args.workload_rate, slo_p99=args.slo_p99,
     )
     from paxos_tpu.harness.metrics import MetricsLog, MetricsRegistry
 
@@ -2473,6 +2632,7 @@ def cmd_trace(args: argparse.Namespace) -> int:
             coverage=_coverage_from_args(args),
             exposure=_exposure_from_args(args),
             margin=_margin_from_args(args),
+            workload=_workload_from_args(args),
         )
         # Perf plane (obs.perf): host throughput/occupancy as counter
         # tracks on the same unified timeline — free here, the recorder
@@ -2510,6 +2670,8 @@ def cmd_trace(args: argparse.Namespace) -> int:
             registry.ingest_margin(
                 cap.report["margin"], cap.report.get("checker_complete")
             )
+        if "slo" in cap.report:
+            registry.ingest_slo(cap.report["slo"])
         registry.ingest_span_aggregates(cap.aggregates)
         registry.ingest_perf(perf)
         log.emit("spans", lanes=cap.lanes, aggregates=cap.aggregates)
@@ -2945,6 +3107,130 @@ def cmd_margin(args: argparse.Namespace) -> int:
     return 0 if final_rep["violations"] == 0 else 2
 
 
+def cmd_slo(args: argparse.Namespace) -> int:
+    """Client-workload SLO plane: one campaign per offered-load scale,
+    per-class latency table, goodput curve, overload knee, p99 gate."""
+    import dataclasses
+
+    import jax
+
+    from paxos_tpu.harness.metrics import MetricsLog, MetricsRegistry
+    from paxos_tpu.harness.run import run
+    from paxos_tpu.obs.slo import overload_knee, slo_breach
+    from paxos_tpu.workload.generator import WorkloadConfig
+
+    if args.engine == "fused" and jax.devices()[0].platform != "tpu":
+        print("error: --engine fused compiles Mosaic kernels (TPU only); "
+              "use --engine xla", file=sys.stderr)
+        return 1
+    kw = {"seed": args.seed}
+    if args.n_inst:
+        kw["n_inst"] = args.n_inst
+    base = CONFIGS[args.config](**kw)
+    try:
+        base = config_mod.apply_fault_overrides(base, args.fault)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    registry = MetricsRegistry()
+    points: list = []
+    at_one: Optional[dict] = None
+    with MetricsLog(args.log) as log:
+        log.emit("start", config=args.config, n_inst=base.n_inst,
+                 protocol=base.protocol, engine=args.engine)
+        for scale in args.sweep:
+            wl = WorkloadConfig(
+                mix=args.mix,
+                rate=min(1.0, args.rate * scale),
+                burst_rate=min(1.0, WorkloadConfig().burst_rate * scale),
+                slo_p99_ticks=args.slo_p99,
+            )
+            try:
+                wl.validate()
+            except ValueError as e:
+                print(f"error: sweep scale {scale}: {e}", file=sys.stderr)
+                return 1
+            cfg = dataclasses.replace(base, workload=wl)
+            rep = run(cfg, total_ticks=args.ticks, chunk=args.chunk,
+                      engine=args.engine)
+            slo = rep["slo"]
+            pt = {
+                "rate_scale": scale,
+                "rate": wl.rate,
+                "offered": slo["offered"],
+                "done": slo["done"],
+                "shed": slo["shed"],
+                "goodput": slo["goodput"],
+                "queue_depth": slo["queue_depth"],
+                "depth_peak": slo["depth_peak"],
+                "p99_ticks": slo["p99_ticks"],
+                "violations": rep["violations"],
+                "classes": slo["classes"],
+            }
+            points.append(pt)
+            log.emit("sweep_point", **{
+                k: v for k, v in pt.items() if k != "classes"
+            })
+            if scale == 1.0:
+                at_one = slo
+                registry.ingest_slo(slo, args.slo_p99)
+        # Gate at scale 1.0 (the configured operating point); a sweep
+        # without it gates on the first swept point instead.
+        gate = at_one or {"classes": points[0]["classes"]}
+        breaches = slo_breach(gate, args.slo_p99)
+        knee = overload_knee(points, floor=args.knee_floor)
+        out = {
+            "metric": "slo",
+            "config": args.config,
+            "engine": args.engine,
+            "n_inst": base.n_inst,
+            "ticks": args.ticks,
+            "mix": args.mix,
+            "slo_p99_ticks": args.slo_p99,
+            "sweep": points,
+            "overload_knee": knee,
+            "breaches": breaches,
+        }
+        snap = registry.snapshot()
+        if snap.get("gauges"):
+            log.emit("metrics", **snap)
+        log.emit("final", **{k: v for k, v in out.items() if k != "sweep"})
+    if args.as_json:
+        print(json.dumps(out))
+    else:
+        print(f"# slo plane  config={args.config} n_inst={base.n_inst} "
+              f"ticks={args.ticks} mix={args.mix} engine={args.engine}")
+        print(f"{'scale':>7}{'rate':>9}{'offered':>10}{'done':>10}"
+              f"{'shed':>8}{'goodput':>9}{'p99':>6}{'depth_pk':>10}")
+        for pt in points:
+            print(f"{pt['rate_scale']:>7}{pt['rate']:>9.4f}"
+                  f"{pt['offered']:>10}{pt['done']:>10}{pt['shed']:>8}"
+                  f"{pt['goodput']:>9.3f}{pt['p99_ticks']:>6}"
+                  f"{pt['depth_peak']:>10}")
+        if knee is not None:
+            print(f"# overload knee: scale {knee['rate_scale']} "
+                  f"(goodput {knee['goodput']:.3f} < {args.knee_floor})")
+        else:
+            print(f"# no overload knee inside the swept range "
+                  f"(goodput >= {args.knee_floor} everywhere)")
+        if at_one is not None:
+            print("# per-class latency at scale 1.0 (ticks, queue-delay "
+                  "inclusive)")
+            print(f"{'class':<10}{'lanes':>7}{'offered':>9}{'done':>8}"
+                  f"{'goodput':>9}{'p50':>6}{'p95':>6}{'p99':>6}")
+            fmt = lambda v: "-" if v < 0 else v
+            for name, row in at_one["classes"].items():
+                print(f"{name:<10}{row['lanes']:>7}{row['offered']:>9}"
+                      f"{row['done']:>8}{row['goodput']:>9.3f}"
+                      f"{fmt(row['p50_ticks']):>6}{fmt(row['p95_ticks']):>6}"
+                      f"{fmt(row['p99_ticks']):>6}")
+        if breaches:
+            print(f"# SLO BREACH: p99 > {args.slo_p99} ticks for "
+                  f"{', '.join(breaches)}")
+    return 2 if breaches else 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.platform == "cpu":
@@ -2983,6 +3269,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         return cmd_coverage(args)
     if args.cmd == "exposure":
         return cmd_exposure(args)
+    if args.cmd == "slo":
+        return cmd_slo(args)
     if args.cmd == "margin":
         return cmd_margin(args)
     return 1

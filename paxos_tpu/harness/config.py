@@ -19,6 +19,7 @@ from paxos_tpu.faults.injector import FaultConfig
 from paxos_tpu.obs.coverage import CoverageConfig
 from paxos_tpu.obs.exposure import ExposureConfig
 from paxos_tpu.obs.margin import MarginConfig
+from paxos_tpu.workload.generator import WorkloadConfig
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +56,12 @@ class SimConfig:
     # contract: the state's margin leaf prunes to None and the fold draws
     # no PRNG, so schedules are bit-identical (tests/test_margin.py).
     margin: MarginConfig = dataclasses.field(default_factory=MarginConfig)
+    # Open-loop client workload (workload.generator) — same default-off
+    # contract: the state's wload leaf prunes to None and no arrival PRNG
+    # is drawn, so schedules are bit-identical (tests/test_workload.py).
+    workload: WorkloadConfig = dataclasses.field(
+        default_factory=WorkloadConfig
+    )
 
     def fingerprint(self) -> str:
         d = dataclasses.asdict(self)
@@ -75,6 +82,10 @@ class SimConfig:
         # fingerprints keep matching.
         if d["margin"] == dataclasses.asdict(MarginConfig()):
             del d["margin"]
+        # Workload too: disabled (the default) drops out so pre-workload
+        # fingerprints keep matching.
+        if d["workload"] == dataclasses.asdict(WorkloadConfig()):
+            del d["workload"]
         # The packed lane-state layout version (core/*_state.py) is part of
         # the on-device representation: a layout change invalidates every
         # checkpoint recorded under the old bit positions, so it must

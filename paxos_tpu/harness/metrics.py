@@ -212,6 +212,43 @@ class MetricsRegistry:
         if checker_complete is not None:
             self.gauge("checker_complete", float(checker_complete))
 
+    def ingest_slo(
+        self, slo: dict[str, Any], slo_p99_ticks: "Optional[int]" = None
+    ) -> None:
+        """Fold one ``obs.slo.slo_host`` dict into the registry.
+
+        Workload counters are cumulative on-device (the queue plane only
+        accumulates), so everything lands as gauges under an ``slo_``
+        prefix — the namespace stays disjoint from every other plane
+        (tests/test_metrics.py pins the prefix partition).  Per-class
+        offered/done/shed/goodput and latency quantiles become series
+        labelled by ``class`` (quantiles additionally by ``quantile``,
+        the summary idiom); unserved classes export no quantiles rather
+        than a faked -1, so a scraper alerting on ``slo_latency_ticks``
+        only sees real traffic.  ``slo_p99_ticks`` (the configured SLO)
+        rides along so dashboards can draw the breach line.
+        """
+        for name, row in slo["classes"].items():
+            kw = {"class": name}
+            self.gauge("slo_offered", row["offered"], **kw)
+            self.gauge("slo_done", row["done"], **kw)
+            self.gauge("slo_shed", row["shed"], **kw)
+            self.gauge("slo_goodput", row["goodput"], **kw)
+            self.gauge("slo_lanes", row["lanes"], **kw)
+            for q in ("p50", "p95", "p99"):
+                v = row[f"{q}_ticks"]
+                if v >= 0:
+                    self.gauge(
+                        "slo_latency_ticks", v, quantile=q, **kw
+                    )
+        for name in ("offered", "done", "shed", "goodput",
+                     "queue_depth", "depth_peak"):
+            self.gauge(f"slo_{name}", slo[name])
+        if slo["p99_ticks"] >= 0:
+            self.gauge("slo_p99_ticks", slo["p99_ticks"])
+        if slo_p99_ticks is not None and slo_p99_ticks > 0:
+            self.gauge("slo_target_p99_ticks", slo_p99_ticks)
+
     def ingest_span_aggregates(self, agg: dict[str, Any]) -> None:
         """Fold ``obs.spans.span_aggregates`` output into gauges.
 

@@ -81,6 +81,14 @@ def init_state(cfg: SimConfig):
         from paxos_tpu.obs.margin import MarginState
 
         state = state.replace(margin=MarginState.init(cfg.n_inst))
+    if cfg.workload.enabled():
+        from paxos_tpu.workload.generator import WloadState
+
+        state = state.replace(
+            wload=WloadState.init(
+                cfg.n_inst, cfg.n_prop, cfg.workload, cfg.seed
+            )
+        )
     return state
 
 
@@ -664,6 +672,10 @@ def summarize_device(
         from paxos_tpu.obs.margin import margin_device
 
         dev["margin"] = margin_device(state.margin)
+    if getattr(state, "wload", None) is not None:
+        from paxos_tpu.obs.slo import slo_device
+
+        dev["slo"] = slo_device(state.wload)
     if liveness:
         from paxos_tpu.check.liveness import liveness_device
 
@@ -722,6 +734,10 @@ def summarize_host(host: dict, meta: dict) -> dict[str, Any]:
         from paxos_tpu.obs.margin import margin_host
 
         out["margin"] = margin_host(host["margin"])
+    if "slo" in host:
+        from paxos_tpu.obs.slo import slo_host
+
+        out["slo"] = slo_host(host["slo"])
     if "liveness" in host:
         from paxos_tpu.check.liveness import liveness_host
 

@@ -297,6 +297,9 @@ def soak(
     # Per-seed margin snapshots (obs.margin): ranked at the end into the
     # which-seed-came-closest table.
     mar_rows: list = []
+    # Per-seed SLO blocks (obs.slo): merged at the end (summed histograms,
+    # recomputed percentiles) into the cross-seed client-latency tally.
+    slo_rows: list = []
     slots_total = 0
     rep_rates: list[float] = []  # slots replicated per lane-tick, per campaign
     retries_used = 0
@@ -496,6 +499,10 @@ def soak(
         if mar is not None:
             seed_rec["min_quorum_slack"] = mar["min_quorum_slack"]
             mar_rows.append({"seed": fscfg.seed, **mar})
+        slo = report.get("slo")
+        if slo is not None:
+            seed_rec["slo_p99_ticks"] = slo["p99_ticks"]
+            slo_rows.append(slo)
         cov = report.get("coverage")
         if cov is not None:
             cov_last = cov
@@ -621,6 +628,10 @@ def soak(
             },
             "seed_ranking": sorted(mar_rows, key=_tightness),
         }
+    if slo_rows:
+        from paxos_tpu.obs.slo import slo_merge
+
+        replication["slo"] = slo_merge(slo_rows)
     return replication | {
         "metric": "soak",
         "rounds": rounds,

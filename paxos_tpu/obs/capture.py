@@ -84,6 +84,7 @@ def capture_round_trace(
     coverage=None,
     exposure=None,
     margin=None,
+    workload=None,
 ) -> CaptureResult:
     """Run ``cfg`` for ``ticks`` with full tracing; decode ``max_lanes`` lanes.
 
@@ -100,7 +101,10 @@ def capture_round_trace(
     timeline shows WHEN each class started touching the protocol; and
     ``margin`` (an ``obs.margin.MarginConfig``) draws the
     ``min_quorum_slack`` / ``near_miss_lanes`` distance-to-violation
-    curves, so the timeline shows WHEN the campaign got close.
+    curves, so the timeline shows WHEN the campaign got close; and
+    ``workload`` (a ``workload.generator.WorkloadConfig``) draws the
+    ``slo_p99_ticks`` / ``queue_depth`` client-latency curves, so the
+    timeline shows WHEN the queues backed up.
     Sampling needs the state pytree at each boundary, so any sampler
     forces the serial per-chunk dispatcher (the sample itself is a small
     device_get, not a state round-trip); a trace run is a debug tool, so
@@ -122,23 +126,28 @@ def capture_round_trace(
     sample_coverage = coverage is not None and coverage.enabled()
     sample_exposure = exposure is not None and exposure.enabled()
     sample_margin = margin is not None and margin.enabled()
+    sample_workload = workload is not None and workload.enabled()
     if sample_coverage:
         tcfg = dataclasses.replace(tcfg, coverage=coverage)
     if sample_exposure:
         tcfg = dataclasses.replace(tcfg, exposure=exposure)
     if sample_margin:
         tcfg = dataclasses.replace(tcfg, margin=margin)
+    if sample_workload:
+        tcfg = dataclasses.replace(tcfg, workload=workload)
     with sp.span("init", n_inst=tcfg.n_inst, protocol=tcfg.protocol):
         state = init_state(tcfg)
         plan = init_plan(tcfg)
     counters: Optional[dict[str, list]] = None
-    if sample_coverage or sample_exposure or sample_margin:
+    if sample_coverage or sample_exposure or sample_margin or sample_workload:
         if sample_coverage:
             from paxos_tpu.obs.coverage import coverage_device
         if sample_exposure:
             from paxos_tpu.obs.exposure import CLASSES, exposure_device
         if sample_margin:
             from paxos_tpu.obs.margin import SENTINEL, margin_device
+        if sample_workload:
+            from paxos_tpu.obs.slo import slo_device, slo_host
 
         advance = make_advance(
             tcfg, plan, engine, compact=bool(make_longlog(tcfg))
@@ -149,6 +158,9 @@ def capture_round_trace(
         )
         mar_samples: dict[str, list] = {
             name: [] for name in ("min_quorum_slack", "near_miss_lanes")
+        }
+        slo_samples: dict[str, list] = {
+            name: [] for name in ("slo_p99_ticks", "queue_depth")
         }
         done = 0
         while done < ticks:
@@ -180,6 +192,18 @@ def capture_round_trace(
                 mar_samples["near_miss_lanes"].append(
                     (done, int(md["near_miss_lanes"]))
                 )
+            if sample_workload:
+                with sp.span("slo_sample", tick=done):
+                    sd = slo_host(jax.device_get(slo_device(state.wload)))
+                # No served traffic yet (-1) would draw a misleading
+                # negative spike; the latency curve starts at first serve.
+                if sd["p99_ticks"] >= 0:
+                    slo_samples["slo_p99_ticks"].append(
+                        (done, sd["p99_ticks"])
+                    )
+                slo_samples["queue_depth"].append(
+                    (done, sd["queue_depth"])
+                )
         counters = {}
         if sample_coverage:
             counters["coverage_bits_set"] = cov_samples
@@ -189,6 +213,10 @@ def capture_round_trace(
             for name, series in mar_samples.items():
                 if series:
                     counters[f"margin_{name}"] = series
+        if sample_workload:
+            for name, series in slo_samples.items():
+                if series:
+                    counters[name] = series
     else:
         advance = make_advance_grouped(
             tcfg, plan, engine, compact=bool(make_longlog(tcfg))

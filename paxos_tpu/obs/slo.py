@@ -1,0 +1,192 @@
+"""SLO reductions for the client-workload plane (``workload.generator``).
+
+The device half (:func:`slo_device`) reduces the per-lane queue counters
+into one small pytree at the summarize boundary — per-class offered /
+served / shed totals and the per-class log2 latency histogram — so the
+whole SLO block rides the existing single ``device_get`` in
+``harness.run.summarize``.  The host half (:func:`slo_host`) turns the
+histograms into queue-delay-inclusive client-latency percentiles
+(p50/p95/p99, reported as the bucket's inclusive upper edge in ticks) and
+goodput-vs-offered ratios; :func:`slo_breach` applies the configured p99
+SLO (exit 2 in the ``paxos_tpu slo`` subcommand), and
+:func:`overload_knee` locates the first point of an offered-load sweep
+where goodput stops tracking offered load.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paxos_tpu.workload.generator import CLASSES, WloadState
+
+PERCENTILES = (50, 95, 99)
+
+
+def slo_device(wl: WloadState) -> dict:
+    """Device half of the SLO report: reductions only, no transfer."""
+    n_classes = len(CLASSES)
+    cls = (
+        jax.lax.broadcasted_iota(
+            jnp.int32, (n_classes,) + wl.mode.shape, 0
+        )
+        == wl.mode[None]
+    )  # (C, P, I) — lane-class membership
+
+    def per_class(x):
+        return jnp.where(cls, x[None], 0).sum(axis=(1, 2), dtype=jnp.int32)
+
+    return {
+        "offered": per_class(wl.offered),  # (C,)
+        "done": per_class(wl.done),  # (C,)
+        "shed": per_class(wl.shed),  # (C,)
+        "lanes": cls.astype(jnp.int32).sum(axis=(1, 2), dtype=jnp.int32),
+        "hist": wl.hist.sum(axis=-1, dtype=jnp.int32),  # (C*B,)
+        "queue_depth": wl.depth.sum(dtype=jnp.int32),  # () live depth now
+        "depth_peak": wl.depth_peak.max(),  # () high-water mark
+    }
+
+
+def _bucket_edge(b: int) -> int:
+    """Inclusive upper edge (ticks) of log2 bucket ``b``: [2^b, 2^(b+1))."""
+    return (1 << (b + 1)) - 1
+
+
+def _percentile_ticks(hist, q: int) -> int:
+    """The q-th percentile latency from a log2-bucket histogram, in ticks.
+
+    Reported as the holding bucket's upper edge (conservative); -1 when
+    the class served nothing.
+    """
+    total = int(sum(hist))
+    if total == 0:
+        return -1
+    need = (total * q + 99) // 100  # ceil(total * q / 100), int-exact
+    cum = 0
+    for b, n in enumerate(hist):
+        cum += int(n)
+        if cum >= need:
+            return _bucket_edge(b)
+    return _bucket_edge(len(hist) - 1)
+
+
+def slo_host(host: dict) -> dict:
+    """Format a ``device_get``'d :func:`slo_device` pytree."""
+    n_classes = len(CLASSES)
+    flat = [int(v) for v in host["hist"]]
+    bins = len(flat) // n_classes
+    classes = {}
+    for c, name in enumerate(CLASSES):
+        hist = flat[c * bins : (c + 1) * bins]
+        offered = int(host["offered"][c])
+        done = int(host["done"][c])
+        row = {
+            "lanes": int(host["lanes"][c]),
+            "offered": offered,
+            "done": done,
+            "shed": int(host["shed"][c]),
+            "goodput": (done / offered) if offered else 0.0,
+            "hist": hist,
+        }
+        for q in PERCENTILES:
+            row[f"p{q}_ticks"] = _percentile_ticks(hist, q)
+        classes[name] = row
+    offered = sum(r["offered"] for r in classes.values())
+    done = sum(r["done"] for r in classes.values())
+    return {
+        "classes": classes,
+        "offered": offered,
+        "done": done,
+        "shed": sum(r["shed"] for r in classes.values()),
+        "goodput": (done / offered) if offered else 0.0,
+        "queue_depth": int(host["queue_depth"]),
+        "depth_peak": int(host["depth_peak"]),
+        # Campaign-wide p99: the worst class that actually served traffic.
+        "p99_ticks": max(
+            (r["p99_ticks"] for r in classes.values() if r["done"] > 0),
+            default=-1,
+        ),
+    }
+
+
+def slo_merge(blocks: list) -> dict:
+    """Merge per-campaign ``slo_host`` blocks into one cross-seed tally.
+
+    Counters and histograms sum (each seed's lanes are a fresh client
+    population, like exposure's ``lanes_exposed``); percentiles are
+    recomputed from the summed histograms — NOT averaged, an average of
+    percentiles is not a percentile.  ``queue_depth`` is point-in-time so
+    the last block wins; ``depth_peak`` is a high-water mark so the max
+    wins.  The key shape matches ``slo_host`` so
+    ``MetricsRegistry.ingest_slo`` folds the merged block directly.
+    """
+    classes: dict = {}
+    for blk in blocks:
+        for name, row in blk["classes"].items():
+            acc = classes.setdefault(name, {
+                "lanes": 0, "offered": 0, "done": 0, "shed": 0,
+                "hist": [0] * len(row["hist"]),
+            })
+            for k in ("lanes", "offered", "done", "shed"):
+                acc[k] += row[k]
+            acc["hist"] = [a + b for a, b in zip(acc["hist"], row["hist"])]
+    for row in classes.values():
+        row["goodput"] = (
+            row["done"] / row["offered"] if row["offered"] else 0.0
+        )
+        for q in PERCENTILES:
+            row[f"p{q}_ticks"] = _percentile_ticks(row["hist"], q)
+    offered = sum(r["offered"] for r in classes.values())
+    done = sum(r["done"] for r in classes.values())
+    return {
+        "classes": classes,
+        "offered": offered,
+        "done": done,
+        "shed": sum(r["shed"] for r in classes.values()),
+        "goodput": (done / offered) if offered else 0.0,
+        "queue_depth": blocks[-1]["queue_depth"] if blocks else 0,
+        "depth_peak": max((b["depth_peak"] for b in blocks), default=0),
+        "p99_ticks": max(
+            (r["p99_ticks"] for r in classes.values() if r["done"] > 0),
+            default=-1,
+        ),
+    }
+
+
+def slo_report(wl: WloadState) -> dict:
+    """Host-readable SLO summary (one blocking transfer; tests/CLI)."""
+    return slo_host(jax.device_get(slo_device(wl)))
+
+
+def slo_breach(report: dict, p99_ticks: int) -> list:
+    """Classes whose served-traffic p99 exceeds the SLO (empty = healthy).
+
+    ``p99_ticks <= 0`` disables gating (no SLO configured).
+    """
+    if p99_ticks <= 0:
+        return []
+    return sorted(
+        name
+        for name, row in report["classes"].items()
+        if row["done"] > 0 and row["p99_ticks"] > p99_ticks
+    )
+
+
+def overload_knee(points: list, floor: float = 0.9) -> Optional[dict]:
+    """First point of an offered-load sweep where goodput/offered < floor.
+
+    ``points`` is a list of dicts each carrying ``rate_scale``, ``offered``
+    and ``done`` (the ``paxos_tpu slo`` sweep builds it); returns the knee
+    point annotated with its goodput ratio, or ``None`` when the system
+    kept up everywhere (no knee inside the swept range).
+    """
+    for pt in points:
+        offered = pt.get("offered", 0)
+        if offered <= 0:
+            continue
+        ratio = pt.get("done", 0) / offered
+        if ratio < floor:
+            return dict(pt, goodput=ratio)
+    return None

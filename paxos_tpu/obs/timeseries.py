@@ -228,10 +228,11 @@ def compare_series(
     rps_floor: float = 0.25,
     gap_k: float = 4.0,
     gap_min_s: float = 120.0,
+    slo_k: float = 2.0,
 ) -> dict:
     """Trend-gate a fleet's RAW sample rows; mirrors ``compare_benches``.
 
-    Three detectors, each finding naming the worker and record:
+    Four detectors, each finding naming the worker and record:
 
     - **discovery_stall** — a ``(worker, record)`` group with at least
       ``stall_samples`` samples whose coverage union never grew past its
@@ -245,6 +246,10 @@ def compare_series(
       exceeds both ``gap_k`` x its median gap and the ``gap_min_s``
       absolute floor: the worker went dark mid-record (the floor keeps
       honest compile stalls on slow CI out of the findings).
+    - **slo_degradation** — a ``(worker, record)`` group whose LAST
+      ``slo_p99_ticks`` sample exceeds ``slo_k`` x its own median
+      (>= 4 samples): client latency blew past its steady state late in
+      the campaign, which the campaign-total percentile would blur.
 
     The rps and gap detectors read the non-canonical ``wall`` sidecar,
     so they see real delivery behaviour; the stall detector reads only
@@ -272,6 +277,15 @@ def compare_series(
                 "kind": "discovery_stall", "worker": w, "record": rec,
                 "samples": len(bits), "union_bits": bits[0],
             })
+        p99s = [r.get("gauges", {}).get("slo_p99_ticks") for r in g]
+        p99s = [float(v) for v in p99s if v is not None]
+        if len(p99s) >= 4:
+            med = _median(p99s)
+            if med > 0 and p99s[-1] > slo_k * med:
+                findings.append({
+                    "kind": "slo_degradation", "worker": w, "record": rec,
+                    "last_p99_ticks": p99s[-1], "median_p99_ticks": med,
+                })
     for w, g in sorted(by_worker.items()):
         g = sorted(g, key=lambda r: int(r.get("seq", 0)))
         rps = [
@@ -314,6 +328,6 @@ def compare_series(
         "findings": findings,
         "params": {
             "stall_samples": stall_samples, "rps_floor": rps_floor,
-            "gap_k": gap_k, "gap_min_s": gap_min_s,
+            "gap_k": gap_k, "gap_min_s": gap_min_s, "slo_k": slo_k,
         },
     }

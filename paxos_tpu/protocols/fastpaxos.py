@@ -61,6 +61,7 @@ from paxos_tpu.faults.injector import (
 from paxos_tpu.kernels.quorum import fast_quorum, majority, quorum_reached
 from paxos_tpu.protocols.paxos import delay_stamps
 from paxos_tpu.transport import inmemory_tpu as net
+from paxos_tpu.workload import generator as wload_mod
 from paxos_tpu.utils.bitops import popcount
 
 
@@ -476,6 +477,16 @@ def apply_tick_fast(
             ~equiv, q2, fast_quorum=fquorum,
         )
 
+    wl = state.wload
+    if wl is not None:
+        # Client queue (workload.generator): a lane retires one queued
+        # request on its proposer's commit edge (phase -> DONE this tick).
+        with jax.named_scope(wload_mod.WLOAD_SCOPE):
+            wl = wload_mod.observe(
+                wl, state.tick, serve=p2_done | fast_done,
+                arrival_bits=masks.arrival_bits,
+            )
+
     state = state.replace(
         acceptor=acc,
         proposer=prop,
@@ -486,6 +497,7 @@ def apply_tick_fast(
         telemetry=tel,
         exposure=exp,
         margin=mar,
+        wload=wl,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built.  PRNG-free, like telemetry.
@@ -509,5 +521,7 @@ def fastpaxos_step(
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     key = streams_mod.tick_key(base_key, state.tick)
-    masks = sample_masks(key, cfg, n_prop, n_acc, n_inst)
+    masks = sample_masks(
+        key, cfg, n_prop, n_acc, n_inst, wload=state.wload is not None
+    )
     return apply_tick_fast(state, masks, plan, cfg)

@@ -55,6 +55,7 @@ from paxos_tpu.faults.injector import (
 )
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
+from paxos_tpu.workload import generator as wload_mod
 
 
 def own_slot_value(pid: jnp.ndarray, slot: jnp.ndarray) -> jnp.ndarray:
@@ -87,10 +88,13 @@ class MPTickMasks:
     # 0=PROMISE 1=ACCEPTED 2=PREPARE 3=ACCEPT.
     delay_bits: Optional[jnp.ndarray] = None  # (4, P, A, I) int32
     lat_bits: Optional[jnp.ndarray] = None  # (4, P, A, I) int32
+    arrival_bits: Optional[jnp.ndarray] = None  # (P, I) int32 raw bits —
+    #   client-arrival draws (workload plane; None unless the plane is on)
 
 
 def sample_mp_masks(
-    key: jax.Array, cfg: FaultConfig, n_prop: int, n_acc: int, n_inst: int
+    key: jax.Array, cfg: FaultConfig, n_prop: int, n_acc: int, n_inst: int,
+    wload: bool = False,
 ) -> MPTickMasks:
     """Draw a tick's masks with ``jax.random`` (the XLA engine's source)."""
     (k_sel, k_idle, k_dup_req, k_hold_pr, k_hold_ac, k_drop_pr, k_drop_ac,
@@ -143,6 +147,11 @@ def sample_mp_masks(
         ),
         lat_bits=(
             raw_bits("LAT_BITS", (4,) + edge) if cfg.p_delay > 0.0 else None
+        ),
+        # Workload arrivals fold like the gray draws (off = zero eqns) but
+        # on their own registered constant, gated on the wload plane.
+        arrival_bits=(
+            raw_bits("ARRIVAL_BITS", (n_prop, n_inst)) if wload else None
         ),
     )
 
@@ -230,6 +239,11 @@ def mp_counter_masks(
         lat_bits=(
             cp.counter_bits(tick_seed, s["LAT_BITS"], (4,) + edge)
             if cfg.p_delay > 0.0
+            else None
+        ),
+        arrival_bits=(
+            cp.counter_bits(tick_seed, s["ARRIVAL"], (n_prop, n_inst))
+            if state.wload is not None
             else None
         ),
     )
@@ -730,6 +744,15 @@ def apply_tick_mp(
             mar, state.learner, learner, acc.promised,
             bv_bal(acc.log).max(axis=1), ~equiv, quorum,
         )
+    wl = state.wload
+    if wl is not None:
+        # Client queue (workload.generator): a leader retires one queued
+        # request per committed log slot (slot_done is the commit edge).
+        with jax.named_scope(wload_mod.WLOAD_SCOPE):
+            wl = wload_mod.observe(
+                wl, state.tick, serve=slot_done,
+                arrival_bits=masks.arrival_bits,
+            )
 
     state = state.replace(
         acceptor=acc,
@@ -742,6 +765,7 @@ def apply_tick_mp(
         telemetry=tel,
         exposure=exp,
         margin=mar,
+        wload=wl,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built (includes `base`, so the same window at a
@@ -758,7 +782,9 @@ def multipaxos_step(
     n_acc, n_inst = state.acceptor.promised.shape
     n_prop = state.proposer.bal.shape[0]
     key = streams_mod.tick_key(base_key, state.tick)
-    masks = sample_mp_masks(key, cfg, n_prop, n_acc, n_inst)
+    masks = sample_mp_masks(
+        key, cfg, n_prop, n_acc, n_inst, wload=state.wload is not None
+    )
     return apply_tick_mp(state, masks, plan, cfg)
 
 

@@ -65,6 +65,7 @@ from paxos_tpu.faults.injector import (
 )
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.transport import inmemory_tpu as net
+from paxos_tpu.workload import generator as wload_mod
 
 
 @struct.dataclass
@@ -99,10 +100,13 @@ class TickMasks:
     #   bits — per-send delay decision (p_delay); axis 0: 0=requests 1=replies
     lat_bits: Optional[jnp.ndarray] = None  # (2, 2, P, A, I) int32 raw bits
     #   — sampled latency, reduced mod delay_max and capped per link
+    arrival_bits: Optional[jnp.ndarray] = None  # (P, I) int32 raw bits —
+    #   client-arrival draws (workload plane; None unless the plane is on)
 
 
 def sample_masks(
-    key: jax.Array, cfg: FaultConfig, n_prop: int, n_acc: int, n_inst: int
+    key: jax.Array, cfg: FaultConfig, n_prop: int, n_acc: int, n_inst: int,
+    wload: bool = False,
 ) -> TickMasks:
     """Draw a tick's masks with ``jax.random`` (the XLA engine's source)."""
     (k_sel, k_idle, k_dup_req, k_hold, k_dup_rep, k_drop_prom, k_drop_accd,
@@ -156,6 +160,11 @@ def sample_masks(
         ),
         lat_bits=(
             raw_bits("LAT_BITS", (2,) + slot) if cfg.p_delay > 0.0 else None
+        ),
+        # Workload arrivals fold like the gray draws (off = zero eqns) but
+        # on their own registered constant, gated on the wload plane.
+        arrival_bits=(
+            raw_bits("ARRIVAL_BITS", (n_prop, n_inst)) if wload else None
         ),
     )
 
@@ -251,6 +260,11 @@ def counter_masks(
         lat_bits=(
             cp.counter_bits(tick_seed, s["LAT_BITS"], (2,) + slot)
             if cfg.p_delay > 0.0
+            else None
+        ),
+        arrival_bits=(
+            cp.counter_bits(tick_seed, s["ARRIVAL"], (n_prop, n_inst))
+            if state.wload is not None
             else None
         ),
     )
@@ -728,6 +742,15 @@ def apply_tick(
             mar, state.learner, learner, acc.promised, acc.acc_bal,
             ~equiv, q2,
         )
+    wl = state.wload
+    if wl is not None:
+        # Client queue (workload.generator): a lane retires one queued
+        # request on its proposer's commit edge (phase -> DONE this tick).
+        with jax.named_scope(wload_mod.WLOAD_SCOPE):
+            wl = wload_mod.observe(
+                wl, state.tick, serve=p2_done,
+                arrival_bits=masks.arrival_bits,
+            )
 
     state = state.replace(
         acceptor=acc,
@@ -739,6 +762,7 @@ def apply_tick(
         telemetry=tel,
         exposure=exp,
         margin=mar,
+        wload=wl,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built, so host-side digests of returned states
@@ -756,5 +780,7 @@ def paxos_step(
     n_prop = state.proposer.bal.shape[0]
     # Keys depend only on (seed, tick): checkpoint/resume replays bit-exactly.
     key = streams_mod.tick_key(base_key, state.tick)
-    masks = sample_masks(key, cfg, n_prop, n_acc, n_inst)
+    masks = sample_masks(
+        key, cfg, n_prop, n_acc, n_inst, wload=state.wload is not None
+    )
     return apply_tick(state, masks, plan, cfg)

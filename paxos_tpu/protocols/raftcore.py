@@ -65,6 +65,7 @@ from paxos_tpu.faults.injector import (
 from paxos_tpu.kernels.quorum import majority, quorum_reached
 from paxos_tpu.protocols.paxos import delay_stamps
 from paxos_tpu.transport import inmemory_tpu as net
+from paxos_tpu.workload import generator as wload_mod
 
 
 def apply_tick_raft(
@@ -431,6 +432,16 @@ def apply_tick_raft(
             ~equiv, quorum,
         )
 
+    wl = state.wload
+    if wl is not None:
+        # Client queue (workload.generator): a lane retires one queued
+        # request on its proposer's commit edge (leader commit this tick).
+        with jax.named_scope(wload_mod.WLOAD_SCOPE):
+            wl = wload_mod.observe(
+                wl, state.tick, serve=committed,
+                arrival_bits=masks.arrival_bits,
+            )
+
     state = state.replace(
         acceptor=voter,
         proposer=cand,
@@ -441,6 +452,7 @@ def apply_tick_raft(
         telemetry=tel,
         exposure=exp,
         margin=mar,
+        wload=wl,
     )
     # ---- Coverage sketch (obs.coverage): hash the post-tick state the ----
     # replace above just built.  PRNG-free, like telemetry.
@@ -463,5 +475,7 @@ def raftcore_step(
     n_acc, n_inst = state.acceptor.voted.shape
     n_prop = state.proposer.bal.shape[0]
     key = streams_mod.tick_key(base_key, state.tick)
-    masks = sample_masks(key, cfg, n_prop, n_acc, n_inst)
+    masks = sample_masks(
+        key, cfg, n_prop, n_acc, n_inst, wload=state.wload is not None
+    )
     return apply_tick_raft(state, masks, plan, cfg)

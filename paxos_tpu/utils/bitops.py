@@ -211,6 +211,11 @@ def leaf_paths(state) -> "list[str]":
             return
         if dataclasses.is_dataclass(obj):
             for f in dataclasses.fields(obj):
+                # Static aux fields (flax ``pytree_node=False``, e.g. the
+                # workload plane's knob carrier) are treedef data, not
+                # leaves — they never reach the codec.
+                if not f.metadata.get("pytree_node", True):
+                    continue
                 v = getattr(obj, f.name)
                 if v is not None:
                     walk(v, prefix + f.name + ".")

@@ -1,0 +1,18 @@
+"""Client-workload plane: open-loop traffic + on-device queue accounting.
+
+See ``workload.generator`` for the arrival processes and queue mechanics,
+and ``obs.slo`` for the summarize-boundary SLO reductions.
+"""
+
+from paxos_tpu.workload.generator import (  # noqa: F401
+    CLASSES,
+    MIXES,
+    WLOAD_SCOPE,
+    WloadState,
+    WorkloadConfig,
+    arrival_threshold,
+    np_arrival_threshold,
+    np_replay_queue,
+    observe,
+    rate_to_threshold,
+)

@@ -1,0 +1,347 @@
+"""Open-loop client-arrival generator and on-device queue accounting.
+
+The tenth observability layer (ROADMAP item 5): every lane previously ran
+the same duel-style proposer workload, so nothing measured how the
+protocols behave under production-shaped traffic.  This module adds a
+per-proposer *open-loop* client queue — arrivals keep coming whether or
+not the system keeps up, which is what makes overload measurable at all
+(a closed loop self-throttles and hides the knee).
+
+Per (proposer, instance) lane:
+
+- **Arrival process** (:func:`arrival_threshold`): one Bernoulli draw per
+  tick against a per-lane uint32 threshold.  The threshold is modulated by
+  the lane's workload *class* (``mode``): Poisson (constant baseline
+  rate), bursty (a ``burst_len``-tick window of ``burst_rate`` every
+  ``period`` ticks), or diurnal (a triangle wave between the two rates).
+  Class and phase are sampled once per campaign from the dedicated
+  ``ROOT_WLOAD`` key lineage (``core.streams``), exactly like the fault
+  plan; the per-tick raw bits come from the protocol mask samplers on the
+  registered ``ARRIVAL`` streams/folds, so both engines draw their own
+  deterministic stream and the auditor can see every draw.
+- **Bounded queue** (:func:`observe`): a ring of enqueue-tick stamps.
+  Serves happen *before* enqueues each tick; an arrival finding the ring
+  full is **shed** (counted — goodput < offered is the overload signal).
+  A serve pops the head stamp and banks ``tick - stamp`` — the
+  queue-delay-inclusive client latency — into a per-class log2-bucket
+  histogram, reduced at the summarize boundary (``obs.slo``) into
+  per-class p50/p95/p99 and goodput-vs-offered curves.
+
+The default-off-is-free contract (``obs.exposure`` is the template):
+:class:`WloadState` rides as an Optional ``wload`` leaf of every protocol
+state — ``None`` when disabled (pruned pytree, zero PRNG draws, golden
+schedule digests byte-identical on both engines).  All leaves are int32
+with a trailing instances axis and no scalars, so the fused engine's
+generic passthrough codec (``utils/bitops``) carries the plane with ZERO
+layout-table changes — the packed LAYOUT goldens stay byte-identical.
+Mosaic diet: elementwise int32, iota-masked ``where`` instead of scatter,
+sign-flip unsigned compares (``faults.injector.bits_below``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from paxos_tpu.core import streams as streams_mod
+from paxos_tpu.faults.injector import bits_below
+
+# Workload classes, in mode order (mode c of a lane is CLASSES[c]) — the
+# row order of the per-class histogram and SLO tables.  Append only.
+CLASSES = ("poisson", "bursty", "diurnal")
+
+MIXES = ("off",) + CLASSES + ("mixed",)
+
+# Named-scope tag wrapping every protocol's client-queue fold — the flow
+# auditor (analysis/flow.py) uses it to recognize the arrival-sampling /
+# queue-accounting region in traced step functions.
+WLOAD_SCOPE = "__wload__client_queue"
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadConfig:
+    """Static client-workload knobs (frozen: rides ``SimConfig`` into jit).
+
+    ``mix="off"`` — the default — disables the plane entirely (the state
+    leaf prunes to ``None``, zero PRNG draws, bit-identical schedules).
+    A named mix pins every lane to that arrival class; ``"mixed"`` samples
+    a class per lane from the ``ROOT_WLOAD`` lineage.
+    """
+
+    mix: str = "off"
+    rate: float = 0.05  # baseline per-tick arrival probability
+    burst_rate: float = 0.5  # peak probability (bursty window / diurnal crest)
+    period: int = 32  # bursty/diurnal cycle length, ticks
+    burst_len: int = 8  # in-burst window length (bursty class)
+    queue_cap: int = 8  # bounded per-proposer queue depth
+    hist_bins: int = 16  # log2 latency buckets (bucket b: [2^b, 2^(b+1)))
+    slo_p99_ticks: int = 0  # per-class p99 SLO; 0 = no breach gating
+
+    def enabled(self) -> bool:
+        return self.mix != "off"
+
+    def validate(self) -> None:
+        if self.mix not in MIXES:
+            raise ValueError(
+                f"workload mix {self.mix!r} not in {MIXES}"
+            )
+        if self.enabled():
+            if not 2 <= self.period:
+                raise ValueError("workload period must be >= 2 ticks")
+            if not 1 <= self.burst_len <= self.period:
+                raise ValueError(
+                    "workload burst_len must be in [1, period]"
+                )
+            if not 1 <= self.queue_cap <= 64:
+                raise ValueError("workload queue_cap must be in [1, 64]")
+            if not 2 <= self.hist_bins <= 24:
+                raise ValueError("workload hist_bins must be in [2, 24]")
+            if not 0.0 <= self.rate <= 1.0:
+                raise ValueError("workload rate must be in [0, 1]")
+            if not 0.0 <= self.burst_rate <= 1.0:
+                raise ValueError("workload burst_rate must be in [0, 1]")
+
+
+def rate_to_threshold(p: float) -> int:
+    """uint32 Bernoulli threshold for rate ``p``, as a python int.
+
+    Matches ``kernels.counter_prng.bern``'s quantization exactly so the
+    numpy replay oracle and both device engines agree bit-for-bit.
+    """
+    return max(0, min(int(round(p * float(1 << 32))), (1 << 32) - 1))
+
+
+def _i32(c: int) -> jnp.ndarray:
+    """int32 constant with the bit pattern of a (possibly >2^31) literal."""
+    c &= 0xFFFFFFFF
+    return jnp.int32(c - (1 << 32) if c >= (1 << 31) else c)
+
+
+@struct.dataclass
+class WloadState:
+    """Per-lane open-loop client queue (int32, instance-minor, no scalars).
+
+    The plan half (``mode``/``phase``) is sampled once at init from the
+    ``ROOT_WLOAD`` lineage and never rewritten; the queue half mutates
+    every tick.  The static :class:`WorkloadConfig` rides as pytree aux
+    data (``pytree_node=False``) so :func:`observe` — called from inside
+    ``apply_tick`` with no access to ``SimConfig`` — sees the knobs at
+    trace time; it is part of the treedef, which the structure goldens
+    pin per audit config.
+    """
+
+    mode: jnp.ndarray  # (P, I) int32 — arrival class, index into CLASSES
+    phase: jnp.ndarray  # (P, I) int32 — cycle phase offset in [0, period)
+    ring: jnp.ndarray  # (Q, P, I) int32 — enqueue-tick stamps (circular)
+    head: jnp.ndarray  # (P, I) int32 — ring read index in [0, Q)
+    depth: jnp.ndarray  # (P, I) int32 — live queue depth in [0, Q]
+    depth_peak: jnp.ndarray  # (P, I) int32 — running max of depth
+    offered: jnp.ndarray  # (P, I) int32 — arrivals sampled (open-loop load)
+    done: jnp.ndarray  # (P, I) int32 — requests served (goodput)
+    shed: jnp.ndarray  # (P, I) int32 — arrivals dropped on a full ring
+    hist: jnp.ndarray  # (C*B, I) int32 — per-class log2 latency buckets
+    cfg: WorkloadConfig = struct.field(pytree_node=False)
+
+    @classmethod
+    def init(
+        cls, n_inst: int, n_prop: int, cfg: WorkloadConfig, seed: int
+    ) -> "WloadState":
+        """Sample the workload plan and zero the queue (host-side, once).
+
+        Both engines share this init (like the fault plan), so the plan
+        half is engine-independent by construction.
+        """
+        cfg.validate()
+        k_mode, k_phase = jax.random.split(
+            streams_mod.root_wload_key(seed), 2
+        )
+        shape = (n_prop, n_inst)
+        if cfg.mix == "mixed":
+            mode = jax.random.randint(
+                k_mode, shape, 0, len(CLASSES), jnp.int32
+            )
+        else:
+            mode = jnp.full(shape, CLASSES.index(cfg.mix), jnp.int32)
+        phase = jax.random.randint(k_phase, shape, 0, cfg.period, jnp.int32)
+
+        def z():
+            return jnp.zeros(shape, jnp.int32)
+
+        return cls(
+            mode=mode,
+            phase=phase,
+            ring=jnp.zeros((cfg.queue_cap,) + shape, jnp.int32),
+            head=z(),
+            depth=z(),
+            depth_peak=z(),
+            offered=z(),
+            done=z(),
+            shed=z(),
+            hist=jnp.zeros((len(CLASSES) * cfg.hist_bins, n_inst), jnp.int32),
+            cfg=cfg,
+        )
+
+
+def arrival_threshold(wl: WloadState, tick) -> jnp.ndarray:
+    """(P, I) int32 uint32-bit-pattern Bernoulli threshold for this tick.
+
+    All-int32 (Mosaic-safe): the diurnal interpolation multiplies a static
+    per-step threshold increment by the triangle position — int32 wrapping
+    arithmetic is arithmetic mod 2^32, so the bit pattern matches the
+    uint32 math of the numpy oracle exactly.
+    """
+    cfg = wl.cfg
+    t_lo = rate_to_threshold(cfg.rate)
+    t_hi = rate_to_threshold(cfg.burst_rate)
+    halfp = max(cfg.period // 2, 1)
+    step = (t_hi - t_lo) // halfp  # static python int (can be negative)
+
+    pos = (tick + wl.phase) % jnp.int32(cfg.period)  # (P, I), non-negative
+    thr = jnp.full_like(wl.mode, _i32(t_lo))  # class 0: constant baseline
+    thr = jnp.where(
+        (wl.mode == 1) & (pos < jnp.int32(cfg.burst_len)), _i32(t_hi), thr
+    )
+    tri = jnp.minimum(pos, jnp.int32(cfg.period) - pos)  # [0, halfp]
+    thr = jnp.where(wl.mode == 2, _i32(t_lo) + _i32(step) * tri, thr)
+    return thr
+
+
+def observe(
+    wl: WloadState, tick, serve: jnp.ndarray, arrival_bits: jnp.ndarray
+) -> WloadState:
+    """Fold one tick into the queue: serve first, then enqueue arrivals.
+
+    ``serve`` is the protocol's per-(P, I) commit edge this tick (a lane
+    whose proposer just completed a decision can retire one queued
+    request); ``arrival_bits`` the raw int32 bits drawn on the registered
+    ``ARRIVAL`` stream/fold by the engine's mask sampler.  PRNG-free
+    itself — all randomness arrives pre-sampled, like the fault masks —
+    and serve-before-enqueue means a request can never be served on its
+    arrival tick (minimum latency 1 tick).
+    """
+    cfg = wl.cfg
+    cap = cfg.queue_cap
+    bins = cfg.hist_bins
+    rowq = jax.lax.broadcasted_iota(jnp.int32, wl.ring.shape, 0)
+
+    # ---- Serve: pop the head stamp, bank the latency ----
+    pop = serve & (wl.depth > 0)  # (P, I)
+    stamp = jnp.where(rowq == wl.head[None], wl.ring, 0).sum(axis=0)
+    latency = tick - stamp  # >= 1 where popped (serve-before-enqueue)
+    # log2 bucket: b = #{k in [1, bins): latency >= 2^k}, clamped to bins-1.
+    bucket = jnp.zeros_like(latency)
+    for k in range(1, bins):
+        bucket = bucket + (latency >= jnp.int32(1 << k)).astype(jnp.int32)
+    hist_row = wl.mode * jnp.int32(bins) + bucket  # (P, I)
+    rowh = jax.lax.broadcasted_iota(
+        jnp.int32, (wl.hist.shape[0],) + wl.mode.shape, 0
+    )
+    hist = wl.hist + jnp.where(
+        (rowh == hist_row[None]) & pop[None], 1, 0
+    ).sum(axis=1, dtype=jnp.int32)
+    head1 = wl.head + 1
+    head = jnp.where(
+        pop, jnp.where(head1 >= cap, head1 - cap, head1), wl.head
+    )
+    depth = wl.depth - pop.astype(jnp.int32)
+
+    # ---- Enqueue: one Bernoulli arrival per lane per tick ----
+    arrival = bits_below(arrival_bits, arrival_threshold(wl, tick))
+    room = depth < jnp.int32(cap)
+    enq = arrival & room
+    slot = head + depth
+    slot = jnp.where(slot >= cap, slot - cap, slot)
+    ring = jnp.where(
+        (rowq == slot[None]) & enq[None],
+        jnp.broadcast_to(tick, wl.ring.shape).astype(jnp.int32),
+        wl.ring,
+    )
+    depth = depth + enq.astype(jnp.int32)
+
+    return wl.replace(
+        ring=ring,
+        head=head,
+        depth=depth,
+        depth_peak=jnp.maximum(wl.depth_peak, depth),
+        offered=wl.offered + arrival.astype(jnp.int32),
+        done=wl.done + pop.astype(jnp.int32),
+        shed=wl.shed + (arrival & ~room).astype(jnp.int32),
+        hist=hist,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Numpy replay oracle: the same arrival thresholds and queue mechanics in
+# plain numpy uint32/int64 arithmetic — the bit-exact host-side twin the
+# generator tests diff both device engines against (tests/test_workload.py).
+
+
+def np_arrival_threshold(
+    cfg: WorkloadConfig, mode: np.ndarray, phase: np.ndarray, tick: int
+) -> np.ndarray:
+    """uint32 thresholds for one tick (numpy twin of :func:`arrival_threshold`)."""
+    t_lo = rate_to_threshold(cfg.rate)
+    t_hi = rate_to_threshold(cfg.burst_rate)
+    halfp = max(cfg.period // 2, 1)
+    step = (t_hi - t_lo) // halfp
+    pos = (tick + phase.astype(np.int64)) % cfg.period
+    thr = np.full(mode.shape, t_lo, np.int64)
+    thr[(mode == 1) & (pos < cfg.burst_len)] = t_hi
+    tri = np.minimum(pos, cfg.period - pos)
+    diur = (t_lo + step * tri) % (1 << 32)
+    thr = np.where(mode == 2, diur, thr)
+    return thr.astype(np.uint32)
+
+
+def np_replay_queue(
+    cfg: WorkloadConfig,
+    mode: np.ndarray,
+    arrivals: np.ndarray,
+    serves: np.ndarray,
+) -> dict:
+    """Replay the queue over captured per-tick streams; exact counters.
+
+    ``arrivals``/``serves`` are (T, P, I) bool; returns the final
+    offered/done/shed/depth/depth_peak/head arrays and the (C*B, I)
+    histogram, for bit-exact comparison with the device leaves.
+    """
+    cap, bins = cfg.queue_cap, cfg.hist_bins
+    n_ticks, n_prop, n_inst = arrivals.shape
+    ring = np.zeros((cap, n_prop, n_inst), np.int64)
+    head = np.zeros((n_prop, n_inst), np.int64)
+    depth = np.zeros((n_prop, n_inst), np.int64)
+    depth_peak = np.zeros((n_prop, n_inst), np.int64)
+    offered = np.zeros((n_prop, n_inst), np.int64)
+    done = np.zeros((n_prop, n_inst), np.int64)
+    shed = np.zeros((n_prop, n_inst), np.int64)
+    hist = np.zeros((len(CLASSES) * bins, n_inst), np.int64)
+    for t in range(n_ticks):
+        pop = serves[t] & (depth > 0)
+        for p, i in zip(*np.nonzero(pop)):
+            lat = t - ring[head[p, i], p, i]
+            b = min(int(lat).bit_length() - 1, bins - 1) if lat >= 1 else 0
+            hist[int(mode[p, i]) * bins + b, i] += 1
+            head[p, i] = (head[p, i] + 1) % cap
+            depth[p, i] -= 1
+            done[p, i] += 1
+        arr = arrivals[t]
+        offered += arr
+        room = depth < cap
+        shed += arr & ~room
+        for p, i in zip(*np.nonzero(arr & room)):
+            ring[(head[p, i] + depth[p, i]) % cap, p, i] = t
+            depth[p, i] += 1
+        depth_peak = np.maximum(depth_peak, depth)
+    return {
+        "head": head,
+        "depth": depth,
+        "depth_peak": depth_peak,
+        "offered": offered,
+        "done": done,
+        "shed": shed,
+        "hist": hist,
+    }

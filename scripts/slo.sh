@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Client-workload SLO wrapper: one campaign per offered-load scale, the
+# per-class queue-delay-inclusive latency table, the goodput-vs-offered
+# curve, and the overload knee.  One report on stdout (--json for
+# machines); exits 2 when a served class's p99 breaches --slo-p99.
+#
+# Usage: scripts/slo.sh [paxos_tpu slo flags...]
+#   scripts/slo.sh --config config3 --mix poisson --slo-p99 64
+#   scripts/slo.sh --config config2 --sweep 0.5 1.0 2.0 --json
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python -m paxos_tpu slo "$@"

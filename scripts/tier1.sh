@@ -555,4 +555,63 @@ assert p.returncode == 2, (p.returncode, p.stdout, p.stderr)
 assert "w0" in p.stderr and "discovery_stall" in p.stderr, p.stderr
 EOF
 fi
+# SLO smoke: the client-workload plane's end-to-end acceptance, kept
+# cheap.  A bursty campaign through the `slo` subcommand must emit
+# nonzero per-class latency histograms that account exactly for every
+# served request (exit 0 with no SLO configured); the SAME campaign
+# gated at an unmeetable 1-tick p99 must exit 2 naming the breaching
+# class; and a planted late-latency regression in a fleet series must
+# trip the `slo_degradation` trend detector through the stats gate.
+if [ "$rc" -eq 0 ]; then
+  so=/tmp/_t1_slo.json; sd=/tmp/_t1_slo_dir; rm -rf "$so" "$sd"
+  timeout -k 10 300 env JAX_PLATFORMS=cpu python -m paxos_tpu slo \
+    --config config2 --n-inst 256 --ticks 96 --chunk 32 --mix bursty \
+    --rate 0.2 --sweep 0.5 1.0 --json >"$so" 2>/dev/null
+  if [ $? -eq 0 ]; then
+    timeout -k 10 600 env JAX_PLATFORMS=cpu python - "$so" "$sd" <<'EOF' \
+    && echo SLO_SMOKE=ok || { echo SLO_SMOKE=FAILED; rc=1; }
+import json, pathlib, subprocess, sys
+out = json.load(open(sys.argv[1]))
+assert out["breaches"] == [], out["breaches"]  # no SLO configured
+pts = out["sweep"]
+assert len(pts) == 2 and all(p["offered"] > 0 for p in pts), pts
+at1 = next(p for p in pts if p["rate_scale"] == 1.0)
+bursty = at1["classes"]["bursty"]
+assert bursty["done"] > 0, bursty
+assert sum(bursty["hist"]) == bursty["done"], bursty  # exact accounting
+assert any(v > 0 for v in bursty["hist"]), bursty
+# Queued bursts cannot all serve in one tick: guarantees the breach leg.
+assert bursty["p99_ticks"] >= 2, bursty
+flags = ["--config", "config2", "--n-inst", "256", "--ticks", "96",
+         "--chunk", "32", "--mix", "bursty", "--rate", "0.2"]
+p = subprocess.run(
+    [sys.executable, "-m", "paxos_tpu", "slo", *flags,
+     "--sweep", "1.0", "--slo-p99", "1"],
+    capture_output=True, text=True)
+assert p.returncode == 2, (p.returncode, p.stdout, p.stderr)
+assert "SLO BREACH" in p.stdout and "bursty" in p.stdout, p.stdout
+# Planted latency regression: steady p99 then a late 3x blow-up must
+# exit 2 through the series trend gate as slo_degradation (coverage
+# grows so the stall detector stays quiet — this is the SLO finding).
+from paxos_tpu.fuzz.corpus import append_event
+from paxos_tpu.obs.timeseries import sample_row
+fake = pathlib.Path(sys.argv[2])
+(fake / "series").mkdir(parents=True)
+with open(fake / "series" / "w0.jsonl", "a") as fh:
+    for i, p99 in enumerate([4, 4, 4, 4, 12]):
+        append_event(fh, sample_row(
+            worker="w0", record="c00000", attempt=0, seq=i, clock=i,
+            gauges={"worker_union_bits": 10 * (i + 1),
+                    "slo_p99_ticks": p99}))
+g = subprocess.run(
+    [sys.executable, "-m", "paxos_tpu", "stats", "--fleet-root",
+     str(fake), "--series-gate"], capture_output=True, text=True)
+assert g.returncode == 2, (g.returncode, g.stdout, g.stderr)
+assert "slo_degradation" in g.stderr and "w0" in g.stderr, g.stderr
+EOF
+  else
+    echo SLO_SMOKE=FAILED; rc=1
+  fi
+fi
+
 exit $rc

@@ -37,7 +37,9 @@ from paxos_tpu.obs.coverage import CoverageConfig
 # breaks that invalidate recorded corpus journals, which is exactly what
 # this pin should make loud.
 GOLDEN_MUTATION_DIGEST = (
-    "6eea3cf3cb5ab074a199ac0454aa22f01c246367b20e6ad917c3549b01995721"
+    # Re-recorded for PR 20: MUTATION_OPS grew the set-workload op (id 15),
+# which changes the op-selection modulus — a deliberate registry change.
+    "0ca2c530e658b9d1b8529956bbb59f0c291fa7e429be22c2dae4945537a784fe"
 )
 
 
@@ -153,6 +155,45 @@ def test_mutate_pure_and_canonical():
     keys = [atom_key(a) for a in atoms]
     assert len(keys) == len(set(keys))
     assert len(ops) == 5
+
+
+def test_campaign_config_lights_workload_from_atom():
+    """A wload atom lights SimConfig.workload (a campaign dimension, not a
+    plan field): mix/rate come from the atom, every other workload knob
+    keeps the base's value, the fault config never moves, and the plan
+    decoder skips the kind entirely."""
+    from paxos_tpu.fuzz.mutate import MUTATION_OPS
+    from paxos_tpu.workload.generator import WorkloadConfig
+
+    # Append-only op-id contract: the workload op rides id 15 at the end.
+    assert (MUTATION_OPS[-1].op_id, MUTATION_OPS[-1].name) == (
+        15, "set-workload"
+    )
+
+    base = config1_no_faults(n_inst=64, seed=0)
+    step = (1 << 32) // 16
+    atoms = [{"kind": "wload", "lane": 0, "mix": "bursty", "rate": 3 * step}]
+    ccfg = campaign_config(base, 5, atoms, {})
+    assert ccfg.workload.enabled()
+    assert ccfg.workload.mix == "bursty"
+    assert ccfg.workload.rate == 3 / 16  # exact binary float: stable keys
+    assert ccfg.workload.queue_cap == WorkloadConfig().queue_cap
+    assert ccfg.fault == base.fault  # no fault knob lit
+    assert ccfg.fingerprint() != campaign_config(base, 5, [], {}).fingerprint()
+    # One workload per campaign: the LAST wload atom wins (atom_key
+    # ignores the payload, so the corpus dedup keeps a single entry).
+    both = atoms + [{"kind": "wload", "lane": 0, "mix": "diurnal",
+                     "rate": 8 * step}]
+    assert campaign_config(base, 5, both, {}).workload.mix == "diurnal"
+    assert atom_key(both[0]) == atom_key(both[1])
+    # The plan decoder materializes nothing for the kind.
+    plan = atoms_to_plan(atoms, 64, 3, 1, cfg=ccfg.fault)
+    empty = atoms_to_plan([], 64, 3, 1, cfg=ccfg.fault)
+    import jax
+
+    assert jax.tree_util.tree_structure(plan) == (
+        jax.tree_util.tree_structure(empty)
+    )
 
 
 # --- fitness model --------------------------------------------------------

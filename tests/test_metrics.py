@@ -344,3 +344,129 @@ def test_stats_cli_missing_and_empty(tmp_path, capsys):
     empty.write_text("")
     assert main(["stats", str(empty)]) == 1
     capsys.readouterr()
+
+
+def test_registry_ingest_slo_gauges():
+    """SLO block lands as slo_* gauges: per-class series labelled by class,
+    latency quantiles in summary idiom, unserved classes export NO
+    quantiles (never a faked -1), and the configured SLO target rides
+    along as the dashboard breach line."""
+    reg = MetricsRegistry()
+    reg.ingest_slo(
+        {
+            "classes": {
+                "bursty": {"lanes": 8, "offered": 20, "done": 16,
+                           "shed": 4, "goodput": 0.8, "hist": [16, 0],
+                           "p50_ticks": 1, "p95_ticks": 3, "p99_ticks": 7},
+                "diurnal": {"lanes": 8, "offered": 0, "done": 0,
+                            "shed": 0, "goodput": 0.0, "hist": [0, 0],
+                            "p50_ticks": -1, "p95_ticks": -1,
+                            "p99_ticks": -1},
+            },
+            "offered": 20, "done": 16, "shed": 4, "goodput": 0.8,
+            "queue_depth": 3, "depth_peak": 4, "p99_ticks": 7,
+        },
+        slo_p99_ticks=16,
+    )
+    g = reg.snapshot()["gauges"]
+    assert g["slo_offered"] == 20
+    assert g["slo_goodput"] == 0.8
+    assert g["slo_queue_depth"] == 3
+    assert g["slo_depth_peak"] == 4
+    assert g["slo_p99_ticks"] == 7
+    assert g["slo_target_p99_ticks"] == 16
+    assert g["slo_offered{class=bursty}"] == 20
+    assert g["slo_latency_ticks{class=bursty,quantile=p99}"] == 7
+    # The unserved class exports counters but no latency series at all.
+    assert g["slo_offered{class=diurnal}"] == 0
+    assert not any(
+        k.startswith("slo_latency_ticks{class=diurnal") for k in g
+    )
+    assert 'paxos_tpu_slo_latency_ticks{class="bursty",quantile="p50"} 1' in (
+        reg.to_prometheus()
+    )
+
+
+# One representative payload per ingest family — every plane that exports
+# gauges into the shared registry.  Growing a new plane?  Add it here so
+# the prefix-partition test below covers it.
+_INGEST_FAMILIES = {
+    "telemetry": ("telemetry_", lambda reg: reg.ingest(
+        {"counters": {"decide": 4}, "hist": [4, 0],
+         "hist_ticks_per_bin": 4, "hist_overflow": 1})),
+    "coverage": ("coverage_", lambda reg: reg.ingest_coverage(
+        {"bits_set": 5, "bits_total": 64, "saturation": 5 / 64,
+         "est_states": 7.0})),
+    "exposure": ("exposure_", lambda reg: reg.ingest_exposure(
+        {"classes": {"drop": {"injected": 3, "effective": 1,
+                              "lanes_exposed": 2}}},
+        lit={"drop": True})),
+    "margin": ("margin_", lambda reg: reg.ingest_margin(
+        {"min_quorum_slack": 1, "near_misses": 4}, checker_complete=True)),
+    "perf": ("perf_", lambda reg: reg.ingest_perf(
+        {"dispatches": 2, "rounds_per_sec": 5.0,
+         "chunk_latency_us": {"p50": 3.0},
+         "vmem": {"vmem_limit_bytes": 1 << 20}})),
+    "fleet": ("fleet_", lambda reg: reg.ingest_fleet(
+        {"workers": 1, "queue_depth": 0, "records_done": 2})),
+    "lineage": ("lineage_", lambda reg: reg.ingest_lineage(
+        {"entries": 2, "roots": 1, "best_fitness": 1.0},
+        ops={"add-skew": {"fitness": 1.0}})),
+    "slo": ("slo_", lambda reg: reg.ingest_slo(
+        {"classes": {"poisson": {"lanes": 4, "offered": 2, "done": 2,
+                                 "shed": 0, "goodput": 1.0, "hist": [2],
+                                 "p50_ticks": 1, "p95_ticks": 1,
+                                 "p99_ticks": 1}},
+         "offered": 2, "done": 2, "shed": 0, "goodput": 1.0,
+         "queue_depth": 0, "depth_peak": 1, "p99_ticks": 1},
+        slo_p99_ticks=8)),
+    "spans": ("round_latency_", lambda reg: reg.ingest_span_aggregates(
+        {"round_latency_p50": 3, "rounds_total": 5, "rounds_decided": 4})),
+}
+
+# Pre-plane legacy gauges that intentionally live at the namespace root.
+# This list must only ever SHRINK — new planes get a prefix, full stop.
+_UNPREFIXED_LEGACY = {
+    "hist_overflow_decides",  # telemetry
+    "fault_vacuous",          # exposure's vacuous-chaos alert
+    "checker_complete",       # margin's oracle-completeness bit
+    "rounds_total", "rounds_decided", "rounds_preempted",  # spans
+    "preemption_depth_max", "faults_per_decided_round",
+}
+
+
+def test_gauge_prefix_partition():
+    """Every plane's gauges stay inside its own prefix: no family may emit
+    a gauge under another family's prefix, and anything outside every
+    prefix must be a known pre-plane legacy name — so one shared registry
+    (fleet mode folds ALL planes into one) can never silently collide."""
+    prefixes = {fam: p for fam, (p, _) in _INGEST_FAMILIES.items()}
+    for fa, pa in prefixes.items():
+        for fb, pb in prefixes.items():
+            if fa != fb:
+                assert not pa.startswith(pb), (
+                    f"prefix {pa!r} ({fa}) shadows {pb!r} ({fb})"
+                )
+    for fam, (_, drive) in _INGEST_FAMILIES.items():
+        reg = MetricsRegistry()
+        drive(reg)
+        own = prefixes[fam]
+        names = {k.split("{")[0] for k in reg.snapshot()["gauges"]}
+        assert any(n.startswith(own) for n in names) or fam == "telemetry", (
+            f"{fam} ingest emitted nothing under its own prefix {own!r}"
+        )
+        for n in names:
+            hits = [
+                (f, p) for f, p in prefixes.items() if n.startswith(p)
+            ]
+            if hits:
+                assert hits == [(fam, own)], (
+                    f"gauge {n!r} (emitted by {fam}) collides with the "
+                    f"{hits[0][0]} plane's prefix {hits[0][1]!r}"
+                )
+            else:
+                assert n in _UNPREFIXED_LEGACY, (
+                    f"gauge {n!r} (emitted by {fam}) squats the root "
+                    f"namespace — give it the {own!r} prefix or add it to "
+                    f"the legacy list"
+                )

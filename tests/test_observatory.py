@@ -250,6 +250,37 @@ def test_compare_series_heartbeat_gap():
     assert compare_series(calm)["findings"] == []
 
 
+def _slo_rows(worker, record, p99s):
+    rows = _rows(worker, record, list(range(len(p99s))),
+                 bits={c: 10 * (c + 1) for c in range(len(p99s))})
+    for r, v in zip(rows, p99s):
+        r["gauges"]["slo_p99_ticks"] = v
+    return rows
+
+
+def test_compare_series_slo_degradation():
+    """A record whose LAST p99 sample blows past slo_k x its own median
+    is a finding naming worker and record; the campaign-total percentile
+    would blur the late blow-up away."""
+    rows = _slo_rows("w0", "c00000", [4, 4, 4, 4, 12])
+    gate = compare_series(rows)
+    assert [f["kind"] for f in gate["findings"]] == ["slo_degradation"]
+    f = gate["findings"][0]
+    assert f["worker"] == "w0" and f["record"] == "c00000"
+    assert f["last_p99_ticks"] == 12.0 and f["median_p99_ticks"] == 4.0
+    # Steady latency is quiet, even when nonzero.
+    assert compare_series(_slo_rows("w0", "c0", [4, 4, 5, 4, 6]))[
+        "findings"] == []
+    # Below 4 samples a spike is not a trend.
+    assert compare_series(_slo_rows("w0", "c0", [4, 4, 12]))[
+        "findings"] == []
+    # An all-unserved record (median 0) never divides into a finding.
+    assert compare_series(_slo_rows("w0", "c0", [0, 0, 0, 0, 9]))[
+        "findings"] == []
+    # The knob is honest: a looser gate admits the same series.
+    assert compare_series(rows, slo_k=4.0)["findings"] == []
+
+
 def test_compare_series_empty_is_not_ok():
     gate = compare_series([])
     assert not gate["ok"] and gate["compared"] == 0
@@ -517,6 +548,39 @@ def test_work_loop_sampling_off_writes_no_journal(tmp_path):
     assert stats["samples"] == 1
     rows = load_series(q2.series_path("w0"))["rows"]
     assert len(rows) == 1 and rows[0]["worker"] == "w0"
+
+
+def test_workload_record_samples_slo_gauge(tmp_path):
+    """A workload-on fleet record rides its per-seed campaign p99 into
+    the sampled series (the slo_degradation detector's input); a
+    workload-off record's rows carry no slo_* gauges at all."""
+    from paxos_tpu.fleet.worker import work_loop
+
+    records = plan_records(mode="soak", **dict(
+        _SOAK_KW, records=1, seeds_per_record=2,
+        workload="bursty", workload_rate=0.3, slo_p99=64))
+    q = CampaignQueue(tmp_path / "wl")
+    for r in records:
+        q.enqueue(r)
+    stats = work_loop(tmp_path / "wl", "w0", lease_s=30.0, poll_s=0.05,
+                      sample_every=1)
+    assert stats["records_done"] == 1
+    rows = load_series(q.series_path("w0"))["rows"]
+    assert len(rows) == 2
+    served = [r["gauges"] for r in rows if "slo_p99_ticks" in r["gauges"]]
+    assert served, "no sampled row carried the SLO gauge"
+    for g in served:
+        assert g["slo_p99_ticks"] >= 1 and g["slo_queue_depth"] >= 0
+
+    off = plan_records(mode="soak", **dict(_SOAK_KW, records=1,
+                                           seeds_per_record=1))
+    q2 = CampaignQueue(tmp_path / "off")
+    for r in off:
+        q2.enqueue(r)
+    work_loop(tmp_path / "off", "w0", lease_s=30.0, poll_s=0.05,
+              sample_every=1)
+    for row in load_series(q2.series_path("w0"))["rows"]:
+        assert not any(k.startswith("slo_") for k in row["gauges"])
 
 
 def test_planted_stall_fixture_exits_2_via_stats(tmp_path):

@@ -148,6 +148,7 @@ def test_fingerprint_unchanged_by_default_telemetry():
     del d["coverage"]  # default-off coverage is likewise dropped (PR 8)
     del d["exposure"]  # ... and default-off exposure (PR 9)
     del d["margin"]  # ... and default-off margin (PR 12)
+    del d["workload"]  # ... and default-off workload (PR 20)
     d["layout_version"] = layout_version(cfg.protocol)
     pre = hashlib.sha256(
         json.dumps(d, sort_keys=True).encode()
